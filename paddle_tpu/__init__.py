@@ -1,0 +1,10 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capabilities
+of PaddlePaddle Fluid (reference: /root/reference, ~v1.6).
+
+Compute path: Program IR lowered to XLA (jit/pjit + GSPMD shardings, Pallas
+kernels for custom ops). Distributed: jax.sharding Mesh over ICI/DCN.
+"""
+
+__version__ = "0.1.0"
+
+from . import fluid  # noqa: F401

@@ -1,0 +1,74 @@
+"""paddle_tpu.fluid — the static-graph framework core.
+
+Capability parity with the reference's ``python/paddle/fluid`` package,
+executed by lowering Programs to XLA (see ``executor.py``).
+"""
+
+from . import (  # noqa: F401
+    backward,
+    clip,
+    compiler,
+    data_feeder,
+    executor,
+    framework,
+    initializer,
+    io,
+    layers,
+    metrics,
+    optimizer,
+    param_attr,
+    regularizer,
+    unique_name,
+)
+from .backward import append_backward, calc_gradient, gradients  # noqa: F401
+from .compiler import BuildStrategy, CompiledProgram, ExecutionStrategy  # noqa: F401
+from .data_feeder import DataFeeder  # noqa: F401
+from .executor import Executor, Scope, global_scope, scope_guard  # noqa: F401
+from .framework import (  # noqa: F401
+    Program,
+    Variable,
+    default_main_program,
+    default_startup_program,
+    program_guard,
+)
+from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
+
+
+class CPUPlace:
+    """Device tags (reference ``platform/place.h:26``). Placement is
+    controlled by JAX backends; these are advisory."""
+
+    def __repr__(self):
+        return "CPUPlace"
+
+
+class TPUPlace:
+    def __init__(self, device_id=0):
+        self.device_id = device_id
+
+    def __repr__(self):
+        return "TPUPlace(%d)" % self.device_id
+
+
+# CUDAPlace alias maps to the accelerator (TPU) for script compatibility
+CUDAPlace = TPUPlace
+CUDAPinnedPlace = CPUPlace
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_tpu():
+    import jax
+
+    try:
+        return any(d.platform != "cpu" for d in jax.devices())
+    except RuntimeError:
+        return False
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """fluid.data (reference 1.6 new-style): shape given verbatim."""
+    return layers.io.data(name, shape, dtype=dtype, append_batch_size=False,
+                          lod_level=lod_level)

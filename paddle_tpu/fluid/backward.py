@@ -1,0 +1,106 @@
+"""append_backward / gradients — the autodiff entry points.
+
+Parity: reference ``python/paddle/fluid/backward.py`` (``append_backward:933``,
+``calc_gradient:1199``). TPU-first: instead of synthesizing per-op ``*_grad``
+ops via C++ grad makers (``core.get_grad_op_desc``), one ``autodiff`` op is
+appended whose lowering differentiates the traced forward with ``jax.grad``
+(see ``ops/autodiff.py``). Duplicate-grad summation, stop_gradient, and
+recompute fall out of the functional transform for free.
+"""
+
+from . import framework
+from .framework import Parameter, Variable, grad_var_name
+
+__all__ = ["append_backward", "gradients", "calc_gradient"]
+
+
+def _collect_params(program, parameter_list=None, no_grad_set=None):
+    no_grad = set(no_grad_set or [])
+    if parameter_list is not None:
+        names = [p.name if isinstance(p, Variable) else p for p in parameter_list]
+        params = [program.global_block().var(n) for n in names]
+    else:
+        params = program.all_parameters()
+    return [
+        p for p in params
+        if getattr(p, "trainable", True) and not p.stop_gradient and p.name not in no_grad
+    ]
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """Appends gradient computation for ``loss`` w.r.t. trainable params.
+
+    Returns ``[(param, grad_var), ...]`` like the reference. ``checkpoints``
+    (recompute) is honored by ``jax.checkpoint`` over segments — see
+    ``RecomputeOptimizer``.
+    """
+    program = loss.block.program
+    block = loss.block
+    params = _collect_params(program, parameter_list, no_grad_set)
+    if not params:
+        raise ValueError("No trainable parameters to differentiate")
+
+    grad_vars = []
+    wrt, gnames = [], []
+    for p in params:
+        gname = grad_var_name(p.name)
+        gv = block.create_var(name=gname, shape=p.shape, dtype=p.dtype,
+                              persistable=False, stop_gradient=True)
+        grad_vars.append(gv)
+        wrt.append(p.name)
+        gnames.append(gname)
+        program.param_grad_map[p.name] = gname
+
+    # loss@GRAD exists for API parity (constant 1 — scale handled in lowering)
+    loss_grad = block.create_var(name=grad_var_name(loss.name), shape=loss.shape,
+                                 dtype=loss.dtype, stop_gradient=True)
+
+    attrs = {"loss": loss.name, "wrt": wrt, "grad_names": gnames, "loss_scale": 1.0}
+    if checkpoints:
+        attrs["checkpoints"] = [
+            c.name if isinstance(c, Variable) else c for c in checkpoints
+        ]
+    block.append_op(
+        "autodiff",
+        inputs={"Loss": [loss]},
+        outputs={"Grads": gnames},
+        attrs=attrs,
+    )
+    return list(zip(params, grad_vars))
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Reference ``fluid.gradients`` / ``calc_gradient``."""
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    block = targets[0].block
+    program = block.program
+    gvars = []
+    gnames = []
+    for x in inputs:
+        gname = grad_var_name(x.name)
+        gv = block.create_var(name=gname, shape=x.shape, dtype=x.dtype,
+                              stop_gradient=True)
+        gvars.append(gv)
+        gnames.append(gname)
+    tg_names = []
+    if target_gradients:
+        tg_names = [
+            tg.name if isinstance(tg, Variable) else tg for tg in target_gradients
+        ]
+    block.append_op(
+        "calc_gradient",
+        inputs={"Targets": [t.name for t in targets]},
+        outputs={"Grads": gnames},
+        attrs={
+            "targets": [t.name for t in targets],
+            "wrt": [x.name for x in inputs],
+            "grad_names": gnames,
+            "target_gradients": tg_names,
+        },
+    )
+    return gvars
+
+
+calc_gradient = gradients

@@ -1,0 +1,97 @@
+"""Gradient clipping (reference ``python/paddle/fluid/clip.py``):
+by value, by norm, by global norm; attached per-param or globally."""
+
+from . import framework
+from .framework import Variable
+
+__all__ = [
+    "set_gradient_clip", "ErrorClipByValue", "GradientClipByValue",
+    "GradientClipByNorm", "GradientClipByGlobalNorm",
+    "append_gradient_clip_ops",
+]
+
+_GRADIENT_CLIP_ATTR = "@grad_clip@"
+
+
+class BaseErrorClipAttr:
+    pass
+
+
+class ErrorClipByValue(BaseErrorClipAttr):
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = min if min is not None else -max
+
+
+class BaseGradientClipAttr:
+    def _process(self, params_grads):
+        raise NotImplementedError
+
+
+class GradientClipByValue(BaseGradientClipAttr):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def _process(self, params_grads):
+        from .layers import nn
+
+        return [(p, nn.clip(g, self.min, self.max)) for p, g in params_grads]
+
+
+class GradientClipByNorm(BaseGradientClipAttr):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _process(self, params_grads):
+        from .layers import nn
+
+        return [(p, nn.clip_by_norm(g, self.clip_norm)) for p, g in params_grads]
+
+
+class GradientClipByGlobalNorm(BaseGradientClipAttr):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _process(self, params_grads):
+        from .layers import nn, ops, tensor
+
+        sq_sums = [nn.reduce_sum(ops.square(g)) for _, g in params_grads]
+        stacked = nn.sum([nn.reshape(s, [1]) for s in sq_sums]) if len(sq_sums) > 1 \
+            else nn.reshape(sq_sums[0], [1])
+        global_norm = ops.sqrt(stacked)
+        clip_var = tensor.fill_constant([1], "float32", self.clip_norm)
+        scale = nn.elementwise_div(
+            clip_var, nn.elementwise_max(global_norm, clip_var))
+        return [(p, nn.elementwise_mul(g, scale)) for p, g in params_grads]
+
+
+_global_clip = None
+
+
+def set_gradient_clip(clip, param_list=None, program=None):
+    global _global_clip
+    _global_clip = clip
+    if param_list:
+        for p in param_list:
+            if isinstance(p, str):
+                p = framework.default_main_program().global_block().var(p)
+            p._grad_clip = clip
+
+
+def append_gradient_clip_ops(params_grads):
+    # per-param clip attr wins; else the global clip
+    clipped = []
+    todo_global = []
+    for p, g in params_grads:
+        attr = getattr(p, "_grad_clip", None)
+        if attr is not None:
+            clipped.extend(attr._process([(p, g)]))
+        else:
+            todo_global.append((p, g))
+    if todo_global:
+        if _global_clip is not None:
+            clipped.extend(_global_clip._process(todo_global))
+        else:
+            clipped.extend(todo_global)
+    return clipped

@@ -1,0 +1,141 @@
+"""desc-dict <-> protobuf bytes conversion for Program serialization.
+
+Parity: the reference serializes ``ProgramDesc`` protobuf directly
+(``program_desc.h:30``); here the in-memory IR is plain Python and this
+module is the (de)serialization boundary.
+"""
+
+from . import framework_pb2 as pb
+
+
+def _attr_to_pb(a, value):
+    if isinstance(value, bool):
+        a.b = value
+    elif isinstance(value, int):
+        a.i = value
+    elif isinstance(value, float):
+        a.f = value
+    elif isinstance(value, str):
+        a.s = value
+    elif isinstance(value, (list, tuple)):
+        if all(isinstance(v, bool) for v in value) and value:
+            a.ints.val.extend(int(v) for v in value)
+        elif all(isinstance(v, int) for v in value):
+            a.ints.val.extend(value)
+        elif all(isinstance(v, (int, float)) for v in value):
+            a.floats.val.extend(float(v) for v in value)
+        else:
+            a.strings.val.extend(str(v) for v in value)
+    elif value is None:
+        a.s = "\0__none__"
+    else:
+        a.s = "\0__repr__" + repr(value)
+
+
+def _attr_from_pb(a):
+    kind = a.WhichOneof("value")
+    if kind == "i":
+        return int(a.i)
+    if kind == "f":
+        return float(a.f)
+    if kind == "s":
+        if a.s == "\0__none__":
+            return None
+        if a.s.startswith("\0__repr__"):
+            import ast
+
+            try:
+                return ast.literal_eval(a.s[len("\0__repr__"):])
+            except (ValueError, SyntaxError):
+                return a.s
+        return a.s
+    if kind == "b":
+        return bool(a.b)
+    if kind == "ints":
+        return [int(v) for v in a.ints.val]
+    if kind == "floats":
+        return [float(v) for v in a.floats.val]
+    if kind == "strings":
+        return list(a.strings.val)
+    return None
+
+
+def program_to_bytes(desc):
+    p = pb.ProgramDesc()
+    p.version = desc.get("version", 1)
+    p.random_seed = desc.get("random_seed", 0)
+    for k, v in desc.get("param_grad_map", {}).items():
+        p.param_grad_map[k] = v
+    p.feed_names.extend(desc.get("feed_names", []))
+    p.fetch_names.extend(desc.get("fetch_names", []))
+    for bdesc in desc["blocks"]:
+        b = p.blocks.add()
+        b.idx = bdesc["idx"]
+        b.parent_idx = bdesc.get("parent_idx", -1)
+        for vdesc in bdesc["vars"]:
+            v = b.vars.add()
+            v.name = vdesc["name"]
+            v.shape.extend(int(s) for s in vdesc["shape"])
+            v.dtype = vdesc["dtype"]
+            v.persistable = vdesc.get("persistable", False)
+            v.stop_gradient = vdesc.get("stop_gradient", False)
+            v.is_data = vdesc.get("is_data", False)
+            v.is_parameter = vdesc.get("is_parameter", False)
+            v.trainable = vdesc.get("trainable", False)
+        for odesc in bdesc["ops"]:
+            o = b.ops.add()
+            o.type = odesc["type"]
+            for slot, args in odesc["inputs"].items():
+                s = o.inputs.add()
+                s.slot = slot
+                s.args.extend(args)
+            for slot, args in odesc["outputs"].items():
+                s = o.outputs.add()
+                s.slot = slot
+                s.args.extend(args)
+            for k, v in odesc["attrs"].items():
+                _attr_to_pb(o.attrs[k], v)
+    return p.SerializeToString()
+
+
+def program_from_bytes(data):
+    p = pb.ProgramDesc()
+    p.ParseFromString(data)
+    blocks = []
+    for b in p.blocks:
+        blocks.append(
+            {
+                "idx": b.idx,
+                "parent_idx": b.parent_idx,
+                "vars": [
+                    {
+                        "name": v.name,
+                        "shape": list(v.shape),
+                        "dtype": v.dtype,
+                        "persistable": v.persistable,
+                        "stop_gradient": v.stop_gradient,
+                        "is_data": v.is_data,
+                        "is_parameter": v.is_parameter,
+                        "trainable": v.trainable,
+                    }
+                    for v in b.vars
+                ],
+                "ops": [
+                    {
+                        "type": o.type,
+                        "inputs": {s.slot: list(s.args) for s in o.inputs},
+                        "outputs": {s.slot: list(s.args) for s in o.outputs},
+                        "attrs": {k: _attr_from_pb(a) for k, a in o.attrs.items()},
+                    }
+                    for o in b.ops
+                ],
+            }
+        )
+    return {
+        "version": p.version,
+        "random_seed": p.random_seed,
+        "blocks": blocks,
+        "param_grad_map": dict(p.param_grad_map),
+        "feed_names": list(p.feed_names),
+        "fetch_names": list(p.fetch_names),
+    }

@@ -1,0 +1,171 @@
+"""Checkpointing & model export.
+
+Parity: reference ``python/paddle/fluid/io.py`` — ``save_params:273`` /
+``save_persistables:523`` / ``load_persistables:801`` /
+``save_inference_model:1011`` / ``load_inference_model:1215`` and the
+unified ``save:1493``/``load:1547``.
+
+Storage format: one file per var (like the reference's per-var ``save`` op
+files) or a combined ``.npz``; the program goes as protobuf (``__model__``).
+"""
+
+import os
+
+import numpy as np
+
+from . import framework
+from .executor import global_scope
+from .framework import Program, Variable
+
+__all__ = [
+    "save_vars", "save_params", "save_persistables", "load_vars",
+    "load_params", "load_persistables", "save_inference_model",
+    "load_inference_model", "save", "load",
+]
+
+
+def _is_persistable(var):
+    return var.persistable
+
+
+def _is_param(var):
+    return isinstance(var, framework.Parameter)
+
+
+def save_vars(executor, dirname, main_program=None, vars=None, predicate=None,
+              filename=None):
+    main_program = main_program or framework.default_main_program()
+    if vars is None:
+        vars = [v for v in main_program.list_vars() if predicate(v)]
+    scope = global_scope()
+    os.makedirs(dirname, exist_ok=True)
+    if filename is not None:
+        arrays = {}
+        for v in vars:
+            val = scope.find_var(v.name)
+            if val is not None:
+                arrays[v.name] = np.asarray(val)
+        np.savez(os.path.join(dirname, filename), **arrays)
+    else:
+        for v in vars:
+            val = scope.find_var(v.name)
+            if val is not None:
+                np.save(os.path.join(dirname, v.name + ".npy"), np.asarray(val))
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    return save_vars(executor, dirname, main_program, predicate=_is_param,
+                     filename=filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    return save_vars(executor, dirname, main_program,
+                     predicate=_is_persistable, filename=filename)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
+              filename=None):
+    main_program = main_program or framework.default_main_program()
+    if vars is None:
+        vars = [v for v in main_program.list_vars() if predicate(v)]
+    scope = global_scope()
+    if filename is not None:
+        data = np.load(os.path.join(dirname, filename))
+        for v in vars:
+            if v.name in data:
+                scope.set_var(v.name, data[v.name])
+    else:
+        for v in vars:
+            path = os.path.join(dirname, v.name + ".npy")
+            if os.path.exists(path):
+                scope.set_var(v.name, np.load(path))
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    return load_vars(executor, dirname, main_program, predicate=_is_param,
+                     filename=filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    return load_vars(executor, dirname, main_program,
+                     predicate=_is_persistable, filename=filename)
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None, export_for_deployment=True,
+                         program_only=False):
+    """Prunes to the inference subgraph and saves program + params
+    (reference ``io.py:1011``)."""
+    main_program = main_program or framework.default_main_program()
+    pruned = main_program._prune(target_vars)
+    pruned._feed_names = list(feeded_var_names)
+    pruned._fetch_names = [
+        v.name if isinstance(v, Variable) else v for v in target_vars
+    ]
+    os.makedirs(dirname, exist_ok=True)
+    model_path = os.path.join(dirname, model_filename or "__model__")
+    desc = pruned.to_desc()
+    desc["feed_names"] = pruned._feed_names
+    desc["fetch_names"] = pruned._fetch_names
+    from .core import proto_io
+
+    with open(model_path, "wb") as f:
+        f.write(proto_io.program_to_bytes(desc))
+    # only save params the pruned program still references
+    needed = {n for blk in pruned.blocks for op in blk.ops
+              for n in op.input_arg_names()}
+    vars = [v for v in main_program.list_vars()
+            if v.persistable and v.name in needed]
+    save_vars(executor, dirname, main_program, vars=vars,
+              filename=params_filename)
+    return pruned._fetch_names
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    from .core import proto_io
+
+    model_path = os.path.join(dirname, model_filename or "__model__")
+    with open(model_path, "rb") as f:
+        desc = proto_io.program_from_bytes(f.read())
+    program = Program.from_desc(desc)
+    feed_names = desc.get("feed_names", [])
+    fetch_names = desc.get("fetch_names", [])
+    load_vars(executor, dirname, program, predicate=_is_persistable,
+              filename=params_filename)
+    fetch_vars = [program.global_block().var(n) for n in fetch_names]
+    return program, feed_names, fetch_vars
+
+
+def save(program, model_path):
+    """Unified save (reference ``io.py:1493``): params + opt state + program."""
+    base = model_path
+    os.makedirs(os.path.dirname(base) or ".", exist_ok=True)
+    scope = global_scope()
+    params = {}
+    opt = {}
+    for v in program.list_vars():
+        if not v.persistable:
+            continue
+        val = scope.find_var(v.name)
+        if val is None:
+            continue
+        (params if _is_param(v) else opt)[v.name] = np.asarray(val)
+    with open(base + ".pdparams", "wb") as f:
+        np.savez(f, **params)
+    with open(base + ".pdopt", "wb") as f:
+        np.savez(f, **opt)
+    with open(base + ".pdmodel", "wb") as f:
+        f.write(program.serialize_to_string())
+
+
+def load(program, model_path, executor=None, var_list=None):
+    scope = global_scope()
+    for suffix in (".pdparams", ".pdopt"):
+        path = model_path + suffix
+        if not os.path.exists(path):
+            continue
+        data = np.load(path)
+        for name in data.files:
+            scope.set_var(name, data[name])

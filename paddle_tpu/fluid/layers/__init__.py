@@ -1,0 +1,11 @@
+"""Layers namespace (reference ``python/paddle/fluid/layers/``)."""
+
+from .. import ops as _ops  # registers all lowering rules  # noqa: F401
+from . import io, learning_rate_scheduler, loss, metric_op, nn, ops, tensor
+from .io import data
+from .learning_rate_scheduler import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .metric_op import accuracy, auc
+from .nn import *  # noqa: F401,F403
+from .ops import *  # noqa: F401,F403
+from .tensor import *  # noqa: F401,F403

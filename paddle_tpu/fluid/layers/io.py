@@ -1,0 +1,23 @@
+"""Data-entry layers (reference ``layers/data.py`` / ``layers/io.py``)."""
+
+from .. import framework
+from ..layer_helper import LayerHelper
+
+__all__ = ["data"]
+
+
+def data(name, shape, dtype="float32", append_batch_size=True,
+         lod_level=0, type=None, stop_gradient=True):
+    """Declares a feed slot. ``append_batch_size`` prepends -1 like the
+    reference ``fluid.layers.data``; ``fluid.data`` passes shapes verbatim."""
+    helper = LayerHelper("data", name=name)
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    return helper.main_program.current_block().create_var(
+        name=name,
+        shape=shape,
+        dtype=dtype,
+        stop_gradient=stop_gradient,
+        is_data=True,
+    )

@@ -1,0 +1,120 @@
+"""LR schedules as in-graph computation on the step counter.
+
+Parity: reference ``layers/learning_rate_scheduler.py`` (8 schedules). Each
+returns a Variable computed from the persistable ``@LR_STEP@`` counter, so
+the schedule runs inside the compiled step — no host round-trip.
+"""
+
+import math
+
+from ..layer_helper import LayerHelper
+from . import nn, ops, tensor
+from .nn import autoincreased_step_counter
+
+__all__ = [
+    "exponential_decay", "natural_exp_decay", "inverse_time_decay",
+    "polynomial_decay", "piecewise_decay", "noam_decay", "cosine_decay",
+    "linear_lr_warmup",
+]
+
+
+def _step_counter():
+    counter = autoincreased_step_counter(counter_name="@LR_STEP@", begin=0, step=1)
+    return tensor.cast(counter, "float32")
+
+
+def noam_decay(d_model, warmup_steps):
+    step = _step_counter()
+    a = step ** -0.5
+    b = step * (warmup_steps ** -1.5)
+    return (d_model ** -0.5) * nn.elementwise_min(a, b)
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    step = _step_counter()
+    div = step / float(decay_steps)
+    if staircase:
+        div = ops.floor(div)
+    return nn.scale(
+        nn.elementwise_pow(tensor.fill_constant([1], "float32", decay_rate), div),
+        scale=float(learning_rate),
+    )
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    step = _step_counter()
+    div = step / float(decay_steps)
+    if staircase:
+        div = ops.floor(div)
+    return nn.scale(ops.exp(nn.scale(div, scale=-float(decay_rate))),
+                    scale=float(learning_rate))
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    step = _step_counter()
+    div = step / float(decay_steps)
+    if staircase:
+        div = ops.floor(div)
+    denom = nn.scale(div, scale=float(decay_rate), bias=1.0)
+    return nn.elementwise_div(
+        tensor.fill_constant([1], "float32", float(learning_rate)), denom)
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=0.0001,
+                     power=1.0, cycle=False):
+    step = _step_counter()
+    if cycle:
+        div = nn.elementwise_max(
+            tensor.fill_constant([1], "float32", 1.0),
+            ops.ceil(step / float(decay_steps)))
+        decay_steps_var = nn.scale(div, scale=float(decay_steps))
+        frac = nn.elementwise_div(step, decay_steps_var)
+    else:
+        step = nn.elementwise_min(
+            step, tensor.fill_constant([1], "float32", float(decay_steps)))
+        frac = nn.scale(step, scale=1.0 / decay_steps)
+    one_minus = nn.scale(frac, scale=-1.0, bias=1.0)
+    poly = nn.elementwise_pow(one_minus,
+                              tensor.fill_constant([1], "float32", power))
+    return nn.scale(poly, scale=float(learning_rate) - end_learning_rate,
+                    bias=end_learning_rate)
+
+
+def piecewise_decay(boundaries, values):
+    step = _step_counter()
+    lr = tensor.fill_constant([1], "float32", values[-1])
+    # evaluate from last boundary backwards via where-chains
+    from .nn import elementwise_add, elementwise_mul
+
+    for b, v in zip(reversed(boundaries), reversed(values[:-1])):
+        cond = step < float(b)
+        condf = tensor.cast(cond, "float32")
+        lr = elementwise_add(
+            elementwise_mul(condf, tensor.fill_constant([1], "float32", v)),
+            elementwise_mul(nn.scale(condf, scale=-1.0, bias=1.0), lr),
+        )
+    return lr
+
+
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    step = _step_counter()
+    epoch = ops.floor(nn.scale(step, scale=1.0 / step_each_epoch))
+    cos_arg = nn.scale(epoch, scale=math.pi / epochs)
+    return nn.scale(nn.scale(ops.cos(cos_arg), bias=1.0),
+                    scale=0.5 * float(learning_rate))
+
+
+def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
+    step = _step_counter()
+    if not isinstance(learning_rate, float):
+        lr_after = learning_rate
+    else:
+        lr_after = tensor.fill_constant([1], "float32", learning_rate)
+    frac = nn.scale(step, scale=1.0 / warmup_steps)
+    warm = nn.scale(frac, scale=float(end_lr - start_lr), bias=float(start_lr))
+    cond = step < float(warmup_steps)
+    condf = tensor.cast(cond, "float32")
+    return nn.elementwise_add(
+        nn.elementwise_mul(condf, warm),
+        nn.elementwise_mul(nn.scale(condf, scale=-1.0, bias=1.0), lr_after),
+    )

@@ -1,0 +1,29 @@
+"""Python-operator sugar on Variable (reference ``layers/math_op_patch.py``)."""
+
+import numpy as np
+
+from .. import framework
+
+
+def binary_op(x, other, op_type, reverse=False):
+    from ..layer_helper import LayerHelper
+    from .tensor import fill_constant
+
+    helper = LayerHelper(op_type)
+    if not isinstance(other, framework.Variable):
+        val = float(other)
+        # scalar + elementwise → use scale op where possible (cheaper IR)
+        if op_type == "elementwise_add" and not reverse:
+            from .nn import scale as scale_layer
+
+            return scale_layer(x, scale=1.0, bias=val)
+        shape = [1]
+        other = fill_constant(shape, framework.dtype_str(x.dtype), val)
+    a, b = (other, x) if reverse else (x, other)
+    out = helper.create_variable_for_type_inference(
+        "bool" if op_type in ("less_than", "less_equal", "greater_than",
+                              "greater_equal", "equal", "not_equal") else a.dtype
+    )
+    helper.append_op(type=op_type, inputs={"X": [a], "Y": [b]},
+                     outputs={"Out": [out]}, attrs={"axis": -1})
+    return out

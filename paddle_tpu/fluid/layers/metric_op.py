@@ -1,0 +1,50 @@
+"""Metric layers: accuracy, auc (reference ``layers/metric_op.py``)."""
+
+from ..layer_helper import LayerHelper
+
+__all__ = ["accuracy", "auc"]
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    helper = LayerHelper("accuracy", **locals())
+    values = helper.create_variable_for_type_inference(input.dtype)
+    indices = helper.create_variable_for_type_inference("int64")
+    helper.append_op(type="top_k", inputs={"X": [input]},
+                     outputs={"Out": [values], "Indices": [indices]},
+                     attrs={"k": k})
+    acc = helper.create_variable_for_type_inference("float32")
+    correct = correct or helper.create_variable_for_type_inference("int32")
+    total = total or helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="accuracy",
+        inputs={"Out": [values], "Indices": [indices], "Label": [label]},
+        outputs={"Accuracy": [acc], "Correct": [correct], "Total": [total]},
+    )
+    return acc
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1, slide_steps=1):
+    helper = LayerHelper("auc", **locals())
+    stat_pos = helper.main_program.global_block().create_var(
+        name=helper.name_prefix + ".stat_pos", shape=(num_thresholds + 1,),
+        dtype="float32", persistable=True, stop_gradient=True)
+    stat_neg = helper.main_program.global_block().create_var(
+        name=helper.name_prefix + ".stat_neg", shape=(num_thresholds + 1,),
+        dtype="float32", persistable=True, stop_gradient=True)
+    from ..initializer import Constant
+
+    sb = helper.startup_program.global_block()
+    for v in (stat_pos, stat_neg):
+        sv = sb.create_var(name=v.name, shape=v.shape, dtype="float32",
+                           persistable=True)
+        Constant(0.0)(sv, sb)
+    auc_out = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        type="auc",
+        inputs={"Predict": [input], "Label": [label], "StatPos": [stat_pos],
+                "StatNeg": [stat_neg]},
+        outputs={"AUC": [auc_out], "StatPosOut": [stat_pos],
+                 "StatNegOut": [stat_neg]},
+        attrs={"num_thresholds": num_thresholds, "curve": curve},
+    )
+    return auc_out, [stat_pos, stat_neg]

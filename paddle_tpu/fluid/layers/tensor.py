@@ -1,0 +1,257 @@
+"""Tensor-manipulation layers (reference ``layers/tensor.py``)."""
+
+import numpy as np
+
+from .. import framework
+from ..framework import Variable
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "create_tensor", "create_parameter", "create_global_var", "cast", "concat",
+    "sums", "assign", "fill_constant_batch_size_like", "fill_constant",
+    "argmin", "argmax", "argsort", "ones", "zeros", "reverse", "has_inf",
+    "has_nan", "isfinite", "range", "linspace", "zeros_like", "ones_like",
+    "diag", "eye",
+]
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    helper = LayerHelper("create_tensor", **locals())
+    return helper.main_program.current_block().create_var(
+        name=name, shape=(), dtype=dtype, persistable=persistable
+    )
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    helper = LayerHelper("create_parameter", **locals())
+    from ..param_attr import ParamAttr
+
+    attr = ParamAttr._to_attr(attr)
+    if name:
+        attr.name = name
+    return helper.create_parameter(attr, shape, dtype, is_bias, default_initializer)
+
+
+def create_global_var(shape, value, dtype, persistable=False, force_cpu=False,
+                      name=None):
+    helper = LayerHelper("global_var", name=name)
+    var = helper.main_program.global_block().create_var(
+        name=name, shape=shape, dtype=dtype, persistable=persistable,
+        stop_gradient=True,
+    )
+    sb = helper.startup_program.global_block()
+    sv = sb.create_var(name=var.name, shape=shape, dtype=dtype,
+                       persistable=persistable)
+    from ..initializer import Constant
+
+    Constant(value)(sv, sb)
+    return var
+
+
+def cast(x, dtype):
+    helper = LayerHelper("cast", x=x, dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type="cast", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"out_dtype": framework.dtype_str(framework.convert_dtype(dtype))})
+    return out
+
+
+def concat(input, axis=0, name=None):
+    helper = LayerHelper("concat", **locals())
+    out = helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op(type="concat", inputs={"X": input}, outputs={"Out": [out]},
+                     attrs={"axis": axis})
+    return out
+
+
+def sums(input, out=None):
+    helper = LayerHelper("sum", **locals())
+    if out is None:
+        out = helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op(type="sum", inputs={"X": input}, outputs={"Out": [out]})
+    return out
+
+
+def assign(input, output=None):
+    helper = LayerHelper("assign", **locals())
+    if isinstance(input, Variable):
+        if output is None:
+            output = helper.create_variable_for_type_inference(input.dtype)
+        helper.append_op(type="assign", inputs={"X": [input]},
+                         outputs={"Out": [output]})
+    else:
+        arr = np.asarray(input)
+        if output is None:
+            output = helper.create_variable_for_type_inference(arr.dtype)
+        helper.append_op(
+            type="assign_value",
+            outputs={"Out": [output]},
+            attrs={"shape": list(arr.shape),
+                   "dtype": framework.dtype_str(arr.dtype),
+                   "values": arr.ravel().tolist()},
+        )
+    return output
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None):
+    helper = LayerHelper("fill_constant")
+    if out is None:
+        out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="fill_constant",
+        outputs={"Out": [out]},
+        attrs={"shape": list(shape),
+               "dtype": framework.dtype_str(framework.convert_dtype(dtype)),
+               "value": float(value)},
+    )
+    return out
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value, input_dim_idx=0,
+                                  output_dim_idx=0):
+    helper = LayerHelper("fill_constant_batch_size_like", **locals())
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="fill_constant_batch_size_like",
+        inputs={"Input": [input]},
+        outputs={"Out": [out]},
+        attrs={"shape": list(shape),
+               "dtype": framework.dtype_str(framework.convert_dtype(dtype)),
+               "value": float(value), "input_dim_idx": input_dim_idx,
+               "output_dim_idx": output_dim_idx},
+    )
+    return out
+
+
+def argmin(x, axis=0):
+    helper = LayerHelper("arg_min", **locals())
+    out = helper.create_variable_for_type_inference("int64")
+    helper.append_op(type="arg_min", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"axis": axis})
+    return out
+
+
+def argmax(x, axis=0):
+    helper = LayerHelper("arg_max", **locals())
+    out = helper.create_variable_for_type_inference("int64")
+    helper.append_op(type="arg_max", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"axis": axis})
+    return out
+
+
+def argsort(input, axis=-1, descending=False, name=None):
+    helper = LayerHelper("argsort", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ids = helper.create_variable_for_type_inference("int64")
+    helper.append_op(type="argsort", inputs={"X": [input]},
+                     outputs={"Out": [out], "Indices": [ids]},
+                     attrs={"axis": axis, "descending": descending})
+    return out, ids
+
+
+def ones(shape, dtype="float32", force_cpu=False):
+    return fill_constant(shape, dtype, 1.0)
+
+
+def zeros(shape, dtype="float32", force_cpu=False):
+    return fill_constant(shape, dtype, 0.0)
+
+
+def reverse(x, axis):
+    helper = LayerHelper("reverse", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="reverse", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"axis": axis if isinstance(axis, (list, tuple)) else [axis]})
+    return out
+
+
+def has_inf(x):
+    helper = LayerHelper("has_inf", **locals())
+    out = helper.create_variable_for_type_inference("bool")
+    helper.append_op(type="has_inf", inputs={"X": [x]}, outputs={"Out": [out]})
+    return out
+
+
+def has_nan(x):
+    helper = LayerHelper("has_nan", **locals())
+    out = helper.create_variable_for_type_inference("bool")
+    helper.append_op(type="has_nan", inputs={"X": [x]}, outputs={"Out": [out]})
+    return out
+
+
+def isfinite(x):
+    helper = LayerHelper("isfinite", **locals())
+    out = helper.create_variable_for_type_inference("bool")
+    helper.append_op(type="isfinite", inputs={"X": [x]}, outputs={"Out": [out]})
+    return out
+
+
+def range(start, end, step, dtype):
+    helper = LayerHelper("range")
+    start = assign(np.asarray([start], framework.convert_dtype(dtype))) if not isinstance(start, Variable) else start
+    end = assign(np.asarray([end], framework.convert_dtype(dtype))) if not isinstance(end, Variable) else end
+    step = assign(np.asarray([step], framework.convert_dtype(dtype))) if not isinstance(step, Variable) else step
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type="range",
+                     inputs={"Start": [start], "End": [end], "Step": [step]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def linspace(start, stop, num, dtype):
+    helper = LayerHelper("linspace")
+    start = assign(np.asarray([start], "float32")) if not isinstance(start, Variable) else start
+    stop = assign(np.asarray([stop], "float32")) if not isinstance(stop, Variable) else stop
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type="linspace",
+                     inputs={"Start": [start], "Stop": [stop]},
+                     outputs={"Out": [out]}, attrs={"num": int(num)})
+    return out
+
+
+def zeros_like(x, out=None):
+    helper = LayerHelper("zeros_like")
+    if out is None:
+        out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="zeros_like", inputs={"X": [x]}, outputs={"Out": [out]})
+    return out
+
+
+def ones_like(x, out=None):
+    helper = LayerHelper("ones_like")
+    if out is None:
+        out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="ones_like", inputs={"X": [x]}, outputs={"Out": [out]})
+    return out
+
+
+def diag(diagonal):
+    helper = LayerHelper("diag", **locals())
+    out = helper.create_variable_for_type_inference(diagonal.dtype)
+    helper.append_op(type="diag", inputs={"Diagonal": [diagonal]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def eye(num_rows, num_columns=None, batch_shape=None, dtype="float32"):
+    helper = LayerHelper("eye")
+    num_columns = num_columns if num_columns is not None else num_rows
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type="eye", outputs={"Out": [out]},
+                     attrs={"num_rows": num_rows, "num_columns": num_columns,
+                            "dtype": framework.dtype_str(framework.convert_dtype(dtype))})
+    if batch_shape:
+        from .nn import expand, unsqueeze
+
+        for _ in batch_shape:
+            out = unsqueeze(out, [0])
+        out = expand(out, list(batch_shape) + [1, 1])
+    return out
+
+
+def tensor_array_to_tensor(input, axis=1):
+    raise NotImplementedError(
+        "LoDTensorArray is replaced by static stacked tensors under XLA; "
+        "use layers.stack/concat"
+    )

@@ -1,0 +1,18 @@
+"""Op lowering library — importing this module registers all op rules.
+
+Role parity: reference ``paddle/fluid/operators/`` (341 registered op types).
+Each submodule groups ops like the reference's operator directories.
+"""
+
+from . import (  # noqa: F401
+    activations,
+    autodiff,
+    creation,
+    elementwise,
+    loss,
+    math,
+    metrics,
+    nn,
+    optimizer_ops,
+    tensor_ops,
+)

@@ -1,0 +1,175 @@
+"""Tensor-creation and random ops.
+
+Parity: reference ``operators/fill_constant_op.cc``, ``uniform_random_op.cc``,
+``gaussian_random_op.cc``, ``truncated_gaussian_random_op.cc``,
+``assign_value_op.cc``, ``range_op.cc``, ``linspace_op.cc``, ``eye_op`` /
+``diag_op.cc``. Randomness is functional: each op draws a key from the
+threaded PRNG stream (see ``registry.LowerCtx.next_rng``).
+"""
+
+import numpy as np
+
+from ..registry import register
+
+
+def _shape_attr(ctx, op):
+    shape = op.attr("shape")
+    return tuple(int(s) for s in shape)
+
+
+@register("fill_constant")
+def _fill_constant(ctx, op):
+    import jax.numpy as jnp
+
+    dtype = np.dtype(op.attr("dtype", "float32"))
+    value = op.attr("value", 0.0)
+    shape = _shape_attr(ctx, op)
+    ctx.set_output(op, "Out", jnp.full(shape, value, dtype=dtype))
+
+
+@register("fill_constant_batch_size_like")
+def _fill_constant_bsl(ctx, op):
+    import jax.numpy as jnp
+
+    ref = ctx.get_input(op, "Input")
+    dtype = np.dtype(op.attr("dtype", "float32"))
+    shape = list(_shape_attr(ctx, op))
+    in_idx = op.attr("input_dim_idx", 0)
+    out_idx = op.attr("output_dim_idx", 0)
+    shape[out_idx] = ref.shape[in_idx]
+    ctx.set_output(op, "Out", jnp.full(tuple(shape), op.attr("value", 0.0), dtype=dtype))
+
+
+@register("uniform_random", has_state=True)
+def _uniform_random(ctx, op):
+    import jax
+
+    dtype = np.dtype(op.attr("dtype", "float32"))
+    shape = _shape_attr(ctx, op)
+    lo, hi = op.attr("min", -1.0), op.attr("max", 1.0)
+    out = jax.random.uniform(ctx.next_rng(), shape, minval=lo, maxval=hi, dtype=jax.numpy.float32)
+    ctx.set_output(op, "Out", out.astype(dtype))
+
+
+@register("uniform_random_batch_size_like", has_state=True)
+def _uniform_random_bsl(ctx, op):
+    import jax
+
+    ref = ctx.get_input(op, "Input")
+    dtype = np.dtype(op.attr("dtype", "float32"))
+    shape = list(_shape_attr(ctx, op))
+    shape[op.attr("output_dim_idx", 0)] = ref.shape[op.attr("input_dim_idx", 0)]
+    out = jax.random.uniform(
+        ctx.next_rng(), tuple(shape), minval=op.attr("min", -1.0), maxval=op.attr("max", 1.0)
+    )
+    ctx.set_output(op, "Out", out.astype(dtype))
+
+
+@register("gaussian_random", has_state=True)
+def _gaussian_random(ctx, op):
+    import jax
+    import jax.numpy as jnp
+
+    dtype = np.dtype(op.attr("dtype", "float32"))
+    shape = _shape_attr(ctx, op)
+    mean, std = op.attr("mean", 0.0), op.attr("std", 1.0)
+    out = mean + std * jax.random.normal(ctx.next_rng(), shape, dtype=jnp.float32)
+    ctx.set_output(op, "Out", out.astype(dtype))
+
+
+@register("gaussian_random_batch_size_like", has_state=True)
+def _gaussian_random_bsl(ctx, op):
+    import jax
+    import jax.numpy as jnp
+
+    ref = ctx.get_input(op, "Input")
+    dtype = np.dtype(op.attr("dtype", "float32"))
+    shape = list(_shape_attr(ctx, op))
+    shape[op.attr("output_dim_idx", 0)] = ref.shape[op.attr("input_dim_idx", 0)]
+    out = op.attr("mean", 0.0) + op.attr("std", 1.0) * jax.random.normal(
+        ctx.next_rng(), tuple(shape), dtype=jnp.float32
+    )
+    ctx.set_output(op, "Out", out.astype(dtype))
+
+
+@register("truncated_gaussian_random", has_state=True)
+def _truncated_gaussian_random(ctx, op):
+    import jax
+    import jax.numpy as jnp
+
+    dtype = np.dtype(op.attr("dtype", "float32"))
+    shape = _shape_attr(ctx, op)
+    mean, std = op.attr("mean", 0.0), op.attr("std", 1.0)
+    out = jax.random.truncated_normal(ctx.next_rng(), -2.0, 2.0, shape, dtype=jnp.float32)
+    ctx.set_output(op, "Out", (mean + std * out).astype(dtype))
+
+
+@register("randint", has_state=True)
+def _randint(ctx, op):
+    import jax
+
+    dtype = np.dtype(op.attr("dtype", "int64"))
+    shape = _shape_attr(ctx, op)
+    out = jax.random.randint(ctx.next_rng(), shape, op.attr("low", 0), op.attr("high", 1))
+    ctx.set_output(op, "Out", out.astype(dtype))
+
+
+@register("sampling_id", has_state=True)
+def _sampling_id(ctx, op):
+    import jax
+
+    x = ctx.get_input(op, "X")
+    out = jax.random.categorical(ctx.next_rng(), jax.numpy.log(x + 1e-20), axis=-1)
+    ctx.set_output(op, "Out", out.astype(np.dtype("int64")))
+
+
+@register("assign_value")
+def _assign_value(ctx, op):
+    import jax.numpy as jnp
+
+    dtype = np.dtype(op.attr("dtype", "float32"))
+    shape = _shape_attr(ctx, op)
+    values = op.attr("values")
+    ctx.set_output(op, "Out", jnp.asarray(values, dtype=dtype).reshape(shape))
+
+
+@register("range")
+def _range(ctx, op):
+    import jax.numpy as jnp
+
+    start = ctx.get_input(op, "Start")
+    end = ctx.get_input(op, "End")
+    step = ctx.get_input(op, "Step")
+    # XLA needs static shapes: range bounds must be trace-time constants.
+    start, end, step = (np.asarray(v).item() if not hasattr(v, "aval") else v for v in (start, end, step))
+    ctx.set_output(op, "Out", jnp.arange(start, end, step))
+
+
+@register("linspace")
+def _linspace(ctx, op):
+    import jax.numpy as jnp
+
+    start = ctx.get_input(op, "Start")
+    stop = ctx.get_input(op, "Stop")
+    num = op.attr("num")
+    if num is None:
+        num = int(np.asarray(ctx.get_input(op, "Num")))
+    ctx.set_output(op, "Out", jnp.linspace(jnp.reshape(start, ()), jnp.reshape(stop, ()), int(num)))
+
+
+@register("eye")
+def _eye(ctx, op):
+    import jax.numpy as jnp
+
+    dtype = np.dtype(op.attr("dtype", "float32"))
+    ctx.set_output(
+        op, "Out", jnp.eye(op.attr("num_rows"), op.attr("num_columns"), dtype=dtype)
+    )
+
+
+@register("diag")
+def _diag(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "Diagonal")
+    ctx.set_output(op, "Out", jnp.diag(x))

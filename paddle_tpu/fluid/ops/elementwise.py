@@ -1,0 +1,125 @@
+"""Elementwise binary ops with fluid's axis-broadcast semantics, comparison
+and logical ops.
+
+Parity: reference ``operators/elementwise/`` (the broadcast engine
+``elementwise_op_function.h``) and ``operators/controlflow/compare_op.cc``,
+``logical_op.cc``. On TPU these all lower to VPU-vectorized XLA elementwise
+HLOs and fuse into neighbors; no custom kernels needed.
+"""
+
+import numpy as np
+
+from ..registry import register
+
+
+def _broadcast_y(x, y, axis):
+    """fluid semantics: align Y's dims to X starting at ``axis``; trailing
+    dims of Y are matched, remaining X dims broadcast. axis=-1 means
+    right-aligned (numpy) broadcasting."""
+    import jax.numpy as jnp
+
+    if axis is None or axis == -1 or x.ndim == y.ndim:
+        return y
+    # trim trailing size-1 dims of y (fluid allows e.g. y shape (N,1) vs axis=0)
+    yshape = list(y.shape)
+    while yshape and yshape[-1] == 1 and len(yshape) > 1:
+        yshape.pop()
+    new_shape = [1] * x.ndim
+    for i, s in enumerate(yshape):
+        new_shape[axis + i] = s
+    return jnp.reshape(y, new_shape)
+
+
+for _name in [
+    "elementwise_add",
+    "elementwise_sub",
+    "elementwise_mul",
+    "elementwise_div",
+    "elementwise_max",
+    "elementwise_min",
+    "elementwise_pow",
+    "elementwise_mod",
+    "elementwise_floordiv",
+]:
+    def _mk(name):
+        @register(name)
+        def _lower(ctx, op):
+            import jax.numpy as jnp
+
+            fns = {
+                "elementwise_add": jnp.add,
+                "elementwise_sub": jnp.subtract,
+                "elementwise_mul": jnp.multiply,
+                "elementwise_div": jnp.divide,
+                "elementwise_max": jnp.maximum,
+                "elementwise_min": jnp.minimum,
+                "elementwise_pow": jnp.power,
+                "elementwise_mod": jnp.mod,
+                "elementwise_floordiv": jnp.floor_divide,
+            }
+            x = ctx.get_input(op, "X")
+            y = ctx.get_input(op, "Y")
+            y = _broadcast_y(x, y, op.attr("axis", -1))
+            ctx.set_output(op, "Out", fns[name](x, y))
+
+    _mk(_name)
+
+
+# -- comparisons (outputs bool) --------------------------------------------
+
+for _name, _attr in [
+    ("less_than", "lt"),
+    ("less_equal", "le"),
+    ("greater_than", "gt"),
+    ("greater_equal", "ge"),
+    ("equal", "eq"),
+    ("not_equal", "ne"),
+]:
+    def _mkc(name, kind):
+        @register(name)
+        def _lower(ctx, op):
+            import jax.numpy as jnp
+
+            fns = {
+                "lt": jnp.less,
+                "le": jnp.less_equal,
+                "gt": jnp.greater,
+                "ge": jnp.greater_equal,
+                "eq": jnp.equal,
+                "ne": jnp.not_equal,
+            }
+            x = ctx.get_input(op, "X")
+            y = ctx.get_input(op, "Y")
+            ctx.set_output(op, "Out", fns[kind](x, y))
+
+    _mkc(_name, _attr)
+
+
+# -- logical ---------------------------------------------------------------
+
+@register("logical_and")
+def _logical_and(ctx, op):
+    import jax.numpy as jnp
+
+    ctx.set_output(op, "Out", jnp.logical_and(ctx.get_input(op, "X"), ctx.get_input(op, "Y")))
+
+
+@register("logical_or")
+def _logical_or(ctx, op):
+    import jax.numpy as jnp
+
+    ctx.set_output(op, "Out", jnp.logical_or(ctx.get_input(op, "X"), ctx.get_input(op, "Y")))
+
+
+@register("logical_xor")
+def _logical_xor(ctx, op):
+    import jax.numpy as jnp
+
+    ctx.set_output(op, "Out", jnp.logical_xor(ctx.get_input(op, "X"), ctx.get_input(op, "Y")))
+
+
+@register("logical_not")
+def _logical_not(ctx, op):
+    import jax.numpy as jnp
+
+    ctx.set_output(op, "Out", jnp.logical_not(ctx.get_input(op, "X")))

@@ -1,0 +1,268 @@
+"""Loss ops.
+
+Parity: reference ``operators/cross_entropy_op.cc``,
+``softmax_with_cross_entropy_op.cc``, ``squared_l2_distance``/
+``square_error_cost``, ``sigmoid_cross_entropy_with_logits_op.cc``,
+``huber_loss_op.cc``, ``log_loss_op.cc``, ``smooth_l1_loss_op.cc``,
+``kldiv_loss_op.cc``, ``bpr_loss_op.cc``, ``rank_loss_op.cc``,
+``margin_rank_loss_op.cc``, ``hinge_loss_op.cc``, ``center_loss_op``.
+"""
+
+import numpy as np
+
+from ..registry import register
+
+
+def _gather_label_prob(x, label):
+    import jax.numpy as jnp
+
+    if label.ndim == x.ndim and label.shape[-1] == 1:
+        label = label[..., 0]
+    lab = label.astype(np.dtype("int32"))
+    return jnp.take_along_axis(x, lab[..., None], axis=-1), lab
+
+
+@register("cross_entropy")
+def _cross_entropy(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")  # probabilities
+    label = ctx.get_input(op, "Label")
+    soft = op.attr("soft_label", False)
+    ignore = op.attr("ignore_index", -100)
+    if soft:
+        out = -jnp.sum(label * jnp.log(jnp.clip(x, 1e-20, None)), axis=-1, keepdims=True)
+    else:
+        p, lab = _gather_label_prob(x, label)
+        out = -jnp.log(jnp.clip(p, 1e-20, None))
+        out = jnp.where((lab == ignore)[..., None], 0.0, out)
+    ctx.set_output(op, "Y", out)
+
+
+@register("softmax_with_cross_entropy")
+def _softmax_with_cross_entropy(ctx, op):
+    import jax
+    import jax.numpy as jnp
+
+    logits = ctx.get_input(op, "Logits")
+    label = ctx.get_input(op, "Label")
+    soft = op.attr("soft_label", False)
+    ignore = op.attr("ignore_index", -100)
+    axis = op.attr("axis", -1)
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    softmax = jnp.exp(logp)
+    if soft:
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+    else:
+        if label.ndim == logits.ndim and label.shape[axis] == 1:
+            lab = jnp.squeeze(label, axis=axis)
+        else:
+            lab = label
+        lab = lab.astype(np.dtype("int32"))
+        picked = jnp.take_along_axis(logp, lab[..., None], axis=axis)
+        loss = -picked
+        loss = jnp.where((lab == ignore)[..., None], 0.0, loss)
+    ctx.set_output(op, "Softmax", softmax)
+    ctx.set_output(op, "Loss", loss)
+
+
+@register("square_error_cost")
+def _square_error_cost(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    y = ctx.get_input(op, "Y")
+    ctx.set_output(op, "Out", jnp.square(x - y))
+
+
+@register("sigmoid_cross_entropy_with_logits")
+def _sigmoid_ce(ctx, op):
+    import jax
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    label = ctx.get_input(op, "Label")
+    ignore = op.attr("ignore_index", -100)
+    loss = jnp.maximum(x, 0.0) - x * label + jax.nn.softplus(-jnp.abs(x))
+    mask = label != ignore
+    loss = jnp.where(mask, loss, 0.0)
+    if op.attr("normalize", False):
+        loss = loss / jnp.maximum(jnp.sum(mask.astype(x.dtype)), 1.0)
+    ctx.set_output(op, "Out", loss)
+
+
+@register("huber_loss")
+def _huber_loss(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    y = ctx.get_input(op, "Y")
+    delta = op.attr("delta", 1.0)
+    r = y - x
+    a = jnp.abs(r)
+    loss = jnp.where(a <= delta, 0.5 * jnp.square(r), delta * (a - 0.5 * delta))
+    ctx.set_output(op, "Out", loss)
+    ctx.set_output(op, "Residual", r)
+
+
+@register("log_loss")
+def _log_loss(ctx, op):
+    import jax.numpy as jnp
+
+    p = ctx.get_input(op, "Predicted")
+    label = ctx.get_input(op, "Labels")
+    eps = op.attr("epsilon", 1e-4)
+    out = -label * jnp.log(p + eps) - (1.0 - label) * jnp.log(1.0 - p + eps)
+    ctx.set_output(op, "Loss", out)
+
+
+@register("smooth_l1_loss")
+def _smooth_l1(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    y = ctx.get_input(op, "Y")
+    sigma = op.attr("sigma", 1.0)
+    in_w = ctx.get_input(op, "InsideWeight", 1.0)
+    out_w = ctx.get_input(op, "OutsideWeight", 1.0)
+    s2 = sigma * sigma
+    d = (x - y) * in_w
+    a = jnp.abs(d)
+    loss = jnp.where(a < 1.0 / s2, 0.5 * s2 * jnp.square(d), a - 0.5 / s2)
+    loss = loss * out_w
+    ctx.set_output(op, "Diff", d)
+    ctx.set_output(op, "Out", jnp.sum(loss.reshape(loss.shape[0], -1), axis=1, keepdims=True))
+
+
+@register("kldiv_loss")
+def _kldiv_loss(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")  # log-probabilities
+    target = ctx.get_input(op, "Target")
+    loss = target * (jnp.log(jnp.clip(target, 1e-20, None)) - x)
+    loss = jnp.where(target > 0, loss, 0.0)
+    red = op.attr("reduction", "mean")
+    if red == "mean":
+        loss = jnp.mean(loss)
+    elif red == "sum":
+        loss = jnp.sum(loss)
+    elif red == "batchmean":
+        loss = jnp.sum(loss) / x.shape[0]
+    ctx.set_output(op, "Loss", loss)
+
+
+@register("bpr_loss")
+def _bpr_loss(ctx, op):
+    import jax
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")  # (N, C) scores
+    label = ctx.get_input(op, "Label")
+    if label.ndim == 2 and label.shape[-1] == 1:
+        label = label[..., 0]
+    lab = label.astype(np.dtype("int32"))
+    pos = jnp.take_along_axis(x, lab[:, None], axis=1)
+    diff = -(x - pos)
+    loss = jnp.sum(jax.nn.softplus(-diff), axis=1, keepdims=True) - jax.nn.softplus(0.0)
+    n_neg = x.shape[1] - 1
+    ctx.set_output(op, "Y", loss / n_neg)
+
+
+@register("rank_loss")
+def _rank_loss(ctx, op):
+    import jax
+    import jax.numpy as jnp
+
+    label = ctx.get_input(op, "Label")
+    left = ctx.get_input(op, "Left")
+    right = ctx.get_input(op, "Right")
+    d = left - right
+    out = jnp.maximum(d, 0.0) - d * label + jax.nn.softplus(-jnp.abs(d))
+    ctx.set_output(op, "Out", out)
+
+
+@register("margin_rank_loss")
+def _margin_rank_loss(ctx, op):
+    import jax.numpy as jnp
+
+    label = ctx.get_input(op, "Label")
+    x1 = ctx.get_input(op, "X1")
+    x2 = ctx.get_input(op, "X2")
+    margin = op.attr("margin", 0.0)
+    out = jnp.maximum(0.0, -label * (x1 - x2) + margin)
+    ctx.set_output(op, "Out", out)
+    ctx.set_output(op, "Activated", (out > 0).astype(x1.dtype))
+
+
+@register("hinge_loss")
+def _hinge_loss(ctx, op):
+    import jax.numpy as jnp
+
+    logits = ctx.get_input(op, "Logits")
+    labels = ctx.get_input(op, "Labels")
+    ctx.set_output(op, "Loss", jnp.maximum(0.0, 1.0 - (2.0 * labels - 1.0) * logits))
+
+
+@register("center_loss")
+def _center_loss(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    label = ctx.get_input(op, "Label")
+    centers = ctx.get_input(op, "Centers")
+    alpha = ctx.get_input(op, "CenterUpdateRate")
+    if label.ndim == 2:
+        label = label[..., 0]
+    lab = label.astype(np.dtype("int32"))
+    picked = centers[lab]
+    diff = x - picked
+    ctx.set_output(op, "Loss", 0.5 * jnp.sum(jnp.square(diff), axis=1, keepdims=True))
+    ctx.set_output(op, "SampleCenterDiff", diff)
+    if op.attr("need_update", True) and op.output("CentersOut"):
+        counts = jnp.zeros((centers.shape[0],), x.dtype).at[lab].add(1.0)
+        upd = jnp.zeros_like(centers).at[lab].add(diff)
+        new_centers = centers + jnp.reshape(alpha, ()) * upd / (counts[:, None] + 1.0)
+        ctx.set(op.output("CentersOut")[0], new_centers)
+
+
+@register("mse_loss")
+def _mse_loss(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    y = ctx.get_input(op, "Y")
+    ctx.set_output(op, "Out", jnp.mean(jnp.square(x - y)))
+
+
+@register("npair_loss")
+def _npair_loss(ctx, op):
+    import jax
+    import jax.numpy as jnp
+
+    anchor = ctx.get_input(op, "Anchor")
+    positive = ctx.get_input(op, "Positive")
+    labels = ctx.get_input(op, "Labels")
+    l2_reg = op.attr("l2_reg", 0.002)
+    batch = anchor.shape[0]
+    sim = anchor @ positive.T
+    lab = labels.reshape(-1)
+    target = (lab[:, None] == lab[None, :]).astype(anchor.dtype)
+    target = target / jnp.sum(target, axis=1, keepdims=True)
+    logp = jax.nn.log_softmax(sim, axis=1)
+    ce = -jnp.sum(target * logp) / batch
+    reg = l2_reg * (jnp.sum(jnp.square(anchor)) + jnp.sum(jnp.square(positive))) / batch
+    ctx.set_output(op, "Out", ce + reg)
+
+
+@register("teacher_student_sigmoid_loss")
+def _teacher_student_loss(ctx, op):
+    import jax
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    label = ctx.get_input(op, "Label")
+    # teacher (label<-1 or >1 encodes soft target regions) — simplified dual loss
+    sig = jax.nn.sigmoid(x)
+    loss = jnp.maximum(x, 0.0) - x * label + jax.nn.softplus(-jnp.abs(x))
+    ctx.set_output(op, "Y", loss)

@@ -1,0 +1,254 @@
+"""Dense math ops: mul/matmul (MXU), reductions, scale/clip, top-k, argsort.
+
+Parity: reference ``operators/mul_op.cc``, ``matmul_op.cc``,
+``reduce_ops/``, ``scale_op.cc``, ``clip_op.cc``, ``top_k_op.cc``,
+``arg_{max,min}_op``, ``argsort_op.cc``, ``sum_op.cc``, ``mean_op.cc``.
+
+Matmuls keep their natural (large, batched) shapes so XLA tiles them onto
+the 128x128 MXU; no manual blocking.
+"""
+
+import numpy as np
+
+from ..registry import register
+
+
+@register("mul")
+def _mul(ctx, op):
+    """Reference mul_op: flatten x to 2-D by x_num_col_dims, y by
+    y_num_col_dims, then matmul."""
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    y = ctx.get_input(op, "Y")
+    xd = op.attr("x_num_col_dims", 1)
+    yd = op.attr("y_num_col_dims", 1)
+    xs, ys = x.shape, y.shape
+    x2 = jnp.reshape(x, (int(np.prod(xs[:xd])), -1))
+    y2 = jnp.reshape(y, (int(np.prod(ys[:yd])), -1))
+    out = x2 @ y2
+    out_shape = xs[:xd] + ys[yd:]
+    ctx.set_output(op, "Out", jnp.reshape(out, out_shape))
+
+
+@register("matmul")
+def _matmul(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    y = ctx.get_input(op, "Y")
+    tx, ty = op.attr("transpose_X", False), op.attr("transpose_Y", False)
+    alpha = op.attr("alpha", 1.0)
+    if x.ndim == 1:
+        x = x[None, :]
+    if y.ndim == 1:
+        y = y[:, None]
+    if tx:
+        x = jnp.swapaxes(x, -1, -2)
+    if ty:
+        y = jnp.swapaxes(y, -1, -2)
+    out = jnp.matmul(x, y)
+    if alpha != 1.0:
+        out = out * alpha
+    ctx.set_output(op, "Out", out)
+
+
+@register("bmm")
+def _bmm(ctx, op):
+    import jax.numpy as jnp
+
+    ctx.set_output(op, "Out", jnp.matmul(ctx.get_input(op, "X"), ctx.get_input(op, "Y")))
+
+
+@register("sum")
+def _sum(ctx, op):
+    xs = ctx.get_inputs(op, "X")
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    ctx.set_output(op, "Out", out)
+
+
+@register("mean")
+def _mean(ctx, op):
+    import jax.numpy as jnp
+
+    ctx.set_output(op, "Out", jnp.mean(ctx.get_input(op, "X")))
+
+
+@register("scale")
+def _scale(ctx, op):
+    x = ctx.get_input(op, "X")
+    scale = op.attr("scale", 1.0)
+    bias = op.attr("bias", 0.0)
+    if op.attr("bias_after_scale", True):
+        out = x * scale + bias
+    else:
+        out = (x + bias) * scale
+    ctx.set_output(op, "Out", out)
+
+
+@register("clip")
+def _clip(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    ctx.set_output(op, "Out", jnp.clip(x, op.attr("min"), op.attr("max")))
+
+
+@register("clip_by_norm")
+def _clip_by_norm(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    max_norm = op.attr("max_norm")
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    ctx.set_output(op, "Out", jnp.where(norm > max_norm, x * (max_norm / norm), x))
+
+
+def _reduce(name, jfn):
+    @register(name)
+    def _lower(ctx, op):
+        x = ctx.get_input(op, "X")
+        dim = op.attr("dim", [0])
+        keep = op.attr("keep_dim", False)
+        if op.attr("reduce_all", False):
+            axes = None
+        else:
+            axes = tuple(d if d >= 0 else d + x.ndim for d in (dim if isinstance(dim, (list, tuple)) else [dim]))
+        ctx.set_output(op, "Out", jfn(x, axes, keep))
+
+
+def _jnp_reduce(fname):
+    def fn(x, axes, keep):
+        import jax.numpy as jnp
+
+        f = getattr(jnp, fname)
+        return f(x, axis=axes, keepdims=keep)
+
+    return fn
+
+
+for _n, _f in [
+    ("reduce_sum", "sum"),
+    ("reduce_mean", "mean"),
+    ("reduce_max", "max"),
+    ("reduce_min", "min"),
+    ("reduce_prod", "prod"),
+    ("reduce_all", "all"),
+    ("reduce_any", "any"),
+]:
+    _reduce(_n, _jnp_reduce(_f))
+
+
+@register("top_k")
+def _top_k(ctx, op):
+    import jax
+
+    x = ctx.get_input(op, "X")
+    k = op.attr("k", 1)
+    vals, idx = jax.lax.top_k(x, k)
+    ctx.set_output(op, "Out", vals)
+    ctx.set_output(op, "Indices", idx.astype(np.dtype("int64")))
+
+
+@register("arg_max")
+def _arg_max(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    axis = op.attr("axis", -1)
+    ctx.set_output(op, "Out", jnp.argmax(x, axis=axis).astype(np.dtype("int64")))
+
+
+@register("arg_min")
+def _arg_min(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    axis = op.attr("axis", -1)
+    ctx.set_output(op, "Out", jnp.argmin(x, axis=axis).astype(np.dtype("int64")))
+
+
+@register("argsort")
+def _argsort(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    axis = op.attr("axis", -1)
+    descending = op.attr("descending", False)
+    idx = jnp.argsort(-x if descending else x, axis=axis)
+    out = jnp.take_along_axis(x, idx, axis=axis)
+    ctx.set_output(op, "Out", out)
+    ctx.set_output(op, "Indices", idx.astype(np.dtype("int64")))
+
+
+@register("l2_normalize")
+def _l2_normalize(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    axis = op.attr("axis", -1)
+    eps = op.attr("epsilon", 1e-10)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True))
+    ctx.set_output(op, "Out", x / jnp.maximum(norm, eps))
+    ctx.set_output(op, "Norm", norm)
+
+
+@register("cos_sim")
+def _cos_sim(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    y = ctx.get_input(op, "Y")
+    xn = jnp.sqrt(jnp.sum(jnp.square(x), axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(jnp.square(y), axis=-1, keepdims=True))
+    out = jnp.sum(x * y, axis=-1, keepdims=True) / (xn * yn + 1e-12)
+    ctx.set_output(op, "Out", out)
+
+
+@register("isfinite")
+def _isfinite(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    ctx.set_output(op, "Out", jnp.all(jnp.isfinite(x)))
+
+
+@register("has_inf")
+def _has_inf(ctx, op):
+    import jax.numpy as jnp
+
+    ctx.set_output(op, "Out", jnp.any(jnp.isinf(ctx.get_input(op, "X"))))
+
+
+@register("has_nan")
+def _has_nan(ctx, op):
+    import jax.numpy as jnp
+
+    ctx.set_output(op, "Out", jnp.any(jnp.isnan(ctx.get_input(op, "X"))))
+
+
+@register("maxout")
+def _maxout(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")  # NCHW
+    groups = op.attr("groups")
+    n, c, h, w = x.shape
+    out = jnp.max(jnp.reshape(x, (n, c // groups, groups, h, w)), axis=2)
+    ctx.set_output(op, "Out", out)
+
+
+@register("bilinear_tensor_product")
+def _bilinear_tensor_product(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")  # (B, M)
+    y = ctx.get_input(op, "Y")  # (B, N)
+    w = ctx.get_input(op, "Weight")  # (out, M, N)
+    bias = ctx.get_input(op, "Bias")
+    out = jnp.einsum("bm,omn,bn->bo", x, w, y)
+    if bias is not None:
+        out = out + bias
+    ctx.set_output(op, "Out", out)

@@ -1,0 +1,85 @@
+"""In-graph metric ops: accuracy, auc, precision/recall.
+
+Parity: reference ``operators/metrics/{accuracy,auc,precision_recall}_op``.
+AUC keeps persistable histogram stats updated in-graph, like the reference's
+stat vars.
+"""
+
+import numpy as np
+
+from ..registry import register
+
+
+@register("accuracy")
+def _accuracy(ctx, op):
+    import jax.numpy as jnp
+
+    pred_idx = ctx.get_input(op, "Indices")  # (N, k) from top_k
+    label = ctx.get_input(op, "Label")
+    if label.ndim == 2 and label.shape[-1] == 1:
+        label = label[..., 0]
+    correct = jnp.any(pred_idx == label[:, None].astype(pred_idx.dtype), axis=1)
+    num_correct = jnp.sum(correct.astype(np.dtype("float32")))
+    total = pred_idx.shape[0]
+    ctx.set_output(op, "Accuracy", num_correct / total)
+    ctx.set_output(op, "Correct", num_correct.astype(np.dtype("int32")))
+    ctx.set_output(op, "Total", jnp.asarray(total, dtype=np.dtype("int32")))
+
+
+@register("auc")
+def _auc(ctx, op):
+    import jax.numpy as jnp
+
+    preds = ctx.get_input(op, "Predict")  # (N, 2) binary probs
+    label = ctx.get_input(op, "Label")
+    stat_pos = ctx.get_input(op, "StatPos")
+    stat_neg = ctx.get_input(op, "StatNeg")
+    num_thresholds = op.attr("num_thresholds", 4095)
+    pos_prob = preds[:, 1] if preds.ndim == 2 else preds
+    if label.ndim == 2:
+        label = label[..., 0]
+    bucket = jnp.clip(
+        (pos_prob * num_thresholds).astype(np.dtype("int32")), 0, num_thresholds
+    )
+    is_pos = (label > 0).astype(stat_pos.dtype)
+    new_pos = stat_pos.at[bucket].add(is_pos)
+    new_neg = stat_neg.at[bucket].add(1.0 - is_pos)
+    # AUC via trapezoid over threshold histogram (descending threshold)
+    pos_flip = jnp.flip(new_pos)
+    neg_flip = jnp.flip(new_neg)
+    tp = jnp.cumsum(pos_flip)
+    fp = jnp.cumsum(neg_flip)
+    tot_pos = tp[-1]
+    tot_neg = fp[-1]
+    tp0 = jnp.concatenate([jnp.zeros((1,), tp.dtype), tp[:-1]])
+    fp0 = jnp.concatenate([jnp.zeros((1,), fp.dtype), fp[:-1]])
+    area = jnp.sum((fp - fp0) * (tp + tp0) / 2.0)
+    auc = jnp.where(tot_pos * tot_neg > 0, area / (tot_pos * tot_neg + 1e-12), 0.0)
+    ctx.set_output(op, "AUC", auc)
+    names = op.output("StatPosOut")
+    if names:
+        ctx.set(names[0], new_pos)
+    names = op.output("StatNegOut")
+    if names:
+        ctx.set(names[0], new_neg)
+
+
+@register("mean_iou")
+def _mean_iou(ctx, op):
+    import jax.numpy as jnp
+
+    pred = ctx.get_input(op, "Predictions").reshape(-1).astype(np.dtype("int32"))
+    label = ctx.get_input(op, "Labels").reshape(-1).astype(np.dtype("int32"))
+    num_classes = op.attr("num_classes")
+    inter = jnp.zeros((num_classes,), np.dtype("float32")).at[
+        jnp.where(pred == label, pred, num_classes - 1)
+    ].add(jnp.where(pred == label, 1.0, 0.0))
+    pred_cnt = jnp.zeros((num_classes,), np.dtype("float32")).at[pred].add(1.0)
+    lab_cnt = jnp.zeros((num_classes,), np.dtype("float32")).at[label].add(1.0)
+    union = pred_cnt + lab_cnt - inter
+    valid = union > 0
+    iou = jnp.where(valid, inter / jnp.maximum(union, 1.0), 0.0)
+    mean = jnp.sum(iou) / jnp.maximum(jnp.sum(valid.astype(iou.dtype)), 1.0)
+    ctx.set_output(op, "OutMeanIou", mean)
+    ctx.set_output(op, "OutWrong", (pred_cnt - inter).astype(np.dtype("int32")))
+    ctx.set_output(op, "OutCorrect", inter.astype(np.dtype("int32")))

@@ -1,0 +1,558 @@
+"""NN ops: conv, pooling, normalization, softmax, dropout, interpolation.
+
+Parity: reference ``operators/conv_op.cc``, ``pool_op.cc``,
+``batch_norm_op.cc``, ``layer_norm_op.cc``, ``group_norm_op.cc``,
+``instance_norm_op.cc``, ``softmax_op.cc``, ``dropout_op.cc``,
+``interpolate_op.cc``, ``conv_transpose_op.cc``, ``lrn_op.cc``,
+``data_norm_op.cc``, ``spectral_norm_op.cc``, ``grid_sampler``/``affine_*``.
+
+Data layout is NCHW (fluid default); XLA:TPU relayouts internally to feed the
+MXU for convs, so no manual layout transform is needed. Convs and matmuls
+stay whole — XLA tiles them; elementwise epilogues (bias, act) fuse.
+"""
+
+import numpy as np
+
+from ..registry import register
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v), int(v))
+
+
+@register("conv2d")
+@register("depthwise_conv2d")
+def _conv2d(ctx, op):
+    import jax
+
+    x = ctx.get_input(op, "Input")  # NCHW
+    w = ctx.get_input(op, "Filter")  # OIHW
+    strides = _pair(op.attr("strides", [1, 1]))
+    pads = _pair(op.attr("paddings", [0, 0]))
+    dil = _pair(op.attr("dilations", [1, 1]))
+    groups = op.attr("groups", 1) or 1
+    if op.type == "depthwise_conv2d":
+        groups = x.shape[1]
+    out = jax.lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=strides,
+        padding=((pads[0], pads[0]), (pads[1], pads[1])),
+        rhs_dilation=dil,
+        feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+    ctx.set_output(op, "Output", out)
+
+
+@register("conv3d")
+def _conv3d(ctx, op):
+    import jax
+
+    x = ctx.get_input(op, "Input")  # NCDHW
+    w = ctx.get_input(op, "Filter")  # OIDHW
+    strides = op.attr("strides", [1, 1, 1])
+    pads = op.attr("paddings", [0, 0, 0])
+    dil = op.attr("dilations", [1, 1, 1])
+    groups = op.attr("groups", 1) or 1
+    out = jax.lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=tuple(strides),
+        padding=tuple((p, p) for p in pads),
+        rhs_dilation=tuple(dil),
+        feature_group_count=groups,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+    )
+    ctx.set_output(op, "Output", out)
+
+
+@register("conv2d_transpose")
+def _conv2d_transpose(ctx, op):
+    import jax
+
+    x = ctx.get_input(op, "Input")
+    w = ctx.get_input(op, "Filter")  # IOHW in fluid transpose convs
+    strides = _pair(op.attr("strides", [1, 1]))
+    pads = _pair(op.attr("paddings", [0, 0]))
+    dil = _pair(op.attr("dilations", [1, 1]))
+    groups = op.attr("groups", 1) or 1
+    out = jax.lax.conv_transpose(
+        x,
+        w,
+        strides=strides,
+        padding=((pads[0], pads[0]), (pads[1], pads[1])),
+        rhs_dilation=dil,
+        dimension_numbers=("NCHW", "IOHW", "NCHW"),
+        transpose_kernel=True,
+    )
+    ctx.set_output(op, "Output", out)
+
+
+@register("conv3d_transpose")
+def _conv3d_transpose(ctx, op):
+    import jax
+
+    x = ctx.get_input(op, "Input")
+    w = ctx.get_input(op, "Filter")
+    strides = tuple(op.attr("strides", [1, 1, 1]))
+    pads = op.attr("paddings", [0, 0, 0])
+    out = jax.lax.conv_transpose(
+        x,
+        w,
+        strides=strides,
+        padding=tuple((p, p) for p in pads),
+        dimension_numbers=("NCDHW", "IODHW", "NCDHW"),
+        transpose_kernel=True,
+    )
+    ctx.set_output(op, "Output", out)
+
+
+def _pool(x, pooling_type, ksize, strides, pads, ceil_mode, exclusive, global_pool, adaptive):
+    import jax
+    import jax.numpy as jnp
+
+    n, c, h, w = x.shape
+    if global_pool:
+        ksize = (h, w)
+        strides = (1, 1)
+        pads = (0, 0)
+    if adaptive:
+        # adaptive pooling: output ksize[i] bins; use reduce over equal splits
+        oh, ow = ksize
+        assert h % oh == 0 and w % ow == 0, "adaptive pool needs divisible dims"
+        kh, kw = h // oh, w // ow
+        ksize, strides, pads = (kh, kw), (kh, kw), (0, 0)
+    window = (1, 1) + tuple(ksize)
+    strides_full = (1, 1) + tuple(strides)
+    pad_full = ((0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1]))
+    if ceil_mode:
+        # add extra (stride-1) padding on the high side so partial windows count
+        pad_full = (
+            (0, 0),
+            (0, 0),
+            (pads[0], pads[0] + strides[0] - 1),
+            (pads[1], pads[1] + strides[1] - 1),
+        )
+    if pooling_type == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return jax.lax.reduce_window(x, init, jax.lax.max, window, strides_full, pad_full)
+    # avg
+    ones = jnp.ones_like(x)
+    summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides_full, pad_full)
+    if exclusive or ceil_mode:
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides_full, pad_full)
+        return summed / counts
+    return summed / (ksize[0] * ksize[1])
+
+
+@register("pool2d")
+def _pool2d(ctx, op):
+    x = ctx.get_input(op, "X")
+    out = _pool(
+        x,
+        op.attr("pooling_type", "max"),
+        _pair(op.attr("ksize", [2, 2])),
+        _pair(op.attr("strides", [1, 1])),
+        _pair(op.attr("paddings", [0, 0])),
+        op.attr("ceil_mode", False),
+        op.attr("exclusive", True),
+        op.attr("global_pooling", False),
+        op.attr("adaptive", False),
+    )
+    ctx.set_output(op, "Out", out)
+
+
+@register("pool3d")
+def _pool3d(ctx, op):
+    import jax
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    ksize = tuple(op.attr("ksize", [2, 2, 2]))
+    strides = tuple(op.attr("strides", [1, 1, 1]))
+    pads = op.attr("paddings", [0, 0, 0])
+    ptype = op.attr("pooling_type", "max")
+    if op.attr("global_pooling", False):
+        ksize = x.shape[2:]
+        strides = (1, 1, 1)
+        pads = [0, 0, 0]
+    window = (1, 1) + ksize
+    strides_full = (1, 1) + strides
+    pad_full = ((0, 0), (0, 0)) + tuple((p, p) for p in pads)
+    if ptype == "max":
+        out = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, window, strides_full, pad_full)
+    else:
+        out = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides_full, pad_full) / int(
+            np.prod(ksize)
+        )
+    ctx.set_output(op, "Out", out)
+
+
+@register("softmax")
+def _softmax(ctx, op):
+    import jax
+
+    x = ctx.get_input(op, "X")
+    axis = op.attr("axis", -1)
+    ctx.set_output(op, "Out", jax.nn.softmax(x, axis=axis))
+
+
+@register("log_softmax")
+def _log_softmax(ctx, op):
+    import jax
+
+    x = ctx.get_input(op, "X")
+    ctx.set_output(op, "Out", jax.nn.log_softmax(x, axis=op.attr("axis", -1)))
+
+
+@register("dropout", has_state=True)
+def _dropout(ctx, op):
+    import jax
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    p = op.attr("dropout_prob", 0.5)
+    is_test = op.attr("is_test", False)
+    impl = op.attr("dropout_implementation", "downgrade_in_infer")
+    if is_test:
+        out = x * (1.0 - p) if impl == "downgrade_in_infer" else x
+        ctx.set_output(op, "Out", out)
+        return
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(ctx.next_rng(), keep, x.shape)
+    if impl == "upscale_in_train":
+        out = jnp.where(mask, x / keep, 0.0)
+    else:
+        out = jnp.where(mask, x, 0.0)
+    ctx.set_output(op, "Out", out)
+    ctx.set_output(op, "Mask", mask.astype(x.dtype))
+
+
+@register("batch_norm")
+def _batch_norm(ctx, op):
+    """Training mode computes batch stats and updates running stats
+    (persistable writes, committed by the executor); test mode uses running
+    stats. Reference ``operators/batch_norm_op.cc``."""
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    scale = ctx.get_input(op, "Scale")
+    bias = ctx.get_input(op, "Bias")
+    mean = ctx.get_input(op, "Mean")
+    var = ctx.get_input(op, "Variance")
+    eps = op.attr("epsilon", 1e-5)
+    momentum = op.attr("momentum", 0.9)
+    is_test = op.attr("is_test", False)
+    layout = op.attr("data_layout", "NCHW")
+    ch_axis = 1 if layout == "NCHW" else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    bshape = [1] * x.ndim
+    bshape[ch_axis] = x.shape[ch_axis]
+
+    if is_test or op.attr("use_global_stats", False):
+        use_mean, use_var = mean, var
+    else:
+        use_mean = jnp.mean(x, axis=axes)
+        use_var = jnp.var(x, axis=axes)
+        new_mean = momentum * mean + (1.0 - momentum) * use_mean
+        new_var = momentum * var + (1.0 - momentum) * use_var
+        # MeanOut/VarianceOut alias Mean/Variance in the reference
+        for slot, val in (("MeanOut", new_mean), ("VarianceOut", new_var)):
+            names = op.output(slot)
+            if names:
+                ctx.set(names[0], val)
+        ctx.set_output(op, "SavedMean", use_mean)
+        ctx.set_output(op, "SavedVariance", 1.0 / jnp.sqrt(use_var + eps))
+
+    inv = 1.0 / jnp.sqrt(use_var + eps)
+    out = (x - use_mean.reshape(bshape)) * (inv * scale).reshape(bshape) + bias.reshape(bshape)
+    ctx.set_output(op, "Y", out)
+
+
+@register("layer_norm")
+def _layer_norm(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    scale = ctx.get_input(op, "Scale")
+    bias = ctx.get_input(op, "Bias")
+    eps = op.attr("epsilon", 1e-5)
+    begin = op.attr("begin_norm_axis", 1)
+    axes = tuple(range(begin, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) / jnp.sqrt(var + eps)
+    norm_shape = x.shape[begin:]
+    if scale is not None:
+        out = out * scale.reshape(norm_shape)
+    if bias is not None:
+        out = out + bias.reshape(norm_shape)
+    ctx.set_output(op, "Y", out)
+    ctx.set_output(op, "Mean", jnp.reshape(mean, (-1,)))
+    ctx.set_output(op, "Variance", jnp.reshape(var, (-1,)))
+
+
+@register("group_norm")
+def _group_norm(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")  # NCHW
+    scale = ctx.get_input(op, "Scale")
+    bias = ctx.get_input(op, "Bias")
+    eps = op.attr("epsilon", 1e-5)
+    groups = op.attr("groups")
+    n, c = x.shape[:2]
+    gx = x.reshape((n, groups, c // groups) + x.shape[2:])
+    axes = tuple(range(2, gx.ndim))
+    mean = jnp.mean(gx, axis=axes, keepdims=True)
+    var = jnp.var(gx, axis=axes, keepdims=True)
+    out = ((gx - mean) / jnp.sqrt(var + eps)).reshape(x.shape)
+    bshape = (1, c) + (1,) * (x.ndim - 2)
+    if scale is not None:
+        out = out * scale.reshape(bshape)
+    if bias is not None:
+        out = out + bias.reshape(bshape)
+    ctx.set_output(op, "Y", out)
+    ctx.set_output(op, "Mean", jnp.reshape(mean, (n, groups)))
+    ctx.set_output(op, "Variance", jnp.reshape(var, (n, groups)))
+
+
+@register("instance_norm")
+def _instance_norm(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")  # NCHW
+    scale = ctx.get_input(op, "Scale")
+    bias = ctx.get_input(op, "Bias")
+    eps = op.attr("epsilon", 1e-5)
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) / jnp.sqrt(var + eps)
+    bshape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+    if scale is not None:
+        out = out * scale.reshape(bshape)
+    if bias is not None:
+        out = out + bias.reshape(bshape)
+    ctx.set_output(op, "Y", out)
+
+
+@register("data_norm")
+def _data_norm(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    size = ctx.get_input(op, "BatchSize")
+    total = ctx.get_input(op, "BatchSum")
+    sq = ctx.get_input(op, "BatchSquareSum")
+    mean = total / size
+    scale = jnp.sqrt(size / sq)
+    ctx.set_output(op, "Y", (x - mean) * scale)
+    ctx.set_output(op, "Means", mean)
+    ctx.set_output(op, "Scales", scale)
+
+
+@register("spectral_norm")
+def _spectral_norm(ctx, op):
+    import jax.numpy as jnp
+
+    w = ctx.get_input(op, "Weight")
+    u = ctx.get_input(op, "U")
+    v = ctx.get_input(op, "V")
+    dim = op.attr("dim", 0)
+    power_iters = op.attr("power_iters", 1)
+    eps = op.attr("eps", 1e-12)
+    wmat = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+    for _ in range(max(power_iters, 0)):
+        v = wmat.T @ u
+        v = v / (jnp.linalg.norm(v) + eps)
+        u = wmat @ v
+        u = u / (jnp.linalg.norm(u) + eps)
+    sigma = u @ wmat @ v
+    ctx.set_output(op, "Out", w / sigma)
+
+
+@register("lrn")
+def _lrn(ctx, op):
+    import jax
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")  # NCHW
+    n_size = op.attr("n", 5)
+    k = op.attr("k", 2.0)
+    alpha = op.attr("alpha", 1e-4)
+    beta = op.attr("beta", 0.75)
+    sq = jnp.square(x)
+    half = n_size // 2
+    summed = jax.lax.reduce_window(
+        sq, 0.0, jax.lax.add, (1, n_size, 1, 1), (1, 1, 1, 1),
+        ((0, 0), (half, n_size - 1 - half), (0, 0), (0, 0)),
+    )
+    ctx.set_output(op, "Out", x / jnp.power(k + alpha * summed, beta))
+
+
+def _resize(x, out_h, out_w, method, align_corners):
+    import jax
+
+    n, c, h, w = x.shape
+    return jax.image.resize(
+        x, (n, c, out_h, out_w), method=method
+    )
+
+
+def _interp_out_hw(ctx, op, x):
+    out_h = op.attr("out_h", -1)
+    out_w = op.attr("out_w", -1)
+    scale = op.attr("scale", 0.0)
+    if op.input("OutSize"):
+        sz = np.asarray(ctx.get_input(op, "OutSize"))
+        out_h, out_w = int(sz[0]), int(sz[1])
+    elif scale and scale > 0:
+        out_h, out_w = int(x.shape[2] * scale), int(x.shape[3] * scale)
+    return out_h, out_w
+
+
+@register("bilinear_interp")
+def _bilinear_interp(ctx, op):
+    x = ctx.get_input(op, "X")
+    out_h, out_w = _interp_out_hw(ctx, op, x)
+    ctx.set_output(op, "Out", _resize(x, out_h, out_w, "bilinear", op.attr("align_corners", True)))
+
+
+@register("nearest_interp")
+def _nearest_interp(ctx, op):
+    x = ctx.get_input(op, "X")
+    out_h, out_w = _interp_out_hw(ctx, op, x)
+    ctx.set_output(op, "Out", _resize(x, out_h, out_w, "nearest", op.attr("align_corners", True)))
+
+
+@register("trilinear_interp")
+def _trilinear_interp(ctx, op):
+    import jax
+
+    x = ctx.get_input(op, "X")  # NCDHW
+    out_d = op.attr("out_d", -1)
+    out_h = op.attr("out_h", -1)
+    out_w = op.attr("out_w", -1)
+    n, c = x.shape[:2]
+    ctx.set_output(op, "Out", jax.image.resize(x, (n, c, out_d, out_h, out_w), "trilinear"))
+
+
+@register("affine_channel")
+def _affine_channel(ctx, op):
+    x = ctx.get_input(op, "X")  # NCHW
+    scale = ctx.get_input(op, "Scale")
+    bias = ctx.get_input(op, "Bias")
+    bshape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+    ctx.set_output(op, "Out", x * scale.reshape(bshape) + bias.reshape(bshape))
+
+
+@register("temporal_shift")
+def _temporal_shift(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    seg_num = op.attr("seg_num")
+    ratio = op.attr("shift_ratio", 0.25)
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    x5 = x.reshape(n, seg_num, c, h, w)
+    c1 = int(c * ratio)
+    c2 = int(c * 2 * ratio)
+    back = jnp.concatenate([x5[:, 1:, :c1], jnp.zeros_like(x5[:, :1, :c1])], axis=1)
+    fwd = jnp.concatenate([jnp.zeros_like(x5[:, :1, c1:c2]), x5[:, :-1, c1:c2]], axis=1)
+    keep = x5[:, :, c2:]
+    out = jnp.concatenate([back, fwd, keep], axis=2)
+    ctx.set_output(op, "Out", out.reshape(nt, c, h, w))
+
+
+@register("grid_sampler")
+def _grid_sampler(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")  # NCHW
+    grid = ctx.get_input(op, "Grid")  # NHW2 in [-1,1]
+    n, c, h, w = x.shape
+    gx = (grid[..., 0] + 1.0) * (w - 1) / 2.0
+    gy = (grid[..., 1] + 1.0) * (h - 1) / 2.0
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    x1, y1 = x0 + 1, y0 + 1
+    wx1, wy1 = gx - x0, gy - y0
+    wx0, wy0 = 1.0 - wx1, 1.0 - wy1
+
+    def sample(xi, yi):
+        xi = jnp.clip(xi, 0, w - 1).astype(np.dtype("int32"))
+        yi = jnp.clip(yi, 0, h - 1).astype(np.dtype("int32"))
+        batch = jnp.arange(n)[:, None, None]
+        return x[batch, :, yi, xi]  # N,H,W,C
+
+    out = (
+        sample(x0, y0) * (wx0 * wy0)[..., None]
+        + sample(x1, y0) * (wx1 * wy0)[..., None]
+        + sample(x0, y1) * (wx0 * wy1)[..., None]
+        + sample(x1, y1) * (wx1 * wy1)[..., None]
+    )
+    ctx.set_output(op, "Output", jnp.moveaxis(out, -1, 1))
+
+
+@register("affine_grid")
+def _affine_grid(ctx, op):
+    import jax.numpy as jnp
+
+    theta = ctx.get_input(op, "Theta")  # N,2,3
+    shape = op.attr("output_shape")
+    if op.input("OutputShape"):
+        shape = [int(v) for v in np.asarray(ctx.get_input(op, "OutputShape"))]
+    n, c, h, w = shape
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    gx, gy = jnp.meshgrid(xs, ys)
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1).reshape(1, h * w, 3)
+    out = jnp.einsum("bhk,bok->bho", jnp.tile(base, (theta.shape[0], 1, 1)), theta)
+    ctx.set_output(op, "Output", out.reshape(theta.shape[0], h, w, 2))
+
+
+@register("im2sequence")
+def _im2sequence(ctx, op):
+    import jax
+
+    x = ctx.get_input(op, "X")
+    ksizes = op.attr("kernels")
+    strides = op.attr("strides", [1, 1])
+    pads = op.attr("paddings", [0, 0, 0, 0])
+    patches = jax.lax.conv_general_dilated_patches(
+        x, tuple(ksizes), tuple(strides), ((pads[0], pads[2]), (pads[1], pads[3]))
+    )
+    n, ckk, oh, ow = patches.shape
+    ctx.set_output(op, "Out", patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, ckk))
+
+
+@register("row_conv")
+def _row_conv(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")  # (B, T, D) batched path
+    w = ctx.get_input(op, "Filter")  # (future_len, D)
+    flen = w.shape[0]
+    t = x.shape[-2]
+    out = jnp.zeros_like(x)
+    for k in range(flen):
+        shifted = jnp.pad(x, [(0, 0)] * (x.ndim - 2) + [(0, k), (0, 0)])[..., k:k + t, :]
+        out = out + shifted * w[k]
+    ctx.set_output(op, "Out", out)
+
+
+@register("multiplex")
+def _multiplex(ctx, op):
+    import jax.numpy as jnp
+
+    ids = ctx.get_input(op, "Ids")
+    xs = jnp.stack(ctx.get_inputs(op, "X"), axis=0)
+    idx = ids.reshape(-1).astype(np.dtype("int32"))
+    rows = jnp.arange(idx.shape[0])
+    ctx.set_output(op, "Out", xs[idx, rows])

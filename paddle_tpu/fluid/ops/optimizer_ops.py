@@ -1,0 +1,259 @@
+"""Optimizer update ops.
+
+Parity: reference ``operators/optimizers/`` — sgd, momentum (+nesterov,
++lars), adam/adamax/adagrad/decayed_adagrad/adadelta, rmsprop, ftrl, lamb,
+dpsgd. Updates write the param (persistable) in the functional env; the
+executor commits them with buffer donation so an update is in-place at the
+XLA level, like the reference's in-place scope mutation.
+"""
+
+import numpy as np
+
+from ..registry import register
+
+
+def _lr(ctx, op):
+    import jax.numpy as jnp
+
+    lr = ctx.get_input(op, "LearningRate")
+    return jnp.reshape(lr, ()).astype(ctx.get_input(op, "Param").dtype)
+
+
+@register("sgd")
+def _sgd(ctx, op):
+    p = ctx.get_input(op, "Param")
+    g = ctx.get_input(op, "Grad")
+    lr = _lr(ctx, op)
+    ctx.set_output(op, "ParamOut", p - lr * g)
+
+
+@register("momentum")
+def _momentum(ctx, op):
+    p = ctx.get_input(op, "Param")
+    g = ctx.get_input(op, "Grad")
+    v = ctx.get_input(op, "Velocity")
+    mu = op.attr("mu")
+    lr = _lr(ctx, op)
+    v_new = mu * v + g
+    if op.attr("use_nesterov", False):
+        p_new = p - (g + mu * v_new) * lr
+    else:
+        p_new = p - lr * v_new
+    ctx.set_output(op, "ParamOut", p_new)
+    ctx.set_output(op, "VelocityOut", v_new)
+
+
+@register("lars_momentum")
+def _lars_momentum(ctx, op):
+    import jax.numpy as jnp
+
+    p = ctx.get_input(op, "Param")
+    g = ctx.get_input(op, "Grad")
+    v = ctx.get_input(op, "Velocity")
+    mu = op.attr("mu")
+    coeff = op.attr("lars_coeff", 0.001)
+    decay = op.attr("lars_weight_decay", 0.0005)
+    lr = _lr(ctx, op)
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    local_lr = lr * coeff * p_norm / (g_norm + decay * p_norm + 1e-12)
+    v_new = mu * v + local_lr * (g + decay * p)
+    ctx.set_output(op, "ParamOut", p - v_new)
+    ctx.set_output(op, "VelocityOut", v_new)
+
+
+@register("adam")
+def _adam(ctx, op):
+    import jax.numpy as jnp
+
+    p = ctx.get_input(op, "Param")
+    g = ctx.get_input(op, "Grad")
+    m = ctx.get_input(op, "Moment1")
+    v = ctx.get_input(op, "Moment2")
+    b1p = ctx.get_input(op, "Beta1Pow")
+    b2p = ctx.get_input(op, "Beta2Pow")
+    b1 = op.attr("beta1", 0.9)
+    b2 = op.attr("beta2", 0.999)
+    eps = op.attr("epsilon", 1e-8)
+    lr = _lr(ctx, op)
+    m_new = b1 * m + (1 - b1) * g
+    v_new = b2 * v + (1 - b2) * jnp.square(g)
+    b1p_, b2p_ = jnp.reshape(b1p, ()), jnp.reshape(b2p, ())
+    lr_t = lr * jnp.sqrt(1 - b2p_) / (1 - b1p_)
+    p_new = p - lr_t * m_new / (jnp.sqrt(v_new) + eps)
+    ctx.set_output(op, "ParamOut", p_new)
+    ctx.set_output(op, "Moment1Out", m_new)
+    ctx.set_output(op, "Moment2Out", v_new)
+    ctx.set_output(op, "Beta1PowOut", b1p * b1)
+    ctx.set_output(op, "Beta2PowOut", b2p * b2)
+
+
+@register("adamax")
+def _adamax(ctx, op):
+    import jax.numpy as jnp
+
+    p = ctx.get_input(op, "Param")
+    g = ctx.get_input(op, "Grad")
+    m = ctx.get_input(op, "Moment")
+    inf_norm = ctx.get_input(op, "InfNorm")
+    b1p = ctx.get_input(op, "Beta1Pow")
+    b1 = op.attr("beta1", 0.9)
+    b2 = op.attr("beta2", 0.999)
+    eps = op.attr("epsilon", 1e-8)
+    lr = _lr(ctx, op)
+    m_new = b1 * m + (1 - b1) * g
+    inf_new = jnp.maximum(b2 * inf_norm, jnp.abs(g) + eps)
+    lr_t = lr / (1 - jnp.reshape(b1p, ()))
+    ctx.set_output(op, "ParamOut", p - lr_t * m_new / inf_new)
+    ctx.set_output(op, "MomentOut", m_new)
+    ctx.set_output(op, "InfNormOut", inf_new)
+    ctx.set_output(op, "Beta1PowOut", b1p * b1)
+
+
+@register("adagrad")
+def _adagrad(ctx, op):
+    import jax.numpy as jnp
+
+    p = ctx.get_input(op, "Param")
+    g = ctx.get_input(op, "Grad")
+    m = ctx.get_input(op, "Moment")
+    eps = op.attr("epsilon", 1e-6)
+    lr = _lr(ctx, op)
+    m_new = m + jnp.square(g)
+    ctx.set_output(op, "ParamOut", p - lr * g / (jnp.sqrt(m_new) + eps))
+    ctx.set_output(op, "MomentOut", m_new)
+
+
+@register("decayed_adagrad")
+def _decayed_adagrad(ctx, op):
+    import jax.numpy as jnp
+
+    p = ctx.get_input(op, "Param")
+    g = ctx.get_input(op, "Grad")
+    m = ctx.get_input(op, "Moment")
+    decay = op.attr("decay", 0.95)
+    eps = op.attr("epsilon", 1e-6)
+    lr = _lr(ctx, op)
+    m_new = decay * m + (1 - decay) * jnp.square(g)
+    ctx.set_output(op, "ParamOut", p - lr * g / (jnp.sqrt(m_new) + eps))
+    ctx.set_output(op, "MomentOut", m_new)
+
+
+@register("adadelta")
+def _adadelta(ctx, op):
+    import jax.numpy as jnp
+
+    p = ctx.get_input(op, "Param")
+    g = ctx.get_input(op, "Grad")
+    avg_sq_g = ctx.get_input(op, "AvgSquaredGrad")
+    avg_sq_u = ctx.get_input(op, "AvgSquaredUpdate")
+    rho = op.attr("rho", 0.95)
+    eps = op.attr("epsilon", 1e-6)
+    g2_new = rho * avg_sq_g + (1 - rho) * jnp.square(g)
+    update = -jnp.sqrt((avg_sq_u + eps) / (g2_new + eps)) * g
+    u2_new = rho * avg_sq_u + (1 - rho) * jnp.square(update)
+    ctx.set_output(op, "ParamOut", p + update)
+    ctx.set_output(op, "AvgSquaredGradOut", g2_new)
+    ctx.set_output(op, "AvgSquaredUpdateOut", u2_new)
+
+
+@register("rmsprop")
+def _rmsprop(ctx, op):
+    import jax.numpy as jnp
+
+    p = ctx.get_input(op, "Param")
+    g = ctx.get_input(op, "Grad")
+    ms = ctx.get_input(op, "MeanSquare")
+    mom = ctx.get_input(op, "Moment")
+    rho = op.attr("decay", 0.95)
+    eps = op.attr("epsilon", 1e-6)
+    momentum = op.attr("momentum", 0.0)
+    centered = op.attr("centered", False)
+    lr = _lr(ctx, op)
+    ms_new = rho * ms + (1 - rho) * jnp.square(g)
+    if centered:
+        mg = ctx.get_input(op, "MeanGrad")
+        mg_new = rho * mg + (1 - rho) * g
+        denom = jnp.sqrt(ms_new - jnp.square(mg_new) + eps)
+        ctx.set_output(op, "MeanGradOut", mg_new)
+    else:
+        denom = jnp.sqrt(ms_new + eps)
+    mom_new = momentum * mom + lr * g / denom
+    ctx.set_output(op, "ParamOut", p - mom_new)
+    ctx.set_output(op, "MeanSquareOut", ms_new)
+    ctx.set_output(op, "MomentOut", mom_new)
+
+
+@register("ftrl")
+def _ftrl(ctx, op):
+    import jax.numpy as jnp
+
+    p = ctx.get_input(op, "Param")
+    g = ctx.get_input(op, "Grad")
+    sq = ctx.get_input(op, "SquaredAccumulator")
+    lin = ctx.get_input(op, "LinearAccumulator")
+    l1 = op.attr("l1", 0.0)
+    l2 = op.attr("l2", 0.0)
+    lr_power = op.attr("lr_power", -0.5)
+    lr = _lr(ctx, op)
+    new_sq = sq + jnp.square(g)
+    if lr_power == -0.5:
+        sigma = (jnp.sqrt(new_sq) - jnp.sqrt(sq)) / lr
+    else:
+        sigma = (jnp.power(new_sq, -lr_power) - jnp.power(sq, -lr_power)) / lr
+    new_lin = lin + g - sigma * p
+    if lr_power == -0.5:
+        denom = jnp.sqrt(new_sq) / lr + 2 * l2
+    else:
+        denom = jnp.power(new_sq, -lr_power) / lr + 2 * l2
+    pre = jnp.clip(new_lin, -l1, l1) - new_lin
+    ctx.set_output(op, "ParamOut", pre / denom)
+    ctx.set_output(op, "SquaredAccumOut", new_sq)
+    ctx.set_output(op, "LinearAccumOut", new_lin)
+
+
+@register("lamb")
+def _lamb(ctx, op):
+    import jax.numpy as jnp
+
+    p = ctx.get_input(op, "Param")
+    g = ctx.get_input(op, "Grad")
+    m = ctx.get_input(op, "Moment1")
+    v = ctx.get_input(op, "Moment2")
+    b1p = ctx.get_input(op, "Beta1Pow")
+    b2p = ctx.get_input(op, "Beta2Pow")
+    b1 = op.attr("beta1", 0.9)
+    b2 = op.attr("beta2", 0.999)
+    eps = op.attr("epsilon", 1e-6)
+    wd = op.attr("weight_decay", 0.01)
+    lr = _lr(ctx, op)
+    m_new = b1 * m + (1 - b1) * g
+    v_new = b2 * v + (1 - b2) * jnp.square(g)
+    m_hat = m_new / (1 - jnp.reshape(b1p, ()))
+    v_hat = v_new / (1 - jnp.reshape(b2p, ()))
+    r = m_hat / (jnp.sqrt(v_hat) + eps) + wd * p
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+    trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+    ctx.set_output(op, "ParamOut", p - lr * trust * r)
+    ctx.set_output(op, "Moment1Out", m_new)
+    ctx.set_output(op, "Moment2Out", v_new)
+    ctx.set_output(op, "Beta1PowOut", b1p * b1)
+    ctx.set_output(op, "Beta2PowOut", b2p * b2)
+
+
+@register("dpsgd", has_state=True)
+def _dpsgd(ctx, op):
+    import jax
+    import jax.numpy as jnp
+
+    p = ctx.get_input(op, "Param")
+    g = ctx.get_input(op, "Grad")
+    lr = _lr(ctx, op)
+    clip = op.attr("clip", 10.0)
+    batch_size = op.attr("batch_size", 16.0)
+    sigma = op.attr("sigma", 1.0)
+    g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    g_clip = g / jnp.maximum(1.0, g_norm / clip)
+    noise = sigma * clip / batch_size * jax.random.normal(ctx.next_rng(), g.shape)
+    ctx.set_output(op, "ParamOut", p - lr * (g_clip + noise))

@@ -1,0 +1,438 @@
+"""Shape/layout manipulation ops.
+
+Parity: reference ``operators/reshape_op.cc``, ``transpose_op.cc``,
+``concat_op.cc``, ``split_op.cc``, ``slice_op.cc``, ``strided_slice_op.cc``,
+``cast_op.cc``, ``stack_op.cc``, ``squeeze/unsqueeze``, ``gather/scatter``,
+``expand_op.cc``, ``one_hot_op.cc``, ``shape_op.cc``, ``assign_op.cc``,
+``where_op.cc``, ``pad_op.cc``, ``flatten_op.cc``, ``unstack``, ``reverse``,
+``tile/expand_as``, ``lookup_table_op.cc`` (dense path).
+"""
+
+import numpy as np
+
+from ..registry import register
+
+
+def _resolve_reshape(x, shape):
+    shape = list(int(s) for s in shape)
+    out = []
+    for i, s in enumerate(shape):
+        if s == 0:  # fluid: 0 means copy input dim
+            out.append(x.shape[i])
+        else:
+            out.append(s)
+    return out
+
+
+@register("reshape2")
+@register("reshape")
+def _reshape(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    shape = op.attr("shape")
+    out = jnp.reshape(x, _resolve_reshape(x, shape))
+    ctx.set_output(op, "Out", out)
+    if op.output("XShape"):
+        ctx.set_output(op, "XShape", jnp.zeros((0,) + x.shape, dtype=x.dtype))
+
+
+@register("transpose2")
+@register("transpose")
+def _transpose(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    axis = op.attr("axis")
+    out = jnp.transpose(x, axis)
+    ctx.set_output(op, "Out", out)
+    if op.output("XShape"):
+        ctx.set_output(op, "XShape", jnp.zeros((0,) + x.shape, dtype=x.dtype))
+
+
+@register("concat")
+def _concat(ctx, op):
+    import jax.numpy as jnp
+
+    xs = ctx.get_inputs(op, "X")
+    ctx.set_output(op, "Out", jnp.concatenate(xs, axis=op.attr("axis", 0)))
+
+
+@register("split")
+def _split(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    axis = op.attr("axis", 0)
+    num = op.attr("num", 0)
+    sections = op.attr("sections")
+    if sections:
+        idx = np.cumsum(sections[:-1])
+        outs = jnp.split(x, idx, axis=axis)
+    else:
+        outs = jnp.split(x, num, axis=axis)
+    for name, o in zip(op.output("Out"), outs):
+        ctx.set(name, o)
+
+
+@register("slice")
+def _slice(ctx, op):
+    x = ctx.get_input(op, "Input")
+    axes = op.attr("axes")
+    starts = op.attr("starts")
+    ends = op.attr("ends")
+    idx = [slice(None)] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        idx[ax] = slice(st, en)
+    ctx.set_output(op, "Out", x[tuple(idx)])
+
+
+@register("strided_slice")
+def _strided_slice(ctx, op):
+    x = ctx.get_input(op, "Input")
+    axes = op.attr("axes")
+    starts, ends, strides = op.attr("starts"), op.attr("ends"), op.attr("strides")
+    idx = [slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[ax] = slice(st, en, sd)
+    ctx.set_output(op, "Out", x[tuple(idx)])
+
+
+@register("cast")
+def _cast(ctx, op):
+    x = ctx.get_input(op, "X")
+    dtype = np.dtype(op.attr("out_dtype", op.attr("dtype", "float32")))
+    ctx.set_output(op, "Out", x.astype(dtype))
+
+
+@register("stack")
+def _stack(ctx, op):
+    import jax.numpy as jnp
+
+    xs = ctx.get_inputs(op, "X")
+    ctx.set_output(op, "Y", jnp.stack(xs, axis=op.attr("axis", 0)))
+
+
+@register("unstack")
+def _unstack(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    axis = op.attr("axis", 0)
+    outs = [jnp.squeeze(s, axis=axis) for s in jnp.split(x, x.shape[axis], axis=axis)]
+    for name, o in zip(op.output("Y"), outs):
+        ctx.set(name, o)
+
+
+@register("squeeze2")
+@register("squeeze")
+def _squeeze(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    axes = op.attr("axes") or None
+    if axes:
+        axes = tuple(a if a >= 0 else a + x.ndim for a in axes)
+        axes = tuple(a for a in axes if x.shape[a] == 1)
+    out = jnp.squeeze(x, axis=axes)
+    ctx.set_output(op, "Out", out)
+    if op.output("XShape"):
+        ctx.set_output(op, "XShape", jnp.zeros((0,) + x.shape, dtype=x.dtype))
+
+
+@register("unsqueeze2")
+@register("unsqueeze")
+def _unsqueeze(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    axes = op.attr("axes")
+    out = x
+    for a in sorted(axes):
+        out = jnp.expand_dims(out, a)
+    ctx.set_output(op, "Out", out)
+    if op.output("XShape"):
+        ctx.set_output(op, "XShape", jnp.zeros((0,) + x.shape, dtype=x.dtype))
+
+
+@register("flatten2")
+@register("flatten")
+def _flatten(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    axis = op.attr("axis", 1)
+    lead = int(np.prod(x.shape[:axis])) if axis > 0 else 1
+    out = jnp.reshape(x, (lead, -1))
+    ctx.set_output(op, "Out", out)
+    if op.output("XShape"):
+        ctx.set_output(op, "XShape", jnp.zeros((0,) + x.shape, dtype=x.dtype))
+
+
+@register("gather")
+def _gather(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    idx = ctx.get_input(op, "Index")
+    ctx.set_output(op, "Out", jnp.take(x, idx.astype(np.dtype("int32")), axis=0))
+
+
+@register("gather_nd")
+def _gather_nd(ctx, op):
+    x = ctx.get_input(op, "X")
+    idx = ctx.get_input(op, "Index")
+    import jax.numpy as jnp
+
+    idx_t = tuple(jnp.moveaxis(idx, -1, 0).astype(np.dtype("int32")))
+    ctx.set_output(op, "Out", x[idx_t])
+
+
+@register("scatter")
+def _scatter(ctx, op):
+    x = ctx.get_input(op, "X")
+    ids = ctx.get_input(op, "Ids")
+    upd = ctx.get_input(op, "Updates")
+    overwrite = op.attr("overwrite", True)
+    ids = ids.astype(np.dtype("int32"))
+    if overwrite:
+        out = x.at[ids].set(upd)
+    else:
+        out = x.at[ids].add(upd)
+    ctx.set_output(op, "Out", out)
+
+
+@register("scatter_nd_add")
+def _scatter_nd_add(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    idx = ctx.get_input(op, "Index")
+    upd = ctx.get_input(op, "Updates")
+    idx_t = tuple(jnp.moveaxis(idx, -1, 0).astype(np.dtype("int32")))
+    ctx.set_output(op, "Out", x.at[idx_t].add(upd))
+
+
+@register("expand")
+def _expand(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    times = op.attr("expand_times")
+    ctx.set_output(op, "Out", jnp.tile(x, times))
+
+
+@register("expand_as")
+def _expand_as(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    y = ctx.get_input(op, "target_tensor") or ctx.get_input(op, "Y")
+    times = [t // s for t, s in zip(y.shape, x.shape)]
+    ctx.set_output(op, "Out", jnp.tile(x, times))
+
+
+@register("one_hot")
+def _one_hot(ctx, op):
+    import jax
+
+    x = ctx.get_input(op, "X")
+    depth = op.attr("depth")
+    if x.ndim >= 2 and x.shape[-1] == 1:
+        x = x[..., 0]
+    ctx.set_output(op, "Out", jax.nn.one_hot(x, depth, dtype=np.dtype("float32")))
+
+
+@register("shape")
+def _shape(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "Input")
+    ctx.set_output(op, "Out", jnp.asarray(x.shape, dtype=np.dtype("int32")))
+
+
+@register("assign")
+def _assign(ctx, op):
+    ctx.set_output(op, "Out", ctx.get_input(op, "X"))
+
+
+@register("where")
+def _where(ctx, op):
+    import jax.numpy as jnp
+
+    cond = ctx.get_input(op, "Condition")
+    x = ctx.get_input(op, "X")
+    y = ctx.get_input(op, "Y")
+    ctx.set_output(op, "Out", jnp.where(cond, x, y))
+
+
+@register("reverse")
+def _reverse(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    axes = op.attr("axis")
+    out = x
+    for a in axes if isinstance(axes, (list, tuple)) else [axes]:
+        out = jnp.flip(out, axis=a)
+    ctx.set_output(op, "Out", out)
+
+
+@register("pad")
+def _pad(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    paddings = op.attr("paddings")  # flat [before0, after0, before1, after1...]
+    pads = [(paddings[2 * i], paddings[2 * i + 1]) for i in range(x.ndim)]
+    ctx.set_output(op, "Out", jnp.pad(x, pads, constant_values=op.attr("pad_value", 0.0)))
+
+
+@register("pad2d")
+def _pad2d(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")  # NCHW
+    p = op.attr("paddings")  # [top, bottom, left, right]
+    mode = op.attr("mode", "constant")
+    pads = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])]
+    if mode == "constant":
+        out = jnp.pad(x, pads, constant_values=op.attr("pad_value", 0.0))
+    elif mode == "reflect":
+        out = jnp.pad(x, pads, mode="reflect")
+    else:
+        out = jnp.pad(x, pads, mode="edge")
+    ctx.set_output(op, "Out", out)
+
+
+@register("pad_constant_like")
+def _pad_constant_like(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    y = ctx.get_input(op, "Y")
+    pads = [(0, xs - ys) for xs, ys in zip(x.shape, y.shape)]
+    ctx.set_output(op, "Out", jnp.pad(y, pads, constant_values=op.attr("pad_value", 0.0)))
+
+
+@register("lookup_table_v2")
+@register("lookup_table")
+def _lookup_table(ctx, op):
+    """Embedding lookup (dense grad path; SelectedRows sparse path is handled
+    by the sparse subsystem in parallel/sparse.py). Reference
+    ``operators/lookup_table_op.cc``."""
+    import jax.numpy as jnp
+
+    w = ctx.get_input(op, "W")
+    ids = ctx.get_input(op, "Ids")
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    padding_idx = op.attr("padding_idx", -1)
+    out = jnp.take(w, ids.astype(np.dtype("int32")), axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (ids == padding_idx)[..., None]
+        out = jnp.where(mask, 0.0, out)
+    ctx.set_output(op, "Out", out)
+
+
+@register("zeros_like")
+def _zeros_like(ctx, op):
+    import jax.numpy as jnp
+
+    ctx.set_output(op, "Out", jnp.zeros_like(ctx.get_input(op, "X")))
+
+
+@register("ones_like")
+def _ones_like(ctx, op):
+    import jax.numpy as jnp
+
+    ctx.set_output(op, "Out", jnp.ones_like(ctx.get_input(op, "X")))
+
+
+@register("increment")
+def _increment(ctx, op):
+    x = ctx.get_input(op, "X")
+    ctx.set_output(op, "Out", x + op.attr("step", 1.0))
+
+
+@register("share_data")
+def _share_data(ctx, op):
+    ctx.set_output(op, "Out", ctx.get_input(op, "X"))
+
+
+@register("label_smooth")
+def _label_smooth(ctx, op):
+    x = ctx.get_input(op, "X")
+    eps = op.attr("epsilon", 0.1)
+    k = x.shape[-1]
+    ctx.set_output(op, "Out", x * (1.0 - eps) + eps / k)
+
+
+@register("unfold")
+def _unfold(ctx, op):
+    import jax
+
+    x = ctx.get_input(op, "X")  # NCHW
+    ksizes = op.attr("kernel_sizes")
+    strides = op.attr("strides", [1, 1])
+    pads = op.attr("paddings", [0, 0, 0, 0])
+    dil = op.attr("dilations", [1, 1])
+    patches = jax.lax.conv_general_dilated_patches(
+        x,
+        filter_shape=ksizes,
+        window_strides=strides,
+        padding=((pads[0], pads[2] if len(pads) > 2 else pads[0]),
+                 (pads[1], pads[3] if len(pads) > 3 else pads[1])),
+        rhs_dilation=dil,
+    )
+    n, ckk, oh, ow = patches.shape
+    ctx.set_output(op, "Out", patches.reshape(n, ckk, oh * ow))
+
+
+@register("space_to_depth")
+def _space_to_depth(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    bs = op.attr("blocksize")
+    n, c, h, w = x.shape
+    out = jnp.reshape(x, (n, c, h // bs, bs, w // bs, bs))
+    out = jnp.transpose(out, (0, 3, 5, 1, 2, 4))
+    ctx.set_output(op, "Out", jnp.reshape(out, (n, c * bs * bs, h // bs, w // bs)))
+
+
+@register("pixel_shuffle")
+def _pixel_shuffle(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    r = op.attr("upscale_factor")
+    n, c, h, w = x.shape
+    out = jnp.reshape(x, (n, c // (r * r), r, r, h, w))
+    out = jnp.transpose(out, (0, 1, 4, 2, 5, 3))
+    ctx.set_output(op, "Out", jnp.reshape(out, (n, c // (r * r), h * r, w * r)))
+
+
+@register("shuffle_channel")
+def _shuffle_channel(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    group = op.attr("group")
+    n, c, h, w = x.shape
+    out = jnp.reshape(x, (n, group, c // group, h, w))
+    out = jnp.swapaxes(out, 1, 2)
+    ctx.set_output(op, "Out", jnp.reshape(out, (n, c, h, w)))
+
+
+@register("unique")
+def _unique(ctx, op):
+    # Dynamic-shape op: runs at trace time only for host/static data. XLA
+    # requires static shapes, so we expose size-preserving unique with
+    # fixed-size output (reference semantic subset).
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    out, idx = jnp.unique(x, return_inverse=True, size=x.shape[0])
+    ctx.set_output(op, "Out", out)
+    ctx.set_output(op, "Index", idx.astype(np.dtype("int32")))

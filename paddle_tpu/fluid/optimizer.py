@@ -1,0 +1,688 @@
+"""Optimizers: append update ops onto the program.
+
+Parity: reference ``python/paddle/fluid/optimizer.py:54`` — ``minimize`` =
+``append_backward`` + ``apply_gradients``; accumulators are persistable scope
+vars; LR is a graph var (scheduler output or constant). 13 concrete
+optimizers + wrappers (ModelAverage, EMA, Lookahead, Recompute).
+
+All update math executes inside the single compiled train step with donated
+buffers — an optimizer step costs zero extra memory traffic beyond the
+reads/writes themselves.
+"""
+
+import numpy as np
+
+from . import framework, unique_name
+from .backward import append_backward
+from .framework import Variable, default_main_program, default_startup_program
+from .initializer import Constant
+from .layer_helper import LayerHelper
+
+__all__ = [
+    "SGD", "Momentum", "Adagrad", "Adam", "Adamax", "Dpsgd", "DecayedAdagrad",
+    "Adadelta", "RMSProp", "Ftrl", "Lamb",
+    "SGDOptimizer", "MomentumOptimizer", "AdagradOptimizer", "AdamOptimizer",
+    "AdamaxOptimizer", "DpsgdOptimizer", "DecayedAdagradOptimizer",
+    "AdadeltaOptimizer", "RMSPropOptimizer", "FtrlOptimizer", "LambOptimizer",
+    "LarsMomentumOptimizer", "DGCMomentumOptimizer",
+    "ModelAverage", "ExponentialMovingAverage", "LookaheadOptimizer",
+    "RecomputeOptimizer", "PipelineOptimizer",
+]
+
+
+class Optimizer:
+    def __init__(self, learning_rate, regularization=None, name=None,
+                 grad_clip=None):
+        self._learning_rate = learning_rate
+        self.regularization = regularization
+        self._name = name
+        self._grad_clip = grad_clip
+        self._accumulators = {}  # acc_name -> {param_name: var}
+        self._lr_var = None
+        self.type = self.__class__.__name__.replace("Optimizer", "").lower()
+
+    # -- learning rate ------------------------------------------------------
+    def _create_global_learning_rate(self):
+        if isinstance(self._learning_rate, Variable):
+            self._lr_var = self._learning_rate
+            return
+        if self._lr_var is not None:
+            return
+        helper = LayerHelper("learning_rate")
+        name = unique_name.generate("learning_rate")
+        self._lr_var = helper.main_program.global_block().create_var(
+            name=name, shape=(1,), dtype="float32", persistable=True,
+            stop_gradient=True,
+        )
+        sb = helper.startup_program.global_block()
+        sv = sb.create_var(name=name, shape=(1,), dtype="float32", persistable=True)
+        Constant(float(self._learning_rate))(sv, sb)
+
+    def _global_learning_rate(self):
+        return self._lr_var
+
+    # -- accumulators -------------------------------------------------------
+    def _add_accumulator(self, name, param, fill_value=0.0, shape=None, dtype=None):
+        if name in self._accumulators and param.name in self._accumulators[name]:
+            return self._accumulators[name][param.name]
+        helper = LayerHelper("accum")
+        shape = shape if shape is not None else param.shape
+        dtype = dtype or param.dtype
+        var_name = unique_name.generate("%s_%s" % (param.name, name))
+        var = helper.main_program.global_block().create_var(
+            name=var_name, shape=shape, dtype=dtype, persistable=True,
+            stop_gradient=True,
+        )
+        sb = helper.startup_program.global_block()
+        sv = sb.create_var(name=var_name, shape=shape, dtype=dtype, persistable=True)
+        Constant(float(fill_value))(sv, sb)
+        self._accumulators.setdefault(name, {})[param.name] = var
+        return var
+
+    def _get_accumulator(self, name, param):
+        return self._accumulators[name][param.name]
+
+    # -- the template -------------------------------------------------------
+    def _create_accumulators(self, block, parameters):
+        pass
+
+    def _append_optimize_op(self, block, param_and_grad):
+        raise NotImplementedError
+
+    def _finish_update(self, block, parameters_and_grads):
+        pass
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return append_backward(loss, parameter_list, no_grad_set, callbacks)
+
+    def apply_gradients(self, params_grads):
+        block = default_main_program().global_block()
+        self._create_global_learning_rate()
+
+        # grad clipping (reference clip.py append_gradient_clip_ops)
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        else:
+            from .clip import append_gradient_clip_ops
+
+            params_grads = append_gradient_clip_ops(params_grads)
+
+        # weight decay / regularization (reference regularizer.append_regularization_ops)
+        from .regularizer import append_regularization_ops
+
+        params_grads = append_regularization_ops(params_grads, self.regularization)
+
+        self._create_accumulators(block, [p for p, _ in params_grads])
+        for pg in params_grads:
+            self._append_optimize_op(block, pg)
+        self._finish_update(block, params_grads)
+        return params_grads
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        return self.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, grad_clip=None):
+        params_grads = self.backward(loss, startup_program, parameter_list,
+                                     no_grad_set)
+        optimize_ops = self.apply_gradients(params_grads)
+        return optimize_ops, params_grads
+
+    def _lr_for(self, param):
+        """Per-param LR multiplier (param.optimize_attr['learning_rate'])."""
+        mult = 1.0
+        if hasattr(param, "optimize_attr"):
+            mult = param.optimize_attr.get("learning_rate", 1.0)
+        if mult == 1.0:
+            return self._lr_var
+        from .layers import nn
+
+        return nn.scale(self._lr_var, scale=mult)
+
+
+class SGDOptimizer(Optimizer):
+    def __init__(self, learning_rate, regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        return block.append_op(
+            "sgd",
+            inputs={"Param": [param], "Grad": [grad],
+                    "LearningRate": [self._lr_for(param)]},
+            outputs={"ParamOut": [param]},
+        )
+
+
+class MomentumOptimizer(Optimizer):
+    def __init__(self, learning_rate, momentum, use_nesterov=False,
+                 regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        velocity = self._get_accumulator("velocity", param)
+        return block.append_op(
+            "momentum",
+            inputs={"Param": [param], "Grad": [grad], "Velocity": [velocity],
+                    "LearningRate": [self._lr_for(param)]},
+            outputs={"ParamOut": [param], "VelocityOut": [velocity]},
+            attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov},
+        )
+
+
+class LarsMomentumOptimizer(Optimizer):
+    def __init__(self, learning_rate, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        velocity = self._get_accumulator("velocity", param)
+        return block.append_op(
+            "lars_momentum",
+            inputs={"Param": [param], "Grad": [grad], "Velocity": [velocity],
+                    "LearningRate": [self._lr_for(param)]},
+            outputs={"ParamOut": [param], "VelocityOut": [velocity]},
+            attrs={"mu": self._momentum, "lars_coeff": self._lars_coeff,
+                   "lars_weight_decay": self._lars_weight_decay},
+        )
+
+
+class AdagradOptimizer(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, regularization=None,
+                 name=None, initial_accumulator_value=0.0):
+        super().__init__(learning_rate, regularization, name)
+        self._epsilon = epsilon
+        self._initial = initial_accumulator_value
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p, fill_value=self._initial)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        moment = self._get_accumulator("moment", param)
+        return block.append_op(
+            "adagrad",
+            inputs={"Param": [param], "Grad": [grad], "Moment": [moment],
+                    "LearningRate": [self._lr_for(param)]},
+            outputs={"ParamOut": [param], "MomentOut": [moment]},
+            attrs={"epsilon": self._epsilon},
+        )
+
+
+class AdamOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, regularization=None, name=None, lazy_mode=False):
+        super().__init__(learning_rate, regularization, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment1", p)
+            self._add_accumulator("moment2", p)
+            self._add_accumulator("beta1_pow_acc", p, fill_value=self._beta1,
+                                  shape=(1,))
+            self._add_accumulator("beta2_pow_acc", p, fill_value=self._beta2,
+                                  shape=(1,))
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        m1 = self._get_accumulator("moment1", param)
+        m2 = self._get_accumulator("moment2", param)
+        b1p = self._get_accumulator("beta1_pow_acc", param)
+        b2p = self._get_accumulator("beta2_pow_acc", param)
+        return block.append_op(
+            "adam",
+            inputs={"Param": [param], "Grad": [grad], "Moment1": [m1],
+                    "Moment2": [m2], "Beta1Pow": [b1p], "Beta2Pow": [b2p],
+                    "LearningRate": [self._lr_for(param)]},
+            outputs={"ParamOut": [param], "Moment1Out": [m1], "Moment2Out": [m2],
+                     "Beta1PowOut": [b1p], "Beta2PowOut": [b2p]},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon},
+        )
+
+
+class AdamaxOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+            self._add_accumulator("inf_norm", p)
+            self._add_accumulator("beta1_pow_acc", p, fill_value=self._beta1,
+                                  shape=(1,))
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        return block.append_op(
+            "adamax",
+            inputs={"Param": [param], "Grad": [grad],
+                    "Moment": [self._get_accumulator("moment", param)],
+                    "InfNorm": [self._get_accumulator("inf_norm", param)],
+                    "Beta1Pow": [self._get_accumulator("beta1_pow_acc", param)],
+                    "LearningRate": [self._lr_for(param)]},
+            outputs={"ParamOut": [param],
+                     "MomentOut": [self._get_accumulator("moment", param)],
+                     "InfNormOut": [self._get_accumulator("inf_norm", param)],
+                     "Beta1PowOut": [self._get_accumulator("beta1_pow_acc", param)]},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon},
+        )
+
+
+class DpsgdOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.001, clip=10.0, batch_size=16.0,
+                 sigma=1.0):
+        super().__init__(learning_rate)
+        self._clip, self._batch_size, self._sigma = clip, batch_size, sigma
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        return block.append_op(
+            "dpsgd",
+            inputs={"Param": [param], "Grad": [grad],
+                    "LearningRate": [self._lr_for(param)]},
+            outputs={"ParamOut": [param]},
+            attrs={"clip": self._clip, "batch_size": self._batch_size,
+                   "sigma": self._sigma},
+        )
+
+
+class DecayedAdagradOptimizer(Optimizer):
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6,
+                 regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self._decay, self._epsilon = decay, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        moment = self._get_accumulator("moment", param)
+        return block.append_op(
+            "decayed_adagrad",
+            inputs={"Param": [param], "Grad": [grad], "Moment": [moment],
+                    "LearningRate": [self._lr_for(param)]},
+            outputs={"ParamOut": [param], "MomentOut": [moment]},
+            attrs={"decay": self._decay, "epsilon": self._epsilon},
+        )
+
+
+class AdadeltaOptimizer(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, rho=0.95,
+                 regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("__avg_squared_grad", p)
+            self._add_accumulator("__avg_squared_update", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        g = self._get_accumulator("__avg_squared_grad", param)
+        u = self._get_accumulator("__avg_squared_update", param)
+        return block.append_op(
+            "adadelta",
+            inputs={"Param": [param], "Grad": [grad], "AvgSquaredGrad": [g],
+                    "AvgSquaredUpdate": [u],
+                    "LearningRate": [self._lr_for(param)]},
+            outputs={"ParamOut": [param], "AvgSquaredGradOut": [g],
+                     "AvgSquaredUpdateOut": [u]},
+            attrs={"epsilon": self._epsilon, "rho": self._rho},
+        )
+
+
+class RMSPropOptimizer(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("momentum", p)
+            self._add_accumulator("mean_square", p)
+            self._add_accumulator("mean_grad", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        return block.append_op(
+            "rmsprop",
+            inputs={"Param": [param], "Grad": [grad],
+                    "Moment": [self._get_accumulator("momentum", param)],
+                    "MeanSquare": [self._get_accumulator("mean_square", param)],
+                    "MeanGrad": [self._get_accumulator("mean_grad", param)],
+                    "LearningRate": [self._lr_for(param)]},
+            outputs={"ParamOut": [param],
+                     "MomentOut": [self._get_accumulator("momentum", param)],
+                     "MeanSquareOut": [self._get_accumulator("mean_square", param)],
+                     "MeanGradOut": [self._get_accumulator("mean_grad", param)]},
+            attrs={"decay": self._rho, "epsilon": self._epsilon,
+                   "momentum": self._momentum, "centered": self._centered},
+        )
+
+
+class FtrlOptimizer(Optimizer):
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5,
+                 regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("squared", p)
+            self._add_accumulator("linear", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        sq = self._get_accumulator("squared", param)
+        lin = self._get_accumulator("linear", param)
+        return block.append_op(
+            "ftrl",
+            inputs={"Param": [param], "Grad": [grad],
+                    "SquaredAccumulator": [sq], "LinearAccumulator": [lin],
+                    "LearningRate": [self._lr_for(param)]},
+            outputs={"ParamOut": [param], "SquaredAccumOut": [sq],
+                     "LinearAccumOut": [lin]},
+            attrs={"l1": self._l1, "l2": self._l2, "lr_power": self._lr_power},
+        )
+
+
+class LambOptimizer(AdamOptimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, regularization=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, regularization,
+                         name)
+        self._weight_decay = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        wd = self._weight_decay
+        if self._exclude_fn is not None and self._exclude_fn(param):
+            wd = 0.0
+        m1 = self._get_accumulator("moment1", param)
+        m2 = self._get_accumulator("moment2", param)
+        b1p = self._get_accumulator("beta1_pow_acc", param)
+        b2p = self._get_accumulator("beta2_pow_acc", param)
+        return block.append_op(
+            "lamb",
+            inputs={"Param": [param], "Grad": [grad], "Moment1": [m1],
+                    "Moment2": [m2], "Beta1Pow": [b1p], "Beta2Pow": [b2p],
+                    "LearningRate": [self._lr_for(param)]},
+            outputs={"ParamOut": [param], "Moment1Out": [m1], "Moment2Out": [m2],
+                     "Beta1PowOut": [b1p], "Beta2PowOut": [b2p]},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon, "weight_decay": wd},
+        )
+
+
+class DGCMomentumOptimizer(MomentumOptimizer):
+    """Momentum with deep-gradient-compression-style top-k sparsification
+    (reference ``optimizer.py:870``). The sparsification itself lives in the
+    collective layer (parallel/dgc.py) — single-process training behaves as
+    plain momentum, like the reference before rampup."""
+
+    def __init__(self, learning_rate, momentum, rampup_begin_step=0,
+                 rampup_step=1, sparsity=(0.999,), use_nesterov=False,
+                 local_grad_clip_norm=None, num_trainers=None,
+                 regularization=None, name=None):
+        super().__init__(learning_rate, momentum, use_nesterov, regularization,
+                         name)
+        self._rampup_begin_step = rampup_begin_step
+        self._sparsity = sparsity
+
+
+# -- wrappers ----------------------------------------------------------------
+
+
+class ModelAverage(Optimizer):
+    """Maintains running averages of params; ``apply()`` context swaps them
+    in for eval (reference ``optimizer.py:2512``)."""
+
+    def __init__(self, average_window_rate, min_average_window=10000,
+                 max_average_window=10000, regularization=None, name=None):
+        super().__init__(0.0, regularization, name)
+        self.average_window = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        self.params_sums = {}
+        program = default_main_program()
+        block = program.global_block()
+        for param in program.all_parameters():
+            if not param.trainable:
+                continue
+            s = self._add_accumulator("sum", param)
+            n = self._add_accumulator("num_acc", param, shape=(1,))
+            block.append_op("sum", inputs={"X": [param, s]}, outputs={"Out": [s]})
+            block.append_op("increment", inputs={"X": [n]}, outputs={"Out": [n]},
+                            attrs={"step": 1.0})
+            self.params_sums[param.name] = (s, n)
+
+    import contextlib
+
+    @contextlib.contextmanager
+    def apply(self, executor, need_restore=True):
+        from .executor import global_scope
+
+        scope = global_scope()
+        backups = {}
+        for pname, (s, n) in self.params_sums.items():
+            backups[pname] = scope.find_var(pname)
+            ssum = np.asarray(scope.find_var(s.name))
+            num = float(np.asarray(scope.find_var(n.name)).reshape(-1)[0])
+            if num > 0:
+                scope.set_var(pname, (ssum / num).astype(backups[pname].dtype))
+        try:
+            yield
+        finally:
+            if need_restore:
+                for pname, val in backups.items():
+                    scope.set_var(pname, val)
+
+    def restore(self, executor):
+        pass
+
+
+class ExponentialMovingAverage:
+    """EMA of params updated in-graph (reference ``optimizer.py:2814``)."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._name = name or ""
+        self._ema_vars = {}
+        program = default_main_program()
+        block = program.global_block()
+        helper = LayerHelper("ema")
+        for param in program.all_parameters():
+            if not param.trainable:
+                continue
+            name = unique_name.generate(param.name + ".ema")
+            ema = block.create_var(name=name, shape=param.shape, dtype=param.dtype,
+                                   persistable=True, stop_gradient=True)
+            sb = default_startup_program().global_block()
+            sv = sb.create_var(name=name, shape=param.shape, dtype=param.dtype,
+                               persistable=True)
+            Constant(0.0)(sv, sb)
+            self._ema_vars[param.name] = ema
+            # ema = decay*ema + (1-decay)*param, written each step
+            from .layers import nn
+
+            tmp = nn.elementwise_add(
+                nn.scale(ema, scale=self._decay),
+                nn.scale(param, scale=1.0 - self._decay),
+            )
+            block.append_op("assign", inputs={"X": [tmp]}, outputs={"Out": [ema]})
+
+    import contextlib
+
+    @contextlib.contextmanager
+    def apply(self, executor, need_restore=True):
+        from .executor import global_scope
+
+        scope = global_scope()
+        backups = {}
+        for pname, ema in self._ema_vars.items():
+            backups[pname] = scope.find_var(pname)
+            v = scope.find_var(ema.name)
+            if v is not None:
+                scope.set_var(pname, v)
+        try:
+            yield
+        finally:
+            if need_restore:
+                for pname, val in backups.items():
+                    scope.set_var(pname, val)
+
+    def update(self):
+        pass  # updates happen in-graph
+
+    def restore(self, executor):
+        pass
+
+
+class LookaheadOptimizer:
+    """Reference ``optimizer.py:3634``: slow/fast weights; every k steps slow
+    += alpha*(fast-slow), fast = slow."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+
+    def minimize(self, loss, startup_program=None):
+        opt_ops, params_grads = self.inner_optimizer.minimize(loss,
+                                                              startup_program)
+        program = loss.block.program
+        block = program.global_block()
+        helper = LayerHelper("lookahead")
+        from .layers import nn, tensor
+
+        step = nn.autoincreased_step_counter(counter_name="@LOOKAHEAD_STEP@")
+        stepf = tensor.cast(step, "float32")
+        kf = float(self.k)
+        # sync_flag = 1.0 when step % k == 0
+        mod = nn.elementwise_sub(
+            stepf, nn.scale(nn.elementwise_floordiv(
+                tensor.cast(step, "int64"),
+                tensor.fill_constant([1], "int64", self.k)).astype("float32"),
+                scale=kf))
+        is_sync = tensor.cast(mod < 0.5, "float32")
+        for param, _ in params_grads:
+            name = unique_name.generate(param.name + ".slow")
+            slow = block.create_var(name=name, shape=param.shape,
+                                    dtype=param.dtype, persistable=True,
+                                    stop_gradient=True)
+            sb = default_startup_program().global_block()
+            sv = sb.create_var(name=name, shape=param.shape, dtype=param.dtype,
+                               persistable=True)
+            Constant(0.0)(sv, sb)
+            new_slow = nn.elementwise_add(
+                slow, nn.scale(nn.elementwise_sub(param, slow),
+                               scale=self.alpha))
+            merged_slow = nn.elementwise_add(
+                nn.elementwise_mul(is_sync, new_slow),
+                nn.elementwise_mul(nn.scale(is_sync, scale=-1.0, bias=1.0), slow),
+            )
+            merged_fast = nn.elementwise_add(
+                nn.elementwise_mul(is_sync, merged_slow),
+                nn.elementwise_mul(nn.scale(is_sync, scale=-1.0, bias=1.0), param),
+            )
+            block.append_op("assign", inputs={"X": [merged_slow]},
+                            outputs={"Out": [slow]})
+            block.append_op("assign", inputs={"X": [merged_fast]},
+                            outputs={"Out": [param]})
+        return opt_ops, params_grads
+
+
+class RecomputeOptimizer:
+    """Activation recomputation (reference ``optimizer.py:3341``). Under the
+    functional-autodiff design the checkpoint list is carried on the autodiff
+    op; its lowering wraps forward segments in ``jax.checkpoint`` so XLA
+    rematerializes instead of saving activations."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+        self._checkpoints = None
+
+    def _set_checkpoints(self, checkpoints):
+        self._checkpoints = checkpoints
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None, checkpoints=None):
+        return append_backward(loss, parameter_list, no_grad_set,
+                               checkpoints=self._checkpoints or checkpoints)
+
+    def apply_gradients(self, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        params_grads = self.backward(loss, startup_program, parameter_list,
+                                     no_grad_set)
+        return self.apply_gradients(params_grads), params_grads
+
+
+class PipelineOptimizer:
+    """Pipeline parallelism (reference ``optimizer.py:3048``). The TPU-native
+    implementation stages the program over mesh axis 'pp' — see
+    paddle_tpu/parallel/pipeline.py. This wrapper records cut points."""
+
+    def __init__(self, optimizer, cut_list=None, place_list=None,
+                 concurrency_list=None, queue_size=30, sync_steps=1,
+                 start_cpu_core_id=0):
+        self._optimizer = optimizer
+        self._cut_list = cut_list or []
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        result = self._optimizer.minimize(loss, startup_program,
+                                          parameter_list, no_grad_set)
+        program = loss.block.program
+        program._pipeline_cut_vars = [
+            [v.name for v in cut] if isinstance(cut, (list, tuple)) else [cut.name]
+            for cut in self._cut_list
+        ]
+        return result
+
+
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+Adagrad = AdagradOptimizer
+Adam = AdamOptimizer
+Adamax = AdamaxOptimizer
+Dpsgd = DpsgdOptimizer
+DecayedAdagrad = DecayedAdagradOptimizer
+Adadelta = AdadeltaOptimizer
+RMSProp = RMSPropOptimizer
+Ftrl = FtrlOptimizer
+Lamb = LambOptimizer

@@ -1,0 +1,138 @@
+"""Op registry: op type -> lowering rule.
+
+Plays the role of the reference's ``OpInfoMap`` + ``REGISTER_OPERATOR``
+(``paddle/fluid/framework/op_registry.h:199``, ``op_info.h:115``) but instead
+of per-device kernel dispatch, each op has a single *lowering rule* that emits
+JAX/XLA (or Pallas) computation when a Block is traced into one compiled
+function. This is the TPU-native analogue of the kernel layer: XLA does the
+tiling/fusion that per-op CUDA kernels hand-coded.
+
+A lowering rule has signature ``lower(ctx, op)`` where ``ctx`` is a
+``LowerCtx`` giving read/write access to the symbolic environment, and ``op``
+is the ``framework.Operator``. Rules read inputs with ``ctx.get`` and bind
+outputs with ``ctx.set``.
+"""
+
+import numpy as np
+
+
+class OpInfo:
+    def __init__(self, type, lower, has_state=False):
+        self.type = type
+        self.lower = lower
+        # has_state: op reads/advances the RNG stream (dropout, random init)
+        self.has_state = has_state
+
+
+class OpRegistry:
+    def __init__(self):
+        self._ops = {}
+
+    def register(self, type, lower=None, **kw):
+        if lower is None:  # decorator form
+            def deco(fn):
+                self._ops[type] = OpInfo(type, fn, **kw)
+                return fn
+
+            return deco
+        self._ops[type] = OpInfo(type, lower, **kw)
+        return lower
+
+    def get(self, type):
+        info = self._ops.get(type)
+        if info is None:
+            raise NotImplementedError(
+                "Op %r has no lowering rule registered (see paddle_tpu/fluid/ops/)" % type
+            )
+        return info
+
+    def has(self, type):
+        return type in self._ops
+
+    def types(self):
+        return sorted(self._ops)
+
+
+registry = OpRegistry()
+register = registry.register
+
+
+class LowerCtx:
+    """Symbolic environment threaded through a block lowering.
+
+    - ``env``: name -> jax value (tracers during jit trace).
+    - ``written``: persistable names assigned during the trace (optimizer
+      updates, BN running stats, step counters) — the executor commits these
+      back to the Scope, the analogue of the reference's in-place scope
+      mutation under XLA's functional model.
+    - RNG: a single threaded PRNG key. Each stateful op calls ``next_rng``.
+      During autodiff replay (``replay_keys``) the recorded keys are reused so
+      the recomputed forward matches bit-for-bit (reference analogue: fixed
+      dropout masks saved for backward).
+    """
+
+    def __init__(self, block, env, rng_key, mesh=None, replay_keys=None):
+        self.block = block
+        self.program = block.program
+        self.env = env
+        self.rng_key = rng_key
+        self.mesh = mesh
+        self.used_keys = []
+        self._replay_keys = list(replay_keys) if replay_keys is not None else None
+        self.written = set()
+        # snapshots for autodiff replay (see ops/autodiff.py)
+        self.initial_env = dict(env)
+        self.initial_rng = rng_key
+
+    def get(self, name):
+        if name not in self.env:
+            raise KeyError(
+                "Var %r not materialized; it must be fed, persistable, or "
+                "produced by an earlier op" % name
+            )
+        return self.env[name]
+
+    def get_input(self, op, slot, default=None):
+        names = op.input(slot)
+        if not names:
+            return default
+        return self.get(names[0])
+
+    def get_inputs(self, op, slot):
+        return [self.get(n) for n in op.input(slot)]
+
+    def set(self, name, value):
+        self.env[name] = value
+        v = self.block._find_var_recursive(name)
+        if v is not None and v.persistable:
+            self.written.add(name)
+
+    def set_output(self, op, slot, value):
+        names = op.output(slot)
+        if names:
+            self.set(names[0], value)
+
+    def var(self, name):
+        return self.block._find_var_recursive(name)
+
+    def next_rng(self):
+        import jax
+
+        if self._replay_keys is not None:
+            key = self._replay_keys.pop(0)
+        else:
+            self.rng_key, key = jax.random.split(self.rng_key)
+        self.used_keys.append(key)
+        return key
+
+    def var_dtype(self, name):
+        v = self.var(name)
+        return np.dtype(v.dtype) if v is not None else np.dtype("float32")
+
+
+def lower_block(ctx, block):
+    """Run every op's lowering rule in order (the `Executor::RunPreparedContext`
+    hot-loop analogue, reference executor.cc:411 — but traced once, compiled
+    by XLA, not interpreted per step)."""
+    for op in block.ops:
+        registry.get(op.type).lower(ctx, op)

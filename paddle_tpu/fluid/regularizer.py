@@ -1,0 +1,49 @@
+"""Weight-decay regularizers (reference ``python/paddle/fluid/regularizer.py``)."""
+
+from .framework import Variable
+
+__all__ = ["L1Decay", "L2Decay", "L1DecayRegularizer", "L2DecayRegularizer",
+           "append_regularization_ops"]
+
+
+class WeightDecayRegularizer:
+    def __call__(self, param, grad, block):
+        raise NotImplementedError
+
+
+class L2DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self._coeff = regularization_coeff
+
+    def __call__(self, param, grad, block):
+        from .layers import nn
+
+        decay = nn.scale(param, scale=self._coeff)
+        return nn.elementwise_add(grad, decay)
+
+
+class L1DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self._coeff = regularization_coeff
+
+    def __call__(self, param, grad, block):
+        from .layers import nn
+
+        decay = nn.scale(nn.sign(param), scale=self._coeff)
+        return nn.elementwise_add(grad, decay)
+
+
+def append_regularization_ops(params_grads, regularization=None):
+    out = []
+    for param, grad in params_grads:
+        regular = getattr(param, "regularizer", None) or regularization
+        if regular is None or grad is None:
+            out.append((param, grad))
+            continue
+        new_grad = regular(param, grad, grad.block)
+        out.append((param, new_grad))
+    return out
+
+
+L1Decay = L1DecayRegularizer
+L2Decay = L2DecayRegularizer

@@ -1,0 +1,58 @@
+"""Static shape/dtype inference by abstract evaluation of op lowering rules.
+
+TPU-first replacement for the reference's per-op ``InferShape`` methods
+(``shape_inference.h``): the lowering rule IS the shape function — we run it
+under ``jax.eval_shape`` (no FLOPs, no memory) and read off output avals.
+Unknown (batch) dims are encoded as -1 in the IR; they are substituted with a
+distinctive dummy extent for abstract eval and mapped back afterwards.
+"""
+
+import numpy as np
+
+_DUMMY = 1097  # unlikely to appear as a real static dim
+
+
+def infer_op_shapes(op):
+    import jax
+
+    from .registry import LowerCtx, registry
+
+    block = op.block
+    if not registry.has(op.type):
+        return
+    names = []
+    vals = []
+    had_dummy = False
+    for name in op.input_arg_names():
+        v = block._find_var_recursive(name)
+        if v is None:
+            return
+        shape = []
+        for s in v.shape:
+            if s == -1:
+                shape.append(_DUMMY)
+                had_dummy = True
+            else:
+                shape.append(int(s))
+        names.append(name)
+        vals.append(jax.ShapeDtypeStruct(tuple(shape), v.dtype))
+
+    out_names = op.output_arg_names()
+
+    def fn(env_vals, key):
+        env = dict(zip(names, env_vals))
+        ctx = LowerCtx(block, env, key)
+        registry.get(op.type).lower(ctx, op)
+        return {n: env[n] for n in out_names if n in env}
+
+    outs = jax.eval_shape(fn, vals, jax.ShapeDtypeStruct((2,), np.uint32))
+    for n, aval in outs.items():
+        v = block._find_var_recursive(n)
+        if v is None:
+            continue
+        shape = tuple(
+            -1 if (had_dummy and s % _DUMMY == 0 and s > 0) else int(s)
+            for s in aval.shape
+        )
+        v.shape = shape
+        v.dtype = np.dtype(aval.dtype)

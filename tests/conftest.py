@@ -1,0 +1,23 @@
+"""Test harness: force a virtual 8-device CPU mesh so multi-chip sharding
+paths compile and execute without TPU hardware (the analogue of the
+reference's spawn-local-subprocess fake cluster, SURVEY §4)."""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+# The environment may pre-set JAX_PLATFORMS to a TPU tunnel backend; the env
+# var alone does not always win, so force it through the config API too.
+jax.config.update("jax_platforms", "cpu")
+assert all(d.platform == "cpu" for d in jax.devices())
+assert len(jax.devices()) == 8, "expected 8 virtual CPU devices for mesh tests"

@@ -1,0 +1,144 @@
+"""OpTest base: numpy-referenced single-op tests with numeric gradient checks.
+
+Parity: reference ``python/paddle/fluid/tests/unittests/op_test.py:135`` —
+build a one-op Program, execute, compare against a numpy reference
+(`check_output`), and compare analytic grads (autodiff op) against central
+finite differences (`check_grad`).
+"""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework
+
+
+class OpTest:
+    """Subclasses set: op_type, inputs (dict name->ndarray), attrs,
+    and either outputs (dict name->ndarray) or a compute() method."""
+
+    op_type = None
+    inputs = {}
+    attrs = {}
+    outputs = {}
+
+    def _build(self, extra_fetch=None):
+        main = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(main, startup):
+            block = main.global_block()
+            in_names = {}
+            feed = {}
+            for slot, value in self.inputs.items():
+                if isinstance(value, list):  # multi-var slot
+                    names = []
+                    for i, v in enumerate(value):
+                        v = np.asarray(v)
+                        n = "%s_%s_%d" % (self.op_type, slot, i)
+                        block.create_var(name=n, shape=v.shape, dtype=v.dtype,
+                                         is_data=True, stop_gradient=False)
+                        feed[n] = v
+                        names.append(n)
+                    in_names[slot] = names
+                else:
+                    value = np.asarray(value)
+                    n = "%s_%s" % (self.op_type, slot)
+                    block.create_var(name=n, shape=value.shape, dtype=value.dtype,
+                                     is_data=True, stop_gradient=False)
+                    feed[n] = value
+                    in_names[slot] = [n]
+            out_names = {}
+            for slot, value in self.outputs.items():
+                if isinstance(value, list):
+                    names = []
+                    for i, v in enumerate(value):
+                        n = "%s_out_%s_%d" % (self.op_type, slot, i)
+                        block.create_var(name=n, shape=(), dtype=np.asarray(v).dtype)
+                        names.append(n)
+                    out_names[slot] = names
+                else:
+                    n = "%s_out_%s" % (self.op_type, slot)
+                    block.create_var(name=n, shape=(),
+                                     dtype=np.asarray(value).dtype)
+                    out_names[slot] = [n]
+            block.append_op(self.op_type, inputs=in_names, outputs=out_names,
+                            attrs=self.attrs)
+        return main, startup, feed, in_names, out_names
+
+    def check_output(self, atol=1e-5, rtol=1e-5):
+        main, startup, feed, _, out_names = self._build()
+        exe = fluid.Executor()
+        fetch = []
+        expected = []
+        for slot, value in self.outputs.items():
+            if isinstance(value, list):
+                for n, v in zip(out_names[slot], value):
+                    fetch.append(n)
+                    expected.append(np.asarray(v))
+            else:
+                fetch.append(out_names[slot][0])
+                expected.append(np.asarray(value))
+        with fluid.scope_guard(fluid.Scope()):
+            results = exe.run(main, feed=feed, fetch_list=fetch)
+        for got, want, name in zip(results, expected, fetch):
+            np.testing.assert_allclose(
+                np.asarray(got, dtype=np.float64) if got.dtype != np.bool_ else got,
+                np.asarray(want, dtype=np.float64) if np.asarray(want).dtype != np.bool_ else want,
+                atol=atol, rtol=rtol,
+                err_msg="output %s of op %s" % (name, self.op_type),
+            )
+
+    def check_grad(self, inputs_to_check, output_name, atol=5e-3, rtol=5e-3,
+                   delta=1e-3):
+        main, startup, feed, in_names, out_names = self._build()
+        block = main.global_block()
+        # find the flat output var name
+        out_var = None
+        for slot, names in out_names.items():
+            for n in names:
+                if slot == output_name or n.endswith("_" + output_name):
+                    out_var = n
+        if out_var is None:
+            out_var = out_names[output_name][0]
+
+        wrt = ["%s_%s" % (self.op_type, s) for s in inputs_to_check]
+        gnames = [w + "@GRAD" for w in wrt]
+        for w, g in zip(wrt, gnames):
+            v = block.var(w)
+            block.create_var(name=g, shape=v.shape, dtype=v.dtype,
+                             stop_gradient=True)
+        block.append_op(
+            "autodiff",
+            inputs={"Loss": [out_var]},
+            outputs={"Grads": gnames},
+            attrs={"loss": out_var, "wrt": wrt, "grad_names": gnames,
+                   "loss_scale": 1.0},
+        )
+        exe = fluid.Executor()
+        with fluid.scope_guard(fluid.Scope()):
+            analytic = exe.run(main, feed=feed, fetch_list=gnames)
+
+        # numeric: central differences on sum(output)
+        def f(feed_override):
+            main2, _, _, _, out_names2 = self._build()
+            exe2 = fluid.Executor()
+            with fluid.scope_guard(fluid.Scope()):
+                (val,) = exe2.run(main2, feed=feed_override, fetch_list=[out_var])
+            return float(np.sum(val))
+
+        for w, got in zip(wrt, analytic):
+            base = feed[w].astype(np.float64)
+            numeric = np.zeros_like(base)
+            flat = base.ravel()
+            num_flat = numeric.ravel()
+            for i in range(flat.size):
+                for sign in (+1, -1):
+                    pert = dict(feed)
+                    b = base.copy().ravel()
+                    b[i] += sign * delta
+                    pert[w] = b.reshape(base.shape).astype(feed[w].dtype)
+                    num_flat[i] += sign * f(pert)
+                num_flat[i] /= 2 * delta
+            np.testing.assert_allclose(
+                got, numeric, atol=atol, rtol=rtol,
+                err_msg="grad wrt %s of op %s" % (w, self.op_type),
+            )

@@ -1,0 +1,126 @@
+"""Executor: feed/fetch, persistable state, startup init, backward, optimizer
+step. Mirrors reference test_executor_and_mul.py / test_optimizer.py."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers, optimizer
+
+
+def test_feed_fetch_mul():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[3], dtype="float32")
+        y = layers.data(name="y", shape=[3, 2], dtype="float32", append_batch_size=False)
+        out = layers.mul(x, y)
+    exe = fluid.Executor()
+    xv = np.random.rand(5, 3).astype(np.float32)
+    yv = np.random.rand(3, 2).astype(np.float32)
+    with fluid.scope_guard(fluid.Scope()):
+        (res,) = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[out])
+    np.testing.assert_allclose(res, xv @ yv, rtol=1e-5)
+
+
+def test_startup_then_train_step_sgd():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        label = layers.data(name="label", shape=[1], dtype="float32")
+        pred = layers.fc(x, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, label))
+        opt = optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)
+
+    exe = fluid.Executor()
+    rng = np.random.RandomState(0)
+    xv = rng.rand(8, 4).astype(np.float32)
+    yv = (xv @ np.array([[1.0], [2.0], [-1.0], [0.5]], np.float32)).astype(np.float32)
+
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(50):
+            (lv,) = exe.run(main, feed={"x": xv, "label": yv}, fetch_list=[loss])
+            losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.1, losses[::10]
+
+
+def test_param_persistence_across_runs():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[2], dtype="float32")
+        out = layers.fc(x, size=2, bias_attr=False)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        w_name = main.all_parameters()[0].name
+        w0 = np.asarray(fluid.global_scope().find_var(w_name))
+        (r1,) = exe.run(main, feed={"x": np.ones((1, 2), np.float32)}, fetch_list=[out])
+        (r2,) = exe.run(main, feed={"x": np.ones((1, 2), np.float32)}, fetch_list=[out])
+        np.testing.assert_allclose(r1, r2, rtol=1e-6)
+        np.testing.assert_allclose(r1.ravel(), w0.sum(axis=0), rtol=1e-5)
+
+
+def test_backward_grads_match_numeric():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[3], dtype="float32")
+        w = layers.create_parameter([3, 1], "float32", name="w")
+        out = layers.mul(x, w)
+        loss = layers.mean(out)
+        grads = fluid.append_backward(loss)
+    exe = fluid.Executor()
+    xv = np.random.rand(4, 3).astype(np.float32)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (g,) = exe.run(main, feed={"x": xv}, fetch_list=["w@GRAD"])
+    # d(mean(x@w))/dw = mean over batch of x, per column
+    expected = xv.mean(axis=0, keepdims=True).T / 1.0
+    np.testing.assert_allclose(g, expected, rtol=1e-5)
+
+
+def test_gradients_api():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[3], dtype="float32")
+        y = layers.reduce_sum(layers.square(x))
+        (gx,) = fluid.gradients(y, x)
+    exe = fluid.Executor()
+    xv = np.random.rand(2, 3).astype(np.float32)
+    with fluid.scope_guard(fluid.Scope()):
+        (g,) = exe.run(main, feed={"x": xv}, fetch_list=[gx])
+    np.testing.assert_allclose(g, 2 * xv, rtol=1e-5)
+
+
+def test_rng_stream_advances():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        u = layers.uniform_random([4], min=0.0, max=1.0)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        (a,) = exe.run(main, fetch_list=[u])
+        (b,) = exe.run(main, fetch_list=[u])
+    assert not np.allclose(a, b)
+
+
+def test_dropout_train_vs_test():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[100], dtype="float32")
+        d = layers.dropout(x, dropout_prob=0.5, dropout_implementation="upscale_in_train")
+    test_prog = main.clone(for_test=True)
+    exe = fluid.Executor()
+    xv = np.ones((2, 100), np.float32)
+    with fluid.scope_guard(fluid.Scope()):
+        (train_out,) = exe.run(main, feed={"x": xv}, fetch_list=[d])
+        (test_out,) = exe.run(test_prog, feed={"x": xv}, fetch_list=[d.name])
+    assert (train_out == 0).any()
+    np.testing.assert_allclose(test_out, xv)

@@ -1,0 +1,101 @@
+"""IR construction, cloning, pruning, protobuf round-trip.
+
+Mirrors reference tests: test_program.py, test_operator_desc.py,
+test_protobuf_descs.py (SURVEY §4)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework, layers
+
+
+def _build_program():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        y = layers.fc(x, size=3, act="relu")
+        loss = layers.mean(y)
+    return main, startup, loss
+
+
+def test_program_construction():
+    main, startup, loss = _build_program()
+    types = [op.type for op in main.global_block().ops]
+    assert "mul" in types
+    assert "relu" in types
+    assert "mean" in types
+    params = main.all_parameters()
+    assert len(params) == 2  # weight + bias
+    # startup has init ops for both params
+    assert len(startup.global_block().ops) >= 2
+
+
+def test_shape_inference():
+    main, _, loss = _build_program()
+    # fc output inferred as (-1, 3)
+    fc_out = None
+    for op in main.global_block().ops:
+        if op.type == "relu":
+            fc_out = main.global_block().var(op.output("Out")[0])
+    assert fc_out is not None
+    assert fc_out.shape == (-1, 3)
+    assert loss.shape == ()
+
+
+def test_clone_for_test_flips_is_test():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = layers.data(name="x", shape=[4])
+        d = layers.dropout(x, dropout_prob=0.5)
+    cloned = main.clone(for_test=True)
+    ops = [op for op in cloned.global_block().ops if op.type == "dropout"]
+    assert ops[0].attr("is_test") is True
+    # original untouched
+    ops0 = [op for op in main.global_block().ops if op.type == "dropout"]
+    assert ops0[0].attr("is_test") is False
+
+
+def test_prune():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = layers.data(name="x", shape=[4])
+        h = layers.fc(x, size=3)
+        out1 = layers.mean(h)
+        out2 = layers.reduce_sum(h)  # should be pruned away
+    pruned = main._prune([out1])
+    types = [op.type for op in pruned.global_block().ops]
+    assert "reduce_sum" not in types
+    assert "mean" in types
+
+
+def test_protobuf_roundtrip():
+    main, _, loss = _build_program()
+    data = main.serialize_to_string()
+    assert isinstance(data, bytes) and len(data) > 0
+    restored = fluid.Program.parse_from_string(data)
+    assert [op.type for op in restored.global_block().ops] == [
+        op.type for op in main.global_block().ops
+    ]
+    # var metadata survives
+    for name, var in main.global_block().vars.items():
+        rvar = restored.global_block().var(name)
+        assert tuple(rvar.shape) == tuple(var.shape)
+        assert rvar.persistable == var.persistable
+    # parameters survive as parameters
+    assert {p.name for p in restored.all_parameters()} == {
+        p.name for p in main.all_parameters()
+    }
+
+
+def test_operator_sugar():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = layers.data(name="x", shape=[4])
+        y = layers.data(name="y", shape=[4])
+        z = x + y
+        w = z * 2.0
+        c = x < y
+    assert z.dtype == np.dtype("float32")
+    assert c.dtype == np.dtype("bool")
