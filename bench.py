@@ -1,0 +1,48 @@
+"""Benchmark entry: prints ONE JSON line with the headline metric.
+
+Run on real TPU hardware by the driver. Current flagship benchmark:
+MNIST LeNet train-step throughput (BASELINE.md config 1); vs_baseline is
+null until the reference numbers exist (the reference publishes none —
+BASELINE.md)."""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+
+def bench_lenet(batch_size=256, warmup=3, iters=20):
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import lenet
+
+    main, startup, loss, acc = lenet.build_train_program()
+    exe = fluid.Executor()
+    rng = np.random.RandomState(0)
+    imgs = rng.rand(batch_size, 1, 28, 28).astype(np.float32)
+    labels = rng.randint(0, 10, (batch_size, 1)).astype(np.int64)
+
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for _ in range(warmup):
+            exe.run(main, feed={"img": imgs, "label": labels}, fetch_list=[loss])
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            (lv,) = exe.run(main, feed={"img": imgs, "label": labels},
+                            fetch_list=[loss])
+        elapsed = time.perf_counter() - t0
+    images_per_sec = batch_size * iters / elapsed
+    return images_per_sec
+
+
+if __name__ == "__main__":
+    ips = bench_lenet()
+    print(json.dumps({
+        "metric": "mnist_lenet_images_per_sec",
+        "value": round(float(ips), 1),
+        "unit": "images/sec",
+        "vs_baseline": None,
+    }))

@@ -1,0 +1,14 @@
+"""DyGraph (eager) mode — reference ``python/paddle/fluid/dygraph/``."""
+
+from . import base, checkpoint, jit, layers, nn
+from .base import (  # noqa: F401
+    Tracer,
+    VarBase,
+    enabled,
+    guard,
+    no_grad,
+    to_variable,
+)
+from .checkpoint import load_dygraph, save_dygraph  # noqa: F401
+from .layers import Layer  # noqa: F401
+from .nn import *  # noqa: F401,F403

@@ -1,0 +1,27 @@
+"""Dygraph checkpointing (reference ``dygraph/checkpoint.py``):
+state-dict save/load."""
+
+import os
+
+import numpy as np
+
+from .base import VarBase
+
+__all__ = ["save_dygraph", "load_dygraph"]
+
+
+def save_dygraph(state_dict, model_path):
+    arrays = {}
+    for k, v in state_dict.items():
+        arrays[k] = v.numpy() if isinstance(v, VarBase) else np.asarray(v)
+    os.makedirs(os.path.dirname(model_path) or ".", exist_ok=True)
+    with open(model_path + ".pdparams", "wb") as f:
+        np.savez(f, **arrays)
+
+
+def load_dygraph(model_path):
+    path = model_path + ".pdparams"
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    data = np.load(path)
+    return {k: data[k] for k in data.files}, None

@@ -1,0 +1,117 @@
+"""Dygraph -> static Program tracing (reference ``dygraph/jit.py`` +
+``imperative/jit/program_desc_tracer``). Records eagerly executed ops into a
+Program so it can be saved/compiled (config 5: dygraph JIT path)."""
+
+import numpy as np
+
+from .. import framework
+from ..framework import Program
+from .base import VarBase
+
+__all__ = ["trace", "TracedLayer"]
+
+
+class _ProgramRecorder:
+    def __init__(self):
+        self.program = Program()
+        self.block = self.program.global_block()
+        self._known = {}  # id(VarBase) -> var name
+
+    def _var_for(self, vb, as_param=False):
+        key = id(vb)
+        if key in self._known:
+            return self._known[key]
+        name = vb.name
+        if as_param or vb.persistable:
+            self.block.create_parameter(shape=list(vb.shape), dtype=vb.dtype,
+                                        name=name)
+        else:
+            self.block.create_var(name=name, shape=list(vb.shape),
+                                  dtype=vb.dtype, is_data=True,
+                                  stop_gradient=vb.stop_gradient)
+        self._known[key] = name
+        return name
+
+    def record(self, op_type, input_slots, out_slot_names, out_vars, attrs):
+        ins = {}
+        for slot, vs in input_slots.items():
+            ins[slot] = [self._var_for(v, as_param=v.persistable) for v in vs]
+        outs = {}
+        for slot, ov in zip(out_slot_names, out_vars):
+            if ov is None:
+                continue
+            name = ov.name
+            self.block.create_var(name=name, shape=list(ov.shape),
+                                  dtype=ov.dtype)
+            self._known[id(ov)] = name
+            outs[slot] = [name]
+        self.block.append_op(op_type, inputs=ins, outputs=outs, attrs=attrs)
+
+
+def trace(layer, inputs):
+    """Runs ``layer(*inputs)`` once, recording a static Program.
+
+    Returns (outputs, TracedLayer)."""
+    tracer = framework._dygraph_tracer()
+    if tracer is None:
+        raise RuntimeError("trace() must run under dygraph.guard()")
+    rec = _ProgramRecorder()
+    inputs = [v if isinstance(v, VarBase) else VarBase(np.asarray(v),
+                                                      stop_gradient=True)
+              for v in inputs]
+    for v in inputs:
+        rec._var_for(v)
+    tracer._program_recorder = rec
+    try:
+        outputs = layer(*inputs)
+    finally:
+        tracer._program_recorder = None
+    out_list = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    tl = TracedLayer(rec.program, layer,
+                     [rec._known[id(v)] for v in inputs],
+                     [rec._known[id(v)] for v in out_list])
+    return outputs, tl
+
+
+class TracedLayer:
+    def __init__(self, program, layer, feed_names, fetch_names):
+        self.program = program
+        self._layer = layer
+        self._feed_names = feed_names
+        self._fetch_names = fetch_names
+        self._scope = None
+
+    def _materialize_scope(self):
+        from ..executor import Scope
+
+        if self._scope is not None:
+            return
+        self._scope = Scope()
+        for _, p in self._layer.named_parameters():
+            self._scope.set_var(p.name, p._ivar)
+
+    def __call__(self, inputs):
+        import paddle_tpu.fluid as fluid
+
+        self._materialize_scope()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        feed = {n: (v.numpy() if isinstance(v, VarBase) else np.asarray(v))
+                for n, v in zip(self._feed_names, inputs)}
+        exe = fluid.Executor()
+        from ..executor import scope_guard
+
+        with scope_guard(self._scope):
+            return exe.run(self.program, feed=feed, fetch_list=self._fetch_names)
+
+    def save_inference_model(self, dirname, feed=None, fetch=None):
+        import paddle_tpu.fluid as fluid
+
+        self._materialize_scope()
+        from ..executor import scope_guard
+
+        exe = fluid.Executor()
+        with scope_guard(self._scope):
+            fetch_vars = [self.program.global_block().var(n)
+                          for n in self._fetch_names]
+            fluid.io.save_inference_model(dirname, self._feed_names, fetch_vars,
+                                          exe, self.program)

@@ -1,0 +1,127 @@
+"""Layer base class for dygraph modules (reference ``dygraph/layers.py``)."""
+
+import numpy as np
+
+from .. import framework
+from ..initializer import Constant, Xavier
+from ..param_attr import ParamAttr
+from .base import VarBase, to_variable
+
+__all__ = ["Layer"]
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        self._full_name = name_scope or self.__class__.__name__.lower()
+        self._dtype = dtype
+        self._parameters = {}
+        self._sub_layers = {}
+        self.training = True
+
+    def full_name(self):
+        return self._full_name
+
+    def train(self):
+        self.training = True
+        for l in self._sub_layers.values():
+            l.train()
+
+    def eval(self):
+        self.training = False
+        for l in self._sub_layers.values():
+            l.eval()
+
+    # -- parameter management ----------------------------------------------
+    def create_parameter(self, shape, attr=None, dtype="float32", is_bias=False,
+                         default_initializer=None):
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        init = attr.initializer or default_initializer or (
+            Constant(0.0) if is_bias else Xavier()
+        )
+        value = _run_initializer(init, shape, dtype)
+        p = VarBase(value, name=attr.name, stop_gradient=not attr.trainable,
+                    persistable=True)
+        p.trainable = attr.trainable
+        p.regularizer = attr.regularizer
+        p.optimize_attr = {"learning_rate": attr.learning_rate}
+        return p
+
+    def add_parameter(self, name, parameter):
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def parameters(self, include_sublayers=True):
+        out = list(self._parameters.values())
+        if include_sublayers:
+            for l in self._sub_layers.values():
+                out.extend(l.parameters())
+        return [p for p in out if p is not None]
+
+    def sublayers(self, include_sublayers=True):
+        out = list(self._sub_layers.values())
+        if include_sublayers:
+            for l in self._sub_layers.values():
+                out.extend(l.sublayers())
+        return out
+
+    def named_parameters(self, prefix=""):
+        for name, p in self._parameters.items():
+            if p is not None:
+                yield (prefix + name if not prefix else prefix + "." + name), p
+        for lname, l in self._sub_layers.items():
+            sub_prefix = lname if not prefix else prefix + "." + lname
+            yield from l.named_parameters(sub_prefix)
+
+    # -- state dict ---------------------------------------------------------
+    def state_dict(self, include_sublayers=True):
+        return {name: p for name, p in self.named_parameters()}
+
+    def set_dict(self, state_dict, include_sublayers=True):
+        for name, p in self.named_parameters():
+            if name in state_dict:
+                val = state_dict[name]
+                p.set_value(val.numpy() if isinstance(val, VarBase) else val)
+
+    load_dict = set_dict
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_gradient()
+
+    # -- call ---------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        return self.forward(*inputs, **kwargs)
+
+    def __setattr__(self, name, value):
+        if isinstance(value, VarBase) and getattr(value, "persistable", False):
+            self.__dict__.setdefault("_parameters", {})[name] = value
+        elif isinstance(value, Layer):
+            self.__dict__.setdefault("_sub_layers", {})[name] = value
+        object.__setattr__(self, name, value)
+
+
+def _run_initializer(init, shape, dtype):
+    """Runs a static-graph initializer eagerly via a one-op program."""
+    import paddle_tpu.fluid as fluid
+
+    prog = framework.Program()
+    startup = framework.Program()
+    with framework.program_guard(prog, startup):
+        blk = prog.global_block()
+        v = blk.create_var(name="out", shape=list(shape), dtype=dtype)
+        init(v, blk)
+    exe = fluid.Executor()
+    from ..executor import Scope, scope_guard
+
+    with scope_guard(Scope()):
+        (val,) = exe.run(prog, fetch_list=["out"], return_numpy=False)
+    return val

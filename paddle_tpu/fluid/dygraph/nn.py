@@ -1,0 +1,454 @@
+"""DyGraph NN modules (reference ``dygraph/nn.py`` — 16 modules,
+SURVEY Appendix A)."""
+
+import numpy as np
+
+from .. import framework
+from ..initializer import Constant, Normal, Xavier
+from .base import VarBase, to_variable
+from .layers import Layer
+
+__all__ = [
+    "Conv2D", "Conv3D", "Pool2D", "FC", "Linear", "BatchNorm", "Embedding",
+    "LayerNorm", "PRelu", "BilinearTensorProduct", "Conv2DTranspose",
+    "GroupNorm", "SpectralNorm", "GRUUnit", "NCE", "TreeConv", "Dropout",
+]
+
+
+def _tracer():
+    t = framework._dygraph_tracer()
+    if t is None:
+        raise RuntimeError("dygraph modules require fluid.dygraph.guard()")
+    return t
+
+
+class Conv2D(Layer):
+    def __init__(self, name_scope=None, num_channels=None, num_filters=None,
+                 filter_size=None, stride=1, padding=0, dilation=1, groups=None,
+                 param_attr=None, bias_attr=None, act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._groups = groups or 1
+        self._stride = [stride] * 2 if isinstance(stride, int) else list(stride)
+        self._padding = [padding] * 2 if isinstance(padding, int) else list(padding)
+        self._dilation = [dilation] * 2 if isinstance(dilation, int) else list(dilation)
+        self._act = act
+        if isinstance(filter_size, int):
+            filter_size = [filter_size] * 2
+        fan = num_channels * filter_size[0] * filter_size[1] // self._groups
+        self.weight = self.create_parameter(
+            [num_filters, num_channels // self._groups] + list(filter_size),
+            param_attr, dtype, default_initializer=Normal(0.0, (2.0 / fan) ** 0.5))
+        self.bias = self.create_parameter([num_filters], bias_attr, dtype,
+                                          is_bias=True)
+
+    def forward(self, input):
+        t = _tracer()
+        (out,) = t.trace_op(
+            "conv2d", {"Input": [input], "Filter": [self.weight]}, ["Output"],
+            {"strides": self._stride, "paddings": self._padding,
+             "dilations": self._dilation, "groups": self._groups})
+        if self.bias is not None:
+            (out,) = t.trace_op("elementwise_add",
+                                {"X": [out], "Y": [self.bias]}, ["Out"],
+                                {"axis": 1})
+        if self._act:
+            (out,) = t.trace_op(self._act, {"X": [out]}, ["Out"], {})
+        return out
+
+
+class Conv3D(Layer):
+    def __init__(self, name_scope=None, num_channels=None, num_filters=None,
+                 filter_size=None, stride=1, padding=0, dilation=1, groups=None,
+                 param_attr=None, bias_attr=None, act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._groups = groups or 1
+        self._stride = [stride] * 3 if isinstance(stride, int) else list(stride)
+        self._padding = [padding] * 3 if isinstance(padding, int) else list(padding)
+        self._act = act
+        if isinstance(filter_size, int):
+            filter_size = [filter_size] * 3
+        self.weight = self.create_parameter(
+            [num_filters, num_channels // self._groups] + list(filter_size),
+            param_attr, dtype)
+        self.bias = self.create_parameter([num_filters], bias_attr, dtype,
+                                          is_bias=True)
+
+    def forward(self, input):
+        t = _tracer()
+        (out,) = t.trace_op(
+            "conv3d", {"Input": [input], "Filter": [self.weight]}, ["Output"],
+            {"strides": self._stride, "paddings": self._padding,
+             "groups": self._groups})
+        if self.bias is not None:
+            (out,) = t.trace_op("elementwise_add",
+                                {"X": [out], "Y": [self.bias]}, ["Out"],
+                                {"axis": 1})
+        if self._act:
+            (out,) = t.trace_op(self._act, {"X": [out]}, ["Out"], {})
+        return out
+
+
+class Conv2DTranspose(Layer):
+    def __init__(self, name_scope=None, num_channels=None, num_filters=None,
+                 filter_size=None, padding=0, stride=1, dilation=1, groups=None,
+                 param_attr=None, bias_attr=None, act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._stride = [stride] * 2 if isinstance(stride, int) else list(stride)
+        self._padding = [padding] * 2 if isinstance(padding, int) else list(padding)
+        self._act = act
+        if isinstance(filter_size, int):
+            filter_size = [filter_size] * 2
+        self.weight = self.create_parameter(
+            [num_channels, num_filters] + list(filter_size), param_attr, dtype)
+        self.bias = self.create_parameter([num_filters], bias_attr, dtype,
+                                          is_bias=True)
+
+    def forward(self, input):
+        t = _tracer()
+        (out,) = t.trace_op(
+            "conv2d_transpose", {"Input": [input], "Filter": [self.weight]},
+            ["Output"], {"strides": self._stride, "paddings": self._padding})
+        if self.bias is not None:
+            (out,) = t.trace_op("elementwise_add",
+                                {"X": [out], "Y": [self.bias]}, ["Out"],
+                                {"axis": 1})
+        if self._act:
+            (out,) = t.trace_op(self._act, {"X": [out]}, ["Out"], {})
+        return out
+
+
+class Pool2D(Layer):
+    def __init__(self, name_scope=None, pool_size=-1, pool_type="max",
+                 pool_stride=1, pool_padding=0, global_pooling=False,
+                 ceil_mode=False, exclusive=True, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._attrs = {
+            "pooling_type": pool_type,
+            "ksize": [pool_size] * 2 if isinstance(pool_size, int) else list(pool_size),
+            "strides": [pool_stride] * 2 if isinstance(pool_stride, int) else list(pool_stride),
+            "paddings": [pool_padding] * 2 if isinstance(pool_padding, int) else list(pool_padding),
+            "global_pooling": global_pooling,
+            "ceil_mode": ceil_mode,
+            "exclusive": exclusive,
+        }
+
+    def forward(self, input):
+        (out,) = _tracer().trace_op("pool2d", {"X": [input]}, ["Out"], self._attrs)
+        return out
+
+
+class Linear(Layer):
+    def __init__(self, input_dim, output_dim, param_attr=None, bias_attr=None,
+                 act=None, dtype="float32"):
+        super().__init__(None, dtype)
+        self._act = act
+        self.weight = self.create_parameter([input_dim, output_dim], param_attr,
+                                            dtype)
+        self.bias = self.create_parameter([output_dim], bias_attr, dtype,
+                                          is_bias=True)
+
+    def forward(self, input):
+        t = _tracer()
+        (out,) = t.trace_op("matmul", {"X": [input], "Y": [self.weight]},
+                            ["Out"], {"transpose_X": False, "transpose_Y": False,
+                                      "alpha": 1.0})
+        if self.bias is not None:
+            (out,) = t.trace_op("elementwise_add",
+                                {"X": [out], "Y": [self.bias]}, ["Out"],
+                                {"axis": -1})
+        if self._act:
+            (out,) = t.trace_op(self._act, {"X": [out]}, ["Out"], {})
+        return out
+
+
+class FC(Layer):
+    """Reference dygraph FC: flattens input to 2-D then matmul."""
+
+    def __init__(self, name_scope=None, size=None, num_flatten_dims=1,
+                 param_attr=None, bias_attr=None, act=None, dtype="float32",
+                 input_dim=None):
+        super().__init__(name_scope, dtype)
+        self._size = size
+        self._num_flatten_dims = num_flatten_dims
+        self._act = act
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self.weight = None
+        self.bias = None
+        if input_dim is not None:
+            self._build(input_dim)
+
+    def _build(self, in_features):
+        self.weight = self.create_parameter([in_features, self._size],
+                                            self._param_attr, self._dtype)
+        self.bias = self.create_parameter([self._size], self._bias_attr,
+                                          self._dtype, is_bias=True)
+
+    def forward(self, input):
+        if self.weight is None:
+            in_features = int(np.prod(input.shape[self._num_flatten_dims:]))
+            self._build(in_features)
+        t = _tracer()
+        (out,) = t.trace_op(
+            "mul", {"X": [input], "Y": [self.weight]}, ["Out"],
+            {"x_num_col_dims": self._num_flatten_dims, "y_num_col_dims": 1})
+        if self.bias is not None:
+            (out,) = t.trace_op("elementwise_add",
+                                {"X": [out], "Y": [self.bias]}, ["Out"],
+                                {"axis": self._num_flatten_dims})
+        if self._act:
+            (out,) = t.trace_op(self._act, {"X": [out]}, ["Out"], {})
+        return out
+
+
+class BatchNorm(Layer):
+    def __init__(self, name_scope=None, num_channels=None, act=None,
+                 is_test=False, momentum=0.9, epsilon=1e-5, param_attr=None,
+                 bias_attr=None, dtype="float32", data_layout="NCHW",
+                 use_global_stats=False):
+        super().__init__(name_scope, dtype)
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._act = act
+        self._data_layout = data_layout
+        self._use_global_stats = use_global_stats
+        self.weight = self.create_parameter([num_channels], param_attr, dtype,
+                                            default_initializer=Constant(1.0))
+        self.bias = self.create_parameter([num_channels], bias_attr, dtype,
+                                          is_bias=True)
+        self._mean = VarBase(np.zeros(num_channels, dtype), stop_gradient=True,
+                             persistable=True)
+        self._variance = VarBase(np.ones(num_channels, dtype),
+                                 stop_gradient=True, persistable=True)
+
+    def forward(self, input):
+        t = _tracer()
+        outs = t.trace_op(
+            "batch_norm",
+            {"X": [input], "Scale": [self.weight], "Bias": [self.bias],
+             "Mean": [self._mean], "Variance": [self._variance]},
+            ["Y", "MeanOut", "VarianceOut", "SavedMean", "SavedVariance"],
+            {"momentum": self._momentum, "epsilon": self._epsilon,
+             "is_test": not self.training, "data_layout": self._data_layout,
+             "use_global_stats": self._use_global_stats})
+        y = outs[0]
+        if outs[1] is not None:  # training: commit running stats
+            self._mean._ivar = outs[1]._ivar
+            self._variance._ivar = outs[2]._ivar
+        if self._act:
+            (y,) = t.trace_op(self._act, {"X": [y]}, ["Out"], {})
+        return y
+
+
+class Embedding(Layer):
+    def __init__(self, name_scope=None, size=None, is_sparse=False,
+                 is_distributed=False, padding_idx=None, param_attr=None,
+                 dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._padding_idx = -1 if padding_idx is None else padding_idx
+        self.weight = self.create_parameter(size, param_attr, dtype,
+                                            default_initializer=Xavier())
+
+    def forward(self, input):
+        (out,) = _tracer().trace_op(
+            "lookup_table", {"W": [self.weight], "Ids": [input]}, ["Out"],
+            {"padding_idx": self._padding_idx})
+        return out
+
+
+class LayerNorm(Layer):
+    def __init__(self, name_scope=None, normalized_shape=None, scale=True,
+                 shift=True, begin_norm_axis=1, epsilon=1e-5, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._epsilon = epsilon
+        self._begin_norm_axis = begin_norm_axis
+        self._act = act
+        n = int(np.prod(normalized_shape)) if normalized_shape else None
+        self.weight = self.create_parameter([n], param_attr, dtype,
+                                            default_initializer=Constant(1.0)) if scale else None
+        self.bias = self.create_parameter([n], bias_attr, dtype,
+                                          is_bias=True) if shift else None
+
+    def forward(self, input):
+        t = _tracer()
+        slots = {"X": [input]}
+        if self.weight is not None:
+            slots["Scale"] = [self.weight]
+        if self.bias is not None:
+            slots["Bias"] = [self.bias]
+        outs = t.trace_op("layer_norm", slots, ["Y", "Mean", "Variance"],
+                          {"epsilon": self._epsilon,
+                           "begin_norm_axis": self._begin_norm_axis})
+        y = outs[0]
+        if self._act:
+            (y,) = t.trace_op(self._act, {"X": [y]}, ["Out"], {})
+        return y
+
+
+class GroupNorm(Layer):
+    def __init__(self, name_scope=None, channels=None, groups=None,
+                 epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+                 dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._groups = groups
+        self._epsilon = epsilon
+        self._act = act
+        self.weight = self.create_parameter([channels], param_attr, dtype,
+                                            default_initializer=Constant(1.0))
+        self.bias = self.create_parameter([channels], bias_attr, dtype,
+                                          is_bias=True)
+
+    def forward(self, input):
+        t = _tracer()
+        outs = t.trace_op(
+            "group_norm",
+            {"X": [input], "Scale": [self.weight], "Bias": [self.bias]},
+            ["Y", "Mean", "Variance"],
+            {"groups": self._groups, "epsilon": self._epsilon})
+        y = outs[0]
+        if self._act:
+            (y,) = t.trace_op(self._act, {"X": [y]}, ["Out"], {})
+        return y
+
+
+class SpectralNorm(Layer):
+    def __init__(self, name_scope=None, weight_shape=None, dim=0,
+                 power_iters=1, eps=1e-12, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._dim = dim
+        self._power_iters = power_iters
+        self._eps = eps
+        h = weight_shape[dim]
+        w = int(np.prod(weight_shape)) // h
+        self._u = VarBase(np.random.randn(h).astype(dtype), stop_gradient=True,
+                          persistable=True)
+        self._v = VarBase(np.random.randn(w).astype(dtype), stop_gradient=True,
+                          persistable=True)
+
+    def forward(self, weight):
+        (out,) = _tracer().trace_op(
+            "spectral_norm", {"Weight": [weight], "U": [self._u], "V": [self._v]},
+            ["Out"], {"dim": self._dim, "power_iters": self._power_iters,
+                      "eps": self._eps})
+        return out
+
+
+class PRelu(Layer):
+    def __init__(self, name_scope=None, mode="all", channel=None,
+                 input_shape=None, param_attr=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._mode = mode
+        if mode == "all":
+            shape = [1]
+        elif mode == "channel":
+            shape = [channel]
+        else:
+            shape = list(input_shape[1:])
+        self.weight = self.create_parameter(shape, param_attr, dtype,
+                                            default_initializer=Constant(0.25))
+
+    def forward(self, input):
+        (out,) = _tracer().trace_op(
+            "prelu", {"X": [input], "Alpha": [self.weight]}, ["Out"],
+            {"mode": self._mode})
+        return out
+
+
+class BilinearTensorProduct(Layer):
+    def __init__(self, name_scope=None, input1_dim=None, input2_dim=None,
+                 output_dim=None, act=None, param_attr=None, bias_attr=None,
+                 dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._act = act
+        self.weight = self.create_parameter(
+            [output_dim, input1_dim, input2_dim], param_attr, dtype)
+        self.bias = self.create_parameter([1, output_dim], bias_attr, dtype,
+                                          is_bias=True)
+
+    def forward(self, x, y):
+        t = _tracer()
+        slots = {"X": [x], "Y": [y], "Weight": [self.weight]}
+        if self.bias is not None:
+            slots["Bias"] = [self.bias]
+        (out,) = t.trace_op("bilinear_tensor_product", slots, ["Out"], {})
+        if self._act:
+            (out,) = t.trace_op(self._act, {"X": [out]}, ["Out"], {})
+        return out
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, dropout_implementation="downgrade_in_infer"):
+        super().__init__()
+        self._p = p
+        self._impl = dropout_implementation
+
+    def forward(self, input):
+        outs = _tracer().trace_op(
+            "dropout", {"X": [input]}, ["Out", "Mask"],
+            {"dropout_prob": self._p, "is_test": not self.training,
+             "dropout_implementation": self._impl})
+        return outs[0]
+
+
+class GRUUnit(Layer):
+    """Single GRU step (reference dygraph GRUUnit)."""
+
+    def __init__(self, name_scope=None, size=None, param_attr=None,
+                 bias_attr=None, activation="tanh", gate_activation="sigmoid",
+                 dtype="float32"):
+        super().__init__(name_scope, dtype)
+        # size is 3*hidden in the reference API
+        self._hidden = size // 3
+        h = self._hidden
+        self.weight = self.create_parameter([h, 3 * h], param_attr, dtype)
+        self.bias = self.create_parameter([1, 3 * h], bias_attr, dtype,
+                                          is_bias=True)
+        self._activation = activation
+        self._gate_activation = gate_activation
+
+    def forward(self, input, hidden):
+        t = _tracer()
+        h = self._hidden
+        # gates = input + hidden @ W[:, :2h]; candidate uses r * (hidden @ W[:, 2h:])
+        (hw,) = t.trace_op("matmul", {"X": [hidden], "Y": [self.weight]},
+                           ["Out"], {"transpose_X": False, "transpose_Y": False,
+                                     "alpha": 1.0})
+        (g,) = t.trace_op("elementwise_add", {"X": [input], "Y": [hw]}, ["Out"],
+                          {"axis": -1})
+        if self.bias is not None:
+            (g,) = t.trace_op("elementwise_add", {"X": [g], "Y": [self.bias]},
+                              ["Out"], {"axis": -1})
+        import jax.numpy as jnp
+
+        # slice via ops for tape continuity
+        def sl(v, lo, hi):
+            (out,) = t.trace_op("slice", {"Input": [v]}, ["Out"],
+                                {"axes": [1], "starts": [lo], "ends": [hi]})
+            return out
+
+        u = sl(g, 0, h)
+        r = sl(g, h, 2 * h)
+        c = sl(g, 2 * h, 3 * h)
+        (u,) = t.trace_op(self._gate_activation, {"X": [u]}, ["Out"], {})
+        (r,) = t.trace_op(self._gate_activation, {"X": [r]}, ["Out"], {})
+        (rh,) = t.trace_op("elementwise_mul", {"X": [r], "Y": [hidden]},
+                           ["Out"], {"axis": -1})
+        (c2,) = t.trace_op("elementwise_add", {"X": [c], "Y": [rh]}, ["Out"],
+                           {"axis": -1})
+        (c3,) = t.trace_op(self._activation, {"X": [c2]}, ["Out"], {})
+        one_minus_u = -u + 1.0
+        new_h = u * hidden + one_minus_u * c3
+        return new_h, g, c3
+
+
+class NCE(Layer):
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError(
+            "NCE requires dynamic negative sampling; planned with the sparse "
+            "subsystem (parallel/sparse.py)")
+
+
+class TreeConv(Layer):
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError("TreeConv planned with detection/graph ops")

@@ -229,6 +229,38 @@ class Executor:
         return _CompiledStep(jfn, state_names, fetch_names)
 
     # convenience ------------------------------------------------------
+    def as_function(self, program, feed_specs, fetch_list, scope=None):
+        """Exposes a Program block as a pure jittable function
+        ``fn(state_dict, feed_dict, rng_key) -> (fetches, new_state, key)``
+        plus example args. ``feed_specs``: {name: example ndarray}."""
+        import jax
+
+        scope = scope or global_scope()
+        block = program.global_block()
+        fetch_names = [v.name if isinstance(v, Variable) else str(v) for v in fetch_list]
+        state_names = sorted(
+            v.name
+            for v in program.list_vars()
+            if v.persistable and scope.has_var(v.name)
+        )
+
+        def step(state, feed_vals, rng_key):
+            env = {}
+            env.update(state)
+            env.update(feed_vals)
+            ctx = LowerCtx(block, env, rng_key)
+            lower_block(ctx, block)
+            fetches = [ctx.get(n) for n in fetch_names]
+            new_state = {n: env[n] for n in state if n in env}
+            new_state.update({n: env[n] for n in ctx.written if n in env})
+            return fetches, new_state, ctx.rng_key
+
+        state = {n: scope.find_var(n) for n in state_names}
+        rng = scope.find_var(RNG_STATE_VAR)
+        if rng is None:
+            rng = jax.random.PRNGKey(program.random_seed or 0)
+        return step, (state, dict(feed_specs), rng)
+
     def close(self):
         self._cache.clear()
 

@@ -124,10 +124,71 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None, grad_clip=None):
+        if framework.in_dygraph_mode():
+            return self._dygraph_minimize(loss, parameter_list)
         params_grads = self.backward(loss, startup_program, parameter_list,
                                      no_grad_set)
         optimize_ops = self.apply_gradients(params_grads)
         return optimize_ops, params_grads
+
+    # -- dygraph (eager) path ----------------------------------------------
+    def _dygraph_minimize(self, loss, parameter_list):
+        """Eager update: runs loss.backward() if grads are absent, then the
+        optimizer op eagerly per param (reference dygraph minimize)."""
+        from .dygraph.base import VarBase
+
+        tracer = framework._dygraph_tracer()
+        if parameter_list is None:
+            raise ValueError("dygraph minimize needs parameter_list "
+                             "(e.g. model.parameters())")
+        if tracer._tape:
+            loss.backward()
+        import jax.numpy as jnp
+
+        if not hasattr(self, "_eager_state"):
+            self._eager_state = {}
+        if isinstance(self._learning_rate, VarBase):
+            lr = float(self._learning_rate.numpy().reshape(-1)[0])
+        elif callable(self._learning_rate):
+            lr = float(self._learning_rate())
+        else:
+            lr = float(self._learning_rate)
+        params_grads = []
+        with tracer._no_grad_guard():
+            for p in parameter_list:
+                if p is None or p._grad is None or p.stop_gradient:
+                    continue
+                g = p._grad
+                if getattr(p, "regularizer", None) is not None or \
+                        self.regularization is not None:
+                    reg = getattr(p, "regularizer", None) or self.regularization
+                    from .regularizer import L1DecayRegularizer
+
+                    if isinstance(reg, L1DecayRegularizer):
+                        g = g + reg._coeff * jnp.sign(p._ivar)
+                    else:
+                        g = g + reg._coeff * p._ivar
+                p._ivar = self._eager_update(p, g, lr)
+                params_grads.append((p, g))
+        return None, params_grads
+
+    def _eager_state_for(self, p, names_and_init):
+        import jax.numpy as jnp
+
+        st = self._eager_state.get(id(p))
+        if st is None:
+            st = {}
+            for name, init in names_and_init:
+                if np.isscalar(init):
+                    st[name] = jnp.full((1,), init, dtype=p._ivar.dtype)
+                else:
+                    st[name] = jnp.full(p._ivar.shape, 0.0, dtype=p._ivar.dtype)
+            self._eager_state[id(p)] = st
+        return st
+
+    def _eager_update(self, p, g, lr):
+        raise NotImplementedError(
+            "%s has no eager update; use static graph mode" % type(self).__name__)
 
     def _lr_for(self, param):
         """Per-param LR multiplier (param.optimize_attr['learning_rate'])."""
@@ -145,6 +206,9 @@ class SGDOptimizer(Optimizer):
     def __init__(self, learning_rate, regularization=None, name=None):
         super().__init__(learning_rate, regularization, name)
 
+    def _eager_update(self, p, g, lr):
+        return p._ivar - lr * g
+
     def _append_optimize_op(self, block, param_and_grad):
         param, grad = param_and_grad
         return block.append_op(
@@ -161,6 +225,14 @@ class MomentumOptimizer(Optimizer):
         super().__init__(learning_rate, regularization, name)
         self._momentum = momentum
         self._use_nesterov = use_nesterov
+
+    def _eager_update(self, p, g, lr):
+        st = self._eager_state_for(p, [("velocity", None)])
+        v_new = self._momentum * st["velocity"] + g
+        st["velocity"] = v_new
+        if self._use_nesterov:
+            return p._ivar - (g + self._momentum * v_new) * lr
+        return p._ivar - lr * v_new
 
     def _create_accumulators(self, block, parameters):
         for p in parameters:
@@ -231,6 +303,21 @@ class AdamOptimizer(Optimizer):
                  epsilon=1e-8, regularization=None, name=None, lazy_mode=False):
         super().__init__(learning_rate, regularization, name)
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _eager_update(self, p, g, lr):
+        import jax.numpy as jnp
+
+        st = self._eager_state_for(
+            p, [("m", None), ("v", None), ("b1p", self._beta1),
+                ("b2p", self._beta2)])
+        st["m"] = self._beta1 * st["m"] + (1 - self._beta1) * g
+        st["v"] = self._beta2 * st["v"] + (1 - self._beta2) * jnp.square(g)
+        b1p, b2p = st["b1p"].reshape(()), st["b2p"].reshape(())
+        lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+        new_p = p._ivar - lr_t * st["m"] / (jnp.sqrt(st["v"]) + self._epsilon)
+        st["b1p"] = st["b1p"] * self._beta1
+        st["b2p"] = st["b2p"] * self._beta2
+        return new_p
 
     def _create_accumulators(self, block, parameters):
         for p in parameters:

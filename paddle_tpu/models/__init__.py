@@ -1,0 +1,7 @@
+"""Model zoo built on the paddle_tpu static-graph API.
+
+Parity targets (BASELINE.md configs): LeNet/MNIST, ResNet-50, BERT/ERNIE,
+DeepFM CTR, Transformer NMT.
+"""
+
+from . import lenet  # noqa: F401
