@@ -64,10 +64,25 @@ class CompiledProgram:
                            exec_strategy=None, share_vars_from=None,
                            places=None):
         self._is_data_parallel = True
+        self._mode = "gspmd"
         self._loss_name = loss_name
         if build_strategy is not None:
             self._build_strategy = build_strategy
         self._places = places
+        return self
+
+    def with_explicit_collectives(self, loss_name=None, places=None,
+                                  mesh_axes=("dp",)):
+        """SPMD execution via shard_map: every op runs per-shard and the
+        program's explicit collective ops (c_allreduce_* etc., inserted by
+        the Fleet/collective transpiler) lower to real XLA collectives over
+        the named mesh axes. This is the reference's Fleet-collective mode
+        (transpiler/collective.py GradAllReduce) on ICI."""
+        self._is_data_parallel = True
+        self._mode = "shard_map"
+        self._loss_name = loss_name
+        self._places = places
+        self._mesh_axes = tuple(mesh_axes)
         return self
 
     # ------------------------------------------------------------------
@@ -82,13 +97,84 @@ class CompiledProgram:
             devices = self._places if self._places is not None else jax.devices()
             if isinstance(devices, int):
                 devices = jax.devices()[:devices]
-            self._mesh = Mesh(np.array(devices), ("dp",))
+            axes = getattr(self, "_mesh_axes", ("dp",))
+            if len(axes) == 1:
+                self._mesh = Mesh(np.array(devices), axes)
+            else:
+                arr = np.array(devices).reshape(
+                    self._mesh_axis_sizes(len(devices), axes))
+                self._mesh = Mesh(arr, axes)
         return self._mesh
 
+    @staticmethod
+    def _mesh_axis_sizes(n, axes):
+        # default: first axis takes all devices unless sizes were provided
+        return (n,) + (1,) * (len(axes) - 1)
+
     def _on_trace_begin(self, ctx):
-        pass
+        if getattr(self, "_mode", "gspmd") == "shard_map":
+            mesh = self.mesh
+            ctx.shard_axes = list(mesh.axis_names)
+            ctx.shard_sizes = dict(mesh.shape)
 
     def wrap_step(self, step, program, block, feed, fetch_names, state_names):
+        if getattr(self, "_mode", "gspmd") == "shard_map":
+            return self._wrap_step_shard_map(step, feed, fetch_names,
+                                             state_names)
+        return self._wrap_step_gspmd(step, feed, fetch_names, state_names)
+
+    def _wrap_step_shard_map(self, step, feed, fetch_names, state_names):
+        """SPMD per-shard execution; program collectives do the syncing."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        mesh = self.mesh
+        axis = mesh.axis_names[0]
+        repl = NamedSharding(mesh, P())
+
+        def feed_spec(name):
+            arr = feed[name]
+            ndim = np.ndim(arr)
+            if ndim >= 1 and np.shape(arr)[0] % mesh.shape[axis] == 0:
+                return P(axis, *([None] * (ndim - 1)))
+            return P()
+
+        feed_specs = {n: feed_spec(n) for n in feed}
+
+        def inner(state, feed_vals, rng):
+            fetches, new_state, new_rng = step(state, feed_vals, rng)
+            # fetches are per-shard; average them for the host (the
+            # reference returns the averaged loss across trainers)
+            out = []
+            for f in fetches:
+                if jnp.issubdtype(f.dtype, jnp.floating):
+                    out.append(jax.lax.pmean(f, axis))
+                else:
+                    out.append(jax.lax.pmax(f, axis))
+            return out, new_state, new_rng
+
+        smapped = jax.shard_map(
+            inner,
+            mesh=mesh,
+            in_specs=({n: P() for n in state_names}, feed_specs, P()),
+            out_specs=([P() for _ in fetch_names], {n: P() for n in state_names}, P()),
+            check_vma=False,
+        )
+        jfn = jax.jit(smapped, donate_argnums=(0,))
+        feed_shardings = {n: NamedSharding(mesh, feed_specs[n]) for n in feed}
+
+        def fn(state, feed_vals, rng):
+            state = {k: jax.device_put(v, repl) for k, v in state.items()}
+            feed_vals = {k: jax.device_put(v, feed_shardings[k])
+                         for k, v in feed_vals.items()}
+            rng = jax.device_put(rng, repl)
+            return jfn(state, feed_vals, rng)
+
+        return fn
+
+    def _wrap_step_gspmd(self, step, feed, fetch_names, state_names):
         """jit the lowered step under the mesh with DP shardings."""
         import jax
         from jax.sharding import NamedSharding

@@ -1,0 +1,90 @@
+"""Fleet abstract base (reference ``incubate/fleet/base/fleet_base.py:38``:
+is_worker:85, is_server:139, init:184, distributed_optimizer:222,
+save_persistables:236; DistributedOptimizer:240)."""
+
+import abc
+
+from .... import framework
+from .role_maker import PaddleCloudRoleMaker, RoleMakerBase
+
+
+class Fleet(abc.ABC):
+    def __init__(self):
+        self._role_maker = None
+        self._is_initialized = False
+        self._optimizer = None
+
+    def init(self, role_maker=None):
+        if role_maker is None:
+            role_maker = PaddleCloudRoleMaker()
+        role_maker.generate_role()
+        self._role_maker = role_maker
+        self._is_initialized = True
+
+    def is_first_worker(self):
+        return self._role_maker.is_first_worker()
+
+    def worker_index(self):
+        return self._role_maker.worker_index()
+
+    def worker_num(self):
+        return self._role_maker.worker_num()
+
+    def is_worker(self):
+        return self._role_maker.is_worker()
+
+    def server_num(self):
+        return self._role_maker.server_num()
+
+    def server_index(self):
+        return self._role_maker.server_index()
+
+    def is_server(self):
+        return self._role_maker.is_server()
+
+    def worker_endpoints(self):
+        return self._role_maker.get_trainer_endpoints()
+
+    def server_endpoints(self):
+        return self._role_maker.get_pserver_endpoints()
+
+    def split_files(self, files):
+        """Round-robin file shards per worker (reference fleet utility)."""
+        idx = self.worker_index()
+        n = self.worker_num()
+        return files[idx::n]
+
+    @abc.abstractmethod
+    def distributed_optimizer(self, optimizer, strategy=None):
+        ...
+
+    @abc.abstractmethod
+    def init_worker(self):
+        ...
+
+    @abc.abstractmethod
+    def init_server(self, model_dir=None):
+        ...
+
+    @abc.abstractmethod
+    def run_server(self):
+        ...
+
+    @abc.abstractmethod
+    def stop_worker(self):
+        ...
+
+    @abc.abstractmethod
+    def save_persistables(self, executor, dirname, main_program=None):
+        ...
+
+
+class DistributedOptimizer(abc.ABC):
+    def __init__(self, optimizer, strategy=None):
+        self._optimizer = optimizer
+        self._strategy = strategy
+
+    @abc.abstractmethod
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        ...

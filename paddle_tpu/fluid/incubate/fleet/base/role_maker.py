@@ -1,0 +1,97 @@
+"""Role makers: who am I in the job? (reference
+``incubate/fleet/base/role_maker.py:25-497`` — MPI, PaddleCloud env,
+UserDefined). TPU-native: roles come from env vars or jax.distributed;
+worker = chip-owning process; server roles map to host-store shards."""
+
+import os
+
+__all__ = ["Role", "RoleMakerBase", "PaddleCloudRoleMaker",
+           "UserDefinedRoleMaker", "UserDefinedCollectiveRoleMaker"]
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+
+
+class RoleMakerBase:
+    def __init__(self):
+        self._role = Role.WORKER
+        self._current_id = 0
+        self._worker_endpoints = []
+        self._server_endpoints = []
+        self._generated = False
+
+    def generate_role(self):
+        self._generated = True
+
+    def is_worker(self):
+        return self._role == Role.WORKER
+
+    def is_server(self):
+        return self._role == Role.SERVER
+
+    def is_first_worker(self):
+        return self.is_worker() and self._current_id == 0
+
+    def worker_index(self):
+        return self._current_id
+
+    def server_index(self):
+        return self._current_id
+
+    def worker_num(self):
+        return max(len(self._worker_endpoints), 1)
+
+    def server_num(self):
+        return len(self._server_endpoints)
+
+    def get_trainer_endpoints(self):
+        return self._worker_endpoints
+
+    def get_pserver_endpoints(self):
+        return self._server_endpoints
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """Reads the reference's env-var contract: PADDLE_TRAINER_ID,
+    PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ENDPOINTS, TRAINING_ROLE,
+    PADDLE_PORT/PADDLE_PSERVERS (role_maker.py:327)."""
+
+    def __init__(self, is_collective=False):
+        super().__init__()
+        self._is_collective = is_collective
+
+    def generate_role(self):
+        if self._generated:
+            return
+        role = os.environ.get("TRAINING_ROLE", "TRAINER").upper()
+        if role in ("TRAINER", "WORKER"):
+            self._role = Role.WORKER
+            self._current_id = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+        else:
+            self._role = Role.SERVER
+            self._current_id = int(os.environ.get("PADDLE_PSERVER_ID", 0))
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self._worker_endpoints = [e for e in eps.split(",") if e]
+        pseps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST",
+                               os.environ.get("PADDLE_PSERVERS", ""))
+        self._server_endpoints = [e for e in pseps.split(",") if e]
+        self._generated = True
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    def __init__(self, current_id=0, role=Role.WORKER, worker_num=1,
+                 server_endpoints=None):
+        super().__init__()
+        self._current_id = current_id
+        self._role = role
+        self._worker_endpoints = ["?"] * worker_num
+        self._server_endpoints = server_endpoints or []
+
+
+class UserDefinedCollectiveRoleMaker(RoleMakerBase):
+    def __init__(self, current_id=0, worker_endpoints=None):
+        super().__init__()
+        self._current_id = current_id
+        self._worker_endpoints = worker_endpoints or ["127.0.0.1:6170"]

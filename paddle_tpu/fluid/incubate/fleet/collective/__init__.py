@@ -1,0 +1,144 @@
+"""Fleet collective mode (reference ``incubate/fleet/collective/__init__.py``:
+DistributedStrategy:134, CollectiveOptimizer:182, fleet singleton).
+
+TPU-native execution: after ``fleet.distributed_optimizer(opt).minimize``,
+the program carries explicit c_allreduce ops (GradAllReduce transpile) and
+``fleet.main_program`` runs under shard_map on the device mesh
+(``CompiledProgram.with_explicit_collectives``) — psum over ICI replaces the
+NCCL ring. Multi-host: jax.distributed coordinates; the mesh spans hosts
+(DCN between slices handled by XLA's collective hierarchy)."""
+
+from .... import framework
+from ....compiler import BuildStrategy, CompiledProgram, ExecutionStrategy
+from ....transpiler.collective import GradAllReduce, LocalSGD
+from ..base.fleet_base import DistributedOptimizer, Fleet
+from ..base.role_maker import PaddleCloudRoleMaker
+
+__all__ = ["fleet", "Collective", "DistributedStrategy", "CollectiveOptimizer"]
+
+
+class DistributedStrategy:
+    """Reference ``collective/__init__.py:134``."""
+
+    def __init__(self):
+        self.mode = "grad_allreduce"  # or "local_sgd"
+        self.collective_mode = "grad_allreduce"
+        self.nccl_comm_num = 1
+        self.use_hierarchical_allreduce = False
+        self.use_local_sgd = False
+        self.local_sgd_k_steps = 1
+        self.fuse_all_reduce_ops = True
+        self.forward_recompute = False
+        self.recompute_checkpoints = []
+        self.use_amp = False
+        self.amp_loss_scale = 2.0 ** 15
+        self.exec_strategy = ExecutionStrategy()
+        self.build_strategy = BuildStrategy()
+
+
+class Collective(Fleet):
+    def __init__(self):
+        super().__init__()
+        self._origin_program = None
+        self.main_program = None
+        self.startup_program = None
+        self._compiled = None
+
+    def init_worker(self):
+        # multi-host bootstrap would call jax.distributed.initialize() here;
+        # single-host (one process owning the chips) needs nothing.
+        pass
+
+    def init_server(self, model_dir=None):
+        raise NotImplementedError(
+            "collective mode has no servers (reference parity)")
+
+    def run_server(self):
+        raise NotImplementedError(
+            "collective mode has no servers (reference parity)")
+
+    def stop_worker(self):
+        pass
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        self._optimizer = CollectiveOptimizer(optimizer, strategy)
+        return self._optimizer
+
+    def compiled_program(self, loss_name=None):
+        """The runnable artifact: shard_map over the device mesh."""
+        if self._compiled is None:
+            self._compiled = CompiledProgram(
+                self.main_program
+            ).with_explicit_collectives(loss_name=loss_name)
+        return self._compiled
+
+    def save_inference_model(self, executor, dirname, feeded_var_names,
+                             target_vars, main_program=None,
+                             export_for_deployment=True):
+        from .... import io
+
+        io.save_inference_model(dirname, feeded_var_names, target_vars,
+                                executor, main_program or self._origin_program)
+
+    def save_persistables(self, executor, dirname, main_program=None,
+                          filename=None):
+        from .... import io
+
+        io.save_persistables(executor, dirname,
+                             main_program or self._origin_program, filename)
+
+
+fleet = Collective()
+
+
+class CollectiveOptimizer(DistributedOptimizer):
+    """Reference ``collective/__init__.py:182``."""
+
+    def __init__(self, optimizer, strategy=None):
+        super().__init__(optimizer, strategy or DistributedStrategy())
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return self._optimizer.backward(loss, startup_program, parameter_list,
+                                        no_grad_set, callbacks)
+
+    def apply_gradients(self, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        opt = self._optimizer
+        strategy = self._strategy
+        if strategy.forward_recompute:
+            from ....optimizer import RecomputeOptimizer
+
+            opt = RecomputeOptimizer(opt)
+            opt._set_checkpoints(strategy.recompute_checkpoints)
+        if strategy.use_amp:
+            from ....contrib.mixed_precision import decorate
+
+            opt = decorate(opt, init_loss_scaling=strategy.amp_loss_scale,
+                           use_dynamic_loss_scaling=True)
+
+        main_program = loss.block.program
+        startup_program = startup_program or framework.default_startup_program()
+        fleet._origin_program = main_program
+        optimize_ops, params_grads = opt.minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+
+        import jax
+
+        nranks = max(fleet.worker_num(), 1)
+        if nranks == 1:
+            # single process: world = local device mesh
+            nranks = len(jax.devices())
+        if strategy.use_local_sgd:
+            t = LocalSGD(nranks=nranks, k_steps=strategy.local_sgd_k_steps)
+        else:
+            t = GradAllReduce(nranks=nranks)
+        t.transpile(startup_program, main_program,
+                    rank=fleet.worker_index(),
+                    endpoints=fleet.worker_endpoints() or None)
+        fleet.main_program = main_program
+        fleet.startup_program = startup_program
+        return optimize_ops, params_grads

@@ -1,7 +1,9 @@
 """Layers namespace (reference ``python/paddle/fluid/layers/``)."""
 
 from .. import ops as _ops  # registers all lowering rules  # noqa: F401
-from . import io, learning_rate_scheduler, loss, metric_op, nn, ops, tensor
+from . import (control_flow, io, learning_rate_scheduler, loss, metric_op,
+               nn, ops, tensor)
+from .control_flow import *  # noqa: F401,F403
 from .io import data
 from .learning_rate_scheduler import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403

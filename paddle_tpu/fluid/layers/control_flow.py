@@ -1,0 +1,528 @@
+"""Control-flow layers (reference ``layers/control_flow.py``: While, Switch,
+cond, case, switch_case, StaticRNN, while_loop, increment, less_than, ...).
+
+Comparison/logical/increment live in nn/elementwise; this module adds the
+block-structured constructs. Sub-blocks are real IR blocks; execution lowers
+them to lax.cond/while_loop/scan (ops/control_flow.py).
+"""
+
+import numpy as np
+
+from .. import framework
+from ..framework import Variable
+from ..layer_helper import LayerHelper
+
+__all__ = ["While", "Switch", "cond", "case", "switch_case", "while_loop",
+           "StaticRNN", "increment", "less_than", "less_equal", "greater_than",
+           "greater_equal", "equal", "not_equal", "is_empty", "Print",
+           "array_write", "array_read", "array_length", "create_array"]
+
+
+def _compare(op_type, x, y, cond=None):
+    helper = LayerHelper(op_type)
+    if cond is None:
+        cond = helper.create_variable_for_type_inference("bool")
+    helper.append_op(type=op_type, inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [cond]})
+    return cond
+
+
+def less_than(x, y, force_cpu=None, cond=None):
+    return _compare("less_than", x, y, cond)
+
+
+def less_equal(x, y, cond=None):
+    return _compare("less_equal", x, y, cond)
+
+
+def greater_than(x, y, cond=None):
+    return _compare("greater_than", x, y, cond)
+
+
+def greater_equal(x, y, cond=None):
+    return _compare("greater_equal", x, y, cond)
+
+
+def equal(x, y, cond=None):
+    return _compare("equal", x, y, cond)
+
+
+def not_equal(x, y, cond=None):
+    return _compare("not_equal", x, y, cond)
+
+
+def increment(x, value=1.0, in_place=True):
+    helper = LayerHelper("increment")
+    if in_place:
+        out = x
+    else:
+        out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="increment", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"step": float(value)})
+    return out
+
+
+def is_empty(x, cond=None):
+    from . import tensor
+
+    helper = LayerHelper("is_empty")
+    if cond is None:
+        cond = helper.create_variable_for_type_inference("bool")
+    # static shapes: emptiness is a compile-time property
+    empty = int(np.prod([d for d in x.shape if d >= 0])) == 0
+    return tensor.assign(np.asarray([empty]), cond)
+
+
+def Print(input, first_n=-1, message=None, summarize=-1, print_tensor_name=True,
+          print_tensor_type=True, print_tensor_shape=True,
+          print_tensor_lod=True, print_phase="both"):
+    """Debug print via jax.debug.print host callback (reference print_op)."""
+    helper = LayerHelper("print")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="print", inputs={"In": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"message": message or ""})
+    return out
+
+
+def _register_print_op():
+    from ..registry import register
+
+    @register("print")
+    def _print(ctx, op):
+        import jax
+
+        x = ctx.get_input(op, "In")
+        msg = op.attr("message", "")
+        jax.debug.print(msg + "{x}", x=x)
+        ctx.set_output(op, "Out", x)
+
+
+_register_print_op()
+
+
+class While:
+    """Reference ``layers/control_flow.py`` While: body mutates outer vars;
+    the condition var must be reassigned inside the body.
+
+        cond = layers.less_than(i, n)
+        w = layers.While(cond)
+        with w.block():
+            ...
+            layers.increment(i)
+            layers.less_than(i, n, cond=cond)
+    """
+
+    def __init__(self, cond, is_test=False, name=None):
+        self.cond_var = cond
+        self.helper = LayerHelper("while", name=name)
+
+    import contextlib
+
+    @contextlib.contextmanager
+    def block(self):
+        program = self.helper.main_program
+        parent_idx = program.current_block_idx
+        sub_block = program._create_block()
+        try:
+            yield
+        finally:
+            program._rollback()
+        program.current_block().append_op(
+            "while",
+            inputs={"Condition": [self.cond_var]},
+            outputs={},
+            attrs={"sub_block": sub_block.idx},
+        )
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None,
+               maximum_trip_count=None):
+    """Functional while (newer-paddle-style API; also the cleanest XLA
+    mapping). cond/body are python callables building sub-blocks.
+
+    ``maximum_trip_count``: if given, lowers to a bounded masked scan, which
+    is reverse-differentiable (XLA cannot reverse-diff unbounded loops; the
+    reference pays the same cost by re-running while bodies in while_grad)."""
+    helper = LayerHelper("while_loop", name=name)
+    program = helper.main_program
+
+    # build condition sub-block
+    cond_block = program._create_block()
+    cond_out = cond(*loop_vars)
+    program._rollback()
+
+    body_block = program._create_block()
+    body_outs = body(*loop_vars)
+    program._rollback()
+    body_outs = body_outs if isinstance(body_outs, (list, tuple)) else [body_outs]
+
+    out_vars = [
+        helper.block.create_var(
+            name=helper.name_prefix + ".out%d" % i, shape=v.shape, dtype=v.dtype)
+        for i, v in enumerate(loop_vars)
+    ]
+    helper.append_op(
+        type="while_loop",
+        inputs={"LoopVars": list(loop_vars)},
+        outputs={"Out": out_vars},
+        attrs={
+            "cond_block": cond_block.idx,
+            "body_block": body_block.idx,
+            "cond_out": cond_out.name,
+            "loop_var_names": [v.name for v in loop_vars],
+            "body_out_names": [v.name for v in body_outs],
+            "out_names": [v.name for v in out_vars],
+            "maximum_trip_count": maximum_trip_count or 0,
+        },
+    )
+    return out_vars
+
+
+def _register_while_loop_op():
+    from ..registry import LowerCtx, register, registry
+
+    @register("while_loop")
+    def _while_loop(ctx, op):
+        import jax
+
+        program = ctx.program
+        cond_block = program.block(op.attr("cond_block"))
+        body_block = program.block(op.attr("body_block"))
+        names = op.attr("loop_var_names")
+        body_out_names = op.attr("body_out_names")
+        cond_out = op.attr("cond_out")
+        out_names = op.attr("out_names")
+        snapshot = dict(ctx.env)
+
+        def run_block(block, env):
+            sub = LowerCtx(block, env, ctx.rng_key, mesh=ctx.mesh)
+            for o in block.ops:
+                registry.get(o.type).lower(sub, o)
+
+        def cond_fun(carry):
+            env = dict(snapshot)
+            env.update(dict(zip(names, carry)))
+            run_block(cond_block, env)
+            c = env[cond_out]
+            return c.reshape(()) if hasattr(c, "reshape") else c
+
+        def body_fun(carry):
+            env = dict(snapshot)
+            env.update(dict(zip(names, carry)))
+            run_block(body_block, env)
+            return tuple(env[n] for n in body_out_names)
+
+        init = tuple(ctx.get(n) for n in names)
+        max_trips = op.attr("maximum_trip_count", 0)
+        if max_trips:
+            # bounded masked scan: differentiable (while_grad analogue)
+            def scan_step(carry, _):
+                active = cond_fun(carry)
+                new = body_fun(carry)
+                import jax.numpy as jnp
+
+                merged = tuple(
+                    jnp.where(active, n_, c_) for n_, c_ in zip(new, carry)
+                )
+                return merged, None
+
+            final, _ = jax.lax.scan(scan_step, init, None, length=max_trips)
+        else:
+            final = jax.lax.while_loop(cond_fun, body_fun, init)
+        for n, v in zip(out_names, final):
+            ctx.set(n, v)
+
+
+_register_while_loop_op()
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """Functional conditional (reference ``layers.cond``)."""
+    helper = LayerHelper("cond", name=name)
+    program = helper.main_program
+
+    true_block = program._create_block()
+    true_out = true_fn() if true_fn is not None else None
+    program._rollback()
+    false_block = program._create_block()
+    false_out = false_fn() if false_fn is not None else None
+    program._rollback()
+
+    def _flat(o):
+        if o is None:
+            return []
+        return list(o) if isinstance(o, (list, tuple)) else [o]
+
+    t_outs, f_outs = _flat(true_out), _flat(false_out)
+    assert len(t_outs) == len(f_outs), "cond branches must return same arity"
+    outs = [
+        helper.block.create_var(name=helper.name_prefix + ".out%d" % i,
+                                shape=v.shape, dtype=v.dtype)
+        for i, v in enumerate(t_outs)
+    ]
+    helper.append_op(
+        type="cond",
+        inputs={"Cond": [pred]},
+        outputs={"Out": outs},
+        attrs={
+            "true_block": true_block.idx,
+            "false_block": false_block.idx,
+            "true_outs": [v.name for v in t_outs],
+            "false_outs": [v.name for v in f_outs],
+            "out_names": [v.name for v in outs],
+        },
+    )
+    if true_out is None:
+        return None
+    if isinstance(true_out, (list, tuple)):
+        return outs
+    return outs[0]
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """Reference ``layers.case``: first true pred wins."""
+    if not pred_fn_pairs:
+        raise ValueError("case needs at least one (pred, fn) pair")
+    pred, fn = pred_fn_pairs[0]
+    rest = pred_fn_pairs[1:]
+    if rest:
+        return cond(pred, fn, lambda: case(rest, default))
+    if default is None:
+        default = fn
+    return cond(pred, fn, default)
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Reference ``layers.switch_case``: dispatch on integer index."""
+    from . import tensor
+
+    pairs = []
+    items = branch_fns.items() if isinstance(branch_fns, dict) else enumerate(branch_fns)
+    for idx, fn in items:
+        iv = tensor.fill_constant([1], "int64", int(idx))
+        pred = equal(branch_index, iv)
+        pairs.append((pred, fn))
+    return case(pairs, default)
+
+
+class Switch:
+    """Reference Switch/case blocks used for LR scheduling. Implemented over
+    cond chains; usable only in the `with switch.case(cond): assign(...)`
+    idiom where each branch assigns the same output vars."""
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("switch", name=name)
+        self._cases = []  # (cond_var_or_None, block_idx)
+
+    import contextlib
+
+    @contextlib.contextmanager
+    def case(self, condition):
+        program = self.helper.main_program
+        blk = program._create_block()
+        try:
+            yield
+        finally:
+            program._rollback()
+        self._cases.append((condition, blk.idx))
+
+    @contextlib.contextmanager
+    def default(self):
+        program = self.helper.main_program
+        blk = program._create_block()
+        try:
+            yield
+        finally:
+            program._rollback()
+        self._cases.append((None, blk.idx))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if exc_type is not None:
+            return False
+        helper = self.helper
+        helper.append_op(
+            type="switch",
+            inputs={"Conds": [c for c, _ in self._cases if c is not None]},
+            outputs={},
+            attrs={
+                "blocks": [b for _, b in self._cases],
+                "has_default": any(c is None for c, _ in self._cases),
+            },
+        )
+        return False
+
+
+def _register_switch_op():
+    from ..registry import LowerCtx, register, registry
+
+    @register("switch")
+    def _switch(ctx, op):
+        import jax
+
+        program = ctx.program
+        blocks = [program.block(i) for i in op.attr("blocks")]
+        conds = ctx.get_inputs(op, "Conds")
+        # carried = union of writes across branches present in outer env
+        carried = []
+        for blk in blocks:
+            for op2 in blk.ops:
+                for n in op2.output_arg_names():
+                    if n in ctx.env and n not in carried:
+                        carried.append(n)
+        snapshot = dict(ctx.env)
+
+        def make_branch(blk):
+            def fn(vals):
+                env = dict(snapshot)
+                env.update(dict(zip(carried, vals)))
+                sub = LowerCtx(blk, env, ctx.rng_key, mesh=ctx.mesh)
+                for o in blk.ops:
+                    registry.get(o.type).lower(sub, o)
+                return tuple(env[n] for n in carried)
+
+            return fn
+
+        vals = tuple(ctx.env[n] for n in carried)
+        # chain: last-to-first so first true cond wins
+        n_conds = len(conds)
+        result = vals
+        if op.attr("has_default"):
+            result = make_branch(blocks[-1])(vals)
+        for i in range(n_conds - 1, -1, -1):
+            c = conds[i].reshape(()) if hasattr(conds[i], "reshape") else conds[i]
+            result = jax.lax.cond(c, make_branch(blocks[i]),
+                                  lambda v, _r=result: _r, vals)
+        for n, v in zip(carried, result):
+            ctx.set(n, v)
+
+
+_register_switch_op()
+
+
+class StaticRNN:
+    """Static (unrolled-length) RNN over time-major inputs, lowered to
+    lax.scan (reference StaticRNN / recurrent_op.cc).
+
+        rnn = StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x)          # x: (T, B, D)
+            h_prev = rnn.memory(init=h0)     # or shape/value init
+            h = layers.fc(x_t, ...)          # build step computation
+            rnn.update_memory(h_prev, h)
+            rnn.step_output(h)
+        outs = rnn()   # (T, B, ...) stacked outputs
+    """
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("static_rnn", name=name)
+        self._block = None
+        self._seq_inputs = []  # (outer var, in-block var)
+        self._memories = []  # (init outer var, pre var, post var or None)
+        self._outputs = []
+        self._finalized = False
+
+    import contextlib
+
+    @contextlib.contextmanager
+    def step(self):
+        program = self.helper.main_program
+        self._block = program._create_block()
+        try:
+            yield
+        finally:
+            program._rollback()
+            self._append_op()
+
+    def step_input(self, x):
+        blk = self._block
+        v = blk.create_var(name=self.helper.name_prefix + ".x%d" % len(self._seq_inputs),
+                           shape=tuple(x.shape[1:]), dtype=x.dtype)
+        self._seq_inputs.append((x, v))
+        return v
+
+    def memory(self, init=None, shape=None, value=0.0, batch_ref=None,
+               dtype="float32"):
+        from . import tensor
+
+        if init is None:
+            assert shape is not None
+            # build init in the PARENT block
+            program = self.helper.main_program
+            cur = program.current_block_idx
+            program.current_block_idx = self._block.parent_idx
+            init = tensor.fill_constant(shape, dtype, value)
+            program.current_block_idx = cur
+        pre = self._block.create_var(
+            name=self.helper.name_prefix + ".mem%d" % len(self._memories),
+            shape=init.shape, dtype=init.dtype)
+        self._memories.append([init, pre, None])
+        return pre
+
+    def update_memory(self, mem, new):
+        for m in self._memories:
+            if m[1] is mem:
+                m[2] = new
+                return
+        raise ValueError("update_memory: unknown memory var")
+
+    def step_output(self, o):
+        self._outputs.append(o)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def _append_op(self):
+        helper = self.helper
+        self._out_vars = [
+            helper.block.create_var(
+                name=helper.name_prefix + ".out%d" % i,
+                shape=(-1,) + tuple(o.shape), dtype=o.dtype)
+            for i, o in enumerate(self._outputs)
+        ]
+        helper.append_op(
+            type="static_rnn",
+            inputs={"SeqIn": [x for x, _ in self._seq_inputs],
+                    "MemInit": [m[0] for m in self._memories]},
+            outputs={"Out": self._out_vars},
+            attrs={
+                "sub_block": self._block.idx,
+                "seq_inputs": [x.name for x, _ in self._seq_inputs],
+                "step_inputs": [v.name for _, v in self._seq_inputs],
+                "mem_init": [m[0].name for m in self._memories],
+                "mem_pre": [m[1].name for m in self._memories],
+                "mem_post": [m[2].name for m in self._memories],
+                "step_outputs": [o.name for o in self._outputs],
+                "out_names": [v.name for v in self._out_vars],
+                "final_mem_names": [],
+            },
+        )
+
+    def __call__(self):
+        if len(self._out_vars) == 1:
+            return self._out_vars[0]
+        return self._out_vars
+
+
+# -- TensorArray stand-ins ---------------------------------------------------
+
+def create_array(dtype):
+    raise NotImplementedError(
+        "LoDTensorArray requires dynamic sizes; under XLA use while_loop with "
+        "pre-allocated (T, ...) tensors or StaticRNN step outputs")
+
+
+def array_write(x, i, array=None):
+    raise NotImplementedError("see create_array")
+
+
+def array_read(array, i):
+    raise NotImplementedError("see create_array")
+
+
+def array_length(array):
+    raise NotImplementedError("see create_array")

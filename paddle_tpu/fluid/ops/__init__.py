@@ -7,6 +7,8 @@ Each submodule groups ops like the reference's operator directories.
 from . import (  # noqa: F401
     activations,
     autodiff,
+    collective,
+    control_flow,
     creation,
     elementwise,
     loss,

@@ -1,0 +1,150 @@
+"""Collective communication ops.
+
+Parity: reference ``operators/collective/`` (c_allreduce_{sum,max,min,prod},
+c_broadcast, c_allgather, c_reducescatter, c_sync_*_stream — SURVEY §2.6).
+
+TPU-native: ``ring_id`` maps to a *named mesh axis* (ring 0 → first axis).
+Under ``shard_map`` these lower to XLA collectives over ICI
+(psum/all_gather/psum_scatter/pbroadcast); outside any mesh context they are
+identity (single-rank world), matching reference behavior with one trainer.
+Stream-sync ops are no-ops: XLA orders collectives by dataflow.
+"""
+
+from ..registry import register
+
+
+def _axis_for(ctx, op):
+    """ring_id -> mesh axis name. Under shard_map, LowerCtx.shard_axes holds
+    the active axis names."""
+    axes = getattr(ctx, "shard_axes", None)
+    if not axes:
+        return None
+    ring = op.attr("ring_id", 0)
+    return axes[min(ring, len(axes) - 1)]
+
+
+def _allreduce(kind):
+    def lower(ctx, op):
+        import jax
+
+        x = ctx.get_input(op, "X")
+        axis = _axis_for(ctx, op)
+        if axis is None:
+            out = x
+        elif kind == "sum":
+            out = jax.lax.psum(x, axis)
+        elif kind == "max":
+            out = jax.lax.pmax(x, axis)
+        elif kind == "min":
+            out = jax.lax.pmin(x, axis)
+        elif kind == "prod":
+            import jax.numpy as jnp
+
+            out = jnp.exp(jax.lax.psum(jnp.log(x), axis))
+        elif kind == "avg":
+            out = jax.lax.pmean(x, axis)
+        ctx.set_output(op, "Out", out)
+
+    return lower
+
+
+for _k in ("sum", "max", "min", "prod", "avg"):
+    register("c_allreduce_%s" % _k, _allreduce(_k))
+register("allreduce", _allreduce("sum"))  # dygraph DP op
+
+
+@register("c_broadcast")
+def _c_broadcast(ctx, op):
+    import jax
+
+    x = ctx.get_input(op, "X")
+    axis = _axis_for(ctx, op)
+    if axis is None:
+        ctx.set_output(op, "Out", x)
+        return
+    root = op.attr("root", 0)
+    # broadcast = select root shard then replicate (all_gather + take)
+    gathered = jax.lax.all_gather(x, axis)
+    ctx.set_output(op, "Out", gathered[root])
+
+
+@register("c_allgather")
+def _c_allgather(ctx, op):
+    import jax
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    axis = _axis_for(ctx, op)
+    if axis is None:
+        ctx.set_output(op, "Out", x)
+        return
+    gathered = jax.lax.all_gather(x, axis)  # (nranks, ...)
+    ctx.set_output(op, "Out", jnp.reshape(gathered, (-1,) + tuple(x.shape[1:])))
+
+
+@register("c_reducescatter")
+def _c_reducescatter(ctx, op):
+    import jax
+
+    x = ctx.get_input(op, "X")
+    axis = _axis_for(ctx, op)
+    if axis is None:
+        ctx.set_output(op, "Out", x)
+        return
+    ctx.set_output(op, "Out", jax.lax.psum_scatter(x, axis, tiled=True))
+
+
+@register("c_concat")
+def _c_concat(ctx, op):
+    _c_allgather(ctx, op)
+
+
+@register("collective_permute")
+def _collective_permute(ctx, op):
+    """Ring permute (ring-attention building block). attrs: shift (default 1,
+    neighbor exchange over the axis ring)."""
+    import jax
+
+    x = ctx.get_input(op, "X")
+    axis = _axis_for(ctx, op)
+    if axis is None:
+        ctx.set_output(op, "Out", x)
+        return
+    n = getattr(ctx, "shard_sizes", {}).get(axis)
+    shift = op.attr("shift", 1)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    ctx.set_output(op, "Out", jax.lax.ppermute(x, axis, perm))
+
+
+@register("c_sync_calc_stream")
+@register("c_sync_comm_stream")
+def _c_sync(ctx, op):
+    # XLA schedules collectives by dataflow; explicit stream sync is a no-op.
+    names = op.input("X")
+    for n, o in zip(names, op.output("Out")):
+        ctx.set(o, ctx.get(n))
+
+
+@register("c_gen_nccl_id")
+@register("gen_nccl_id")
+def _c_gen_nccl_id(ctx, op):
+    # Bootstrap handled by the JAX coordination service (jax.distributed);
+    # nothing to materialize in-graph.
+    pass
+
+
+@register("c_comm_init")
+@register("c_comm_init_all")
+def _c_comm_init(ctx, op):
+    pass
+
+
+@register("barrier")
+def _barrier(ctx, op):
+    import jax
+
+    axis = _axis_for(ctx, op)
+    if axis is not None and op.input("X"):
+        x = ctx.get_input(op, "X")
+        # psum of zeros = synchronization point
+        ctx.set_output(op, "Out", x + 0 * jax.lax.psum(x * 0, axis))

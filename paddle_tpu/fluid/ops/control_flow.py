@@ -1,0 +1,136 @@
+"""Control-flow op lowerings: cond, while, scan (StaticRNN).
+
+Parity: reference ``operators/controlflow/conditional_block_op.cc``,
+``while_op.cc:43`` (runs sub-block via a nested Executor), and
+``recurrent_op.cc`` (static RNN). TPU-first: sub-blocks lower to pure
+functions passed to ``lax.cond`` / ``lax.while_loop`` / ``lax.scan`` — traced
+once and compiled into the same XLA program, instead of re-entering an
+interpreter per iteration. Carried state is the set of sub-block-written
+vars (the scope-mutation analogue, made explicit).
+"""
+
+import numpy as np
+
+from ..registry import LowerCtx, register, registry
+
+
+def _block_writes(block):
+    """Var names written by ops of a block (ordered, deduped)."""
+    seen = []
+    for op in block.ops:
+        for n in op.output_arg_names():
+            if n not in seen:
+                seen.append(n)
+    return seen
+
+
+def _lower_subblock(ctx, block, env):
+    sub = LowerCtx(block, env, ctx.rng_key, mesh=ctx.mesh)
+    for op in block.ops:
+        registry.get(op.type).lower(sub, op)
+    return env
+
+
+@register("cond")
+def _cond(ctx, op):
+    import jax
+
+    program = ctx.program
+    pred = ctx.get_input(op, "Cond")
+    true_idx = op.attr("true_block")
+    false_idx = op.attr("false_block")
+    true_outs = op.attr("true_outs")
+    false_outs = op.attr("false_outs")
+    out_names = op.attr("out_names")
+
+    def make_branch(block_idx, branch_out_names):
+        block = program.block(block_idx)
+
+        def fn(env_snapshot):
+            env = dict(env_snapshot)
+            _lower_subblock(ctx, block, env)
+            return [env[n] for n in branch_out_names]
+
+        return fn
+
+    snapshot = dict(ctx.env)
+    pred_scalar = pred.reshape(()) if hasattr(pred, "reshape") else pred
+    outs = jax.lax.cond(
+        pred_scalar,
+        make_branch(true_idx, true_outs),
+        make_branch(false_idx, false_outs),
+        snapshot,
+    )
+    for name, val in zip(out_names, outs):
+        ctx.set(name, val)
+
+
+@register("while")
+def _while(ctx, op):
+    """Reference while_op semantics: body block mutates vars (incl. the
+    condition var); loop until condition is false. Carried state = all vars
+    the body writes that already exist outside (+ the condition)."""
+    import jax
+
+    program = ctx.program
+    block = program.block(op.attr("sub_block"))
+    cond_name = op.input("Condition")[0]
+
+    writes = _block_writes(block)
+    carried = [n for n in writes if n in ctx.env]
+    if cond_name not in carried:
+        carried = [cond_name] + carried
+
+    init = tuple(ctx.env[n] for n in carried)
+    cond_pos = carried.index(cond_name)
+    snapshot = {k: v for k, v in ctx.env.items() if k not in carried}
+
+    def cond_fun(carry):
+        c = carry[cond_pos]
+        return c.reshape(()) if hasattr(c, "reshape") else c
+
+    def body_fun(carry):
+        env = dict(snapshot)
+        env.update(dict(zip(carried, carry)))
+        _lower_subblock(ctx, block, env)
+        return tuple(env[n] for n in carried)
+
+    final = jax.lax.while_loop(cond_fun, body_fun, init)
+    for n, v in zip(carried, final):
+        ctx.set(n, v)
+
+
+@register("static_rnn")
+def _static_rnn(ctx, op):
+    """StaticRNN (reference recurrent_op.cc) as lax.scan: sequence inputs
+    scanned over time; memories carried; step outputs stacked."""
+    import jax
+
+    program = ctx.program
+    block = program.block(op.attr("sub_block"))
+    seq_inputs = op.attr("seq_inputs")  # outer names, (T, B, ...) time-major
+    step_inputs = op.attr("step_inputs")  # per-step names inside block
+    mem_init = op.attr("mem_init")  # outer names of initial memories
+    mem_pre = op.attr("mem_pre")  # in-block pre-state names
+    mem_post = op.attr("mem_post")  # in-block updated-state names
+    step_outputs = op.attr("step_outputs")  # in-block per-step output names
+    out_names = op.attr("out_names")  # outer stacked output names
+
+    xs = tuple(ctx.get(n) for n in seq_inputs)
+    init = tuple(ctx.get(n) for n in mem_init)
+    snapshot = dict(ctx.env)
+
+    def step(carry, x_t):
+        env = dict(snapshot)
+        env.update(dict(zip(mem_pre, carry)))
+        env.update(dict(zip(step_inputs, x_t)))
+        _lower_subblock(ctx, block, env)
+        new_carry = tuple(env[n] for n in mem_post)
+        outs = tuple(env[n] for n in step_outputs)
+        return new_carry, outs
+
+    final_carry, stacked = jax.lax.scan(step, init, xs)
+    for n, v in zip(out_names, stacked):
+        ctx.set(n, v)
+    for outer, v in zip(op.attr("final_mem_names") or [], final_carry):
+        ctx.set(outer, v)

@@ -85,7 +85,8 @@ def _scale(ctx, op):
         out = x * scale + bias
     else:
         out = (x + bias) * scale
-    ctx.set_output(op, "Out", out)
+    # scale preserves input dtype (reference scale_op semantics)
+    ctx.set_output(op, "Out", out.astype(x.dtype))
 
 
 @register("clip")

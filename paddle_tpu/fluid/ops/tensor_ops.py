@@ -351,8 +351,11 @@ def _ones_like(ctx, op):
 
 @register("increment")
 def _increment(ctx, op):
+    import jax.numpy as jnp
+
     x = ctx.get_input(op, "X")
-    ctx.set_output(op, "Out", x + op.attr("step", 1.0))
+    step = jnp.asarray(op.attr("step", 1.0)).astype(x.dtype)
+    ctx.set_output(op, "Out", x + step)
 
 
 @register("share_data")

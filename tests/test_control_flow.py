@@ -1,0 +1,152 @@
+"""Control flow: cond, While, while_loop, case/switch_case, StaticRNN.
+
+Reference analogues: test_while_op.py, test_cond.py, test_case.py,
+test_recurrent_op.py."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+
+def _run(main, feed, fetch):
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        return exe.run(main, feed=feed, fetch_list=fetch)
+
+
+def test_cond():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        pred = layers.reduce_sum(x) > 0.0
+        out = layers.cond(pred,
+                          lambda: layers.scale(x, scale=2.0),
+                          lambda: layers.scale(x, scale=-1.0))
+    xv = np.ones((2, 4), np.float32)
+    (r,) = _run(main, {"x": xv}, [out])
+    np.testing.assert_allclose(r, 2 * xv)
+    (r,) = _run(main, {"x": -xv}, [out])
+    np.testing.assert_allclose(r, xv)
+
+
+def test_while_op_accumulate():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        i = layers.fill_constant([1], "int64", 0)
+        n = layers.fill_constant([1], "int64", 10)
+        acc = layers.fill_constant([1], "float32", 0.0)
+        cond = layers.less_than(i, n)
+        w = layers.While(cond)
+        with w.block():
+            new_acc = layers.scale(acc, scale=1.0, bias=2.0)
+            layers.assign(new_acc, acc)
+            layers.increment(i, value=1)
+            layers.less_than(i, n, cond=cond)
+    (r, iv) = _run(main, {}, [acc, i])
+    assert float(r) == 20.0
+    assert int(iv) == 10
+
+
+def test_while_loop_functional():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        i = layers.fill_constant([1], "int64", 0)
+        s = layers.fill_constant([1], "float32", 1.0)
+
+        def cond_fn(i, s):
+            n = layers.fill_constant([1], "int64", 5)
+            return layers.less_than(i, n)
+
+        def body_fn(i, s):
+            s2 = layers.scale(s, scale=2.0)
+            i2 = layers.scale(i, scale=1.0, bias=1.0)
+            return [i2, s2]
+
+        i_out, s_out = layers.while_loop(cond_fn, body_fn, [i, s])
+    (iv, sv) = _run(main, {}, [i_out, s_out])
+    assert int(iv) == 5
+    assert float(sv) == 32.0
+
+
+def test_case_and_switch_case():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        idx = layers.data(name="idx", shape=[1], dtype="int64",
+                          append_batch_size=False)
+        out = layers.switch_case(
+            idx,
+            {0: lambda: layers.fill_constant([2], "float32", 10.0),
+             1: lambda: layers.fill_constant([2], "float32", 20.0)},
+            default=lambda: layers.fill_constant([2], "float32", -1.0))
+    (r,) = _run(main, {"idx": np.array([1], np.int64)}, [out])
+    np.testing.assert_allclose(r, [20.0, 20.0])
+    (r,) = _run(main, {"idx": np.array([0], np.int64)}, [out])
+    np.testing.assert_allclose(r, [10.0, 10.0])
+    (r,) = _run(main, {"idx": np.array([7], np.int64)}, [out])
+    np.testing.assert_allclose(r, [-1.0, -1.0])
+
+
+def test_static_rnn_scan():
+    T, B, D, H = 6, 2, 3, 4
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[T, B, D], dtype="float32",
+                        append_batch_size=False)
+        h0 = layers.data(name="h0", shape=[B, H], dtype="float32",
+                         append_batch_size=False)
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x)
+            h_prev = rnn.memory(init=h0)
+            h = layers.fc([x_t, h_prev], size=H, act="tanh",
+                          bias_attr=False)
+            rnn.update_memory(h_prev, h)
+            rnn.step_output(h)
+        outs = rnn()
+    exe = fluid.Executor()
+    rng = np.random.RandomState(0)
+    xv = rng.rand(T, B, D).astype(np.float32)
+    h0v = np.zeros((B, H), np.float32)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        # fetch weights for the numpy reference
+        params = main.all_parameters()
+        w_names = [p.name for p in params]
+        res = exe.run(main, feed={"x": xv, "h0": h0v},
+                      fetch_list=[outs] + w_names)
+    out, ws = res[0], res[1:]
+    wx, wh = ws[0], ws[1]
+    h = h0v
+    for t in range(T):
+        h = np.tanh(xv[t] @ wx + h @ wh)
+        np.testing.assert_allclose(out[t], h, rtol=1e-4, atol=1e-5)
+
+
+def test_while_backward():
+    """Gradient flows through lax.while_loop via the autodiff op."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[3], dtype="float32")
+        x.stop_gradient = False
+
+        def cond_fn(i, s):
+            n = layers.fill_constant([1], "int64", 3)
+            return layers.less_than(i, n)
+
+        def body_fn(i, s):
+            return [layers.scale(i, scale=1.0, bias=1.0),
+                    layers.elementwise_mul(s, s)]
+
+        i0 = layers.fill_constant([1], "int64", 0)
+        _, s_out = layers.while_loop(cond_fn, body_fn, [i0, x],
+                                     maximum_trip_count=4)
+        loss = layers.reduce_sum(s_out)
+        (gx,) = fluid.gradients(loss, x)
+    xv = np.full((1, 3), 1.1, np.float32)
+    # s -> s^2 three times => s^8; ds/dx = 8 x^7
+    (g,) = _run(main, {"x": xv}, [gx])
+    np.testing.assert_allclose(g, 8 * xv ** 7, rtol=1e-4)
