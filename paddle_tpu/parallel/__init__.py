@@ -1,0 +1,31 @@
+"""TPU-native parallelism core: device meshes, sequence/context parallelism
+(ring attention, Ulysses all-to-all), tensor parallelism, and pipeline
+parallelism over a named mesh axis.
+
+This is the capability layer the reference implements with NCCL rings +
+SSA-graph rewrites + the section-based pipeline trainer
+(``paddle/fluid/framework/details/``, ``trainer.h:114``, SURVEY §2.5) —
+re-designed TPU-first: a single ``jax.sharding.Mesh`` with named axes
+(dp/tp/pp/sp/ep), ``shard_map`` for per-shard SPMD code, and XLA collectives
+(psum / all_gather / ppermute / all_to_all) riding ICI. Long-context
+sequence parallelism (absent in the 2019 reference, SURVEY §5.7) is
+first-class here.
+"""
+
+from .mesh import (  # noqa: F401
+    make_mesh,
+    mesh_axis_size,
+    local_slice,
+    DP, TP, PP, SP, EP,
+)
+from .attention import (  # noqa: F401
+    attention_reference,
+    ring_attention,
+    ulysses_attention,
+)
+from .tp import (  # noqa: F401
+    column_parallel_linear,
+    row_parallel_linear,
+    vocab_parallel_embedding,
+)
+from .pipeline import pipeline  # noqa: F401

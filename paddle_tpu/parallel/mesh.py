@@ -1,0 +1,87 @@
+"""Device-mesh construction and axis conventions.
+
+Replaces the reference's ``ring_id``-keyed NCCL comm maps
+(``platform/nccl_helper.h:90``, ``collective_helper.h:62``): instead of
+integer ring ids into comm pools, parallel dimensions are *named mesh axes*
+over an N-d array of devices; XLA routes each collective over the ICI links
+of its axis.
+
+Canonical axis names (any subset, in this order):
+  dp — data parallel            (batch sharded, grads psummed)
+  pp — pipeline parallel        (layer stages, ppermute transfers)
+  tp — tensor/model parallel    (weight sharded, activations psummed)
+  sp — sequence/context parallel (ring attention / Ulysses all-to-all)
+  ep — expert parallel          (MoE expert sharding, all_to_all dispatch)
+"""
+
+import numpy as np
+
+DP, TP, PP, SP, EP = "dp", "tp", "pp", "sp", "ep"
+
+_CANONICAL_ORDER = (DP, PP, TP, SP, EP)
+
+
+def make_mesh(axis_sizes, devices=None):
+    """Build a ``jax.sharding.Mesh`` from ``{axis_name: size}``.
+
+    Axes are laid out in canonical order (dp outermost, sp/ep innermost) so
+    the fastest-varying axes — the ones carrying per-step collectives
+    (tp/sp) — map to nearest-neighbor ICI links.
+
+    A size of -1 means "all remaining devices". If the requested grid is
+    smaller than the device count, the first prod(sizes) devices are used
+    (the rest idle); a grid larger than the device count raises.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    devices = np.asarray(devices)
+    n = devices.size
+
+    names = [a for a in _CANONICAL_ORDER if a in axis_sizes]
+    extra = [a for a in axis_sizes if a not in names]
+    names += extra  # non-canonical axes go innermost
+
+    sizes = []
+    wildcard = None
+    known = 1
+    for a in names:
+        s = axis_sizes[a]
+        if s == -1:
+            if wildcard is not None:
+                raise ValueError("only one axis may be -1")
+            wildcard = a
+            sizes.append(-1)
+        else:
+            known *= int(s)
+            sizes.append(int(s))
+    if wildcard is not None:
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by {known}")
+        sizes[sizes.index(-1)] = n // known
+    else:
+        total = int(np.prod(sizes)) if sizes else 1
+        if total > n:
+            raise ValueError(
+                f"mesh {dict(zip(names, sizes))} needs {total} devices, "
+                f"only {n} available")
+        if total != n:
+            devices = devices.reshape(-1)[:total]
+    return Mesh(devices.reshape(sizes if sizes else (1,)), tuple(names))
+
+
+def mesh_axis_size(mesh, name):
+    return dict(mesh.shape).get(name, 1)
+
+
+def local_slice(array, mesh, axis_name, dim, index=None):
+    """Slice ``array`` along ``dim`` into the shard owned by ``index`` of
+    ``axis_name`` (host-side helper for building per-shard test data)."""
+    size = mesh_axis_size(mesh, axis_name)
+    chunk = array.shape[dim] // size
+    start = (index or 0) * chunk
+    idx = [slice(None)] * array.ndim
+    idx[dim] = slice(start, start + chunk)
+    return array[tuple(idx)]
