@@ -1,9 +1,9 @@
 """Benchmark entry: prints ONE JSON line with the headline metric.
 
-Run on real TPU hardware by the driver. Current flagship benchmark:
-MNIST LeNet train-step throughput (BASELINE.md config 1); vs_baseline is
-null until the reference numbers exist (the reference publishes none —
-BASELINE.md)."""
+Run on real TPU hardware by the driver. Flagship benchmark: BERT-base MLM
+pretraining train-step throughput (BASELINE.md config 3 — the reference's
+ERNIE/BERT Fleet workload), tokens/sec on one chip. ``vs_baseline`` is null:
+the reference publishes no benchmark figures (BASELINE.md)."""
 
 import json
 import os
@@ -15,34 +15,31 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import numpy as np
 
 
-def bench_lenet(batch_size=256, warmup=3, iters=20):
+def bench_bert(batch_size=32, seq_len=128, warmup=3, iters=10):
     import paddle_tpu.fluid as fluid
-    from paddle_tpu.models import lenet
+    from paddle_tpu.models import bert
 
-    main, startup, loss, acc = lenet.build_train_program()
+    cfg = bert.BertConfig.base()
+    main, startup, loss = bert.build_pretrain_program(cfg, seq_len=seq_len)
     exe = fluid.Executor()
-    rng = np.random.RandomState(0)
-    imgs = rng.rand(batch_size, 1, 28, 28).astype(np.float32)
-    labels = rng.randint(0, 10, (batch_size, 1)).astype(np.int64)
+    batch = bert.synthetic_batch(cfg, batch_size, seq_len)
 
     with fluid.scope_guard(fluid.Scope()):
         exe.run(startup)
         for _ in range(warmup):
-            exe.run(main, feed={"img": imgs, "label": labels}, fetch_list=[loss])
+            exe.run(main, feed=batch, fetch_list=[loss])
         t0 = time.perf_counter()
         for _ in range(iters):
-            (lv,) = exe.run(main, feed={"img": imgs, "label": labels},
-                            fetch_list=[loss])
+            exe.run(main, feed=batch, fetch_list=[loss])
         elapsed = time.perf_counter() - t0
-    images_per_sec = batch_size * iters / elapsed
-    return images_per_sec
+    return batch_size * seq_len * iters / elapsed
 
 
 if __name__ == "__main__":
-    ips = bench_lenet()
+    tps = bench_bert()
     print(json.dumps({
-        "metric": "mnist_lenet_images_per_sec",
-        "value": round(float(ips), 1),
-        "unit": "images/sec",
+        "metric": "bert_base_mlm_train_tokens_per_sec",
+        "value": round(float(tps), 1),
+        "unit": "tokens/sec",
         "vs_baseline": None,
     }))

@@ -4,4 +4,4 @@ Parity targets (BASELINE.md configs): LeNet/MNIST, ResNet-50, BERT/ERNIE,
 DeepFM CTR, Transformer NMT.
 """
 
-from . import lenet  # noqa: F401
+from . import bert, deepfm, lenet, resnet, transformer  # noqa: F401

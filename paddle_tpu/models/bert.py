@@ -1,0 +1,174 @@
+"""BERT/ERNIE-style transformer encoder for MLM pretraining —
+BASELINE.md config 3 (the Fleet-collective workload).
+
+Parity: the reference trains ERNIE/BERT through its transformer building
+blocks (``tests/unittests/dist_transformer.py``, multihead attention as the
+fused inference pass ``ir/multihead_matmul_fuse_pass.cc`` recognizes);
+built here from the fluid layer surface. TPU notes: attention and FFN
+matmuls are kept as single large [B*S, H] GEMMs feeding the MXU; masking is
+additive (no dynamic shapes); everything jit-compiles to one XLA program.
+"""
+
+import math
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers, optimizer
+
+
+class BertConfig:
+    def __init__(self, vocab_size=30522, hidden=768, n_layers=12, n_heads=12,
+                 ffn_hidden=3072, max_seq=512, type_vocab=2,
+                 hidden_dropout=0.1, attn_dropout=0.1):
+        self.vocab_size = vocab_size
+        self.hidden = hidden
+        self.n_layers = n_layers
+        self.n_heads = n_heads
+        self.ffn_hidden = ffn_hidden
+        self.max_seq = max_seq
+        self.type_vocab = type_vocab
+        self.hidden_dropout = hidden_dropout
+        self.attn_dropout = attn_dropout
+
+    @staticmethod
+    def base():
+        return BertConfig()
+
+    @staticmethod
+    def tiny():
+        return BertConfig(vocab_size=1024, hidden=64, n_layers=2, n_heads=4,
+                          ffn_hidden=128, max_seq=64)
+
+
+def _mha(x, attn_bias, cfg, prefix):
+    h, n_heads = cfg.hidden, cfg.n_heads
+    d = h // n_heads
+    q = layers.fc(x, h, num_flatten_dims=2, name=prefix + "_q")
+    k = layers.fc(x, h, num_flatten_dims=2, name=prefix + "_k")
+    v = layers.fc(x, h, num_flatten_dims=2, name=prefix + "_v")
+
+    def split_heads(t):
+        t = layers.reshape(t, [0, 0, n_heads, d])
+        return layers.transpose(t, [0, 2, 1, 3])  # [B, nH, S, d]
+
+    q, k, v = split_heads(q), split_heads(k), split_heads(v)
+    scores = layers.matmul(q, k, transpose_y=True,
+                           alpha=1.0 / math.sqrt(d))  # [B, nH, S, S]
+    scores = layers.elementwise_add(scores, attn_bias)
+    weights = layers.softmax(scores)
+    if cfg.attn_dropout:
+        weights = layers.dropout(weights, cfg.attn_dropout,
+                                 dropout_implementation="upscale_in_train")
+    ctx = layers.matmul(weights, v)  # [B, nH, S, d]
+    ctx = layers.transpose(ctx, [0, 2, 1, 3])
+    ctx = layers.reshape(ctx, [0, 0, h])
+    return layers.fc(ctx, h, num_flatten_dims=2, name=prefix + "_out")
+
+
+def _encoder_layer(x, attn_bias, cfg, prefix):
+    attn = _mha(x, attn_bias, cfg, prefix + "_attn")
+    if cfg.hidden_dropout:
+        attn = layers.dropout(attn, cfg.hidden_dropout,
+                              dropout_implementation="upscale_in_train")
+    x = layers.layer_norm(layers.elementwise_add(x, attn), begin_norm_axis=2)
+    ffn = layers.fc(x, cfg.ffn_hidden, num_flatten_dims=2, act="gelu",
+                    name=prefix + "_ffn1")
+    ffn = layers.fc(ffn, cfg.hidden, num_flatten_dims=2,
+                    name=prefix + "_ffn2")
+    if cfg.hidden_dropout:
+        ffn = layers.dropout(ffn, cfg.hidden_dropout,
+                             dropout_implementation="upscale_in_train")
+    return layers.layer_norm(layers.elementwise_add(x, ffn), begin_norm_axis=2)
+
+
+def bert_encoder(src_ids, pos_ids, sent_ids, input_mask, cfg):
+    """input_mask: [B, S, 1] float (1 = token, 0 = pad). Returns [B, S, H]."""
+    emb = layers.embedding(src_ids, size=[cfg.vocab_size, cfg.hidden],
+                           param_attr=fluid.ParamAttr(name="word_emb"))
+    emb = layers.elementwise_add(
+        emb, layers.embedding(pos_ids, size=[cfg.max_seq, cfg.hidden],
+                              param_attr=fluid.ParamAttr(name="pos_emb")))
+    emb = layers.elementwise_add(
+        emb, layers.embedding(sent_ids, size=[cfg.type_vocab, cfg.hidden],
+                              param_attr=fluid.ParamAttr(name="sent_emb")))
+    x = layers.layer_norm(emb, begin_norm_axis=2)
+    if cfg.hidden_dropout:
+        x = layers.dropout(x, cfg.hidden_dropout,
+                           dropout_implementation="upscale_in_train")
+
+    # additive attention bias [B, 1, 1, S]: 0 keep, -1e4 mask
+    mask = layers.transpose(input_mask, [0, 2, 1])  # [B, 1, S]
+    bias = layers.scale(mask, scale=1e4, bias=-1e4)
+    attn_bias = layers.unsqueeze(bias, axes=[1])
+
+    for i in range(cfg.n_layers):
+        x = _encoder_layer(x, attn_bias, cfg, "layer_%d" % i)
+    return x
+
+
+def mlm_loss(enc, mask_label, mask_weight, cfg):
+    """Masked-LM loss over all positions, weighted by mask_weight
+    [B, S, 1] (1 on masked positions). Static shapes: no gather of dynamic
+    position counts — the weighting keeps XLA shapes fixed."""
+    x = layers.fc(enc, cfg.hidden, num_flatten_dims=2, act="gelu",
+                  name="mlm_transform")
+    x = layers.layer_norm(x, begin_norm_axis=2)
+    logits = layers.fc(x, cfg.vocab_size, num_flatten_dims=2,
+                       name="mlm_logits")
+    ce = layers.softmax_with_cross_entropy(logits, mask_label)  # [B, S, 1]
+    num = layers.reduce_sum(layers.elementwise_mul(ce, mask_weight))
+    den = layers.reduce_sum(mask_weight)
+    return layers.elementwise_div(
+        num, layers.elementwise_add(den, layers.fill_constant([1], "float32",
+                                                              1e-6)))
+
+
+def build_pretrain_program(cfg=None, seq_len=128, lr=1e-4, seed=7):
+    cfg = cfg or BertConfig.base()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    with fluid.program_guard(main, startup):
+        src = layers.data("src_ids", shape=[seq_len], dtype="int64")
+        pos = layers.data("pos_ids", shape=[seq_len], dtype="int64")
+        sent = layers.data("sent_ids", shape=[seq_len], dtype="int64")
+        imask = layers.data("input_mask", shape=[seq_len, 1], dtype="float32")
+        mlabel = layers.data("mask_label", shape=[seq_len, 1], dtype="int64")
+        mweight = layers.data("mask_weight", shape=[seq_len, 1],
+                              dtype="float32")
+        enc = bert_encoder(src, pos, sent, imask, cfg)
+        loss = mlm_loss(enc, mlabel, mweight, cfg)
+        optimizer.Adam(learning_rate=lr).minimize(loss)
+    return main, startup, loss
+
+
+def build_encoder_program(cfg=None, seq_len=128, seed=7):
+    """Inference-mode encoder: dropout disabled so the forward is
+    deterministic (the graft-entry / predictor surface)."""
+    import copy
+
+    cfg = copy.copy(cfg or BertConfig.base())
+    cfg.hidden_dropout = 0.0
+    cfg.attn_dropout = 0.0
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    with fluid.program_guard(main, startup):
+        src = layers.data("src_ids", shape=[seq_len], dtype="int64")
+        pos = layers.data("pos_ids", shape=[seq_len], dtype="int64")
+        sent = layers.data("sent_ids", shape=[seq_len], dtype="int64")
+        imask = layers.data("input_mask", shape=[seq_len, 1], dtype="float32")
+        enc = bert_encoder(src, pos, sent, imask, cfg)
+    return main, startup, enc
+
+
+def synthetic_batch(cfg, batch, seq_len, seed=0):
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    src = rng.randint(0, cfg.vocab_size, (batch, seq_len)).astype("int64")
+    pos = np.tile(np.arange(seq_len, dtype="int64"), (batch, 1))
+    sent = np.zeros((batch, seq_len), "int64")
+    imask = np.ones((batch, seq_len, 1), "float32")
+    mlabel = rng.randint(0, cfg.vocab_size, (batch, seq_len, 1)).astype("int64")
+    mweight = (rng.rand(batch, seq_len, 1) < 0.15).astype("float32")
+    return {"src_ids": src, "pos_ids": pos, "sent_ids": sent,
+            "input_mask": imask, "mask_label": mlabel,
+            "mask_weight": mweight}

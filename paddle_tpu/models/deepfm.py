@@ -1,0 +1,92 @@
+"""DeepFM / Wide&Deep CTR model — BASELINE.md config 4 (the sparse
+embedding + parameter-server workload).
+
+Parity: the reference's CTR path (``tests/unittests/dist_ctr.py``,
+``ctr_dataset_reader``) drives sparse ``lookup_table`` ops whose gradients
+are ``SelectedRows`` pushed to pservers (SURVEY §2.5). TPU-native: the
+embedding tables live device-resident and sharded; fields are a dense
+[B, F] id matrix so one gather feeds all fields (no per-slot LoD walk),
+keeping XLA shapes static.
+"""
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers, optimizer
+
+
+class DeepFMConfig:
+    def __init__(self, sparse_feature_dim=int(1e5), num_fields=26,
+                 num_dense=13, embedding_size=10, fc_sizes=(400, 400, 400)):
+        self.sparse_feature_dim = sparse_feature_dim
+        self.num_fields = num_fields
+        self.num_dense = num_dense
+        self.embedding_size = embedding_size
+        self.fc_sizes = tuple(fc_sizes)
+
+    @staticmethod
+    def tiny():
+        return DeepFMConfig(sparse_feature_dim=1000, num_fields=8,
+                            num_dense=4, embedding_size=8, fc_sizes=(32, 32))
+
+
+def deepfm_forward(sparse_ids, dense_x, label, cfg, is_sparse=True):
+    """sparse_ids: [B, F] int64; dense_x: [B, D] float32; label: [B, 1]."""
+    # ---- first order: per-field scalar weights
+    w1 = layers.embedding(sparse_ids, size=[cfg.sparse_feature_dim, 1],
+                          is_sparse=is_sparse,
+                          param_attr=fluid.ParamAttr(name="fm_w1"))  # [B,F,1]
+    first = layers.reduce_sum(w1, dim=1)  # [B, 1]
+
+    # ---- second order: 0.5 * ((sum e)^2 - sum e^2)
+    emb = layers.embedding(sparse_ids,
+                           size=[cfg.sparse_feature_dim, cfg.embedding_size],
+                           is_sparse=is_sparse,
+                           param_attr=fluid.ParamAttr(name="fm_emb"))  # [B,F,E]
+    sum_e = layers.reduce_sum(emb, dim=1)                       # [B, E]
+    sum_sq = layers.elementwise_mul(sum_e, sum_e)
+    sq_sum = layers.reduce_sum(layers.elementwise_mul(emb, emb), dim=1)
+    second = layers.scale(
+        layers.reduce_sum(layers.elementwise_sub(sum_sq, sq_sum), dim=1,
+                          keep_dim=True), scale=0.5)            # [B, 1]
+
+    # ---- deep part
+    deep = layers.reshape(emb, [0, cfg.num_fields * cfg.embedding_size])
+    deep = layers.concat([deep, dense_x], axis=1)
+    for i, sz in enumerate(cfg.fc_sizes):
+        deep = layers.fc(deep, sz, act="relu", name="deep_fc%d" % i)
+    deep_out = layers.fc(deep, 1, name="deep_out")
+
+    logit = layers.elementwise_add(
+        layers.elementwise_add(first, second), deep_out)
+    pred = layers.sigmoid(logit)
+    loss = layers.mean(
+        layers.sigmoid_cross_entropy_with_logits(
+            logit, layers.cast(label, "float32")))
+    return pred, loss
+
+
+def build_train_program(cfg=None, lr=1e-3, is_sparse=True, seed=7):
+    cfg = cfg or DeepFMConfig()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    with fluid.program_guard(main, startup):
+        sparse_ids = layers.data("sparse_ids", shape=[cfg.num_fields],
+                                 dtype="int64")
+        dense_x = layers.data("dense_x", shape=[cfg.num_dense],
+                              dtype="float32")
+        label = layers.data("label", shape=[1], dtype="int64")
+        pred, loss = deepfm_forward(sparse_ids, dense_x, label, cfg,
+                                    is_sparse=is_sparse)
+        optimizer.Adam(learning_rate=lr).minimize(loss)
+    return main, startup, loss, pred
+
+
+def synthetic_batch(cfg, batch, seed=0):
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    return {
+        "sparse_ids": rng.randint(0, cfg.sparse_feature_dim,
+                                  (batch, cfg.num_fields)).astype("int64"),
+        "dense_x": rng.rand(batch, cfg.num_dense).astype("float32"),
+        "label": rng.randint(0, 2, (batch, 1)).astype("int64"),
+    }

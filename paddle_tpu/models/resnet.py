@@ -1,0 +1,94 @@
+"""ResNet for ImageNet-style classification — BASELINE.md config 2.
+
+Parity: reference ``tests/unittests/dist_se_resnext.py`` /
+``tests/book/test_image_classification.py`` model family; built from the
+same fluid layer surface (conv2d/batch_norm/pool2d/fc). Convs stay whole
+NCHW — XLA:TPU tiles them onto the MXU; BN statistics fuse into the conv
+epilogue under jit.
+"""
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers, optimizer
+
+_DEPTH_CFG = {
+    18: ("basic", [2, 2, 2, 2]),
+    34: ("basic", [3, 4, 6, 3]),
+    50: ("bottleneck", [3, 4, 6, 3]),
+    101: ("bottleneck", [3, 4, 23, 3]),
+    152: ("bottleneck", [3, 8, 36, 3]),
+}
+
+
+def _conv_bn(x, filters, ksize, stride=1, act=None, name=None):
+    conv = layers.conv2d(
+        x, num_filters=filters, filter_size=ksize, stride=stride,
+        padding=(ksize - 1) // 2, bias_attr=False,
+        param_attr=fluid.ParamAttr(name=name + "_w") if name else None)
+    return layers.batch_norm(conv, act=act)
+
+
+def _shortcut(x, filters, stride):
+    in_c = x.shape[1]
+    if in_c != filters or stride != 1:
+        return _conv_bn(x, filters, 1, stride)
+    return x
+
+
+def _basic_block(x, filters, stride):
+    y = _conv_bn(x, filters, 3, stride, act="relu")
+    y = _conv_bn(y, filters, 3, 1)
+    return layers.relu(layers.elementwise_add(y, _shortcut(x, filters, stride)))
+
+
+def _bottleneck_block(x, filters, stride):
+    y = _conv_bn(x, filters, 1, act="relu")
+    y = _conv_bn(y, filters, 3, stride, act="relu")
+    y = _conv_bn(y, filters * 4, 1)
+    return layers.relu(
+        layers.elementwise_add(y, _shortcut(x, filters * 4, stride)))
+
+
+def resnet_forward(img, label=None, depth=50, num_classes=1000):
+    kind, blocks = _DEPTH_CFG[depth]
+    block_fn = _basic_block if kind == "basic" else _bottleneck_block
+
+    x = _conv_bn(img, 64, 7, stride=2, act="relu")
+    x = layers.pool2d(x, pool_size=3, pool_stride=2, pool_padding=1,
+                      pool_type="max")
+    for stage, n in enumerate(blocks):
+        filters = 64 * (2 ** stage)
+        for i in range(n):
+            stride = 2 if (i == 0 and stage > 0) else 1
+            x = block_fn(x, filters, stride)
+    x = layers.pool2d(x, pool_type="avg", global_pooling=True)
+    logits = layers.fc(x, size=num_classes)
+    if label is None:
+        return logits, None, None
+    loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+    acc = layers.accuracy(layers.softmax(logits), label)
+    return logits, loss, acc
+
+
+def build_train_program(depth=50, num_classes=1000, image_size=224,
+                        lr=0.1, momentum=0.9, seed=7):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    with fluid.program_guard(main, startup):
+        img = layers.data(name="img", shape=[3, image_size, image_size],
+                          dtype="float32")
+        label = layers.data(name="label", shape=[1], dtype="int64")
+        _, loss, acc = resnet_forward(img, label, depth, num_classes)
+        optimizer.Momentum(learning_rate=lr, momentum=momentum,
+                           regularization=fluid.regularizer.L2Decay(1e-4)
+                           ).minimize(loss)
+    return main, startup, loss, acc
+
+
+def build_infer_program(depth=50, num_classes=1000, image_size=224, seed=7):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    with fluid.program_guard(main, startup):
+        img = layers.data(name="img", shape=[3, image_size, image_size],
+                          dtype="float32")
+        logits, _, _ = resnet_forward(img, None, depth, num_classes)
+    return main, startup, logits

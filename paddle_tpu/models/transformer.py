@@ -1,0 +1,234 @@
+"""Transformer NMT model in DyGraph (eager) mode — BASELINE.md config 5
+(dygraph tracer -> XLA JIT).
+
+Parity: reference ``tests/unittests/dist_transformer.py`` (the
+Transformer-big NMT workload) and the dygraph transformer tests
+(``test_dygraph_transformer`` family), rebuilt on the eager tracer. The
+eager path executes each traced op via the same XLA lowering as the static
+path with a per-op compile cache; `dygraph.jit.trace` then records the whole
+forward into one static Program that jit-compiles into a single fused XLA
+program — the TPU-native counterpart of the reference's
+``imperative/jit/program_desc_tracer``.
+"""
+
+import math
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework
+from paddle_tpu.fluid.dygraph import Layer, nn
+
+
+def _t():
+    return framework._dygraph_tracer()
+
+
+def _op(type, inputs, outs, attrs=None):
+    return _t().trace_op(type, inputs, outs, attrs or {})
+
+
+# -- functional eager helpers (tracer-backed) --------------------------------
+def reshape(x, shape):
+    (out,) = _op("reshape", {"X": [x]}, ["Out"], {"shape": list(shape)})
+    return out
+
+
+def transpose(x, perm):
+    (out,) = _op("transpose", {"X": [x]}, ["Out"], {"axis": list(perm)})
+    return out
+
+
+def matmul(x, y, transpose_y=False, alpha=1.0):
+    (out,) = _op("matmul", {"X": [x], "Y": [y]}, ["Out"],
+                 {"transpose_X": False, "transpose_Y": transpose_y,
+                  "alpha": alpha})
+    return out
+
+
+def softmax(x):
+    (out,) = _op("softmax", {"X": [x]}, ["Out"], {"axis": -1})
+    return out
+
+
+def dropout(x, p, is_test=False):
+    if is_test or not p:
+        return x
+    (out,) = _op("dropout", {"X": [x]}, ["Out"],
+                 {"dropout_prob": p,
+                  "dropout_implementation": "upscale_in_train"})
+    return out
+
+
+def softmax_ce(logits, label):
+    outs = _op("softmax_with_cross_entropy", {"Logits": [logits],
+                                              "Label": [label]},
+               ["Softmax", "Loss"], {"soft_label": False})
+    return outs[1]
+
+
+def reduce_sum(x, dim=None, keep_dim=False):
+    (out,) = _op("reduce_sum", {"X": [x]}, ["Out"],
+                 {"dim": [] if dim is None else [dim],
+                  "keep_dim": keep_dim, "reduce_all": dim is None})
+    return out
+
+
+class MultiHeadAttention(Layer):
+    def __init__(self, d_model, n_heads, dropout_rate=0.1):
+        super().__init__()
+        self.n_heads = n_heads
+        self.d_key = d_model // n_heads
+        self.dropout_rate = dropout_rate
+        self.q_fc = nn.Linear(d_model, d_model)
+        self.k_fc = nn.Linear(d_model, d_model)
+        self.v_fc = nn.Linear(d_model, d_model)
+        self.out_fc = nn.Linear(d_model, d_model)
+
+    def forward(self, q, kv, bias):
+        bsz = q.shape[0]
+
+        def split(t):
+            t = reshape(t, [bsz, -1, self.n_heads, self.d_key])
+            return transpose(t, [0, 2, 1, 3])
+
+        qh = split(self.q_fc(q))
+        kh = split(self.k_fc(kv))
+        vh = split(self.v_fc(kv))
+        scores = matmul(qh, kh, transpose_y=True,
+                        alpha=1.0 / math.sqrt(self.d_key))
+        if bias is not None:
+            scores = scores + bias
+        w = dropout(softmax(scores), self.dropout_rate,
+                    is_test=not self.training)
+        ctx = matmul(w, vh)
+        ctx = transpose(ctx, [0, 2, 1, 3])
+        ctx = reshape(ctx, [bsz, -1, self.n_heads * self.d_key])
+        return self.out_fc(ctx)
+
+
+class FFN(Layer):
+    def __init__(self, d_model, d_inner, dropout_rate=0.1):
+        super().__init__()
+        self.fc1 = nn.Linear(d_model, d_inner, act="relu")
+        self.fc2 = nn.Linear(d_inner, d_model)
+        self.dropout_rate = dropout_rate
+
+    def forward(self, x):
+        return self.fc2(dropout(self.fc1(x), self.dropout_rate,
+                                is_test=not self.training))
+
+
+class EncoderLayer(Layer):
+    def __init__(self, d_model, n_heads, d_inner, dropout_rate=0.1):
+        super().__init__()
+        self.attn = MultiHeadAttention(d_model, n_heads, dropout_rate)
+        self.ffn = FFN(d_model, d_inner, dropout_rate)
+        self.ln1 = nn.LayerNorm(normalized_shape=[d_model], begin_norm_axis=2)
+        self.ln2 = nn.LayerNorm(normalized_shape=[d_model], begin_norm_axis=2)
+        self.dropout_rate = dropout_rate
+
+    def forward(self, x, bias):
+        y = self.attn(x, x, bias)
+        x = self.ln1(x + dropout(y, self.dropout_rate,
+                                 is_test=not self.training))
+        y = self.ffn(x)
+        return self.ln2(x + dropout(y, self.dropout_rate,
+                                    is_test=not self.training))
+
+
+class DecoderLayer(Layer):
+    def __init__(self, d_model, n_heads, d_inner, dropout_rate=0.1):
+        super().__init__()
+        self.self_attn = MultiHeadAttention(d_model, n_heads, dropout_rate)
+        self.cross_attn = MultiHeadAttention(d_model, n_heads, dropout_rate)
+        self.ffn = FFN(d_model, d_inner, dropout_rate)
+        self.ln1 = nn.LayerNorm(normalized_shape=[d_model], begin_norm_axis=2)
+        self.ln2 = nn.LayerNorm(normalized_shape=[d_model], begin_norm_axis=2)
+        self.ln3 = nn.LayerNorm(normalized_shape=[d_model], begin_norm_axis=2)
+        self.dropout_rate = dropout_rate
+
+    def forward(self, x, enc, self_bias, cross_bias):
+        y = self.self_attn(x, x, self_bias)
+        x = self.ln1(x + dropout(y, self.dropout_rate,
+                                 is_test=not self.training))
+        y = self.cross_attn(x, enc, cross_bias)
+        x = self.ln2(x + dropout(y, self.dropout_rate,
+                                 is_test=not self.training))
+        y = self.ffn(x)
+        return self.ln3(x + dropout(y, self.dropout_rate,
+                                    is_test=not self.training))
+
+
+class Transformer(Layer):
+    """Encoder-decoder transformer for teacher-forced NMT training."""
+
+    def __init__(self, src_vocab, tgt_vocab, d_model=512, n_heads=8,
+                 d_inner=2048, n_layers=6, max_len=256, dropout_rate=0.1):
+        super().__init__()
+        self.d_model = d_model
+        self.src_emb = nn.Embedding(size=[src_vocab, d_model])
+        self.tgt_emb = nn.Embedding(size=[tgt_vocab, d_model])
+        self.pos_emb = nn.Embedding(size=[max_len, d_model])
+        self.enc_layers = [EncoderLayer(d_model, n_heads, d_inner,
+                                        dropout_rate) for _ in range(n_layers)]
+        self.dec_layers = [DecoderLayer(d_model, n_heads, d_inner,
+                                        dropout_rate) for _ in range(n_layers)]
+        for i, l in enumerate(self.enc_layers):
+            self.add_sublayer("enc_%d" % i, l)
+        for i, l in enumerate(self.dec_layers):
+            self.add_sublayer("dec_%d" % i, l)
+        self.proj = nn.Linear(d_model, tgt_vocab)
+        self.dropout_rate = dropout_rate
+
+    @staticmethod
+    def big(src_vocab=32000, tgt_vocab=32000):
+        return Transformer(src_vocab, tgt_vocab, d_model=1024, n_heads=16,
+                           d_inner=4096, n_layers=6)
+
+    @staticmethod
+    def tiny(src_vocab=512, tgt_vocab=512):
+        return Transformer(src_vocab, tgt_vocab, d_model=32, n_heads=4,
+                           d_inner=64, n_layers=2, max_len=64)
+
+    def _embed(self, ids, emb, pos_ids):
+        x = emb(ids)
+        (x,) = _op("scale", {"X": [x]}, ["Out"],
+                   {"scale": math.sqrt(self.d_model), "bias": 0.0,
+                    "bias_after_scale": True})
+        return x + self.pos_emb(pos_ids) if pos_ids is not None else x
+
+    def forward(self, src_ids, tgt_ids, pos_src, pos_tgt, causal_bias):
+        enc = dropout(self._embed(src_ids, self.src_emb, pos_src),
+                      self.dropout_rate, is_test=not self.training)
+        for l in self.enc_layers:
+            enc = l(enc, None)
+        dec = dropout(self._embed(tgt_ids, self.tgt_emb, pos_tgt),
+                      self.dropout_rate, is_test=not self.training)
+        for l in self.dec_layers:
+            dec = l(dec, enc, causal_bias, None)
+        return self.proj(dec)
+
+
+def make_causal_bias(seq_len):
+    m = np.triu(np.full((seq_len, seq_len), -1e4, np.float32), k=1)
+    return m.reshape(1, 1, seq_len, seq_len)
+
+
+def loss_fn(logits, labels):
+    """Mean token cross-entropy. labels: [B, S, 1] int64."""
+    ce = softmax_ce(logits, labels)
+    total = reduce_sum(ce)
+    n = float(np.prod(labels.shape))
+    (loss,) = _op("scale", {"X": [total]}, ["Out"],
+                  {"scale": 1.0 / n, "bias": 0.0, "bias_after_scale": True})
+    return loss
+
+
+def synthetic_batch(src_vocab, tgt_vocab, batch, seq_len, seed=0):
+    rng = np.random.RandomState(seed)
+    src = rng.randint(1, src_vocab, (batch, seq_len)).astype("int64")
+    tgt = rng.randint(1, tgt_vocab, (batch, seq_len)).astype("int64")
+    labels = rng.randint(1, tgt_vocab, (batch, seq_len, 1)).astype("int64")
+    pos = np.tile(np.arange(seq_len, dtype="int64"), (batch, 1))
+    return src, tgt, labels, pos

@@ -16,10 +16,61 @@ explicit kernels are for shard_map code paths (Fleet-collective mode) and
 serve as the reference semantics.
 """
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
 from .mesh import TP
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def copy_to_tp_region(x, axis_name=TP):
+    """Megatron's *f* operator: identity forward, psum backward. Place at
+    the entry of a tensor-parallel block so replicated activations feeding
+    tp-sharded weights get their cotangents summed across the tp ranks —
+    after this, grads of params *outside* the block (layernorms, embeddings)
+    are exact per-rank with no manual tp reductions."""
+    return x
+
+
+def _copy_fwd(x, axis_name):
+    return x, None
+
+
+def _copy_bwd(axis_name, _, g):
+    return (jax.lax.psum(g, axis_name),)
+
+
+copy_to_tp_region.defvjp(_copy_fwd, _copy_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_from_tp_region(x, axis_name=TP):
+    """Megatron's *g* operator: psum forward, identity backward. Place at
+    the exit of a tensor-parallel block (the row-parallel output reduce)."""
+    return jax.lax.psum(x, axis_name)
+
+
+def _reduce_fwd(x, axis_name):
+    return jax.lax.psum(x, axis_name), None
+
+
+def _reduce_bwd(axis_name, _, g):
+    return (g,)
+
+
+reduce_from_tp_region.defvjp(_reduce_fwd, _reduce_bwd)
+
+
+def pmean_exact(x, axis_name):
+    """Mean over an axis with the mathematically exact VJP (cotangent/n).
+
+    Under ``shard_map(..., check_vma=False)`` raw ``psum``/``pmean``
+    transpose to another psum, scaling cotangents by the axis size; any
+    loss reduction inside a differentiated per-shard program must use this
+    (or ``reduce_from_tp_region``) instead."""
+    return reduce_from_tp_region(x / jax.lax.axis_size(axis_name), axis_name)
 
 
 def column_parallel_linear(x, w_local, b_local=None, axis_name=TP):
