@@ -7,6 +7,7 @@ executed by lowering Programs to XLA (see ``executor.py``).
 from . import (  # noqa: F401
     backward,
     clip,
+    contrib,
     compiler,
     data_feeder,
     executor,

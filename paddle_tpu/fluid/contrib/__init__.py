@@ -1,0 +1,3 @@
+"""contrib: AMP, slim (quant), extensions — reference ``python/paddle/fluid/contrib/``."""
+
+from . import mixed_precision  # noqa: F401

@@ -1,0 +1,222 @@
+"""mixed_precision.decorate — the AMP optimizer wrapper.
+
+Parity: reference ``contrib/mixed_precision/decorator.py:216`` (`decorate`)
+and ``OptimizerWithMixedPrecision:27``. TPU-first defaults: bfloat16 (fp32
+exponent range → ``init_loss_scaling=1.0`` and no dynamic scaling needed);
+fp16 semantics (scaling + inf/nan-gated updates) are kept for parity and
+for the rare fp16 deployment.
+
+Dynamic loss scaling: grads are checked with ``isfinite``; on overflow the
+whole gradient set is zeroed for that step (a zero-grad optimizer step —
+accumulator decay still advances, a deliberate simplification vs the
+reference's conditional skip block) and the scale is multiplied by
+``decr_ratio``; after ``incr_every_n_steps`` clean steps it is multiplied
+by ``incr_ratio``.
+"""
+
+from ... import framework, unique_name
+from ...framework import default_startup_program
+
+from .fp16_lists import AutoMixedPrecisionLists
+from .fp16_utils import rewrite_program
+
+__all__ = ["decorate", "OptimizerWithMixedPrecision"]
+
+
+def _scalar_var(block, name, dtype, value, startup=True):
+    v = block.create_var(name=name, shape=[1], dtype=dtype, persistable=True)
+    if startup:
+        sb = default_startup_program().global_block()
+        sb.create_var(name=name, shape=[1], dtype=dtype, persistable=True)
+        sb.append_op("fill_constant", outputs={"Out": [name]},
+                     attrs={"shape": [1], "dtype": dtype, "value": value})
+    return v
+
+
+class OptimizerWithMixedPrecision:
+    def __init__(self, optimizer, amp_lists, init_loss_scaling,
+                 use_dynamic_loss_scaling, incr_every_n_steps,
+                 decr_every_n_nan_or_inf, incr_ratio, decr_ratio, dest_dtype):
+        self._optimizer = optimizer
+        self._amp_lists = amp_lists or AutoMixedPrecisionLists()
+        self._init_loss_scaling = float(init_loss_scaling)
+        self._use_dynamic = use_dynamic_loss_scaling
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n_nan_or_inf = decr_every_n_nan_or_inf
+        self._incr_ratio = float(incr_ratio)
+        self._decr_ratio = float(decr_ratio)
+        self._dest_dtype = dest_dtype
+        self._loss_scaling = None
+
+    def get_loss_scaling(self):
+        return self._loss_scaling
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        main = loss.block.program
+        rewrite_program(main, self._amp_lists, self._dest_dtype)
+        params_grads = self._optimizer.backward(
+            loss, startup_program, parameter_list, no_grad_set, callbacks)
+        block = main.global_block()
+
+        # scale the loss by setting the autodiff op's loss_scale attr
+        for op in block.ops:
+            if op.type == "autodiff":
+                op.attrs["loss_scale"] = self._init_loss_scaling
+
+        helper_name = unique_name.generate("loss_scaling")
+        if self._use_dynamic:
+            self._loss_scaling = _scalar_var(
+                block, helper_name, "float32", self._init_loss_scaling)
+            self._good_steps = _scalar_var(
+                block, helper_name + "_good", "int32", 0)
+
+        new_pg = []
+        finite_names = []
+        if self._use_dynamic:
+            for p, g in params_grads:
+                fname = g.name + ".finite"
+                block.create_var(name=fname, shape=[], dtype="bool",
+                                 stop_gradient=True)
+                block.append_op("isfinite", {"X": [g.name]},
+                                {"Out": [fname]})
+                finite_names.append(fname)
+            all_finite = finite_names[0]
+            for fn in finite_names[1:]:
+                nxt = unique_name.generate("all_finite")
+                block.create_var(name=nxt, shape=[], dtype="bool",
+                                 stop_gradient=True)
+                block.append_op("logical_and", {"X": [all_finite], "Y": [fn]},
+                                {"Out": [nxt]})
+                all_finite = nxt
+            gate = unique_name.generate("amp_gate")
+            block.create_var(name=gate, shape=[], dtype="float32",
+                            stop_gradient=True)
+            block.append_op("cast", {"X": [all_finite]}, {"Out": [gate]},
+                            {"out_dtype": "float32"})
+            self._all_finite = all_finite
+            self._append_scale_update(block, gate)
+
+        inv = 1.0 / self._init_loss_scaling
+        for p, g in params_grads:
+            if inv != 1.0 or self._use_dynamic:
+                scaled = g.block.create_var(
+                    name=g.name + ".unscaled", shape=g.shape, dtype=g.dtype,
+                    stop_gradient=True)
+                block.append_op("scale", {"X": [g.name]},
+                                {"Out": [scaled.name]},
+                                {"scale": inv, "bias": 0.0,
+                                 "bias_after_scale": True})
+                if self._use_dynamic:
+                    # select, not multiply: inf * 0 == nan would poison params
+                    zeros = g.block.create_var(
+                        name=g.name + ".zeros", shape=g.shape, dtype=g.dtype,
+                        stop_gradient=True)
+                    block.append_op("zeros_like", {"X": [g.name]},
+                                    {"Out": [zeros.name]})
+                    gated = g.block.create_var(
+                        name=g.name + ".gated", shape=g.shape, dtype=g.dtype,
+                        stop_gradient=True)
+                    block.append_op("where",
+                                    {"Condition": [self._all_finite],
+                                     "X": [scaled.name], "Y": [zeros.name]},
+                                    {"Out": [gated.name]})
+                    scaled = gated
+                new_pg.append((p, scaled))
+            else:
+                new_pg.append((p, g))
+        return new_pg
+
+    def _append_scale_update(self, block, gate_name):
+        """loss_scaling/good_steps update in pure elementwise arithmetic:
+        scale' = finite ? (ready ? scale*incr : scale) : scale*decr
+        good'  = finite ? (ready ? 0 : good+1) : 0
+        """
+        u = unique_name.generate
+        s, good = self._loss_scaling.name, self._good_steps.name
+
+        def tmp(dtype="float32", shape=(1,)):
+            n = u("amp_ls")
+            block.create_var(name=n, shape=list(shape), dtype=dtype,
+                             stop_gradient=True)
+            return n
+
+        goodf = tmp()
+        block.append_op("cast", {"X": [good]}, {"Out": [goodf]},
+                        {"out_dtype": "float32"})
+        good1 = tmp()
+        block.append_op("scale", {"X": [goodf]}, {"Out": [good1]},
+                        {"scale": 1.0, "bias": 1.0, "bias_after_scale": True})
+        # ready = (good+1 >= incr_every_n) as float, via hard_sigmoid-free
+        # arithmetic: relu(sign(good+1 - n)) + (good+1 == n ? 1 : 0) —
+        # simpler: ready = cast(good1 >= n)
+        thresh = tmp()
+        block.append_op("fill_constant", outputs={"Out": [thresh]},
+                        attrs={"shape": [1], "dtype": "float32",
+                               "value": float(self._incr_every_n_steps)})
+        readyb = tmp("bool")
+        block.append_op("greater_equal", {"X": [good1], "Y": [thresh]},
+                        {"Out": [readyb]})
+        ready = tmp()
+        block.append_op("cast", {"X": [readyb]}, {"Out": [ready]},
+                        {"out_dtype": "float32"})
+
+        # factor = finite*(1 + ready*(incr-1)) + (1-finite)*decr
+        t1 = tmp()
+        block.append_op("scale", {"X": [ready]}, {"Out": [t1]},
+                        {"scale": self._incr_ratio - 1.0, "bias": 1.0,
+                         "bias_after_scale": True})
+        t2 = tmp()
+        block.append_op("elementwise_mul", {"X": [t1], "Y": [gate_name]},
+                        {"Out": [t2]}, {"axis": -1})
+        notf = tmp()
+        block.append_op("scale", {"X": [gate_name]}, {"Out": [notf]},
+                        {"scale": -1.0, "bias": 1.0, "bias_after_scale": True})
+        t3 = tmp()
+        block.append_op("scale", {"X": [notf]}, {"Out": [t3]},
+                        {"scale": self._decr_ratio, "bias": 0.0,
+                         "bias_after_scale": True})
+        factor = tmp()
+        block.append_op("elementwise_add", {"X": [t2], "Y": [t3]},
+                        {"Out": [factor]}, {"axis": -1})
+        news = tmp()
+        block.append_op("elementwise_mul", {"X": [s], "Y": [factor]},
+                        {"Out": [news]}, {"axis": -1})
+        block.append_op("assign", {"X": [news]}, {"Out": [s]})
+
+        # good' = finite * (1-ready) * (good+1)
+        t4 = tmp()
+        block.append_op("scale", {"X": [ready]}, {"Out": [t4]},
+                        {"scale": -1.0, "bias": 1.0, "bias_after_scale": True})
+        t5 = tmp()
+        block.append_op("elementwise_mul", {"X": [t4], "Y": [gate_name]},
+                        {"Out": [t5]}, {"axis": -1})
+        t6 = tmp()
+        block.append_op("elementwise_mul", {"X": [t5], "Y": [good1]},
+                        {"Out": [t6]}, {"axis": -1})
+        newgood = tmp("int32")
+        block.append_op("cast", {"X": [t6]}, {"Out": [newgood]},
+                        {"out_dtype": "int32"})
+        block.append_op("assign", {"X": [newgood]}, {"Out": [good]})
+
+    def apply_gradients(self, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        params_grads = self.backward(loss, startup_program, parameter_list,
+                                     no_grad_set)
+        optimize_ops = self.apply_gradients(params_grads)
+        return optimize_ops, params_grads
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling=1.0,
+             incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+             incr_ratio=2.0, decr_ratio=0.5,
+             use_dynamic_loss_scaling=False, dest_dtype="bfloat16"):
+    """Wrap an optimizer for mixed-precision training (reference
+    ``decorator.py:216``). TPU default: bfloat16, static scale 1.0."""
+    return OptimizerWithMixedPrecision(
+        optimizer, amp_lists, init_loss_scaling, use_dynamic_loss_scaling,
+        incr_every_n_steps, decr_every_n_nan_or_inf, incr_ratio, decr_ratio,
+        dest_dtype)

@@ -1,0 +1,49 @@
+"""AMP op lists: which ops run in low precision (bf16/fp16), which must
+stay fp32, and which follow their inputs.
+
+Parity: reference ``contrib/mixed_precision/fp16_lists.py``. TPU note: the
+white list is the MXU ops (matmul/conv) — on TPU the low-precision dtype of
+choice is bfloat16, whose fp32-range exponent makes loss scaling optional.
+"""
+
+__all__ = ["AutoMixedPrecisionLists"]
+
+# ops that benefit from low precision (MXU-bound)
+white_list = {
+    "conv2d", "conv3d", "depthwise_conv2d", "conv2d_transpose",
+    "conv3d_transpose", "matmul", "mul", "bmm",
+}
+
+# numerically sensitive ops kept in fp32
+black_list = {
+    "exp", "log", "square", "softmax", "log_softmax", "mean", "sum",
+    "reduce_sum", "reduce_mean", "cos_sim", "softmax_with_cross_entropy",
+    "sigmoid_cross_entropy_with_logits", "cross_entropy", "layer_norm",
+    "batch_norm", "group_norm", "instance_norm", "l2_normalize",
+}
+
+# everything else follows its inputs (elementwise, activations, shape ops)
+gray_list = {
+    "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "elementwise_div", "elementwise_max", "elementwise_min", "relu", "gelu",
+    "tanh", "sigmoid", "dropout", "pool2d", "pool3d", "reshape", "transpose",
+    "concat", "split", "slice", "flatten", "squeeze", "unsqueeze", "stack",
+    "scale", "cast", "pad", "gather", "lookup_table", "lookup_table_v2",
+}
+
+
+class AutoMixedPrecisionLists:
+    """User-tunable white/black lists (reference ``fp16_lists.py:23``)."""
+
+    def __init__(self, custom_white_list=None, custom_black_list=None,
+                 custom_black_varnames=None):
+        self.white_list = set(white_list)
+        self.black_list = set(black_list)
+        self.gray_list = set(gray_list)
+        self.black_varnames = set(custom_black_varnames or [])
+        for t in custom_white_list or []:
+            self.black_list.discard(t)
+            self.white_list.add(t)
+        for t in custom_black_list or []:
+            self.white_list.discard(t)
+            self.black_list.add(t)

@@ -95,7 +95,10 @@ def _feed_signature(feed, block):
     sig = []
     for name in sorted(feed):
         arr = feed[name]
-        sig.append((name, tuple(np.shape(arr)), str(np.asarray(arr).dtype)))
+        dt = getattr(arr, "dtype", None)  # avoid np.asarray on device arrays
+        if dt is None:
+            dt = np.asarray(arr).dtype
+        sig.append((name, tuple(np.shape(arr)), str(dt)))
     return tuple(sig)
 
 
@@ -144,8 +147,12 @@ class Executor:
 
         block = program.global_block()
 
-        # normalize feeds to declared dtype
+        # normalize feeds to declared dtype; device-resident jax Arrays pass
+        # through untouched (the DataLoader/buffered-reader path pre-stages
+        # H2D transfers — critical when the chip sits behind a slow link)
         for name in list(feed):
+            if isinstance(feed[name], jax.Array):
+                continue
             var = block._find_var_recursive(name)
             arr = np.asarray(feed[name])
             if var is not None and arr.dtype != var.dtype:

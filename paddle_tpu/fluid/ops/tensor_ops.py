@@ -100,8 +100,10 @@ def _strided_slice(ctx, op):
 
 @register("cast")
 def _cast(ctx, op):
+    from ..framework import convert_dtype
+
     x = ctx.get_input(op, "X")
-    dtype = np.dtype(op.attr("out_dtype", op.attr("dtype", "float32")))
+    dtype = convert_dtype(op.attr("out_dtype", op.attr("dtype", "float32")))
     ctx.set_output(op, "Out", x.astype(dtype))
 
 

@@ -82,6 +82,9 @@ def _encoder_layer(x, attn_bias, cfg, prefix):
 
 def bert_encoder(src_ids, pos_ids, sent_ids, input_mask, cfg):
     """input_mask: [B, S, 1] float (1 = token, 0 = pad). Returns [B, S, H]."""
+    assert src_ids.shape[-1] <= cfg.max_seq, (
+        f"seq_len {src_ids.shape[-1]} exceeds cfg.max_seq {cfg.max_seq}: "
+        "positions past max_seq would silently clamp in the pos-emb gather")
     emb = layers.embedding(src_ids, size=[cfg.vocab_size, cfg.hidden],
                            param_attr=fluid.ParamAttr(name="word_emb"))
     emb = layers.elementwise_add(
@@ -122,7 +125,8 @@ def mlm_loss(enc, mask_label, mask_weight, cfg):
                                                               1e-6)))
 
 
-def build_pretrain_program(cfg=None, seq_len=128, lr=1e-4, seed=7):
+def build_pretrain_program(cfg=None, seq_len=128, lr=1e-4, seed=7,
+                           use_amp=False):
     cfg = cfg or BertConfig.base()
     main, startup = fluid.Program(), fluid.Program()
     main.random_seed = seed
@@ -136,7 +140,12 @@ def build_pretrain_program(cfg=None, seq_len=128, lr=1e-4, seed=7):
                               dtype="float32")
         enc = bert_encoder(src, pos, sent, imask, cfg)
         loss = mlm_loss(enc, mlabel, mweight, cfg)
-        optimizer.Adam(learning_rate=lr).minimize(loss)
+        opt = optimizer.Adam(learning_rate=lr)
+        if use_amp:
+            from ..fluid.contrib import mixed_precision
+
+            opt = mixed_precision.decorate(opt)
+        opt.minimize(loss)
     return main, startup, loss
 
 

@@ -198,15 +198,19 @@ class Transformer(Layer):
                     "bias_after_scale": True})
         return x + self.pos_emb(pos_ids) if pos_ids is not None else x
 
-    def forward(self, src_ids, tgt_ids, pos_src, pos_tgt, causal_bias):
+    def forward(self, src_ids, tgt_ids, pos_src, pos_tgt, causal_bias,
+                src_bias=None):
+        """src_bias: optional [B, 1, 1, S_src] additive padding mask (0 keep,
+        -1e4 pad) applied to encoder self-attention and decoder
+        cross-attention; None = no source padding."""
         enc = dropout(self._embed(src_ids, self.src_emb, pos_src),
                       self.dropout_rate, is_test=not self.training)
         for l in self.enc_layers:
-            enc = l(enc, None)
+            enc = l(enc, src_bias)
         dec = dropout(self._embed(tgt_ids, self.tgt_emb, pos_tgt),
                       self.dropout_rate, is_test=not self.training)
         for l in self.dec_layers:
-            dec = l(dec, enc, causal_bias, None)
+            dec = l(dec, enc, causal_bias, src_bias)
         return self.proj(dec)
 
 

@@ -1,0 +1,125 @@
+"""AMP (mixed precision) tests — reference contrib/mixed_precision
+(test_mixed_precision_decorate / test_image_classification_fp16 analogues)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers, optimizer
+from paddle_tpu.fluid.contrib import mixed_precision
+from paddle_tpu.models import bert, lenet
+
+
+def test_rewrite_program_inserts_casts():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[16], dtype="float32")
+        y = layers.fc(x, 8)
+        loss = layers.mean(y)
+    n_casts_before = sum(1 for op in main.global_block().ops
+                         if op.type == "cast")
+    mixed_precision.rewrite_program(
+        main, mixed_precision.AutoMixedPrecisionLists(), "bfloat16")
+    casts = [op for op in main.global_block().ops if op.type == "cast"]
+    assert len(casts) > n_casts_before
+    # the matmul (white) now consumes bf16-cast inputs
+    mm = next(op for op in main.global_block().ops
+              if op.type in ("mul", "matmul"))
+    assert any(n.endswith(".cast_bfloat16") for n in mm.input_arg_names())
+
+
+def test_amp_lenet_trains():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 7
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", shape=[1, 28, 28], dtype="float32")
+        label = layers.data("label", shape=[1], dtype="int64")
+        _, loss, acc = lenet.lenet_forward(img, label)
+        opt = mixed_precision.decorate(optimizer.Adam(learning_rate=1e-3))
+        opt.minimize(loss)
+    exe = fluid.Executor()
+    rng = np.random.RandomState(0)
+    feed = {"img": rng.rand(16, 1, 28, 28).astype(np.float32),
+            "label": rng.randint(0, 10, (16, 1)).astype(np.int64)}
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = [float(np.asarray(exe.run(main, feed=feed,
+                                           fetch_list=[loss])[0]))
+                  for _ in range(6)]
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
+
+
+def test_amp_dynamic_loss_scaling_updates():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4], dtype="float32")
+        y = layers.fc(x, 4)
+        loss = layers.mean(y)
+        opt = mixed_precision.decorate(
+            optimizer.SGD(learning_rate=0.1), init_loss_scaling=32.0,
+            use_dynamic_loss_scaling=True, incr_every_n_steps=2)
+        opt.minimize(loss)
+    scale_var = opt.get_loss_scaling()
+    exe = fluid.Executor()
+    feed = {"x": np.ones((4, 4), np.float32)}
+    with fluid.scope_guard(fluid.Scope()) as _:
+        exe.run(startup)
+        scales = []
+        for _ in range(4):
+            out = exe.run(main, feed=feed, fetch_list=[loss, scale_var])
+            scales.append(float(np.asarray(out[1])))
+    # finite grads throughout; fetch sees the post-step value: good-step
+    # counter hits incr_every_n=2 at steps 1 and 3 -> scale doubles there
+    assert scales == [32.0, 64.0, 64.0, 128.0]
+
+
+def test_amp_overflow_halves_scale_and_protects_params():
+    """fp16 overflow: inf grads must be gated with a select (inf*0 = nan
+    would poison params) and the dynamic scale must halve."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4], dtype="float32")
+        y = layers.fc(x, 4)
+        loss = layers.mean(y)
+        opt = mixed_precision.decorate(
+            optimizer.SGD(learning_rate=0.1), init_loss_scaling=256.0,
+            use_dynamic_loss_scaling=True, dest_dtype="float16")
+        opt.minimize(loss)
+    sv = opt.get_loss_scaling()
+    w = main.global_block().all_parameters()[0]
+    exe = fluid.Executor()
+    feed = {"x": np.full((4, 4), 6e4, np.float32)}  # overflows fp16 matmul
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        scales = []
+        for _ in range(3):
+            out = exe.run(main, feed=feed, fetch_list=[loss, sv, w])
+            scales.append(float(np.asarray(out[1]).ravel()[0]))
+            assert np.isfinite(np.asarray(out[2])).all(), "params poisoned"
+    assert scales == [128.0, 64.0, 32.0]
+
+
+def test_amp_bert_tiny_trains():
+    cfg = bert.BertConfig.tiny()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 7
+    with fluid.program_guard(main, startup):
+        src = layers.data("src_ids", shape=[32], dtype="int64")
+        pos = layers.data("pos_ids", shape=[32], dtype="int64")
+        sent = layers.data("sent_ids", shape=[32], dtype="int64")
+        imask = layers.data("input_mask", shape=[32, 1], dtype="float32")
+        mlabel = layers.data("mask_label", shape=[32, 1], dtype="int64")
+        mweight = layers.data("mask_weight", shape=[32, 1], dtype="float32")
+        enc = bert.bert_encoder(src, pos, sent, imask, cfg)
+        loss = bert.mlm_loss(enc, mlabel, mweight, cfg)
+        opt = mixed_precision.decorate(optimizer.Adam(learning_rate=1e-3))
+        opt.minimize(loss)
+    batch = bert.synthetic_batch(cfg, 4, 32)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = [float(np.asarray(exe.run(main, feed=batch,
+                                           fetch_list=[loss])[0]))
+                  for _ in range(6)]
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
