@@ -1,0 +1,10 @@
+"""Built-in dataset readers (reference ``python/paddle/dataset/``).
+
+Each module exposes ``train()``/``test()`` reader creators. Files are
+served from the local cache dir (``~/.cache/paddle_tpu/dataset``); in
+network-less environments a deterministic synthetic fallback keeps
+pipelines and tests runnable (set ``PADDLE_TPU_DATASET_STRICT=1`` to
+error instead).
+"""
+
+from . import cifar, common, imdb, mnist, uci_housing  # noqa: F401

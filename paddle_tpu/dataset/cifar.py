@@ -1,0 +1,62 @@
+"""CIFAR-10/100 readers (reference ``dataset/cifar.py``): yields
+(image[3072] float32 in [0,1], label int)."""
+
+import pickle
+import tarfile
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train10", "test10", "train100", "test100"]
+
+CIFAR10_URL = "https://dataset.bj.bcebos.com/cifar/cifar-10-python.tar.gz"
+CIFAR10_MD5 = "c58f30108f718f92721af3b95e74349a"
+CIFAR100_URL = "https://dataset.bj.bcebos.com/cifar/cifar-100-python.tar.gz"
+CIFAR100_MD5 = "eb9058c3a382ffc7106e4002c42a8d85"
+
+
+def _reader(url, md5, sub_name, label_key, n_classes, n_synth, seed):
+    def rd():
+        try:
+            path = common.download(url, "cifar", md5)
+        except IOError:
+            if not common.synthetic_allowed():
+                raise
+            common._warn_synthetic("cifar")
+            rng = np.random.RandomState(seed)
+            for _ in range(n_synth):
+                yield (rng.rand(3072).astype("float32"),
+                       int(rng.randint(0, n_classes)))
+            return
+        with tarfile.open(path, mode="r") as tf:
+            for member in tf.getmembers():
+                if sub_name not in member.name:
+                    continue
+                batch = pickle.load(tf.extractfile(member), encoding="bytes")
+                data = batch[b"data"].astype("float32") / 255.0
+                labels = batch.get(label_key)
+                for x, y in zip(data, labels):
+                    yield x, int(y)
+
+    return rd
+
+
+def train10():
+    return _reader(CIFAR10_URL, CIFAR10_MD5, "data_batch", b"labels", 10,
+                   1024, 0)
+
+
+def test10():
+    return _reader(CIFAR10_URL, CIFAR10_MD5, "test_batch", b"labels", 10,
+                   256, 1)
+
+
+def train100():
+    return _reader(CIFAR100_URL, CIFAR100_MD5, "train", b"fine_labels", 100,
+                   1024, 2)
+
+
+def test100():
+    return _reader(CIFAR100_URL, CIFAR100_MD5, "test", b"fine_labels", 100,
+                   256, 3)

@@ -1,0 +1,53 @@
+"""Dataset cache/download helpers (reference ``dataset/common.py``)."""
+
+import hashlib
+import os
+import warnings
+
+__all__ = ["DATA_HOME", "download", "md5file", "synthetic_allowed"]
+
+DATA_HOME = os.path.expanduser(
+    os.environ.get("PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/dataset"))
+
+
+def md5file(fname):
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def synthetic_allowed():
+    return os.environ.get("PADDLE_TPU_DATASET_STRICT", "0") != "1"
+
+
+def download(url, module_name, md5sum, save_name=None):
+    """Returns the cached path; downloads if absent and the environment has
+    network access. In sealed environments, callers fall back to synthetic
+    data (see package docstring)."""
+    dirname = os.path.join(DATA_HOME, module_name)
+    os.makedirs(dirname, exist_ok=True)
+    filename = os.path.join(dirname,
+                            save_name or url.split("/")[-1])
+    if os.path.exists(filename) and (not md5sum or
+                                     md5file(filename) == md5sum):
+        return filename
+    try:
+        import urllib.request
+
+        urllib.request.urlretrieve(url, filename)  # nosec - dataset fetch
+        return filename
+    except Exception as e:  # no network (the normal case on TPU pods)
+        if os.path.exists(filename):
+            os.remove(filename)
+        raise IOError(
+            f"cannot download {url} ({e}); place the file at {filename} "
+            "or rely on the synthetic fallback") from e
+
+
+def _warn_synthetic(name):
+    warnings.warn(
+        f"dataset {name!r}: no cached file and no network -> serving "
+        "deterministic synthetic samples (shapes/dtypes match the real "
+        "data). Set PADDLE_TPU_DATASET_STRICT=1 to error instead.")

@@ -1,0 +1,74 @@
+"""IMDB sentiment reader (reference ``dataset/imdb.py``): yields
+(word-id list, label 0/1)."""
+
+import re
+import tarfile
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "word_dict"]
+
+URL = "https://dataset.bj.bcebos.com/imdb%2FaclImdb_v1.tar.gz"
+MD5 = "7c2ac02c03563afcf9b574c7e56c153a"
+
+_TOKEN = re.compile(r"[A-Za-z']+")
+_SYNTH_VOCAB = 5000
+
+
+def word_dict():
+    try:
+        path = common.download(URL, "imdb", MD5)
+    except IOError:
+        if not common.synthetic_allowed():
+            raise
+        return {("w%d" % i).encode(): i for i in range(_SYNTH_VOCAB)}
+    freq = {}
+    with tarfile.open(path, mode="r") as tf:
+        for member in tf.getmembers():
+            if re.match(r"aclImdb/(train|test)/(pos|neg)/.*\.txt$",
+                        member.name):
+                doc = tf.extractfile(member).read().decode("latin-1").lower()
+                for w in _TOKEN.findall(doc):
+                    freq[w] = freq.get(w, 0) + 1
+    words = sorted(freq, key=lambda w: (-freq[w], w))
+    return {w.encode(): i for i, w in enumerate(words)}
+
+
+def _reader(pattern, wd, n_synth, seed):
+    def rd():
+        try:
+            path = common.download(URL, "imdb", MD5)
+        except IOError:
+            if not common.synthetic_allowed():
+                raise
+            common._warn_synthetic("imdb")
+            rng = np.random.RandomState(seed)
+            for _ in range(n_synth):
+                n = int(rng.randint(8, 64))
+                yield (list(rng.randint(0, _SYNTH_VOCAB, n)),
+                       int(rng.randint(0, 2)))
+            return
+        unk = len(wd)
+        with tarfile.open(path, mode="r") as tf:
+            for member in tf.getmembers():
+                m = re.match(pattern, member.name)
+                if not m:
+                    continue
+                label = 1 if m.group(1) == "pos" else 0
+                doc = tf.extractfile(member).read().decode("latin-1").lower()
+                ids = [wd.get(w.encode(), unk) for w in _TOKEN.findall(doc)]
+                yield ids, label
+
+    return rd
+
+
+def train(wd=None):
+    return _reader(r"aclImdb/train/(pos|neg)/.*\.txt$",
+                   wd or word_dict(), 512, 0)
+
+
+def test(wd=None):
+    return _reader(r"aclImdb/test/(pos|neg)/.*\.txt$",
+                   wd or word_dict(), 128, 1)

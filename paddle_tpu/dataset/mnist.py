@@ -1,0 +1,56 @@
+"""MNIST reader (reference ``dataset/mnist.py``): yields
+(image[784] float32 in [-1,1], label int64)."""
+
+import gzip
+import struct
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test"]
+
+URL_PREFIX = "https://dataset.bj.bcebos.com/mnist/"
+TRAIN_IMAGE_MD5 = "f68b3c2dcbeaaa9fbdd348bbdeb94873"
+TRAIN_LABEL_MD5 = "d53e105ee54ea40749a09fcbcd1e9432"
+TEST_IMAGE_MD5 = "9fb629c4189551a2d022fa330f9573f3"
+TEST_LABEL_MD5 = "ec29112dd5afa0611ce80d1b7f02629c"
+
+
+def _reader(image_url, image_md5, label_url, label_md5, n_synth, seed):
+    def rd():
+        try:
+            img_path = common.download(image_url, "mnist", image_md5)
+            lbl_path = common.download(label_url, "mnist", label_md5)
+        except IOError:
+            if not common.synthetic_allowed():
+                raise
+            common._warn_synthetic("mnist")
+            rng = np.random.RandomState(seed)
+            for _ in range(n_synth):
+                yield (rng.rand(784).astype("float32") * 2 - 1,
+                       int(rng.randint(0, 10)))
+            return
+        with gzip.open(img_path, "rb") as f_img, \
+                gzip.open(lbl_path, "rb") as f_lbl:
+            _, n, rows, cols = struct.unpack(">IIII", f_img.read(16))
+            struct.unpack(">II", f_lbl.read(8))
+            for _ in range(n):
+                img = np.frombuffer(f_img.read(rows * cols), "uint8")
+                img = img.astype("float32") / 127.5 - 1.0
+                (label,) = struct.unpack("B", f_lbl.read(1))
+                yield img, int(label)
+
+    return rd
+
+
+def train():
+    return _reader(URL_PREFIX + "train-images-idx3-ubyte.gz", TRAIN_IMAGE_MD5,
+                   URL_PREFIX + "train-labels-idx1-ubyte.gz", TRAIN_LABEL_MD5,
+                   n_synth=1024, seed=0)
+
+
+def test():
+    return _reader(URL_PREFIX + "t10k-images-idx3-ubyte.gz", TEST_IMAGE_MD5,
+                   URL_PREFIX + "t10k-labels-idx1-ubyte.gz", TEST_LABEL_MD5,
+                   n_synth=256, seed=1)

@@ -1,0 +1,49 @@
+"""UCI housing reader (reference ``dataset/uci_housing.py``): yields
+(features[13] float32, price[1] float32), feature-normalized."""
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test"]
+
+URL = "https://dataset.bj.bcebos.com/uci_housing/housing.data"
+MD5 = "d4accdce7a25600298819f8e28e8d593"
+FEATURE_NUM = 13
+
+
+def _load():
+    try:
+        path = common.download(URL, "uci_housing", MD5)
+        data = np.loadtxt(path).astype("float32")
+    except IOError:
+        if not common.synthetic_allowed():
+            raise
+        common._warn_synthetic("uci_housing")
+        rng = np.random.RandomState(0)
+        x = rng.rand(506, FEATURE_NUM).astype("float32")
+        w = rng.rand(FEATURE_NUM, 1).astype("float32")
+        data = np.concatenate([x, x @ w + 0.1 * rng.rand(506, 1)], 1)
+    feats = data[:, :FEATURE_NUM]
+    mu, sigma = feats.mean(0), feats.std(0) + 1e-6
+    data[:, :FEATURE_NUM] = (feats - mu) / sigma
+    split = int(len(data) * 0.8)
+    return data[:split], data[split:]
+
+
+def train():
+    def rd():
+        tr, _ = _load()
+        for row in tr:
+            yield row[:FEATURE_NUM], row[FEATURE_NUM:]
+
+    return rd
+
+
+def test():
+    def rd():
+        _, te = _load()
+        for row in te:
+            yield row[:FEATURE_NUM], row[FEATURE_NUM:]
+
+    return rd

@@ -1,0 +1,13 @@
+"""Reader composition library (reference ``python/paddle/reader/``)."""
+
+from .decorator import (  # noqa: F401
+    buffered,
+    cache,
+    chain,
+    compose,
+    firstn,
+    map_readers,
+    multiprocess_reader,
+    shuffle,
+    xmap_readers,
+)

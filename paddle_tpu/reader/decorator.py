@@ -1,0 +1,189 @@
+"""Reader decorators: compose sample generators.
+
+Parity: reference ``python/paddle/reader/decorator.py`` (map_readers:
+``:44``, shuffle ``:62``, chain ``:90``, compose ``:126``, buffered
+``:168``, firstn ``:206``, xmap_readers ``:220``, multiprocess_reader
+``:320``, cache ``:30``). A "reader" is a zero-arg callable returning a
+sample iterator.
+"""
+
+import itertools
+import queue as _queue
+import random as _random
+import threading
+
+__all__ = ["map_readers", "shuffle", "chain", "compose", "buffered",
+           "firstn", "xmap_readers", "multiprocess_reader", "cache"]
+
+
+def cache(reader):
+    all_data = tuple(reader())
+
+    def rd():
+        return iter(all_data)
+
+    return rd
+
+
+def map_readers(func, *readers):
+    def rd():
+        for items in zip(*[r() for r in readers]):
+            yield func(*items)
+
+    return rd
+
+
+def shuffle(reader, buf_size):
+    def rd():
+        buf = []
+        for s in reader():
+            buf.append(s)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+
+    return rd
+
+
+def chain(*readers):
+    def rd():
+        return itertools.chain(*[r() for r in readers])
+
+    return rd
+
+
+def compose(*readers, **kwargs):
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def _flatten(item):
+        if isinstance(item, tuple):
+            return item
+        return (item,)
+
+    def rd():
+        its = [r() for r in readers]
+        for items in (zip(*its) if check_alignment
+                      else itertools.zip_longest(*its)):
+            yield sum((_flatten(i) for i in items), ())
+
+    return rd
+
+
+def buffered(reader, size):
+    """Background-thread prefetch queue of ``size`` samples."""
+    end = object()
+
+    def rd():
+        q = _queue.Queue(maxsize=size)
+
+        def fill():
+            try:
+                for s in reader():
+                    q.put(s)
+            finally:
+                q.put(end)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            s = q.get()
+            if s is end:
+                break
+            yield s
+
+    return rd
+
+
+def firstn(reader, n):
+    def rd():
+        return itertools.islice(reader(), n)
+
+    return rd
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map over samples with worker threads (reference uses
+    threads too — the mappers are usually IO/numpy bound)."""
+    end = object()
+
+    def rd():
+        in_q = _queue.Queue(buffer_size)
+        out_q = _queue.Queue(buffer_size)
+
+        def feed():
+            for i, s in enumerate(reader()):
+                in_q.put((i, s))
+            for _ in range(process_num):
+                in_q.put(end)
+
+        def work():
+            while True:
+                item = in_q.get()
+                if item is end:
+                    out_q.put(end)
+                    break
+                i, s = item
+                out_q.put((i, mapper(s)))
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+
+        done = 0
+        if order:
+            import heapq
+
+            heap, next_i = [], 0
+            while done < process_num:
+                item = out_q.get()
+                if item is end:
+                    done += 1
+                    continue
+                heapq.heappush(heap, item)
+                while heap and heap[0][0] == next_i:
+                    yield heapq.heappop(heap)[1]
+                    next_i += 1
+            while heap:
+                yield heapq.heappop(heap)[1]
+        else:
+            while done < process_num:
+                item = out_q.get()
+                if item is end:
+                    done += 1
+                    continue
+                yield item[1]
+
+    return rd
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Multi-process fan-in of several readers. Implemented with threads
+    running each reader (samples are numpy — the GIL is released in C) to
+    stay fork-safe under JAX runtimes; same interleaved-stream semantics."""
+    end = object()
+
+    def rd():
+        q = _queue.Queue(queue_size)
+
+        def run(r):
+            try:
+                for s in r():
+                    q.put(s)
+            finally:
+                q.put(end)
+
+        for r in readers:
+            threading.Thread(target=run, args=(r,), daemon=True).start()
+        done = 0
+        while done < len(readers):
+            s = q.get()
+            if s is end:
+                done += 1
+                continue
+            yield s
+
+    return rd
