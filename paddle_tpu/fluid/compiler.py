@@ -71,6 +71,33 @@ class CompiledProgram:
         self._places = places
         return self
 
+    def with_pipeline(self, loss_name=None, places=None, num_microbatches=2,
+                      microbatch_vars=None):
+        """Pipeline-parallel execution of a Program whose optimizer was
+        wrapped in ``PipelineOptimizer`` (cut points recorded on
+        ``program._pipeline_cut_vars``).
+
+        TPU-native redesign of the reference's section trainer
+        (``PipelineTrainer`` trainer.h:114, scope queues + host threads):
+        the forward ops are split into stages at the cut vars; all stages
+        execute as ONE SPMD program over the ``pp`` mesh axis — each rank
+        selects its stage with ``lax.switch``, activations hop rank→rank by
+        ``ppermute``, and the GPipe fill/drain schedule is a ``lax.scan``
+        over ``M + P - 1`` ticks (see paddle_tpu/parallel/pipeline.py). The
+        backward schedule falls out of differentiating the scan. Contract
+        (GPipe's): activations at every cut share one shape.
+        """
+        self._is_data_parallel = True
+        self._mode = "pipeline"
+        self._loss_name = loss_name
+        self._places = places
+        self._mesh_axes = ("pp",)
+        self._num_microbatches = int(num_microbatches)
+        self._microbatch_vars = (set(
+            v.name if hasattr(v, "name") else str(v) for v in microbatch_vars)
+            if microbatch_vars is not None else None)
+        return self
+
     def with_explicit_collectives(self, loss_name=None, places=None,
                                   mesh_axes=("dp",)):
         """SPMD execution via shard_map: every op runs per-shard and the
@@ -118,10 +145,211 @@ class CompiledProgram:
             ctx.shard_sizes = dict(mesh.shape)
 
     def wrap_step(self, step, program, block, feed, fetch_names, state_names):
-        if getattr(self, "_mode", "gspmd") == "shard_map":
+        mode = getattr(self, "_mode", "gspmd")
+        if mode == "shard_map":
             return self._wrap_step_shard_map(step, feed, fetch_names,
                                              state_names)
+        if mode == "pipeline":
+            return self._wrap_step_pipeline(program, block, feed,
+                                            fetch_names, state_names)
         return self._wrap_step_gspmd(step, feed, fetch_names, state_names)
+
+    def _wrap_step_pipeline(self, program, block, feed, fetch_names,
+                            state_names):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from .registry import LowerCtx, registry
+
+        mesh = self.mesh
+        axis = mesh.axis_names[0]
+        n_stages = mesh.shape[axis]
+        M = self._num_microbatches
+        cuts = [names[0] for names in
+                getattr(program, "_pipeline_cut_vars", [])]
+        if len(cuts) != n_stages - 1:
+            raise ValueError(
+                "PipelineOptimizer recorded %d cut vars but the mesh has %d "
+                "pp ranks (need exactly ranks-1 cuts)" % (len(cuts), n_stages))
+
+        ops = block.ops
+        ad_idx = next(i for i, o in enumerate(ops) if o.type == "autodiff")
+        ad_op = ops[ad_idx]
+        fwd_ops, post_ops = ops[:ad_idx], ops[ad_idx + 1:]
+        wrt = list(ad_op.attr("wrt"))
+        grad_names = list(ad_op.attr("grad_names"))
+        loss_name = self._loss_name or ad_op.attr("loss")
+
+        producer = {}
+        for i, o in enumerate(fwd_ops):
+            for nm in o.output_arg_names():
+                producer[nm] = i
+        segments, start = [], 0
+        for c in cuts:
+            segments.append(fwd_ops[start:producer[c] + 1])
+            start = producer[c] + 1
+        segments.append(fwd_ops[start:])
+
+        def make_stage(seg, out_name, is_last):
+            def stage(env_base, x_recv, in_name, rng):
+                env = dict(env_base)
+                if in_name is not None:
+                    env[in_name] = x_recv
+                ctx = LowerCtx(block, env, rng)
+                for o in seg:
+                    registry.get(o.type).lower(ctx, o)
+                if is_last:
+                    loss = env[loss_name]
+                    if loss.ndim > 0:
+                        loss = jnp.mean(loss)
+                    return jnp.zeros_like(x_recv), loss
+                return env[out_name], jnp.zeros((), "float32")
+            return stage
+
+        stages = []
+        for r, seg in enumerate(segments):
+            stages.append(make_stage(
+                seg, cuts[r] if r < n_stages - 1 else None,
+                r == n_stages - 1))
+        stage_ins = [None] + cuts  # stage r consumes cuts[r-1]
+
+        # Which feeds are batch-major? Explicit list wins; otherwise infer
+        # the batch size as the most common leading dim among feeds (a bare
+        # divisibility test would slice e.g. a (seq, seq) attention mask).
+        explicit = getattr(self, "_microbatch_vars", None)
+        if explicit is not None:
+            mb_names = sorted(n for n in feed if n in explicit)
+        else:
+            from collections import Counter
+
+            lead = Counter(np.shape(feed[n])[0] for n in feed
+                           if np.ndim(feed[n]) >= 1)
+            batch_dims = [d for d, c in lead.items()
+                          if c == max(lead.values())] if lead else []
+            if len(batch_dims) != 1:
+                raise ValueError(
+                    "cannot infer the batch-major feeds (leading dims %r); "
+                    "pass microbatch_vars=[...] to with_pipeline" % (lead,))
+            bdim = batch_dims[0]
+            if bdim % M != 0:
+                raise ValueError(
+                    "batch dim %d not divisible by num_microbatches %d"
+                    % (bdim, M))
+            mb_names = sorted(n for n in feed
+                              if np.ndim(feed[n]) >= 1
+                              and np.shape(feed[n])[0] == bdim)
+        full_names = sorted(n for n in feed if n not in mb_names)
+
+        def kernel(params, rest_state, mb_feeds, full_feeds, rng):
+            # advance the persistent RNG state every step (dropout masks
+            # must differ across steps); stages draw from step_rng
+            step_rng, next_rng = jax.random.split(rng)
+            rng = step_rng
+            rank = jax.lax.axis_index(axis)
+            perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+            # probe the cut activation shape with microbatch 0 through
+            # stage 0 (the GPipe uniform-activation contract); XLA dedups
+            # this against the first real tick
+            env0 = {**rest_state, **params,
+                    **{k: v[0] for k, v in mb_feeds.items()},
+                    **full_feeds}
+            y0, _ = stages[0](env0, jnp.zeros((), "float32"), None, rng)
+            tmpl = jnp.zeros_like(y0)
+
+            def fwd(ps):
+                def tick(carry, t):
+                    recv, loss_acc = carry
+                    mb = jnp.clip(t - rank, 0, M - 1)
+                    env_base = {**rest_state, **ps,
+                                **{k: jax.lax.dynamic_index_in_dim(
+                                    v, mb, 0, keepdims=False)
+                                   for k, v in mb_feeds.items()},
+                                **full_feeds}
+                    branches = [
+                        (lambda eb, xr, rg, _s=s, _in=stage_ins[r]:
+                         _s(eb, xr, _in, rg))
+                        for r, s in enumerate(stages)
+                    ]
+                    y, l = jax.lax.switch(
+                        rank, branches, env_base, recv,
+                        jax.random.fold_in(rng, t))
+                    valid = ((rank == n_stages - 1) & (t - rank >= 0)
+                             & (t - rank < M))
+                    loss_acc = loss_acc + jnp.where(valid, l, 0.0)
+                    recv = jax.lax.ppermute(y, axis, perm)
+                    return (recv, loss_acc), None
+
+                (_, loss_acc), _ = jax.lax.scan(
+                    tick, (tmpl, jnp.zeros((), "float32")),
+                    jnp.arange(M + n_stages - 1))
+                # return the LOCAL contribution (nonzero on the last rank
+                # only): grads flow back across ranks through the ppermute
+                # transpose, and one psum below aggregates them — psumming
+                # the loss in here too would double-count every cotangent
+                return loss_acc / M
+
+            local_loss, grads = jax.value_and_grad(fwd)(params)
+            loss = jax.lax.psum(local_loss, axis)
+            grads = jax.tree_util.tree_map(
+                lambda g: jax.lax.psum(g, axis), grads)
+
+            # run the post-autodiff ops (optimizer updates etc.) with the
+            # pipelined grads bound to the autodiff op's output names
+            env = {**rest_state, **params, **full_feeds,
+                   **{k: v[0] for k, v in mb_feeds.items()}}
+            env[loss_name] = loss
+            for gn, wn in zip(grad_names, wrt):
+                env[gn] = grads[wn]
+            ctx = LowerCtx(block, env, rng)
+            for o in post_ops:
+                registry.get(o.type).lower(ctx, o)
+
+            new_params = {n: env[n] for n in params}
+            new_rest = {n: env[n] for n in rest_state}
+            fetches = []
+            for fn_ in fetch_names:
+                if fn_ == loss_name:
+                    fetches.append(loss)
+                elif fn_ in env:
+                    fetches.append(env[fn_])
+                else:
+                    raise KeyError(
+                        "pipeline mode can fetch the loss or persistable "
+                        "vars, not intermediate %r" % fn_)
+            return fetches, new_params, new_rest, next_rng
+
+        repl = NamedSharding(mesh, P())
+        smapped = jax.shard_map(
+            kernel, mesh=mesh,
+            in_specs=(P(), P(), P(), P(), P()),
+            out_specs=(P(), P(), P(), P()),
+            check_vma=False)
+        jfn = jax.jit(smapped, donate_argnums=(0, 1))
+
+        def fn(state, feed_vals, rng):
+            params = {n: state[n] for n in state if n in wrt}
+            rest = {n: state[n] for n in state if n not in wrt}
+            mbf, fullf = {}, {}
+            for k, v in feed_vals.items():
+                if k in mb_names:
+                    arr = jnp.asarray(v)
+                    mbf[k] = arr.reshape((M, arr.shape[0] // M)
+                                         + arr.shape[1:])
+                else:
+                    fullf[k] = jnp.asarray(v)
+            put = lambda tree: jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, repl), tree)
+            fetches, new_params, new_rest, new_rng = jfn(
+                put(params), put(rest), put(mbf), put(fullf),
+                jax.device_put(rng, repl))
+            new_state = dict(new_rest)
+            new_state.update(new_params)
+            return fetches, new_state, new_rng
+
+        return fn
 
     def _wrap_step_shard_map(self, step, feed, fetch_names, state_names):
         """SPMD per-shard execution; program collectives do the syncing."""

@@ -6,12 +6,15 @@ exponent range → ``init_loss_scaling=1.0`` and no dynamic scaling needed);
 fp16 semantics (scaling + inf/nan-gated updates) are kept for parity and
 for the rare fp16 deployment.
 
-Dynamic loss scaling: grads are checked with ``isfinite``; on overflow the
-whole gradient set is zeroed for that step (a zero-grad optimizer step —
-accumulator decay still advances, a deliberate simplification vs the
-reference's conditional skip block) and the scale is multiplied by
-``decr_ratio``; after ``incr_every_n_steps`` clean steps it is multiplied
-by ``incr_ratio``.
+Dynamic loss scaling: the loss is multiplied by the ``loss_scaling``
+*variable* in-graph (so each step trains with the current scale) and grads
+are divided by the same pre-update value. Grads are checked with
+``isfinite``; on overflow the whole gradient set is zeroed for that step (a
+zero-grad optimizer step — accumulator decay still advances, a deliberate
+simplification vs the reference's conditional skip block). After
+``decr_every_n_nan_or_inf`` consecutive overflow steps the scale is
+multiplied by ``decr_ratio``; after ``incr_every_n_steps`` clean steps it
+is multiplied by ``incr_ratio``.
 """
 
 from ... import framework, unique_name
@@ -59,17 +62,24 @@ class OptimizerWithMixedPrecision:
             loss, startup_program, parameter_list, no_grad_set, callbacks)
         block = main.global_block()
 
-        # scale the loss by setting the autodiff op's loss_scale attr
-        for op in block.ops:
-            if op.type == "autodiff":
-                op.attrs["loss_scale"] = self._init_loss_scaling
-
         helper_name = unique_name.generate("loss_scaling")
         if self._use_dynamic:
             self._loss_scaling = _scalar_var(
                 block, helper_name, "float32", self._init_loss_scaling)
             self._good_steps = _scalar_var(
                 block, helper_name + "_good", "int32", 0)
+            self._bad_steps = _scalar_var(
+                block, helper_name + "_bad", "int32", 0)
+
+        # Scale the loss. Dynamic mode reads the loss_scaling *variable* at
+        # runtime (reference decorator.py:135) so updated scales apply on the
+        # next step; static mode bakes the constant into the autodiff op.
+        for op in block.ops:
+            if op.type == "autodiff":
+                if self._use_dynamic:
+                    op.attrs["loss_scale_var"] = self._loss_scaling.name
+                else:
+                    op.attrs["loss_scale"] = self._init_loss_scaling
 
         new_pg = []
         finite_names = []
@@ -95,6 +105,13 @@ class OptimizerWithMixedPrecision:
             block.append_op("cast", {"X": [all_finite]}, {"Out": [gate]},
                             {"out_dtype": "float32"})
             self._all_finite = all_finite
+            # snapshot the scale the grads were computed with BEFORE the
+            # update mutates it — unscaling must divide by the old value
+            pre = unique_name.generate("loss_scaling_pre")
+            block.create_var(name=pre, shape=[1], dtype="float32",
+                             stop_gradient=True)
+            block.append_op("assign", {"X": [self._loss_scaling.name]},
+                            {"Out": [pre]})
             self._append_scale_update(block, gate)
 
         inv = 1.0 / self._init_loss_scaling
@@ -103,10 +120,15 @@ class OptimizerWithMixedPrecision:
                 scaled = g.block.create_var(
                     name=g.name + ".unscaled", shape=g.shape, dtype=g.dtype,
                     stop_gradient=True)
-                block.append_op("scale", {"X": [g.name]},
-                                {"Out": [scaled.name]},
-                                {"scale": inv, "bias": 0.0,
-                                 "bias_after_scale": True})
+                if self._use_dynamic:
+                    block.append_op("elementwise_div",
+                                    {"X": [g.name], "Y": [pre]},
+                                    {"Out": [scaled.name]}, {"axis": -1})
+                else:
+                    block.append_op("scale", {"X": [g.name]},
+                                    {"Out": [scaled.name]},
+                                    {"scale": inv, "bias": 0.0,
+                                     "bias_after_scale": True})
                 if self._use_dynamic:
                     # select, not multiply: inf * 0 == nan would poison params
                     zeros = g.block.create_var(
@@ -128,12 +150,19 @@ class OptimizerWithMixedPrecision:
         return new_pg
 
     def _append_scale_update(self, block, gate_name):
-        """loss_scaling/good_steps update in pure elementwise arithmetic:
-        scale' = finite ? (ready ? scale*incr : scale) : scale*decr
+        """loss_scaling/good_steps/bad_steps update in pure elementwise
+        arithmetic (reference ``update_loss_scaling``):
+
+        ready      = good+1 >= incr_every_n_steps
+        decr_ready = bad+1  >= decr_every_n_nan_or_inf
+        scale' = finite ? (ready ? scale*incr : scale)
+                        : (decr_ready ? scale*decr : scale)
         good'  = finite ? (ready ? 0 : good+1) : 0
+        bad'   = finite ? 0 : (decr_ready ? 0 : bad+1)
         """
         u = unique_name.generate
-        s, good = self._loss_scaling.name, self._good_steps.name
+        s, good, bad = (self._loss_scaling.name, self._good_steps.name,
+                        self._bad_steps.name)
 
         def tmp(dtype="float32", shape=(1,)):
             n = u("amp_ls")
@@ -141,27 +170,35 @@ class OptimizerWithMixedPrecision:
                              stop_gradient=True)
             return n
 
-        goodf = tmp()
-        block.append_op("cast", {"X": [good]}, {"Out": [goodf]},
-                        {"out_dtype": "float32"})
-        good1 = tmp()
-        block.append_op("scale", {"X": [goodf]}, {"Out": [good1]},
-                        {"scale": 1.0, "bias": 1.0, "bias_after_scale": True})
-        # ready = (good+1 >= incr_every_n) as float, via hard_sigmoid-free
-        # arithmetic: relu(sign(good+1 - n)) + (good+1 == n ? 1 : 0) —
-        # simpler: ready = cast(good1 >= n)
-        thresh = tmp()
-        block.append_op("fill_constant", outputs={"Out": [thresh]},
-                        attrs={"shape": [1], "dtype": "float32",
-                               "value": float(self._incr_every_n_steps)})
-        readyb = tmp("bool")
-        block.append_op("greater_equal", {"X": [good1], "Y": [thresh]},
-                        {"Out": [readyb]})
-        ready = tmp()
-        block.append_op("cast", {"X": [readyb]}, {"Out": [ready]},
-                        {"out_dtype": "float32"})
+        def plus1_float(counter):
+            cf = tmp()
+            block.append_op("cast", {"X": [counter]}, {"Out": [cf]},
+                            {"out_dtype": "float32"})
+            c1 = tmp()
+            block.append_op("scale", {"X": [cf]}, {"Out": [c1]},
+                            {"scale": 1.0, "bias": 1.0,
+                             "bias_after_scale": True})
+            return c1
 
-        # factor = finite*(1 + ready*(incr-1)) + (1-finite)*decr
+        def ge_const(x, value):
+            thresh = tmp()
+            block.append_op("fill_constant", outputs={"Out": [thresh]},
+                            attrs={"shape": [1], "dtype": "float32",
+                                   "value": float(value)})
+            gb = tmp("bool")
+            block.append_op("greater_equal", {"X": [x], "Y": [thresh]},
+                            {"Out": [gb]})
+            gf = tmp()
+            block.append_op("cast", {"X": [gb]}, {"Out": [gf]},
+                            {"out_dtype": "float32"})
+            return gf
+
+        good1 = plus1_float(good)
+        bad1 = plus1_float(bad)
+        ready = ge_const(good1, self._incr_every_n_steps)
+        decr_ready = ge_const(bad1, self._decr_every_n_nan_or_inf)
+
+        # factor = finite*(1 + ready*(incr-1)) + (1-finite)*(1 + decr_ready*(decr-1))
         t1 = tmp()
         block.append_op("scale", {"X": [ready]}, {"Out": [t1]},
                         {"scale": self._incr_ratio - 1.0, "bias": 1.0,
@@ -172,10 +209,13 @@ class OptimizerWithMixedPrecision:
         notf = tmp()
         block.append_op("scale", {"X": [gate_name]}, {"Out": [notf]},
                         {"scale": -1.0, "bias": 1.0, "bias_after_scale": True})
-        t3 = tmp()
-        block.append_op("scale", {"X": [notf]}, {"Out": [t3]},
-                        {"scale": self._decr_ratio, "bias": 0.0,
+        dfac = tmp()
+        block.append_op("scale", {"X": [decr_ready]}, {"Out": [dfac]},
+                        {"scale": self._decr_ratio - 1.0, "bias": 1.0,
                          "bias_after_scale": True})
+        t3 = tmp()
+        block.append_op("elementwise_mul", {"X": [notf], "Y": [dfac]},
+                        {"Out": [t3]}, {"axis": -1})
         factor = tmp()
         block.append_op("elementwise_add", {"X": [t2], "Y": [t3]},
                         {"Out": [factor]}, {"axis": -1})
@@ -184,20 +224,25 @@ class OptimizerWithMixedPrecision:
                         {"Out": [news]}, {"axis": -1})
         block.append_op("assign", {"X": [news]}, {"Out": [s]})
 
-        # good' = finite * (1-ready) * (good+1)
-        t4 = tmp()
-        block.append_op("scale", {"X": [ready]}, {"Out": [t4]},
-                        {"scale": -1.0, "bias": 1.0, "bias_after_scale": True})
-        t5 = tmp()
-        block.append_op("elementwise_mul", {"X": [t4], "Y": [gate_name]},
-                        {"Out": [t5]}, {"axis": -1})
-        t6 = tmp()
-        block.append_op("elementwise_mul", {"X": [t5], "Y": [good1]},
-                        {"Out": [t6]}, {"axis": -1})
-        newgood = tmp("int32")
-        block.append_op("cast", {"X": [t6]}, {"Out": [newgood]},
-                        {"out_dtype": "int32"})
-        block.append_op("assign", {"X": [newgood]}, {"Out": [good]})
+        def update_counter(counter, keep_gate, ready_f, c1):
+            # counter' = keep_gate * (1-ready_f) * (counter+1)
+            t4 = tmp()
+            block.append_op("scale", {"X": [ready_f]}, {"Out": [t4]},
+                            {"scale": -1.0, "bias": 1.0,
+                             "bias_after_scale": True})
+            t5 = tmp()
+            block.append_op("elementwise_mul", {"X": [t4], "Y": [keep_gate]},
+                            {"Out": [t5]}, {"axis": -1})
+            t6 = tmp()
+            block.append_op("elementwise_mul", {"X": [t5], "Y": [c1]},
+                            {"Out": [t6]}, {"axis": -1})
+            newc = tmp("int32")
+            block.append_op("cast", {"X": [t6]}, {"Out": [newc]},
+                            {"out_dtype": "int32"})
+            block.append_op("assign", {"X": [newc]}, {"Out": [counter]})
+
+        update_counter(good, gate_name, ready, good1)
+        update_counter(bad, notf, decr_ready, bad1)
 
     def apply_gradients(self, params_grads):
         return self._optimizer.apply_gradients(params_grads)

@@ -22,6 +22,17 @@ def _is_float(dtype):
     return np.issubdtype(d, np.floating) or "float" in d.name  # incl. bfloat16
 
 
+def _is_fp32(var):
+    """True when var's dtype normalizes to float32. convert_dtype (not raw
+    np.dtype) so a var already rewritten to "bfloat16" doesn't raise."""
+    if var is None or var.dtype is None:
+        return False
+    try:
+        return np.dtype(convert_dtype(var.dtype)) == _FLOAT32
+    except TypeError:
+        return False
+
+
 def _insert_cast(block, new_ops, cache, name, dest_dtype, suffix):
     """Emit (or reuse) a cast of var `name` to dest_dtype; returns new name."""
     key = (name, suffix)
@@ -66,21 +77,16 @@ def rewrite_program(main_program, amp_lists, dest_dtype="bfloat16"):
                 casted = []
                 for n in names:
                     v = block._find_var_recursive(n)
-                    if v is not None and v.dtype is not None and \
-                            np.dtype(v.dtype) == _FLOAT32:
-                        if n in low_vars:
-                            casted.append(n)
-                        else:
-                            casted.append(_insert_cast(
-                                block, new_ops, cache, n, low, low_suffix))
+                    if n not in low_vars and _is_fp32(v):
+                        casted.append(_insert_cast(
+                            block, new_ops, cache, n, low, low_suffix))
                     else:
                         casted.append(n)
                 op.inputs[slot] = casted
             for out in op.output_arg_names():
                 v = block._find_var_recursive(out)
-                if v is not None and v.dtype is not None and \
-                        np.dtype(v.dtype) == _FLOAT32:
-                    v.dtype = dest_dtype
+                if _is_fp32(v):
+                    v.dtype = convert_dtype(dest_dtype)
                     low_vars.add(out)
         elif op.type in amp_lists.black_list:
             for slot, names in op.inputs.items():
@@ -101,9 +107,7 @@ def rewrite_program(main_program, amp_lists, dest_dtype="bfloat16"):
                     casted = []
                     for n in names:
                         v = block._find_var_recursive(n)
-                        if n not in low_vars and v is not None and \
-                                v.dtype is not None and \
-                                np.dtype(v.dtype) == _FLOAT32:
+                        if n not in low_vars and _is_fp32(v):
                             casted.append(_insert_cast(
                                 block, new_ops, cache, n, low, low_suffix))
                         else:

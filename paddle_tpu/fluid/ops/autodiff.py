@@ -20,6 +20,93 @@ XLA dedups).
 from ..registry import LowerCtx, register, registry
 
 
+def _run_ops(rctx, ops, wrt_names):
+    """Lower `ops` in order on rctx, honoring stop_gradient markers."""
+    import jax
+
+    for o in ops:
+        registry.get(o.type).lower(rctx, o)
+        for name in o.output_arg_names():
+            v = rctx.var(name)
+            if v is not None and v.stop_gradient and name not in wrt_names:
+                rctx.env[name] = jax.lax.stop_gradient(rctx.env[name])
+
+
+def _replay_forward_checkpointed(ctx, prior_ops, wrt_names, overrides,
+                                 checkpoints):
+    """Replay the forward split into segments at the checkpoint vars, each
+    wrapped in ``jax.checkpoint`` so XLA saves only segment boundaries and
+    rematerializes intermediate activations during the backward pass
+    (reference recompute: ``backward.py:576``
+    ``_append_backward_ops_with_checkpoints_``).
+
+    Only the loss needs to survive to the caller: each segment returns just
+    the env entries later segments (or the loss) consume, so the residual
+    set the grad transform saves is exactly those boundary values.
+    """
+    import jax
+
+    # segment boundaries: after the op that (last) produces each checkpoint
+    producer = {}
+    for i, o in enumerate(prior_ops):
+        for name in o.output_arg_names():
+            producer[name] = i
+    cut_idx = sorted({producer[c] for c in checkpoints if c in producer})
+    segments = []
+    start = 0
+    for ci in cut_idx:
+        segments.append(prior_ops[start:ci + 1])
+        start = ci + 1
+    if start < len(prior_ops):
+        segments.append(prior_ops[start:])
+    if len(segments) <= 1:
+        renv = _replay_forward(ctx, prior_ops, wrt_names, overrides)
+        return renv
+
+    # vars each later segment reads (so each segment's output pytree is the
+    # minimal carry); key slices per segment from the primal lowering record
+    spans = ctx.op_key_spans
+    all_keys = list(ctx.used_keys)
+    seg_keys, seg_needs = [], []
+    for seg in segments:
+        ks = [spans.get(id(o), (0, 0)) for o in seg]
+        lo = min((s for s, _ in ks), default=0)
+        hi = max((e for _, e in ks), default=0)
+        seg_keys.append(all_keys[lo:hi])
+        seg_needs.append(set())
+    for i in range(len(segments)):
+        for later in segments[i + 1:]:
+            for o in later:
+                seg_needs[i].update(o.input_arg_names())
+
+    env = dict(ctx.initial_env)
+    env.update(overrides)
+    for i, seg in enumerate(segments):
+        keep = seg_needs[i]
+        is_last = i == len(segments) - 1
+
+        def run_seg(env_in, _seg=seg, _keys=seg_keys[i], _keep=keep,
+                    _last=is_last):
+            rctx = LowerCtx(ctx.block, dict(env_in), ctx.initial_rng,
+                            mesh=ctx.mesh, replay_keys=list(_keys))
+            rctx.initial_env = ctx.initial_env
+            rctx.initial_rng = ctx.initial_rng
+            _run_ops(rctx, _seg, wrt_names)
+            if _last:
+                return rctx.env
+            out = dict(env_in)
+            for k in _keep:
+                if k in rctx.env:
+                    out[k] = rctx.env[k]
+            return out
+
+        if is_last:
+            env = run_seg(env)
+        else:
+            env = jax.checkpoint(run_seg)(env)
+    return env
+
+
 def _replay_forward(ctx, prior_ops, wrt_names, overrides):
     """Build env after replaying prior_ops with wrt vars overridden."""
     import jax
@@ -35,12 +122,7 @@ def _replay_forward(ctx, prior_ops, wrt_names, overrides):
     )
     rctx.initial_env = ctx.initial_env
     rctx.initial_rng = ctx.initial_rng
-    for o in prior_ops:
-        registry.get(o.type).lower(rctx, o)
-        for name in o.output_arg_names():
-            v = rctx.var(name)
-            if v is not None and v.stop_gradient and name not in wrt_names:
-                renv[name] = jax.lax.stop_gradient(renv[name])
+    _run_ops(rctx, prior_ops, wrt_names)
     return renv
 
 
@@ -52,6 +134,17 @@ def _autodiff(ctx, op):
     wrt_names = list(op.attr("wrt"))
     grad_names = list(op.attr("grad_names"))
     loss_scale = op.attr("loss_scale", 1.0)
+    # AMP dynamic loss scaling: the scale is a runtime *variable* (reference
+    # decorator.py:135 multiplies the loss by the loss_scaling var), so the
+    # dynamically updated value takes effect on the next step — a static
+    # attr would freeze the scale at its initial value.
+    scale_var = op.attr("loss_scale_var", None)
+    if scale_var is not None:
+        import jax.numpy as jnp
+
+        # composes with the static attr (e.g. GradAllReduce's 1/nranks)
+        loss_scale = loss_scale * jnp.reshape(
+            jax.lax.stop_gradient(ctx.get(scale_var)), ()).astype("float32")
 
     block = ctx.block
     idx = next(i for i, o in enumerate(block.ops) if o is op)
@@ -64,8 +157,15 @@ def _autodiff(ctx, op):
             v = ctx.get(n)
         wrt_vals.append(v)
 
+    checkpoints = op.attr("checkpoints", None)
+
     def fwd(vals):
-        renv = _replay_forward(ctx, prior_ops, set(wrt_names), dict(zip(wrt_names, vals)))
+        overrides = dict(zip(wrt_names, vals))
+        if checkpoints:
+            renv = _replay_forward_checkpointed(
+                ctx, prior_ops, set(wrt_names), overrides, list(checkpoints))
+        else:
+            renv = _replay_forward(ctx, prior_ops, set(wrt_names), overrides)
         loss = renv[loss_name]
         if loss.ndim > 0:
             import jax.numpy as jnp

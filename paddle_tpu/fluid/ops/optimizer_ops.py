@@ -257,3 +257,55 @@ def _dpsgd(ctx, op):
     g_clip = g / jnp.maximum(1.0, g_norm / clip)
     noise = sigma * clip / batch_size * jax.random.normal(ctx.next_rng(), g.shape)
     ctx.set_output(op, "ParamOut", p - lr * (g_clip + noise))
+
+
+@register("dgc")
+def _dgc(ctx, op):
+    """Deep Gradient Compression step (reference ``operators/dgc_op.cc``):
+    momentum correction + error feedback + top-k masked-dense gradient.
+    Computation lives in paddle_tpu/parallel/dgc.py. Pre-rampup steps pass
+    the plain momentum velocity through (reference rampup semantics),
+    gated in-graph on CurrentStep."""
+    import jax.numpy as jnp
+
+    from ...parallel import dgc as dgc_lib
+
+    import jax
+
+    u = ctx.get_input(op, "U")
+    v = ctx.get_input(op, "V")
+    g = ctx.get_input(op, "Grad")
+    m = op.attr("m", 0.9)
+    sparsity = list(op.attr("sparsity", [0.999]))
+    rampup = op.attr("rampup_begin_step", 0)
+    rampup_step = max(int(op.attr("rampup_step", 1)), 1)
+    step_in = (jnp.reshape(ctx.get_input(op, "CurrentStep"), ()).astype(
+        "float32") if op.input("CurrentStep") else None)
+
+    if len(sparsity) > 1 and step_in is not None:
+        # reference warmup ramp: sparsity[i] holds for rampup_step /
+        # len(sparsity) steps after rampup_begin_step; each branch has a
+        # static top-k so shapes stay XLA-friendly
+        per = max(rampup_step // len(sparsity), 1)
+        idx = jnp.clip(((step_in - float(rampup)) // per).astype("int32"),
+                       0, len(sparsity) - 1)
+        u_dgc, v_dgc, send = jax.lax.switch(
+            idx,
+            [lambda u=u, v=v, g=g, s=s: dgc_lib.dgc_compress(
+                u, v, g, m, 1.0 - float(s)) for s in sparsity])
+    else:
+        u_dgc, v_dgc, send = dgc_lib.dgc_compress(
+            u, v, g, m, 1.0 - float(sparsity[-1]))
+
+    if rampup > 0 and step_in is not None:
+        use = (step_in >= float(rampup)).astype(g.dtype)
+        keep = 1.0 - use
+        u1 = m * u + g  # plain momentum velocity pre-rampup
+        u_out = use * u_dgc + keep * u1
+        v_out = use * v_dgc  # error feedback starts empty at rampup
+        send = use * send + keep * u1
+    else:
+        u_out, v_out = u_dgc, v_dgc
+    ctx.set_output(op, "UOut", u_out)
+    ctx.set_output(op, "VOut", v_out)
+    ctx.set_output(op, "GradOut", send)

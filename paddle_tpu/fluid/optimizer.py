@@ -531,10 +531,15 @@ class LambOptimizer(AdamOptimizer):
 
 
 class DGCMomentumOptimizer(MomentumOptimizer):
-    """Momentum with deep-gradient-compression-style top-k sparsification
-    (reference ``optimizer.py:870``). The sparsification itself lives in the
-    collective layer (parallel/dgc.py) — single-process training behaves as
-    plain momentum, like the reference before rampup."""
+    """Momentum with deep-gradient-compression top-k sparsification
+    (reference ``optimizer.py:870``, ``operators/dgc_op.cc``): each step the
+    ``dgc`` op applies momentum correction + error-feedback accumulation and
+    emits a masked-dense gradient with only the top ``1-sparsity`` fraction
+    of entries non-zero (paddle_tpu/parallel/dgc.py); the param update is a
+    plain SGD step on that compressed gradient. Under ``GradAllReduce`` the
+    allreduce moves onto the compressed gradient (the reference's
+    sparse_all_reduce_op_handle). Steps before ``rampup_begin_step`` behave
+    as plain momentum, gated in-graph on a step counter."""
 
     def __init__(self, learning_rate, momentum, rampup_begin_step=0,
                  rampup_step=1, sparsity=(0.999,), use_nesterov=False,
@@ -542,8 +547,43 @@ class DGCMomentumOptimizer(MomentumOptimizer):
                  regularization=None, name=None):
         super().__init__(learning_rate, momentum, use_nesterov, regularization,
                          name)
-        self._rampup_begin_step = rampup_begin_step
-        self._sparsity = sparsity
+        self._rampup_begin_step = int(rampup_begin_step)
+        self._rampup_step = int(rampup_step)
+        self._sparsity = list(sparsity)
+        self._dgc_step_var = None
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("dgc_u", p)
+            self._add_accumulator("dgc_v", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        u = self._get_accumulator("dgc_u", param)
+        v = self._get_accumulator("dgc_v", param)
+        inputs = {"U": [u], "V": [v], "Grad": [grad]}
+        if self._rampup_begin_step > 0 or len(self._sparsity) > 1:
+            if self._dgc_step_var is None:
+                from .layers import nn
+
+                self._dgc_step_var = nn.autoincreased_step_counter(
+                    counter_name="@DGC_STEP@", begin=0)
+            inputs["CurrentStep"] = [self._dgc_step_var]
+        compressed = block.create_var(
+            name=unique_name.generate(grad.name + ".dgc"), shape=grad.shape,
+            dtype=grad.dtype, stop_gradient=True)
+        block.append_op(
+            "dgc", inputs=inputs,
+            outputs={"UOut": [u], "VOut": [v], "GradOut": [compressed]},
+            attrs={"m": self._momentum,
+                   "sparsity": [float(s) for s in self._sparsity],
+                   "rampup_begin_step": self._rampup_begin_step,
+                   "rampup_step": self._rampup_step})
+        return block.append_op(
+            "sgd",
+            inputs={"Param": [param], "Grad": [compressed],
+                    "LearningRate": [self._lr_for(param)]},
+            outputs={"ParamOut": [param]})
 
 
 # -- wrappers ----------------------------------------------------------------
@@ -740,9 +780,14 @@ class RecomputeOptimizer:
 
 
 class PipelineOptimizer:
-    """Pipeline parallelism (reference ``optimizer.py:3048``). The TPU-native
-    implementation stages the program over mesh axis 'pp' — see
-    paddle_tpu/parallel/pipeline.py. This wrapper records cut points."""
+    """Pipeline parallelism (reference ``optimizer.py:3048``). Records the
+    cut points on the program; ``CompiledProgram.with_pipeline`` consumes
+    them to run the forward as a GPipe schedule over the 'pp' mesh axis
+    (stages dispatched by lax.switch, activations via ppermute — see
+    ``compiler.py:_wrap_step_pipeline`` and paddle_tpu/parallel/pipeline.py).
+    ``place_list``/``concurrency_list``/``queue_size`` are the reference's
+    host-thread knobs and are meaningless in the single-SPMD-program design;
+    accepted for API parity, ignored."""
 
     def __init__(self, optimizer, cut_list=None, place_list=None,
                  concurrency_list=None, queue_size=30, sync_steps=1,

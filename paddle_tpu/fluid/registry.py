@@ -80,6 +80,9 @@ class LowerCtx:
         self.used_keys = []
         self._replay_keys = list(replay_keys) if replay_keys is not None else None
         self.written = set()
+        # per-op [start, end) spans into used_keys, recorded by lower_block —
+        # the autodiff recompute path slices keys per checkpoint segment
+        self.op_key_spans = {}
         # snapshots for autodiff replay (see ops/autodiff.py)
         self.initial_env = dict(env)
         self.initial_rng = rng_key
@@ -135,4 +138,6 @@ def lower_block(ctx, block):
     hot-loop analogue, reference executor.cc:411 — but traced once, compiled
     by XLA, not interpreted per step)."""
     for op in block.ops:
+        start = len(ctx.used_keys)
         registry.get(op.type).lower(ctx, op)
+        ctx.op_key_spans[id(op)] = (start, len(ctx.used_keys))

@@ -46,16 +46,32 @@ class GradAllReduce(Collective):
 
     def _transpile_main_program(self):
         block = self.main_program.global_block()
+        # DGC grads are allreduced AFTER compression (the reference's
+        # sparse_all_reduce_op_handle): the dense grad skips the autodiff
+        # allreduce and the masked-dense compressed grad gets one instead.
+        dgc_grads = set()
+        for op in block.ops:
+            if op.type == "dgc":
+                dgc_grads.update(op.input("Grad"))
         new_ops = []
         for op in block.ops:
             new_ops.append(op)
-            if op.type in ("autodiff",):
+            if op.type == "autodiff":
                 # scale loss gradient by 1/nranks (reference :189)
                 op.attrs["loss_scale"] = op.attrs.get("loss_scale", 1.0) / self.nranks
                 for gname in op.attr("grad_names"):
+                    if gname in dgc_grads:
+                        continue
                     ar = framework.Operator(
                         block, "c_allreduce_sum",
                         inputs={"X": [gname]}, outputs={"Out": [gname]},
+                        attrs={"ring_id": 0, "use_calc_stream": True})
+                    new_ops.append(ar)
+            elif op.type == "dgc":
+                for cname in op.output("GradOut"):
+                    ar = framework.Operator(
+                        block, "c_allreduce_sum",
+                        inputs={"X": [cname]}, outputs={"Out": [cname]},
                         attrs={"ring_id": 0, "use_calc_stream": True})
                     new_ops.append(ar)
         block.ops = new_ops
@@ -72,10 +88,43 @@ class LocalSGD(Collective):
 
     def _transpile_main_program(self):
         block = self.main_program.global_block()
-        # every-step averaging when k_steps == 1; otherwise gated averaging
+        if self.k_steps <= 1:
+            for param in self.main_program.all_parameters():
+                block.append_op(
+                    "c_allreduce_avg",
+                    inputs={"X": [param.name]}, outputs={"Out": [param.name]},
+                    attrs={"ring_id": 0})
+            self.main_program._bump()
+            return
+        # Gated averaging every k steps. The collective itself always runs
+        # (SPMD collectives cannot be skipped per-step without divergent
+        # control flow); the *application* is gated in-graph:
+        #   p' = sync ? pmean(p) : p,  sync = (step % k == 0)
+        from ..framework import program_guard
+        from ..layers import nn, tensor
+
+        with program_guard(self.main_program, self.startup_program):
+            step = nn.autoincreased_step_counter(
+                counter_name="@LOCALSGD_STEP@", begin=1)
+            k = tensor.fill_constant([1], "int64", self.k_steps)
+            mod = nn.elementwise_sub(
+                step, nn.elementwise_mul(nn.elementwise_floordiv(step, k), k))
+            sync = tensor.cast(
+                nn.elementwise_sub(tensor.ones([1], "int64"),
+                                   tensor.cast(mod > 0, "int64")), "float32")
         for param in self.main_program.all_parameters():
+            avg = block.create_var(
+                name=param.name + ".localsgd_avg", shape=param.shape,
+                dtype=param.dtype, stop_gradient=True)
             block.append_op(
                 "c_allreduce_avg",
-                inputs={"X": [param.name]}, outputs={"Out": [param.name]},
+                inputs={"X": [param.name]}, outputs={"Out": [avg.name]},
                 attrs={"ring_id": 0})
+            # p' = p + sync * (avg - p)
+            with program_guard(self.main_program, self.startup_program):
+                delta = nn.elementwise_mul(
+                    nn.elementwise_sub(avg, param), sync, axis=-1)
+                newp = nn.elementwise_add(param, delta)
+            block.append_op("assign", inputs={"X": [newp]},
+                            outputs={"Out": [param.name]})
         self.main_program._bump()

@@ -83,7 +83,8 @@ def test_amp_overflow_halves_scale_and_protects_params():
         loss = layers.mean(y)
         opt = mixed_precision.decorate(
             optimizer.SGD(learning_rate=0.1), init_loss_scaling=256.0,
-            use_dynamic_loss_scaling=True, dest_dtype="float16")
+            use_dynamic_loss_scaling=True, decr_every_n_nan_or_inf=1,
+            dest_dtype="float16")
         opt.minimize(loss)
     sv = opt.get_loss_scaling()
     w = main.global_block().all_parameters()[0]
@@ -97,6 +98,70 @@ def test_amp_overflow_halves_scale_and_protects_params():
             scales.append(float(np.asarray(out[1]).ravel()[0]))
             assert np.isfinite(np.asarray(out[2])).all(), "params poisoned"
     assert scales == [128.0, 64.0, 32.0]
+
+
+def test_amp_decr_counter_gates_halving():
+    """decr_every_n_nan_or_inf=2: the scale halves only after two
+    consecutive overflow steps (reference update_loss_scaling's
+    num_bad_steps counter)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4], dtype="float32")
+        y = layers.fc(x, 4)
+        loss = layers.mean(y)
+        opt = mixed_precision.decorate(
+            optimizer.SGD(learning_rate=0.1), init_loss_scaling=256.0,
+            use_dynamic_loss_scaling=True, decr_every_n_nan_or_inf=2,
+            dest_dtype="float16")
+        opt.minimize(loss)
+    sv = opt.get_loss_scaling()
+    exe = fluid.Executor()
+    feed = {"x": np.full((4, 4), 6e4, np.float32)}  # overflows fp16 matmul
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        scales = [float(np.asarray(exe.run(main, feed=feed,
+                                           fetch_list=[sv])[0]).ravel()[0])
+                  for _ in range(4)]
+    # bad counter: 1 (no decr), 2 (decr, reset), 1, 2 (decr)
+    assert scales == [256.0, 128.0, 128.0, 64.0]
+
+
+def test_amp_applied_scale_recovers_grads():
+    """The *applied* scale must track the variable: an init scale big enough
+    to overflow the fp16 backward produces inf grads (zeroed step); once the
+    dynamic scale halves below the fp16 max, grads become finite and params
+    actually move — impossible if the compile-time init scale kept applying."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4], dtype="float32")
+        y = layers.fc(x, 4)
+        loss = layers.mean(y)
+        opt = mixed_precision.decorate(
+            optimizer.SGD(learning_rate=0.1),
+            init_loss_scaling=float(2 ** 21),  # dLoss/dy = 2^21/16 > fp16 max
+            use_dynamic_loss_scaling=True, decr_every_n_nan_or_inf=1,
+            dest_dtype="float16")
+        opt.minimize(loss)
+    sv = opt.get_loss_scaling()
+    w = main.global_block().all_parameters()[0]
+    exe = fluid.Executor()
+    feed = {"x": np.ones((4, 4), np.float32)}
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        # Until the scale decays enough, grads are inf -> gated to zero and
+        # params hold still (w stays at its init value); once the applied
+        # scale is low enough for the whole fp16 backward (incl. the x^T@dy
+        # weight-grad accumulation) the params move.
+        w_first = None
+        moved = []
+        for _ in range(8):
+            out = exe.run(main, feed=feed, fetch_list=[sv, w])
+            wn = np.asarray(out[1])
+            assert np.isfinite(wn).all()
+            if w_first is None:
+                w_first = wn.copy()
+            moved.append(bool(np.abs(wn - w_first).max() > 0))
+    assert moved[-1], "params never moved: dynamic scale not applied in-graph"
 
 
 def test_amp_bert_tiny_trains():
