@@ -1,0 +1,207 @@
+"""Parameter-server tier: host-resident sharded embedding tables with
+pull/push, sync/async/geo update modes.
+
+Reference mapping (SURVEY §2.5/§2.6): pslib sparse tables
+(``framework/fleet/fleet_wrapper.h:55,77,103``), the async/geo
+``Communicator`` (``operators/distributed/communicator.h:175,285,332``).
+TPU-native framing: tables live in host RAM (the reference keeps them on
+pserver hosts), the device graph pulls rows via ``jax.pure_callback`` and
+pushes SelectedRows gradients via ``jax.experimental.io_callback`` — host
+work overlaps device steps instead of crossing an RPC per step.
+
+The row store itself is native C++ (paddle_tpu/native/ps_store.cc,
+mutex-per-shard) loaded over ctypes, with a numpy fallback.
+"""
+
+import queue
+import threading
+
+import numpy as np
+
+from .. import native
+
+_lib = None
+_lib_tried = False
+
+
+def _native_lib():
+    global _lib, _lib_tried
+    if not _lib_tried:
+        _lib_tried = True
+        _lib = native.load_ps_store()
+    return _lib
+
+
+class EmbeddingTable:
+    """One logical [vocab, dim] table, sharded across host memory."""
+
+    def __init__(self, vocab, dim, nshards=8, init_scale=0.05, seed=0,
+                 force_numpy=False):
+        self.vocab, self.dim = int(vocab), int(dim)
+        lib = None if force_numpy else _native_lib()
+        self._lib = lib
+        if lib is not None:
+            self._h = lib.pts_create(self.vocab, self.dim, int(nshards),
+                                     float(init_scale), int(seed))
+        else:
+            rng = np.random.RandomState(seed)
+            self._data = rng.uniform(-init_scale, init_scale,
+                                     (self.vocab, self.dim)).astype(np.float32)
+            self._accum = None
+            self._mu = threading.Lock()
+
+    # -- core ops ---------------------------------------------------------
+    def pull(self, ids):
+        ids = np.ascontiguousarray(np.asarray(ids).reshape(-1), np.int64)
+        out = np.empty((ids.shape[0], self.dim), np.float32)
+        if self._lib is not None:
+            import ctypes
+
+            rc = self._lib.pts_pull(
+                self._h, ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                ids.shape[0],
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+            if rc != 0:
+                raise IndexError("pull failed rc=%d (id out of range?)" % rc)
+            return out
+        with self._mu:
+            return self._data[ids].copy()
+
+    def push(self, ids, grads, lr=0.01, optimizer="sgd", eps=1e-6):
+        ids = np.ascontiguousarray(np.asarray(ids).reshape(-1), np.int64)
+        grads = np.ascontiguousarray(
+            np.asarray(grads, np.float32).reshape(ids.shape[0], self.dim))
+        if self._lib is not None:
+            import ctypes
+
+            i64p = ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+            f32p = grads.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+            if optimizer == "adagrad":
+                rc = self._lib.pts_push_adagrad(self._h, i64p, ids.shape[0],
+                                                f32p, float(lr), float(eps))
+            else:
+                rc = self._lib.pts_push_sgd(self._h, i64p, ids.shape[0],
+                                            f32p, float(lr))
+            if rc != 0:
+                raise IndexError("push failed rc=%d" % rc)
+            return
+        with self._mu:
+            if optimizer == "adagrad":
+                if self._accum is None:
+                    self._accum = np.zeros_like(self._data)
+                for i, r in enumerate(ids):  # duplicates must accumulate
+                    self._accum[r] += grads[i] ** 2
+                    self._data[r] -= lr * grads[i] / (
+                        np.sqrt(self._accum[r]) + eps)
+            else:
+                np.subtract.at(self._data, ids, lr * grads)
+
+    # -- checkpoint -------------------------------------------------------
+    def dump(self):
+        if self._lib is not None:
+            import ctypes
+
+            out = np.empty((self.vocab, self.dim), np.float32)
+            self._lib.pts_dump(
+                self._h, 0, self.vocab,
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+            return out
+        with self._mu:
+            return self._data.copy()
+
+    def load(self, arr):
+        arr = np.ascontiguousarray(np.asarray(arr, np.float32))
+        assert arr.shape == (self.vocab, self.dim)
+        if self._lib is not None:
+            import ctypes
+
+            self._lib.pts_load(
+                self._h, 0, self.vocab,
+                arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+            return
+        with self._mu:
+            self._data[:] = arr
+
+
+class AsyncPusher:
+    """Async-communicator analogue (reference communicator.h:285): pushes
+    are queued and applied by a background thread; ``flush()`` barriers.
+    Queued pushes for the same table merge FIFO — the async-SGD staleness
+    model, same as the reference's merge-and-send threads."""
+
+    def __init__(self, table, max_queue=1024):
+        self.table = table
+        self._q = queue.Queue(maxsize=max_queue)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            try:
+                item = self._q.get(timeout=0.1)
+            except queue.Empty:
+                if self._stop.is_set():
+                    return
+                continue
+            self.table.push(*item[0], **item[1])
+            self._q.task_done()
+
+    def push(self, ids, grads, **kw):
+        self._q.put(((ids, grads), kw))
+
+    def flush(self):
+        self._q.join()
+
+    def stop(self):
+        self.flush()
+        self._stop.set()
+        self._thread.join()
+
+
+class GeoCommunicator:
+    """Geo-SGD delta communicator (reference communicator.h:332 /
+    geo_sgd_transpiler.py): each worker trains against a LOCAL mirror and
+    every ``k_steps`` pushes the delta (local - base) to the global table
+    and refreshes its mirror."""
+
+    def __init__(self, table, k_steps=4):
+        self.table = table
+        self.k_steps = int(k_steps)
+        self._base = table.dump()
+        self.local = self._base.copy()
+        self._step = 0
+
+    def maybe_sync(self):
+        self._step += 1
+        if self._step % self.k_steps:
+            return False
+        delta = self.local - self._base
+        rows = np.nonzero(np.abs(delta).sum(axis=1))[0]
+        if rows.size:
+            # push delta as a gradient with lr = -1 (additive apply)
+            self.table.push(rows.astype(np.int64), delta[rows], lr=-1.0)
+        self._base = self.table.dump()
+        self.local = self._base.copy()
+        return True
+
+
+# global table registry used by the distributed_lookup_table op lowerings
+_tables = {}
+
+
+def register_table(name, table):
+    _tables[name] = table
+    return table
+
+
+def get_table(name):
+    return _tables[name]
+
+
+def has_table(name):
+    return name in _tables
+
+
+def reset_tables():
+    _tables.clear()

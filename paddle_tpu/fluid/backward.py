@@ -41,12 +41,42 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
     if not params:
         raise ValueError("No trainable parameters to differentiate")
 
+    # Params consumed ONLY by is_sparse lookup_table ops get SelectedRows
+    # gradients (reference lookup_table_op.cc grad kernel + selected_rows.h):
+    # rows = the looked-up ids, values = per-lookup cotangents. The autodiff
+    # lowering emits the pair without ever materializing the dense grad.
+    sparse_params = {}
+    for op in block.ops:
+        if op.type in ("lookup_table", "lookup_table_v2"):
+            for w in op.input("W"):
+                if op.attr("is_sparse", False):
+                    sparse_params.setdefault(w, []).append(op)
+                else:
+                    sparse_params[w] = None  # dense use seen -> dense grad
+        else:
+            for name in op.input_arg_names():
+                if name in sparse_params:
+                    sparse_params[name] = None
+    sparse_params = {k: v for k, v in sparse_params.items()
+                     if v and len(v) == 1}
+
     grad_vars = []
     wrt, gnames = [], []
+    sparse_wrt = []
     for p in params:
         gname = grad_var_name(p.name)
-        gv = block.create_var(name=gname, shape=p.shape, dtype=p.dtype,
-                              persistable=False, stop_gradient=True)
+        if p.name in sparse_params:
+            lookup = sparse_params[p.name][0]
+            gv = block.create_var(name=gname, shape=(-1,) + tuple(p.shape[1:]),
+                                  dtype=p.dtype, persistable=False,
+                                  stop_gradient=True, type="selected_rows")
+            block.create_var(name=gname + "@ROWS", shape=(-1,), dtype="int32",
+                             persistable=False, stop_gradient=True)
+            sparse_wrt.append(
+                [p.name, lookup.input("Ids")[0], lookup.output("Out")[0]])
+        else:
+            gv = block.create_var(name=gname, shape=p.shape, dtype=p.dtype,
+                                  persistable=False, stop_gradient=True)
         grad_vars.append(gv)
         wrt.append(p.name)
         gnames.append(gname)
@@ -57,6 +87,8 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
                                  dtype=loss.dtype, stop_gradient=True)
 
     attrs = {"loss": loss.name, "wrt": wrt, "grad_names": gnames, "loss_scale": 1.0}
+    if sparse_wrt:
+        attrs["sparse_wrt"] = sparse_wrt
     if checkpoints:
         attrs["checkpoints"] = [
             c.name if isinstance(c, Variable) else c for c in checkpoints

@@ -84,6 +84,11 @@ def append_gradient_clip_ops(params_grads):
     clipped = []
     todo_global = []
     for p, g in params_grads:
+        if g is not None and getattr(g, "type", "lod_tensor") == "selected_rows":
+            # sparse grads bypass clipping (reference clips dense only;
+            # clipping values alone would mis-scale duplicate rows)
+            clipped.append((p, g))
+            continue
         attr = getattr(p, "_grad_clip", None)
         if attr is not None:
             clipped.extend(attr._process([(p, g)]))

@@ -80,6 +80,7 @@ class Variable:
         stop_gradient=False,
         is_data=False,
         initializer=None,
+        type="lod_tensor",
     ):
         self.block = block
         self.name = name or unique_name.generate("_generated_var")
@@ -88,6 +89,11 @@ class Variable:
         self.persistable = persistable
         self.stop_gradient = stop_gradient
         self.is_data = is_data
+        # "lod_tensor" (dense) or "selected_rows" (sparse rows+values pair;
+        # a selected_rows var NAME binds the values array in the env and
+        # NAME + "@ROWS" binds the int32 row-index array — the TPU-native
+        # encoding of reference SelectedRows, selected_rows.h:32)
+        self.type = type
         self.op = None  # producing op, set by append_op
 
     # -- python operator sugar (maps to ops, usable while building graphs) --

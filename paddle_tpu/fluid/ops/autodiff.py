@@ -107,10 +107,11 @@ def _replay_forward_checkpointed(ctx, prior_ops, wrt_names, overrides,
     return env
 
 
-def _replay_forward(ctx, prior_ops, wrt_names, overrides):
-    """Build env after replaying prior_ops with wrt vars overridden."""
-    import jax
-
+def _replay_forward(ctx, prior_ops, wrt_names, overrides, sparse_eps=None):
+    """Build env after replaying prior_ops with wrt vars overridden.
+    ``sparse_eps``: {param_name: zeros-like-lookup-out} injected additively
+    into that param's lookup output during replay, so the cotangent w.r.t.
+    eps IS the SelectedRows values gradient (no dense W-grad ever built)."""
     renv = dict(ctx.initial_env)
     renv.update(overrides)
     rctx = LowerCtx(
@@ -122,6 +123,8 @@ def _replay_forward(ctx, prior_ops, wrt_names, overrides):
     )
     rctx.initial_env = ctx.initial_env
     rctx.initial_rng = ctx.initial_rng
+    if sparse_eps:
+        rctx.sparse_eps = sparse_eps
     _run_ops(rctx, prior_ops, wrt_names)
     return renv
 
@@ -158,14 +161,21 @@ def _autodiff(ctx, op):
         wrt_vals.append(v)
 
     checkpoints = op.attr("checkpoints", None)
+    sparse_wrt = op.attr("sparse_wrt", None) or []
+    sparse_names = {s[0] for s in sparse_wrt}
+    dense_idx = [i for i, n in enumerate(wrt_names) if n not in sparse_names]
+    dense_names = [wrt_names[i] for i in dense_idx]
 
-    def fwd(vals):
-        overrides = dict(zip(wrt_names, vals))
+    def run_fwd(overrides, sparse_eps):
         if checkpoints:
+            if sparse_eps:
+                raise NotImplementedError(
+                    "recompute + sparse embedding grads not supported yet")
             renv = _replay_forward_checkpointed(
                 ctx, prior_ops, set(wrt_names), overrides, list(checkpoints))
         else:
-            renv = _replay_forward(ctx, prior_ops, set(wrt_names), overrides)
+            renv = _replay_forward(ctx, prior_ops, set(wrt_names), overrides,
+                                   sparse_eps)
         loss = renv[loss_name]
         if loss.ndim > 0:
             import jax.numpy as jnp
@@ -173,9 +183,32 @@ def _autodiff(ctx, op):
             loss = jnp.sum(loss)
         return loss * loss_scale
 
-    grads = jax.grad(fwd)(wrt_vals)
-    for gname, g in zip(grad_names, grads):
-        ctx.set(gname, g)
+    if sparse_wrt:
+        import jax.numpy as jnp
+
+        eps0 = [jnp.zeros_like(ctx.get(out_name))
+                for _, _, out_name in sparse_wrt]
+        dense_vals = [wrt_vals[i] for i in dense_idx]
+
+        def fwd2(dvals, evals):
+            eps_map = {s[0]: e for s, e in zip(sparse_wrt, evals)}
+            return run_fwd(dict(zip(dense_names, dvals)), eps_map)
+
+        gdense, geps = jax.grad(fwd2, argnums=(0, 1))(dense_vals, eps0)
+        for i, g in zip(dense_idx, gdense):
+            ctx.set(grad_names[i], g)
+        for (pname, ids_name, _), ge in zip(sparse_wrt, geps):
+            ids = ctx.get(ids_name)
+            rows = jnp.reshape(ids, (-1,)).astype("int32")
+            values = jnp.reshape(ge, (rows.shape[0], -1))
+            gname = grad_names[wrt_names.index(pname)]
+            ctx.set(gname, values)
+            ctx.set(gname + "@ROWS", rows)
+    else:
+        grads = jax.grad(lambda vals: run_fwd(dict(zip(wrt_names, vals)),
+                                              None))(wrt_vals)
+        for gname, g in zip(grad_names, grads):
+            ctx.set(gname, g)
 
 
 @register("calc_gradient")

@@ -19,21 +19,64 @@ def _lr(ctx, op):
     return jnp.reshape(lr, ()).astype(ctx.get_input(op, "Param").dtype)
 
 
+def _sparse_grad(ctx, op):
+    """(rows, values) when the Grad input is a SelectedRows var, else None.
+    TPU encoding of reference SelectedRows (selected_rows.h:32): values
+    bound to the grad name, int32 rows to name+'@ROWS'; duplicate rows sum."""
+    gname = op.input("Grad")[0]
+    gvar = ctx.var(gname)
+    if gvar is None or getattr(gvar, "type", "lod_tensor") != "selected_rows":
+        return None
+    return ctx.get(gname + "@ROWS"), ctx.get(gname)
+
+
+def _touched_mask(p, rows):
+    import jax.numpy as jnp
+
+    t = jnp.zeros((p.shape[0],), bool).at[rows].set(True)
+    return t.reshape((-1,) + (1,) * (p.ndim - 1))
+
+
 @register("sgd")
 def _sgd(ctx, op):
     p = ctx.get_input(op, "Param")
-    g = ctx.get_input(op, "Grad")
     lr = _lr(ctx, op)
+    sp = _sparse_grad(ctx, op)
+    if sp is not None:
+        rows, vals = sp
+        # scatter-add: duplicate rows accumulate, untouched rows unchanged
+        ctx.set_output(op, "ParamOut",
+                       p.at[rows].add((-lr * vals).astype(p.dtype).reshape(
+                           (rows.shape[0],) + p.shape[1:])))
+        return
+    g = ctx.get_input(op, "Grad")
     ctx.set_output(op, "ParamOut", p - lr * g)
 
 
 @register("momentum")
 def _momentum(ctx, op):
+    import jax.numpy as jnp
+
     p = ctx.get_input(op, "Param")
-    g = ctx.get_input(op, "Grad")
     v = ctx.get_input(op, "Velocity")
     mu = op.attr("mu")
     lr = _lr(ctx, op)
+    sp = _sparse_grad(ctx, op)
+    if sp is not None:
+        # lazy rows-only update (reference momentum_op.h SelectedRows path)
+        rows, vals = sp
+        g = jnp.zeros_like(p).at[rows].add(
+            vals.reshape((rows.shape[0],) + p.shape[1:]))
+        touched = _touched_mask(p, rows)
+        v_new = jnp.where(touched, mu * v + g, v)
+        if op.attr("use_nesterov", False):
+            p_new = jnp.where(touched, p - (g + mu * v_new) * lr, p)
+        else:
+            p_new = jnp.where(touched, p - lr * v_new, p)
+        ctx.set_output(op, "ParamOut", p_new)
+        ctx.set_output(op, "VelocityOut", v_new)
+        return
+    g = ctx.get_input(op, "Grad")
     v_new = mu * v + g
     if op.attr("use_nesterov", False):
         p_new = p - (g + mu * v_new) * lr
@@ -67,7 +110,6 @@ def _adam(ctx, op):
     import jax.numpy as jnp
 
     p = ctx.get_input(op, "Param")
-    g = ctx.get_input(op, "Grad")
     m = ctx.get_input(op, "Moment1")
     v = ctx.get_input(op, "Moment2")
     b1p = ctx.get_input(op, "Beta1Pow")
@@ -76,11 +118,25 @@ def _adam(ctx, op):
     b2 = op.attr("beta2", 0.999)
     eps = op.attr("epsilon", 1e-8)
     lr = _lr(ctx, op)
-    m_new = b1 * m + (1 - b1) * g
-    v_new = b2 * v + (1 - b2) * jnp.square(g)
     b1p_, b2p_ = jnp.reshape(b1p, ()), jnp.reshape(b2p, ())
     lr_t = lr * jnp.sqrt(1 - b2p_) / (1 - b1p_)
-    p_new = p - lr_t * m_new / (jnp.sqrt(v_new) + eps)
+    sp = _sparse_grad(ctx, op)
+    if sp is not None:
+        # lazy-mode sparse adam (reference adam_op.h SelectedRows kernel):
+        # moments decay and params move only on touched rows
+        rows, vals = sp
+        g = jnp.zeros_like(p).at[rows].add(
+            vals.reshape((rows.shape[0],) + p.shape[1:]))
+        touched = _touched_mask(p, rows)
+        m_new = jnp.where(touched, b1 * m + (1 - b1) * g, m)
+        v_new = jnp.where(touched, b2 * v + (1 - b2) * jnp.square(g), v)
+        p_new = jnp.where(touched,
+                          p - lr_t * m_new / (jnp.sqrt(v_new) + eps), p)
+    else:
+        g = ctx.get_input(op, "Grad")
+        m_new = b1 * m + (1 - b1) * g
+        v_new = b2 * v + (1 - b2) * jnp.square(g)
+        p_new = p - lr_t * m_new / (jnp.sqrt(v_new) + eps)
     ctx.set_output(op, "ParamOut", p_new)
     ctx.set_output(op, "Moment1Out", m_new)
     ctx.set_output(op, "Moment2Out", v_new)
@@ -115,10 +171,21 @@ def _adagrad(ctx, op):
     import jax.numpy as jnp
 
     p = ctx.get_input(op, "Param")
-    g = ctx.get_input(op, "Grad")
     m = ctx.get_input(op, "Moment")
     eps = op.attr("epsilon", 1e-6)
     lr = _lr(ctx, op)
+    sp = _sparse_grad(ctx, op)
+    if sp is not None:
+        rows, vals = sp
+        g = jnp.zeros_like(p).at[rows].add(
+            vals.reshape((rows.shape[0],) + p.shape[1:]))
+        touched = _touched_mask(p, rows)
+        m_new = jnp.where(touched, m + jnp.square(g), m)
+        p_new = jnp.where(touched, p - lr * g / (jnp.sqrt(m_new) + eps), p)
+        ctx.set_output(op, "ParamOut", p_new)
+        ctx.set_output(op, "MomentOut", m_new)
+        return
+    g = ctx.get_input(op, "Grad")
     m_new = m + jnp.square(g)
     ctx.set_output(op, "ParamOut", p - lr * g / (jnp.sqrt(m_new) + eps))
     ctx.set_output(op, "MomentOut", m_new)

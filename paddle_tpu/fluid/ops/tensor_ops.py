@@ -320,9 +320,11 @@ def _pad_constant_like(ctx, op):
 @register("lookup_table_v2")
 @register("lookup_table")
 def _lookup_table(ctx, op):
-    """Embedding lookup (dense grad path; SelectedRows sparse path is handled
-    by the sparse subsystem in parallel/sparse.py). Reference
-    ``operators/lookup_table_op.cc``."""
+    """Embedding lookup. With ``is_sparse=True`` the backward produces a
+    SelectedRows gradient (rows = ids, values = cotangents) instead of a
+    dense W-grad: the autodiff lowering injects an additive eps here
+    (``ctx.sparse_eps``) and reads its cotangent — see ops/autodiff.py.
+    Reference ``operators/lookup_table_op.cc``."""
     import jax.numpy as jnp
 
     w = ctx.get_input(op, "W")
@@ -331,6 +333,13 @@ def _lookup_table(ctx, op):
         ids = ids[..., 0]
     padding_idx = op.attr("padding_idx", -1)
     out = jnp.take(w, ids.astype(np.dtype("int32")), axis=0)
+    eps_map = getattr(ctx, "sparse_eps", None)
+    if eps_map is not None:
+        eps = eps_map.get(op.input("W")[0])
+        if eps is not None:
+            # before the padding mask, so padding positions get zero
+            # cotangent exactly like the dense grad path
+            out = out + eps
     if padding_idx is not None and padding_idx >= 0:
         mask = (ids == padding_idx)[..., None]
         out = jnp.where(mask, 0.0, out)
@@ -441,3 +450,46 @@ def _unique(ctx, op):
     out, idx = jnp.unique(x, return_inverse=True, size=x.shape[0])
     ctx.set_output(op, "Out", out)
     ctx.set_output(op, "Index", idx.astype(np.dtype("int32")))
+
+
+@register("merge_selected_rows")
+def _merge_selected_rows(ctx, op):
+    """Sum duplicate rows of a SelectedRows pair (reference
+    ``operators/merge_selected_rows_op.cc`` / math/selected_rows_functor).
+    Static-shape formulation: output keeps the same rows array; the FIRST
+    occurrence of each row id carries the full sum, later duplicates zero."""
+    import jax.numpy as jnp
+
+    xname = op.input("X")[0]
+    rows = ctx.get(xname + "@ROWS")
+    vals = ctx.get(xname)
+    n = rows.shape[0]
+    # first-occurrence index for each position's row id
+    eq = rows[None, :] == rows[:, None]                  # [n, n]
+    first_idx = jnp.argmax(eq, axis=1)                   # min j with same id
+    is_first = first_idx == jnp.arange(n)
+    # summed value per row id, scattered to every occurrence then masked
+    summed = jnp.zeros_like(vals).at[first_idx].add(vals)
+    merged = jnp.where(is_first[:, None], summed, jnp.zeros_like(vals))
+    out = op.output("Out")[0]
+    ctx.set(out, merged)
+    ctx.set(out + "@ROWS", rows)
+
+
+@register("get_tensor_from_selected_rows")
+def _get_tensor_from_selected_rows(ctx, op):
+    """Densify a SelectedRows var into its full-height tensor (reference
+    ``operators/get_tensor_from_selected_rows_op.cc``)."""
+    import jax.numpy as jnp
+
+    xname = op.input("X")[0]
+    rows = ctx.get(xname + "@ROWS")
+    vals = ctx.get(xname)
+    xvar = ctx.var(xname)
+    height = op.attr("height", None)
+    if height is None:
+        # the var records (-1, dim...) — callers must pass height for the
+        # dense shape; fall back to max row + 1 is dynamic, so require it
+        raise ValueError("get_tensor_from_selected_rows needs a 'height' attr")
+    dense = jnp.zeros((int(height),) + tuple(vals.shape[1:]), vals.dtype)
+    ctx.set_output(op, "Out", dense.at[rows].add(vals))

@@ -40,6 +40,15 @@ def append_regularization_ops(params_grads, regularization=None):
         if regular is None or grad is None:
             out.append((param, grad))
             continue
+        if getattr(grad, "type", "lod_tensor") == "selected_rows":
+            # reference regularizer.py skips SelectedRows grads too (sparse
+            # update + decay of untouched rows would densify the gradient)
+            import warnings
+
+            warnings.warn("regularization skipped for sparse gradient of %r"
+                          % param.name)
+            out.append((param, grad))
+            continue
         new_grad = regular(param, grad, grad.block)
         out.append((param, new_grad))
     return out

@@ -1,0 +1,58 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference keeps its runtime native (executors, allocators, pslib
+sparse tables — SURVEY §2.1/§2.6); here the XLA runtime owns device
+execution, and the native tier covers what stays on the host: the sharded
+embedding store (ps_store.cc). Libraries are compiled on first use with
+g++ and cached next to the sources; importers must handle `None` (no
+toolchain) by falling back to pure-numpy implementations.
+"""
+
+import os
+import subprocess
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def _build(name, srcs):
+    so = os.path.join(_DIR, name + ".so")
+    src_paths = [os.path.join(_DIR, s) for s in srcs]
+    if os.path.exists(so) and all(
+            os.path.getmtime(so) >= os.path.getmtime(s) for s in src_paths):
+        return so
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", so] + src_paths
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except Exception:
+        return None
+    return so
+
+
+def load_ps_store():
+    """ctypes handle to the embedding-store library, or None."""
+    import ctypes
+
+    so = _build("libps_store", ["ps_store.cc"])
+    if so is None:
+        return None
+    lib = ctypes.CDLL(so)
+    i64, f32p, i64p = (ctypes.c_int64, ctypes.POINTER(ctypes.c_float),
+                       ctypes.POINTER(ctypes.c_int64))
+    lib.pts_create.restype = i64
+    lib.pts_create.argtypes = [i64, i64, i64, ctypes.c_double, i64]
+    lib.pts_pull.restype = ctypes.c_int
+    lib.pts_pull.argtypes = [i64, i64p, i64, f32p]
+    lib.pts_push_sgd.restype = ctypes.c_int
+    lib.pts_push_sgd.argtypes = [i64, i64p, i64, f32p, ctypes.c_double]
+    lib.pts_push_adagrad.restype = ctypes.c_int
+    lib.pts_push_adagrad.argtypes = [i64, i64p, i64, f32p, ctypes.c_double,
+                                     ctypes.c_double]
+    lib.pts_dump.restype = ctypes.c_int
+    lib.pts_dump.argtypes = [i64, i64, i64, f32p]
+    lib.pts_load.restype = ctypes.c_int
+    lib.pts_load.argtypes = [i64, i64, i64, f32p]
+    lib.pts_dim.restype = i64
+    lib.pts_dim.argtypes = [i64]
+    lib.pts_vocab.restype = i64
+    lib.pts_vocab.argtypes = [i64]
+    return lib

@@ -1,0 +1,173 @@
+// Host-resident sharded embedding store — the native core of the
+// parameter-server capability (reference: pslib sparse tables behind
+// framework/fleet/fleet_wrapper.h:55, operators/distributed/communicator.h).
+//
+// TPU-native framing: big embedding tables live in HOST memory, sharded by
+// row id across S shards (each shard mutex-guarded so pull/push from the
+// data-loader / training threads can overlap); the device graph pulls the
+// rows it needs via host callback and pushes SelectedRows-style gradients
+// back. The optimizer update (SGD / AdaGrad, the reference's downpour
+// flavors) is applied host-side, inside the store, exactly like pslib.
+//
+// Built as a plain C shared library, loaded via ctypes
+// (paddle_tpu/distributed/ps.py), which falls back to a numpy
+// implementation when the toolchain is unavailable.
+
+#include <cstdint>
+#include <cstring>
+#include <cmath>
+#include <mutex>
+#include <random>
+#include <vector>
+
+namespace {
+
+struct Shard {
+  std::vector<float> data;   // rows_in_shard x dim
+  std::vector<float> accum;  // adagrad accumulator (lazily sized)
+  std::mutex mu;
+};
+
+struct Table {
+  int64_t vocab = 0;
+  int64_t dim = 0;
+  int64_t nshards = 1;
+  std::vector<Shard> shards;
+
+  inline int64_t shard_of(int64_t id) const { return id % nshards; }
+  inline int64_t row_in_shard(int64_t id) const { return id / nshards; }
+  inline int64_t shard_rows(int64_t s) const {
+    return (vocab - s + nshards - 1) / nshards;
+  }
+};
+
+std::mutex g_tables_mu;
+std::vector<Table*> g_tables;
+
+}  // namespace
+
+extern "C" {
+
+// Create a table; returns a handle (index). Initialized U(-scale, scale)
+// with the given seed (deterministic across runs for test parity).
+int64_t pts_create(int64_t vocab, int64_t dim, int64_t nshards,
+                   double init_scale, int64_t seed) {
+  auto* t = new Table();
+  t->vocab = vocab;
+  t->dim = dim;
+  t->nshards = nshards < 1 ? 1 : nshards;
+  t->shards = std::vector<Shard>(t->nshards);
+  for (int64_t s = 0; s < t->nshards; ++s) {
+    const int64_t rows = t->shard_rows(s);
+    t->shards[s].data.resize(rows * dim);
+    std::mt19937_64 gen(seed * 1315423911LL + s);
+    std::uniform_real_distribution<float> dist(-init_scale, init_scale);
+    for (auto& x : t->shards[s].data) x = dist(gen);
+  }
+  std::lock_guard<std::mutex> lk(g_tables_mu);
+  g_tables.push_back(t);
+  return static_cast<int64_t>(g_tables.size()) - 1;
+}
+
+static Table* get_table(int64_t h) {
+  std::lock_guard<std::mutex> lk(g_tables_mu);
+  if (h < 0 || h >= static_cast<int64_t>(g_tables.size())) return nullptr;
+  return g_tables[h];
+}
+
+// Gather rows for ids[n] into out[n*dim].
+int pts_pull(int64_t h, const int64_t* ids, int64_t n, float* out) {
+  Table* t = get_table(h);
+  if (!t) return -1;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t id = ids[i];
+    if (id < 0 || id >= t->vocab) return -2;
+    Shard& sh = t->shards[t->shard_of(id)];
+    std::lock_guard<std::mutex> lk(sh.mu);
+    std::memcpy(out + i * t->dim, sh.data.data() + t->row_in_shard(id) * t->dim,
+                t->dim * sizeof(float));
+  }
+  return 0;
+}
+
+// Scatter-add SGD: row[id] -= lr * grad_i (duplicate ids accumulate).
+int pts_push_sgd(int64_t h, const int64_t* ids, int64_t n, const float* grads,
+                 double lr) {
+  Table* t = get_table(h);
+  if (!t) return -1;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t id = ids[i];
+    if (id < 0 || id >= t->vocab) return -2;
+    Shard& sh = t->shards[t->shard_of(id)];
+    std::lock_guard<std::mutex> lk(sh.mu);
+    float* row = sh.data.data() + t->row_in_shard(id) * t->dim;
+    const float* g = grads + i * t->dim;
+    for (int64_t d = 0; d < t->dim; ++d) row[d] -= lr * g[d];
+  }
+  return 0;
+}
+
+// AdaGrad push: accum += g^2; row -= lr * g / (sqrt(accum) + eps).
+int pts_push_adagrad(int64_t h, const int64_t* ids, int64_t n,
+                     const float* grads, double lr, double eps) {
+  Table* t = get_table(h);
+  if (!t) return -1;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t id = ids[i];
+    if (id < 0 || id >= t->vocab) return -2;
+    Shard& sh = t->shards[t->shard_of(id)];
+    std::lock_guard<std::mutex> lk(sh.mu);
+    if (sh.accum.empty()) sh.accum.resize(sh.data.size(), 0.0f);
+    float* row = sh.data.data() + t->row_in_shard(id) * t->dim;
+    float* acc = sh.accum.data() + t->row_in_shard(id) * t->dim;
+    const float* g = grads + i * t->dim;
+    for (int64_t d = 0; d < t->dim; ++d) {
+      acc[d] += g[d] * g[d];
+      row[d] -= lr * g[d] / (std::sqrt(acc[d]) + eps);
+    }
+  }
+  return 0;
+}
+
+// Bulk row access for checkpointing: copies rows [start, start+n) of the
+// logical table (all shards interleaved) into out.
+int pts_dump(int64_t h, int64_t start, int64_t n, float* out) {
+  Table* t = get_table(h);
+  if (!t) return -1;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t id = start + i;
+    if (id < 0 || id >= t->vocab) return -2;
+    Shard& sh = t->shards[t->shard_of(id)];
+    std::lock_guard<std::mutex> lk(sh.mu);
+    std::memcpy(out + i * t->dim, sh.data.data() + t->row_in_shard(id) * t->dim,
+                t->dim * sizeof(float));
+  }
+  return 0;
+}
+
+// Bulk row write (checkpoint restore / test setup).
+int pts_load(int64_t h, int64_t start, int64_t n, const float* in) {
+  Table* t = get_table(h);
+  if (!t) return -1;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t id = start + i;
+    if (id < 0 || id >= t->vocab) return -2;
+    Shard& sh = t->shards[t->shard_of(id)];
+    std::lock_guard<std::mutex> lk(sh.mu);
+    std::memcpy(sh.data.data() + t->row_in_shard(id) * t->dim, in + i * t->dim,
+                t->dim * sizeof(float));
+  }
+  return 0;
+}
+
+int64_t pts_dim(int64_t h) {
+  Table* t = get_table(h);
+  return t ? t->dim : -1;
+}
+
+int64_t pts_vocab(int64_t h) {
+  Table* t = get_table(h);
+  return t ? t->vocab : -1;
+}
+
+}  // extern "C"
