@@ -1,0 +1,7 @@
+"""Distributed runtime: parameter-server tier + multi-process launch.
+
+Reference: ``python/paddle/distributed/`` (launch.py) and the PS stack
+(SURVEY §2.5/§2.6).
+"""
+
+from . import ps  # noqa: F401

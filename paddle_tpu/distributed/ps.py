@@ -5,9 +5,13 @@ Reference mapping (SURVEY §2.5/§2.6): pslib sparse tables
 (``framework/fleet/fleet_wrapper.h:55,77,103``), the async/geo
 ``Communicator`` (``operators/distributed/communicator.h:175,285,332``).
 TPU-native framing: tables live in host RAM (the reference keeps them on
-pserver hosts), the device graph pulls rows via ``jax.pure_callback`` and
-pushes SelectedRows gradients via ``jax.experimental.io_callback`` — host
-work overlaps device steps instead of crossing an RPC per step.
+pserver hosts). The device graph reaches them through the
+``distributed_lookup_table`` op (``fluid/ops/distributed_ops.py``): rows are
+pulled via ``jax.pure_callback`` in the forward, and the autodiff lowering
+pushes the SelectedRows cotangent via an ordered
+``jax.experimental.io_callback`` (``fluid/ops/autodiff.py`` ``dist_push``) —
+host work overlaps device steps instead of crossing an RPC per step. Build
+the graph with ``fluid.layers.embedding(..., is_distributed=True)``.
 
 The row store itself is native C++ (paddle_tpu/native/ps_store.cc,
 mutex-per-shard) loaded over ctypes, with a numpy fallback.
@@ -38,17 +42,33 @@ class EmbeddingTable:
     def __init__(self, vocab, dim, nshards=8, init_scale=0.05, seed=0,
                  force_numpy=False):
         self.vocab, self.dim = int(vocab), int(dim)
+        self._init_scale, self._seed = float(init_scale), int(seed)
         lib = None if force_numpy else _native_lib()
         self._lib = lib
         if lib is not None:
             self._h = lib.pts_create(self.vocab, self.dim, int(nshards),
                                      float(init_scale), int(seed))
         else:
-            rng = np.random.RandomState(seed)
-            self._data = rng.uniform(-init_scale, init_scale,
-                                     (self.vocab, self.dim)).astype(np.float32)
             self._accum = None
             self._mu = threading.Lock()
+            self._data = self._fresh_values()
+
+    def _fresh_values(self):
+        rng = np.random.RandomState(self._seed)
+        return rng.uniform(-self._init_scale, self._init_scale,
+                           (self.vocab, self.dim)).astype(np.float32)
+
+    def reinit(self):
+        """Reset rows (and optimizer state) to the initial distribution —
+        the host-table analogue of re-running the startup program."""
+        if self._lib is not None:
+            rc = self._lib.pts_reset(self._h, self._init_scale, self._seed)
+            if rc != 0:
+                raise RuntimeError("pts_reset failed rc=%d" % rc)
+            return
+        with self._mu:
+            self._data = self._fresh_values()
+            self._accum = None
 
     # -- core ops ---------------------------------------------------------
     def pull(self, ids):
@@ -133,6 +153,7 @@ class AsyncPusher:
         self.table = table
         self._q = queue.Queue(maxsize=max_queue)
         self._stop = threading.Event()
+        self._exc = None
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
@@ -144,19 +165,39 @@ class AsyncPusher:
                 if self._stop.is_set():
                     return
                 continue
-            self.table.push(*item[0], **item[1])
-            self._q.task_done()
+            # task_done() must run even when a push fails (e.g. an
+            # out-of-range id raising IndexError), or flush()/stop() would
+            # deadlock on q.join(); the error is recorded and re-raised from
+            # the caller's next push()/flush().
+            try:
+                self.table.push(*item[0], **item[1])
+            except BaseException as e:  # noqa: B036 — worker must survive
+                if self._exc is None:
+                    self._exc = e
+            finally:
+                self._q.task_done()
+
+    def _raise_pending(self):
+        if self._exc is not None:
+            exc, self._exc = self._exc, None
+            raise exc
 
     def push(self, ids, grads, **kw):
+        self._raise_pending()
         self._q.put(((ids, grads), kw))
 
     def flush(self):
         self._q.join()
+        self._raise_pending()
 
     def stop(self):
-        self.flush()
-        self._stop.set()
-        self._thread.join()
+        # shut the worker down even when flush() re-raises a deferred push
+        # error — otherwise the thread would keep polling forever
+        try:
+            self.flush()
+        finally:
+            self._stop.set()
+            self._thread.join()
 
 
 class GeoCommunicator:
@@ -191,12 +232,32 @@ _tables = {}
 
 
 def register_table(name, table):
+    old = _tables.get(name)
+    if old is not None and (old.vocab, old.dim) != (table.vocab, table.dim):
+        raise ValueError(
+            "table %r already registered with shape (%d, %d); got (%d, %d) — "
+            "reset_tables() or use a different name" %
+            (name, old.vocab, old.dim, table.vocab, table.dim))
     _tables[name] = table
     return table
 
 
 def get_table(name):
     return _tables[name]
+
+
+def ensure_table(name, vocab, dim, **kw):
+    """Get-or-create with shape validation: reusing a name with a different
+    (vocab, dim) raises instead of serving wrong-shaped rows."""
+    old = _tables.get(name)
+    if old is not None:
+        if (old.vocab, old.dim) != (int(vocab), int(dim)):
+            raise ValueError(
+                "table %r exists with shape (%d, %d) but the program wants "
+                "(%d, %d) — reset_tables() or use a different name" %
+                (name, old.vocab, old.dim, vocab, dim))
+        return old
+    return register_table(name, EmbeddingTable(vocab, dim, **kw))
 
 
 def has_table(name):

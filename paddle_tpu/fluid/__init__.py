@@ -25,6 +25,7 @@ from .backward import append_backward, calc_gradient, gradients  # noqa: F401
 from .compiler import BuildStrategy, CompiledProgram, ExecutionStrategy  # noqa: F401
 from .data_feeder import DataFeeder  # noqa: F401
 from .executor import Executor, Scope, global_scope, scope_guard  # noqa: F401
+from .lod import LoDTensor, create_lod_tensor  # noqa: F401
 from .framework import (  # noqa: F401
     Program,
     Variable,

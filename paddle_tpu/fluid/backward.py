@@ -38,25 +38,32 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
     program = loss.block.program
     block = loss.block
     params = _collect_params(program, parameter_list, no_grad_set)
-    if not params:
+    has_dist = any(op.type == "distributed_lookup_table" for op in block.ops)
+    if not params and not has_dist:
         raise ValueError("No trainable parameters to differentiate")
 
     # Params consumed ONLY by is_sparse lookup_table ops get SelectedRows
     # gradients (reference lookup_table_op.cc grad kernel + selected_rows.h):
     # rows = the looked-up ids, values = per-lookup cotangents. The autodiff
     # lowering emits the pair without ever materializing the dense grad.
+    # Two passes, order-independent: first collect every is_sparse lookup
+    # param, then demote any param with another use ANYWHERE in the block
+    # (input or output of any other op, or a dense lookup) — a single
+    # program-order pass would miss consumers appearing before the lookup.
     sparse_params = {}
     for op in block.ops:
-        if op.type in ("lookup_table", "lookup_table_v2"):
+        if op.type in ("lookup_table", "lookup_table_v2") and op.attr(
+                "is_sparse", False):
             for w in op.input("W"):
-                if op.attr("is_sparse", False):
-                    sparse_params.setdefault(w, []).append(op)
-                else:
-                    sparse_params[w] = None  # dense use seen -> dense grad
-        else:
-            for name in op.input_arg_names():
-                if name in sparse_params:
-                    sparse_params[name] = None
+                sparse_params.setdefault(w, []).append(op)
+    for op in block.ops:
+        sparse_w = set()
+        if op.type in ("lookup_table", "lookup_table_v2") and op.attr(
+                "is_sparse", False):
+            sparse_w = set(op.input("W"))
+        for name in list(op.input_arg_names()) + list(op.output_arg_names()):
+            if name in sparse_params and name not in sparse_w:
+                sparse_params[name] = None  # other use seen -> dense grad
     sparse_params = {k: v for k, v in sparse_params.items()
                      if v and len(v) == 1}
 
@@ -89,6 +96,19 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
     attrs = {"loss": loss.name, "wrt": wrt, "grad_names": gnames, "loss_scale": 1.0}
     if sparse_wrt:
         attrs["sparse_wrt"] = sparse_wrt
+    # host-table lookups (PS tier): the autodiff lowering binds the output
+    # cotangent to <out>@PS_GRAD/@PS_ROWS; a distributed_push op appended
+    # AFTER autodiff ships it to the host store (an explicit op so AMP can
+    # unscale/overflow-gate the payload first) — no device grad var
+    dist_push = []
+    for op in block.ops:
+        if op.type == "distributed_lookup_table":
+            dist_push.append([op.attr("table_name"), op.input("Ids")[0],
+                              op.output("Out")[0],
+                              float(op.attr("lr", 0.01)),
+                              op.attr("optimizer", "sgd")])
+    if dist_push:
+        attrs["dist_push"] = dist_push
     if checkpoints:
         attrs["checkpoints"] = [
             c.name if isinstance(c, Variable) else c for c in checkpoints
@@ -99,6 +119,17 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
         outputs={"Grads": gnames},
         attrs=attrs,
     )
+    for tname, _ids, out_name, lr, optname in dist_push:
+        vname, rname = out_name + "@PS_GRAD", out_name + "@PS_ROWS"
+        block.create_var(name=vname, shape=(-1, -1), dtype="float32",
+                         stop_gradient=True)
+        block.create_var(name=rname, shape=(-1,), dtype="int32",
+                         stop_gradient=True)
+        block.append_op(
+            "distributed_push",
+            inputs={"Values": [vname], "Rows": [rname]},
+            attrs={"table_name": tname, "lr": lr, "optimizer": optname},
+        )
     return list(zip(params, grad_vars))
 
 

@@ -9,6 +9,8 @@ replicated, and XLA inserts the gradient all-reduces over ICI automatically.
 BuildStrategy/ExecutionStrategy survive as config surface.
 """
 
+import itertools
+
 import numpy as np
 
 __all__ = ["CompiledProgram", "BuildStrategy", "ExecutionStrategy"]
@@ -51,7 +53,10 @@ class ExecutionStrategy:
 
 
 class CompiledProgram:
+    _uid_counter = itertools.count(1)
+
     def __init__(self, program_or_graph, build_strategy=None):
+        self._uid = next(CompiledProgram._uid_counter)
         self._program = program_or_graph
         self._build_strategy = build_strategy or BuildStrategy()
         self._is_data_parallel = False

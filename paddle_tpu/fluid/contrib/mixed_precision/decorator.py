@@ -83,6 +83,7 @@ class OptimizerWithMixedPrecision:
 
         new_pg = []
         finite_names = []
+        push_ops = [o for o in block.ops if o.type == "distributed_push"]
         if self._use_dynamic:
             for p, g in params_grads:
                 fname = g.name + ".finite"
@@ -91,6 +92,21 @@ class OptimizerWithMixedPrecision:
                 block.append_op("isfinite", {"X": [g.name]},
                                 {"Out": [fname]})
                 finite_names.append(fname)
+            # PS-tier payloads overflow independently of device grads (the
+            # embedding cotangent accumulates the most backward factors) —
+            # check them too, or an inf push would poison host table rows
+            # that have no rollback
+            for o in push_ops:
+                vname = o.input("Values")[0]
+                fname = vname + ".finite"
+                block.create_var(name=fname, shape=[], dtype="bool",
+                                 stop_gradient=True)
+                block.append_op("isfinite", {"X": [vname]}, {"Out": [fname]})
+                finite_names.append(fname)
+            if not finite_names:
+                raise ValueError(
+                    "dynamic loss scaling needs at least one gradient to "
+                    "check (no device grads and no distributed_push ops)")
             all_finite = finite_names[0]
             for fn in finite_names[1:]:
                 nxt = unique_name.generate("all_finite")
@@ -117,9 +133,26 @@ class OptimizerWithMixedPrecision:
         inv = 1.0 / self._init_loss_scaling
         for p, g in params_grads:
             if inv != 1.0 or self._use_dynamic:
-                scaled = g.block.create_var(
-                    name=g.name + ".unscaled", shape=g.shape, dtype=g.dtype,
-                    stop_gradient=True)
+                # A selected_rows grad must keep (a) its type marker — the
+                # optimizer's _sparse_grad check reads var.type — and (b) its
+                # name+'@ROWS' binding, else the (n, dim) values array would
+                # be applied as a dense [vocab, dim] gradient.
+                is_sparse = getattr(g, "type", "lod_tensor") == "selected_rows"
+
+                def _derive(base, suffix):
+                    nv = g.block.create_var(
+                        name=base + suffix, shape=g.shape, dtype=g.dtype,
+                        stop_gradient=True,
+                        type="selected_rows" if is_sparse else "lod_tensor")
+                    if is_sparse:
+                        rows = base + suffix + "@ROWS"
+                        g.block.create_var(name=rows, shape=(-1,),
+                                           dtype="int32", stop_gradient=True)
+                        block.append_op("assign", {"X": [g.name + "@ROWS"]},
+                                        {"Out": [rows]})
+                    return nv
+
+                scaled = _derive(g.name, ".unscaled")
                 if self._use_dynamic:
                     block.append_op("elementwise_div",
                                     {"X": [g.name], "Y": [pre]},
@@ -136,9 +169,7 @@ class OptimizerWithMixedPrecision:
                         stop_gradient=True)
                     block.append_op("zeros_like", {"X": [g.name]},
                                     {"Out": [zeros.name]})
-                    gated = g.block.create_var(
-                        name=g.name + ".gated", shape=g.shape, dtype=g.dtype,
-                        stop_gradient=True)
+                    gated = _derive(g.name, ".gated")
                     block.append_op("where",
                                     {"Condition": [self._all_finite],
                                      "X": [scaled.name], "Y": [zeros.name]},
@@ -147,6 +178,20 @@ class OptimizerWithMixedPrecision:
                 new_pg.append((p, scaled))
             else:
                 new_pg.append((p, g))
+
+        # PS-tier pushes must also be unscaled and overflow-gated: annotate
+        # each distributed_push op and move it AFTER the gate computation in
+        # program order (its lowering reads the gate/scale bindings).
+        if push_ops:
+            for o in push_ops:
+                block.ops.remove(o)
+                if self._use_dynamic:
+                    o.attrs["scale_var"] = pre
+                    o.attrs["gate_var"] = gate
+                else:
+                    o.attrs["scale"] = self._init_loss_scaling
+                block.ops.append(o)
+            loss.block.program._bump()
         return new_pg
 
     def _append_scale_update(self, block, gate_name):

@@ -150,7 +150,14 @@ class Executor:
         # normalize feeds to declared dtype; device-resident jax Arrays pass
         # through untouched (the DataLoader/buffered-reader path pre-stages
         # H2D transfers — critical when the chip sits behind a slow link)
+        from .lod import LoDTensor, lod_name
+
         for name in list(feed):
+            if isinstance(feed[name], LoDTensor):
+                # decompose: data under the name, int32 lengths under @LOD
+                # (the bounded-LoD device encoding, see fluid/lod.py)
+                feed[lod_name(name)] = feed[name].lengths()
+                feed[name] = feed[name].data()
             if isinstance(feed[name], jax.Array):
                 continue
             var = block._find_var_recursive(name)
@@ -166,13 +173,15 @@ class Executor:
             if v.persistable and scope.has_var(v.name)
         )
 
+        # program._uid (a monotonic token) rather than id(program): a GC'd
+        # Program's id can be reused, which would serve a stale compiled step
         key = (
-            id(program),
+            program._uid,
             program._mutation,
             _feed_signature(feed, block),
             tuple(fetch_names),
             tuple(state_names),
-            id(strategy) if strategy is not None else 0,
+            strategy._uid if strategy is not None else 0,
         )
         step = self._cache.get(key)
         if step is None:

@@ -16,6 +16,7 @@ layer (``paddle/fluid/framework/program_desc.h:30``), re-designed TPU-first:
 
 import contextlib
 import copy
+import itertools
 
 import numpy as np
 
@@ -376,12 +377,18 @@ class Program:
     Executor's compile cache to detect graph changes cheaply.
     """
 
+    _uid_counter = itertools.count()
+
     def __init__(self):
         self.blocks = [Block(self, 0)]
         self.current_block_idx = 0
         self.random_seed = 0
         self._mutation = 0
         self._seed_counter = 0
+        # unique per-Program token: the Executor cache key must not use
+        # id(program) — a GC'd Program's id can be reused and serve a stale
+        # compiled step
+        self._uid = next(Program._uid_counter)
         # set by append_backward: maps param name -> grad var name
         self.param_grad_map = {}
 
@@ -424,6 +431,7 @@ class Program:
         (dropout off, batch_norm uses running stats) like the reference's
         ``Program.clone(for_test=True)``."""
         p = Program.__new__(Program)
+        p._uid = next(Program._uid_counter)
         p.random_seed = self.random_seed
         p._mutation = 0
         p._seed_counter = self._seed_counter
@@ -487,6 +495,7 @@ class Program:
     @staticmethod
     def from_desc(desc):
         p = Program.__new__(Program)
+        p._uid = next(Program._uid_counter)
         p.random_seed = desc.get("random_seed", 0)
         p._mutation = 0
         p._seed_counter = 0

@@ -2,7 +2,7 @@
 
 from .. import ops as _ops  # registers all lowering rules  # noqa: F401
 from . import (control_flow, io, learning_rate_scheduler, loss, metric_op,
-               nn, ops, tensor)
+               nn, ops, sequence_lod, tensor)
 from .control_flow import *  # noqa: F401,F403
 from .io import data
 from .learning_rate_scheduler import *  # noqa: F401,F403
@@ -10,4 +10,5 @@ from .loss import *  # noqa: F401,F403
 from .metric_op import accuracy, auc
 from .nn import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403
+from .sequence_lod import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403

@@ -93,8 +93,37 @@ def _append_bias(helper, x, bias_attr, dim_start=1, channel_dim=None):
 
 
 def embedding(input, size, is_sparse=False, is_distributed=False,
-              padding_idx=None, param_attr=None, dtype="float32"):
+              padding_idx=None, param_attr=None, dtype="float32",
+              table_lr=0.01, table_optimizer="sgd"):
     helper = LayerHelper("embedding", **locals())
+    if is_distributed:
+        # PS tier (reference distributed_lookup_table_op.cc): the table is a
+        # host-resident sharded store, NOT a device Parameter. Rows are
+        # pulled via host callback; grads are pushed to the host optimizer
+        # (table_lr/table_optimizer) by a distributed_push op appended in
+        # append_backward. A distributed_table_init op in the STARTUP
+        # program resets the host store like device params.
+        from ...distributed import ps
+
+        from .. import unique_name
+
+        name = (param_attr.name if param_attr is not None
+                and getattr(param_attr, "name", None) else
+                unique_name.generate("dist_emb"))
+        ps.ensure_table(name, size[0], size[1])
+        helper.startup_program.global_block().append_op(
+            "distributed_table_init", attrs={"table_name": name})
+        out = helper.create_variable_for_type_inference(dtype)
+        helper.append_op(
+            type="distributed_lookup_table",
+            inputs={"Ids": [input]},
+            outputs={"Out": [out]},
+            attrs={"table_name": name, "dim": int(size[1]),
+                   "lr": float(table_lr), "optimizer": table_optimizer,
+                   "padding_idx": -1 if padding_idx is None else padding_idx,
+                   "dtype": dtype},
+        )
+        return out
     w = helper.create_parameter(param_attr, size, dtype)
     out = helper.create_variable_for_type_inference(dtype)
     helper.append_op(

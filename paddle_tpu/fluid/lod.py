@@ -1,0 +1,88 @@
+"""LoD (level-of-detail / ragged sequence) tensors, TPU-native.
+
+Reference: ``paddle/fluid/framework/lod_tensor.h:52,104`` — a dense tensor
+plus nested offset tables describing variable-length sequences, threaded
+through ~16 sequence_* ops and the RNN/beam stack.
+
+TPU-native redesign ("bounded LoD"): XLA requires static shapes, so a LoD
+tensor is a *flattened* ``[total_bound, ...]`` array whose first dimension is
+a static physical bound, paired with a device-resident int32 ``lengths``
+vector bound to ``name + "@LOD"`` in the lowering environment (the same
+side-binding convention as SelectedRows' ``@ROWS``). The *logical* total is
+``sum(lengths)`` — rows past it are padding that every sequence op masks out
+via segment arithmetic (``searchsorted(cumsum(lengths), arange(total))``),
+so lengths can change batch-to-batch without recompilation while every
+intermediate keeps a fixed shape for the compiler.
+
+Only level-1 LoD is carried on-device (one lengths vector). The host-side
+``LoDTensor`` accepts recursive (nested) lengths for API parity and flattens
+the innermost level for device use.
+"""
+
+import numpy as np
+
+__all__ = ["LoDTensor", "create_lod_tensor", "LOD_SUFFIX", "lod_name"]
+
+LOD_SUFFIX = "@LOD"
+
+
+def lod_name(name):
+    return name + LOD_SUFFIX
+
+
+class LoDTensor:
+    """Host-side (data, recursive lengths) pair accepted by ``feed={}``.
+
+    The Executor decomposes it into two device arrays: ``name`` gets the
+    flattened data, ``name@LOD`` gets the innermost-level lengths.
+    """
+
+    def __init__(self, data, recursive_seq_lens=None):
+        self._data = np.asarray(data)
+        if recursive_seq_lens is None:
+            recursive_seq_lens = [[self._data.shape[0]]]
+        if recursive_seq_lens and not isinstance(
+                recursive_seq_lens[0], (list, tuple, np.ndarray)):
+            recursive_seq_lens = [recursive_seq_lens]
+        self._rsl = [list(int(x) for x in lvl) for lvl in recursive_seq_lens]
+        total = int(sum(self._rsl[-1]))
+        if total > self._data.shape[0]:
+            raise ValueError(
+                "sum(lengths)=%d exceeds data rows %d"
+                % (total, self._data.shape[0]))
+
+    def recursive_sequence_lengths(self):
+        return [list(lvl) for lvl in self._rsl]
+
+    def lod(self):
+        """Offset form (reference ``LoD``): prefix sums per level."""
+        out = []
+        for lvl in self._rsl:
+            offs = [0]
+            for x in lvl:
+                offs.append(offs[-1] + x)
+            out.append(offs)
+        return out
+
+    def lengths(self):
+        """Innermost-level lengths as int32 (the device-side binding)."""
+        return np.asarray(self._rsl[-1], np.int32)
+
+    def data(self):
+        return self._data
+
+    def __array__(self, dtype=None):
+        return self._data if dtype is None else self._data.astype(dtype)
+
+    @property
+    def shape(self):
+        return self._data.shape
+
+    def __repr__(self):
+        return "LoDTensor(shape=%s, recursive_seq_lens=%s)" % (
+            self._data.shape, self._rsl)
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None):
+    """Reference ``fluid.create_lod_tensor``; ``place`` is advisory."""
+    return LoDTensor(data, recursive_seq_lens)

@@ -10,11 +10,13 @@ from . import (  # noqa: F401
     collective,
     control_flow,
     creation,
+    distributed_ops,
     elementwise,
     loss,
     math,
     metrics,
     nn,
     optimizer_ops,
+    sequence_ops,
     tensor_ops,
 )

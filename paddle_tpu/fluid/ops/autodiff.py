@@ -24,8 +24,11 @@ def _run_ops(rctx, ops, wrt_names):
     """Lower `ops` in order on rctx, honoring stop_gradient markers."""
     import jax
 
+    from ..registry import propagate_lod
+
     for o in ops:
         registry.get(o.type).lower(rctx, o)
+        propagate_lod(rctx, o)
         for name in o.output_arg_names():
             v = rctx.var(name)
             if v is not None and v.stop_gradient and name not in wrt_names:
@@ -162,6 +165,9 @@ def _autodiff(ctx, op):
 
     checkpoints = op.attr("checkpoints", None)
     sparse_wrt = op.attr("sparse_wrt", None) or []
+    # host-table (parameter-server) lookups: no device param, the cotangent
+    # at the lookup output is PUSHED to the host store (ops/distributed_ops)
+    dist_push = op.attr("dist_push", None) or []
     sparse_names = {s[0] for s in sparse_wrt}
     dense_idx = [i for i, n in enumerate(wrt_names) if n not in sparse_names]
     dense_names = [wrt_names[i] for i in dense_idx]
@@ -183,27 +189,46 @@ def _autodiff(ctx, op):
             loss = jnp.sum(loss)
         return loss * loss_scale
 
-    if sparse_wrt:
+    if sparse_wrt or dist_push:
+        import numpy as np
         import jax.numpy as jnp
 
-        eps0 = [jnp.zeros_like(ctx.get(out_name))
-                for _, _, out_name in sparse_wrt]
+        # eps keyed by lookup OUTPUT name (unique per lookup op; works for
+        # host-table lookups which have no W input)
+        eps_outs = [s[2] for s in sparse_wrt] + [d[2] for d in dist_push]
+        eps0 = [jnp.zeros_like(ctx.get(o)) for o in eps_outs]
         dense_vals = [wrt_vals[i] for i in dense_idx]
 
         def fwd2(dvals, evals):
-            eps_map = {s[0]: e for s, e in zip(sparse_wrt, evals)}
+            eps_map = dict(zip(eps_outs, evals))
             return run_fwd(dict(zip(dense_names, dvals)), eps_map)
 
         gdense, geps = jax.grad(fwd2, argnums=(0, 1))(dense_vals, eps0)
         for i, g in zip(dense_idx, gdense):
             ctx.set(grad_names[i], g)
-        for (pname, ids_name, _), ge in zip(sparse_wrt, geps):
+        n_sparse = len(sparse_wrt)
+        for (pname, ids_name, _), ge in zip(sparse_wrt, geps[:n_sparse]):
             ids = ctx.get(ids_name)
             rows = jnp.reshape(ids, (-1,)).astype("int32")
             values = jnp.reshape(ge, (rows.shape[0], -1))
             gname = grad_names[wrt_names.index(pname)]
             ctx.set(gname, values)
             ctx.set(gname + "@ROWS", rows)
+        for (tname, ids_name, out_name, lr, optname), ge in zip(
+                dist_push, geps[n_sparse:]):
+            # bind the cotangent; the actual host push is a separate
+            # `distributed_push` op appended after the autodiff op, so AMP
+            # can unscale/overflow-gate the payload before it leaves the
+            # device (ops/distributed_ops.py)
+            ids = ctx.get(ids_name)
+            # int32 on device (x64 is disabled; widening happens at the host
+            # boundary in table.push — host tables beyond 2^31 rows would
+            # need int64 device ids, which the chip doesn't carry anyway)
+            rows = jnp.reshape(ids, (-1,)).astype(np.dtype("int32"))
+            values = jnp.reshape(
+                ge.astype(np.dtype("float32")), (rows.shape[0], -1))
+            ctx.set(out_name + "@PS_GRAD", values)
+            ctx.set(out_name + "@PS_ROWS", rows)
     else:
         grads = jax.grad(lambda vals: run_fwd(dict(zip(wrt_names, vals)),
                                               None))(wrt_vals)

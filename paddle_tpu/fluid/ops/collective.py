@@ -40,7 +40,9 @@ def _allreduce(kind):
         elif kind == "prod":
             import jax.numpy as jnp
 
-            out = jnp.exp(jax.lax.psum(jnp.log(x), axis))
+            # XLA has no product all-reduce primitive; all_gather + prod is
+            # exact for zeros and negatives (exp(psum(log)) is not)
+            out = jnp.prod(jax.lax.all_gather(x, axis), axis=0)
         elif kind == "avg":
             out = jax.lax.pmean(x, axis)
         ctx.set_output(op, "Out", out)

@@ -1,0 +1,125 @@
+"""Distributed (parameter-server tier) ops.
+
+Parity: reference ``operators/distributed_ops/distributed_lookup_table_op.cc``
+and the pslib pull/push path (``framework/fleet/fleet_wrapper.h:77,103``).
+TPU-native: the table lives in host RAM (``paddle_tpu/distributed/ps.py`` —
+native C++ shard store); the device graph pulls rows with
+``jax.pure_callback`` (XLA host callback, overlapped by the runtime) instead
+of an RPC per step. The gradient push is an explicit ``distributed_push``
+op appended by ``append_backward`` AFTER the autodiff op — the payload is an
+env binding (out_name + '@PS_GRAD'/'@PS_ROWS') produced by the autodiff
+lowering, so AMP can divide out its loss scale and zero the payload on
+overflow (attrs ``scale``/``scale_var``/``gate_var``) before the ordered
+``io_callback`` hands it to the host-side table optimizer — the async-PS
+update model.
+"""
+
+import numpy as np
+
+from ..registry import register
+
+
+def _pull_fn(table_name):
+    def pull(ids):
+        from ...distributed import ps
+
+        return ps.get_table(table_name).pull(np.asarray(ids))
+
+    return pull
+
+
+@register("distributed_lookup_table")
+def _distributed_lookup_table(ctx, op):
+    import jax
+    import jax.numpy as jnp
+
+    ids = ctx.get_input(op, "Ids")
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    table_name = op.attr("table_name")
+    dim = int(op.attr("dim"))
+    # int32 on device; the host pull widens to int64 (table.pull)
+    flat = jnp.reshape(ids, (-1,)).astype(np.dtype("int32"))
+    out = jax.pure_callback(
+        _pull_fn(table_name),
+        jax.ShapeDtypeStruct((flat.shape[0], dim), np.dtype("float32")),
+        flat,
+        vmap_method="sequential",
+    )
+    out = jnp.reshape(out, tuple(ids.shape) + (dim,))
+    # autodiff injects an additive eps whose cotangent IS the push payload;
+    # it goes BEFORE the padding mask so padded positions get zero cotangent
+    eps_map = getattr(ctx, "sparse_eps", None)
+    if eps_map is not None:
+        eps = eps_map.get(op.output("Out")[0])
+        if eps is not None:
+            out = out + eps
+    padding_idx = op.attr("padding_idx", -1)
+    if padding_idx is not None and padding_idx >= 0:
+        out = jnp.where((ids == padding_idx)[..., None], 0.0, out)
+    dtype = op.attr("dtype", "float32")
+    if str(dtype) != "float32":
+        out = out.astype(np.dtype(dtype))
+    ctx.set_output(op, "Out", out)
+
+
+@register("distributed_push")
+def _distributed_push(ctx, op):
+    """Ship the SelectedRows cotangent to the host table optimizer.
+
+    Ordered io_callback: an effect, never DCE'd, sequenced with other host
+    effects. AMP seam: ``scale``/``scale_var`` divide the payload (undoing
+    the loss scale baked into the cotangent) and ``gate_var`` multiplies it
+    (0.0 on overflow — pushing zeros is a no-op update for sgd/adagrad,
+    mirroring the zero-grad device step AMP takes on overflow)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import io_callback
+
+    values = ctx.get_input(op, "Values")
+    rows = ctx.get_input(op, "Rows")
+    scale = float(op.attr("scale", 1.0))
+    if scale != 1.0:
+        values = values / scale
+    scale_var = op.attr("scale_var", None)
+    if scale_var is not None:
+        values = values / jnp.reshape(
+            jax.lax.stop_gradient(ctx.get(scale_var)), ()).astype("float32")
+    gate_var = op.attr("gate_var", None)
+    if gate_var is not None:
+        # select, not multiply: inf * 0 == nan would still reach the table
+        gate = jnp.reshape(jax.lax.stop_gradient(ctx.get(gate_var)), ())
+        values = jnp.where(gate > 0, values, jnp.zeros_like(values))
+    tname = op.attr("table_name")
+    lr = float(op.attr("lr", 0.01))
+    optname = op.attr("optimizer", "sgd")
+
+    def _push(r, v, _t=tname, _lr=lr, _o=optname):
+        from ...distributed import ps
+
+        ps.get_table(_t).push(np.asarray(r), np.asarray(v),
+                              lr=_lr, optimizer=_o)
+        return np.int32(0)
+
+    io_callback(_push, jax.ShapeDtypeStruct((), np.dtype("int32")),
+                rows, values, ordered=True)
+
+
+@register("distributed_table_init")
+def _distributed_table_init(ctx, op):
+    """(Re-)initialize a host table — placed in the STARTUP program by
+    ``layers.embedding(is_distributed=True)`` so ``exe.run(startup)`` resets
+    the host store exactly like it resets device parameters."""
+    import jax
+    from jax.experimental import io_callback
+
+    tname = op.attr("table_name")
+
+    def _init(_t=tname):
+        from ...distributed import ps
+
+        ps.get_table(_t).reinit()
+        return np.int32(0)
+
+    io_callback(_init, jax.ShapeDtypeStruct((), np.dtype("int32")),
+                ordered=True)

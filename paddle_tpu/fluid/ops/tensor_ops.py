@@ -335,7 +335,7 @@ def _lookup_table(ctx, op):
     out = jnp.take(w, ids.astype(np.dtype("int32")), axis=0)
     eps_map = getattr(ctx, "sparse_eps", None)
     if eps_map is not None:
-        eps = eps_map.get(op.input("W")[0])
+        eps = eps_map.get(op.output("Out")[0])
         if eps is not None:
             # before the padding mask, so padding positions get zero
             # cotangent exactly like the dense grad path

@@ -590,8 +590,24 @@ class DGCMomentumOptimizer(MomentumOptimizer):
 
 
 class ModelAverage(Optimizer):
-    """Maintains running averages of params; ``apply()`` context swaps them
-    in for eval (reference ``optimizer.py:2512``)."""
+    """Maintains WINDOWED running averages of params; ``apply()`` swaps them
+    in for eval (reference ``optimizer.py:2512`` +
+    ``operators/average_accumulates_op.cc``).
+
+    Window semantics: accumulation restarts whenever the in-window count
+    reaches ``clip(average_window_rate * num_updates, min_average_window,
+    max_average_window)``; the just-closed window is kept so the served
+    average always covers (current + previous) windows — a bounded window,
+    not an unbounded running sum. The restart is gated in-graph (no
+    divergent control flow under jit):
+
+        r        = (num_acc + 1 >= W)            # restart gate, 0/1
+        sum_prev' = r * (sum + p) + (1-r) * sum_prev
+        old_num'  = r * (num_acc + 1) + (1-r) * old_num
+        sum'      = (1-r) * (sum + p)
+        num_acc'  = (1-r) * (num_acc + 1)
+        average   = (sum + sum_prev) / (num_acc + old_num)
+    """
 
     def __init__(self, average_window_rate, min_average_window=10000,
                  max_average_window=10000, regularization=None, name=None):
@@ -602,15 +618,38 @@ class ModelAverage(Optimizer):
         self.params_sums = {}
         program = default_main_program()
         block = program.global_block()
+        from .layers import nn, tensor
+
+        steps = nn.autoincreased_step_counter(
+            counter_name="@MODEL_AVERAGE_STEP@", begin=1)
+        stepsf = tensor.cast(steps, "float32")
+        # W = clip(rate * num_updates, min_window, max_window)
+        w = nn.clip(nn.scale(stepsf, scale=float(average_window_rate)),
+                    float(min_average_window), float(max_average_window))
         for param in program.all_parameters():
             if not param.trainable:
                 continue
             s = self._add_accumulator("sum", param)
+            sp = self._add_accumulator("sum_prev", param)
             n = self._add_accumulator("num_acc", param, shape=(1,))
-            block.append_op("sum", inputs={"X": [param, s]}, outputs={"Out": [s]})
-            block.append_op("increment", inputs={"X": [n]}, outputs={"Out": [n]},
-                            attrs={"step": 1.0})
-            self.params_sums[param.name] = (s, n)
+            on = self._add_accumulator("old_num_acc", param, shape=(1,))
+            n1 = nn.scale(n, scale=1.0, bias=1.0)          # num_acc + 1
+            s1 = nn.elementwise_add(s, param)              # sum + p
+            rb = n1 >= w
+            r = tensor.cast(rb, "float32")                 # restart gate
+            keep = nn.scale(r, scale=-1.0, bias=1.0)       # 1 - r
+            new_sp = nn.elementwise_add(
+                nn.elementwise_mul(s1, r, axis=-1),
+                nn.elementwise_mul(sp, keep, axis=-1))
+            new_on = nn.elementwise_add(
+                nn.elementwise_mul(n1, r), nn.elementwise_mul(on, keep))
+            new_s = nn.elementwise_mul(s1, keep, axis=-1)
+            new_n = nn.elementwise_mul(n1, keep)
+            for src, dst in ((new_sp, sp), (new_on, on), (new_s, s),
+                             (new_n, n)):
+                block.append_op("assign", inputs={"X": [src]},
+                                outputs={"Out": [dst]})
+            self.params_sums[param.name] = (s, sp, n, on)
 
     import contextlib
 
@@ -620,10 +659,12 @@ class ModelAverage(Optimizer):
 
         scope = global_scope()
         backups = {}
-        for pname, (s, n) in self.params_sums.items():
+        for pname, (s, sp, n, on) in self.params_sums.items():
             backups[pname] = scope.find_var(pname)
-            ssum = np.asarray(scope.find_var(s.name))
-            num = float(np.asarray(scope.find_var(n.name)).reshape(-1)[0])
+            ssum = (np.asarray(scope.find_var(s.name))
+                    + np.asarray(scope.find_var(sp.name)))
+            num = float(np.asarray(scope.find_var(n.name)).reshape(-1)[0]
+                        + np.asarray(scope.find_var(on.name)).reshape(-1)[0])
             if num > 0:
                 scope.set_var(pname, (ssum / num).astype(backups[pname].dtype))
         try:

@@ -133,6 +133,32 @@ class LowerCtx:
         return np.dtype(v.dtype) if v is not None else np.dtype("float32")
 
 
+def propagate_lod(ctx, op):
+    """Dataflow LoD propagation: if exactly one input carries an @LOD
+    lengths binding and an output has the same (static) token dimension,
+    the output inherits it — the analogue of the reference's ShareLoD in
+    per-op InferShape, done generically on the lowered values."""
+    in_lods = []
+    for name in op.input_arg_names():
+        key = name + "@LOD"
+        if key in ctx.env and name in ctx.env:
+            in_lods.append((name, ctx.env[key]))
+    if len(in_lods) != 1:
+        return
+    src_name, lengths = in_lods[0]
+    src = ctx.env[src_name]
+    lead = np.shape(src)[0] if np.ndim(src) else None
+    if lead is None:
+        return
+    for out in op.output_arg_names():
+        key = out + "@LOD"
+        if key in ctx.env or out not in ctx.env:
+            continue
+        v = ctx.env[out]
+        if np.ndim(v) and np.shape(v)[0] == lead:
+            ctx.env[key] = lengths
+
+
 def lower_block(ctx, block):
     """Run every op's lowering rule in order (the `Executor::RunPreparedContext`
     hot-loop analogue, reference executor.cc:411 — but traced once, compiled
@@ -140,4 +166,5 @@ def lower_block(ctx, block):
     for op in block.ops:
         start = len(ctx.used_keys)
         registry.get(op.type).lower(ctx, op)
+        propagate_lod(ctx, op)
         ctx.op_key_spans[id(op)] = (start, len(ctx.used_keys))

@@ -62,6 +62,23 @@ class GradAllReduce(Collective):
                 for gname in op.attr("grad_names"):
                     if gname in dgc_grads:
                         continue
+                    gvar = block.vars.get(gname)
+                    if gvar is not None and getattr(
+                            gvar, "type", "lod_tensor") == "selected_rows":
+                        # A positional c_allreduce_sum over SelectedRows
+                        # values would mix gradients of DIFFERENT rows (each
+                        # rank looked up different ids). Gather every rank's
+                        # (rows, values) instead; the optimizer's scatter-add
+                        # sums duplicates, which IS the cross-rank reduction
+                        # (reference densifies before allreduce — this keeps
+                        # the grad sparse and rides one all-gather on ICI).
+                        for name in (gname, gname + "@ROWS"):
+                            ar = framework.Operator(
+                                block, "c_allgather",
+                                inputs={"X": [name]}, outputs={"Out": [name]},
+                                attrs={"ring_id": 0, "use_calc_stream": True})
+                            new_ops.append(ar)
+                        continue
                     ar = framework.Operator(
                         block, "c_allreduce_sum",
                         inputs={"X": [gname]}, outputs={"Out": [gname]},

@@ -51,6 +51,8 @@ def load_ps_store():
     lib.pts_dump.argtypes = [i64, i64, i64, f32p]
     lib.pts_load.restype = ctypes.c_int
     lib.pts_load.argtypes = [i64, i64, i64, f32p]
+    lib.pts_reset.restype = ctypes.c_int
+    lib.pts_reset.argtypes = [i64, ctypes.c_double, i64]
     lib.pts_dim.restype = i64
     lib.pts_dim.argtypes = [i64]
     lib.pts_vocab.restype = i64

@@ -160,6 +160,22 @@ int pts_load(int64_t h, int64_t start, int64_t n, const float* in) {
   return 0;
 }
 
+// Reset rows to the U(-scale, scale) init distribution (same seed law as
+// pts_create) and drop optimizer state — the startup-program analogue.
+int pts_reset(int64_t h, double init_scale, int64_t seed) {
+  Table* t = get_table(h);
+  if (!t) return -1;
+  for (int64_t s = 0; s < t->nshards; ++s) {
+    Shard& sh = t->shards[s];
+    std::lock_guard<std::mutex> lk(sh.mu);
+    std::mt19937_64 gen(seed * 1315423911LL + s);
+    std::uniform_real_distribution<float> dist(-init_scale, init_scale);
+    for (auto& x : sh.data) x = dist(gen);
+    sh.accum.clear();
+  }
+  return 0;
+}
+
 int64_t pts_dim(int64_t h) {
   Table* t = get_table(h);
   return t ? t->dim : -1;

@@ -188,3 +188,50 @@ def test_amp_bert_tiny_trains():
                   for _ in range(6)]
     assert all(np.isfinite(l) for l in losses)
     assert losses[-1] < losses[0]
+
+
+def test_amp_dynamic_preserves_selected_rows_grads():
+    """Dynamic scaling rewrites grads into '.unscaled'/'.gated' vars; for a
+    SelectedRows grad those must keep the type marker and the @ROWS binding
+    (else the (n, dim) values array would be applied as a dense [vocab, dim]
+    grad). Trains must match the non-AMP sparse baseline at scale 1.0."""
+    vocab, dim, lr = 25, 4, 0.5
+    feed = {"ids": np.array([[1, 3, 3], [9, 1, 1]], np.int64)}
+
+    def run(with_amp):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 13
+        with fluid.program_guard(main, startup):
+            ids = layers.data("ids", shape=[3], dtype="int64")
+            emb = layers.embedding(ids, size=[vocab, dim], is_sparse=True,
+                                   param_attr=fluid.ParamAttr(name="amp_emb"))
+            loss = layers.mean(layers.reduce_sum(emb * emb, dim=-1))
+            opt = optimizer.SGD(learning_rate=lr)
+            if with_amp:
+                opt = mixed_precision.decorate(
+                    opt, init_loss_scaling=1.0,
+                    use_dynamic_loss_scaling=True,
+                    amp_lists=mixed_precision.AutoMixedPrecisionLists(
+                        custom_black_list={"lookup_table"}))
+            opt.minimize(loss)
+        if with_amp:
+            block = main.global_block()
+            gated = block.var("amp_emb@GRAD.gated")
+            assert gated.type == "selected_rows"
+            assert block.var("amp_emb@GRAD.gated@ROWS") is not None
+        exe = fluid.Executor()
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            w0 = np.asarray(exe.run(main, feed=feed,
+                                    fetch_list=["amp_emb"])[0]).copy()
+            w1 = np.asarray(exe.run(main, feed=feed,
+                                    fetch_list=["amp_emb"])[0]).copy()
+        return w0, w1
+
+    base0, base1 = run(False)
+    amp0, amp1 = run(True)
+    np.testing.assert_allclose(amp0, base0, rtol=1e-4)
+    np.testing.assert_allclose(amp1, base1, rtol=1e-4)
+    # untouched rows frozen (sparse update semantics survived AMP)
+    untouched = np.setdiff1d(np.arange(vocab), [1, 3, 9])
+    np.testing.assert_array_equal(amp1[untouched], amp0[untouched])

@@ -1,0 +1,392 @@
+"""PS tier (host-resident tables) + round-3 fix coverage.
+
+Reference analogues: pslib pull/push (``framework/fleet/fleet_wrapper.h``),
+async Communicator (``operators/distributed/communicator.h:285``), GeoSGD
+(``:332``), distributed_lookup_table op
+(``operators/distributed_ops/distributed_lookup_table_op.cc``).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers, optimizer
+from paddle_tpu.distributed import ps
+
+
+@pytest.fixture(autouse=True)
+def _clean_tables():
+    ps.reset_tables()
+    yield
+    ps.reset_tables()
+
+
+@pytest.mark.parametrize("force_numpy", [True, False])
+def test_embedding_table_pull_push(force_numpy):
+    t = ps.EmbeddingTable(10, 4, seed=1, force_numpy=force_numpy)
+    base = t.dump()
+    out = t.pull([2, 5, 2])
+    np.testing.assert_allclose(out[0], base[2], rtol=1e-6)
+    np.testing.assert_allclose(out[1], base[5], rtol=1e-6)
+    # duplicate ids in one push must accumulate
+    g = np.ones((3, 4), np.float32)
+    t.push([2, 5, 2], g, lr=0.1)
+    now = t.dump()
+    np.testing.assert_allclose(now[2], base[2] - 0.2, rtol=1e-5)
+    np.testing.assert_allclose(now[5], base[5] - 0.1, rtol=1e-5)
+    untouched = [i for i in range(10) if i not in (2, 5)]
+    np.testing.assert_array_equal(now[untouched], base[untouched])
+
+
+@pytest.mark.parametrize("force_numpy", [True, False])
+def test_embedding_table_adagrad(force_numpy):
+    t = ps.EmbeddingTable(6, 2, seed=2, force_numpy=force_numpy)
+    base = t.dump()
+    g = np.full((1, 2), 2.0, np.float32)
+    t.push([3], g, lr=0.5, optimizer="adagrad", eps=1e-6)
+    # accum = g^2 = 4 -> step = lr * g / (sqrt(4)+eps) = 0.5
+    np.testing.assert_allclose(t.dump()[3], base[3] - 0.5, rtol=1e-4)
+
+
+def test_embedding_table_dump_load_roundtrip():
+    t = ps.EmbeddingTable(8, 3, seed=3)
+    snap = t.dump()
+    t.push([0, 1], np.ones((2, 3), np.float32), lr=1.0)
+    assert np.abs(t.dump() - snap).max() > 0
+    t.load(snap)
+    np.testing.assert_array_equal(t.dump(), snap)
+
+
+def test_async_pusher_applies_and_flushes():
+    t = ps.EmbeddingTable(10, 2, seed=4, force_numpy=True)
+    base = t.dump()
+    p = ps.AsyncPusher(t)
+    for _ in range(5):
+        p.push(np.array([1], np.int64), np.ones((1, 2), np.float32), lr=0.1)
+    p.flush()
+    np.testing.assert_allclose(t.dump()[1], base[1] - 0.5, rtol=1e-5)
+    p.stop()
+
+
+def test_async_pusher_error_surfaces_no_deadlock():
+    """A failing push (out-of-range id) must not kill the worker silently:
+    flush() must return (no deadlock) and re-raise the recorded error."""
+    t = ps.EmbeddingTable(4, 2, seed=5, force_numpy=True)
+    p = ps.AsyncPusher(t)
+    p.push(np.array([99], np.int64), np.ones((1, 2), np.float32))  # bad id
+    with pytest.raises(IndexError):
+        p.flush()
+    # worker survived: subsequent pushes still work
+    base = t.dump()
+    p.push(np.array([0], np.int64), np.ones((1, 2), np.float32), lr=0.1)
+    p.flush()
+    np.testing.assert_allclose(t.dump()[0], base[0] - 0.1, rtol=1e-5)
+    p.stop()
+
+
+def test_geo_communicator_syncs_every_k():
+    t = ps.EmbeddingTable(5, 2, seed=6, force_numpy=True)
+    geo = ps.GeoCommunicator(t, k_steps=3)
+    base = t.dump()
+    geo.local[1] += 1.0
+    assert not geo.maybe_sync() and not geo.maybe_sync()
+    np.testing.assert_array_equal(t.dump(), base)  # not yet pushed
+    assert geo.maybe_sync()  # step 3: delta pushed
+    np.testing.assert_allclose(t.dump()[1], base[1] + 1.0, rtol=1e-5)
+    np.testing.assert_array_equal(t.dump()[0], base[0])
+
+
+def test_distributed_lookup_table_e2e():
+    """BASELINE config 4 substrate: a model whose embedding lives in the
+    host PS table trains end-to-end — forward pulls via host callback,
+    backward pushes the SelectedRows cotangent, rows move, loss falls."""
+    vocab, dim = 30, 8
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 7
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", shape=[3], dtype="int64")
+        label = layers.data("label", shape=[1], dtype="float32")
+        emb = layers.embedding(
+            ids, size=[vocab, dim], is_distributed=True, table_lr=0.1,
+            param_attr=fluid.ParamAttr(name="ps_emb"))
+        pooled = layers.reduce_sum(emb, dim=1)
+        pred = layers.fc(pooled, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, label))
+        optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+    assert ps.has_table("ps_emb")
+    table = ps.get_table("ps_emb")
+    base = table.dump()
+    ad = next(op for op in main.global_block().ops if op.type == "autodiff")
+    assert ad.attr("dist_push"), "autodiff lost the PS push marker"
+
+    rng = np.random.RandomState(0)
+    feed = {"ids": rng.randint(0, vocab, (16, 3)).astype(np.int64),
+            "label": rng.rand(16, 1).astype(np.float32)}
+    exe = fluid.Executor()
+    losses = []
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for _ in range(8):
+            (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(lv).ravel()[0]))
+    assert losses[-1] < losses[0]
+    now = table.dump()
+    touched = np.unique(feed["ids"])
+    assert np.abs(now[touched] - base[touched]).max() > 0
+    untouched = np.setdiff1d(np.arange(vocab), touched)
+    np.testing.assert_array_equal(now[untouched], base[untouched])
+
+
+def test_sparse_param_demoted_on_use_before_lookup():
+    """A param consumed by another op BEFORE the is_sparse lookup in program
+    order must still get a DENSE gradient (order-independent demotion)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", shape=[2], dtype="int64")
+        emb = layers.embedding(ids, size=[12, 4], is_sparse=True,
+                               param_attr=fluid.ParamAttr(name="w_pre"))
+        wvar = main.global_block().var("w_pre")
+        wsum = layers.reduce_sum(wvar)
+        loss = layers.mean(layers.reduce_sum(emb, dim=-1)) + wsum
+    block = main.global_block()
+    # move the reduce_sum(w_pre) op BEFORE the lookup op
+    lookup_i = next(i for i, o in enumerate(block.ops)
+                    if o.type == "lookup_table")
+    red_i = next(i for i, o in enumerate(block.ops)
+                 if o.type.startswith("reduce_sum")
+                 and "w_pre" in o.input_arg_names())
+    op = block.ops.pop(red_i)
+    block.ops.insert(lookup_i, op)
+    main._bump()
+    with fluid.program_guard(main, startup):
+        optimizer.SGD(learning_rate=0.1).minimize(loss)
+    gvar = block.var("w_pre@GRAD")
+    assert gvar.type != "selected_rows", (
+        "param with a pre-lookup consumer must take the dense grad path")
+    # and it still trains correctly
+    exe = fluid.Executor()
+    feed = {"ids": np.array([[1, 2]], np.int64)}
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        exe.run(main, feed=feed, fetch_list=[loss])
+
+
+def test_sparse_grad_dp_allgather_matches_dense_baseline():
+    """GradAllReduce over a SelectedRows grad must NOT positionally sum
+    values across ranks (ranks hold different rows); the allgather path
+    must reproduce the single-device dense result exactly."""
+    vocab, dim, lr = 40, 4, 0.5
+    feed = {"ids": np.arange(16, dtype=np.int64).reshape(16, 1) % 11,
+            "w8": np.linspace(0.5, 1.5, 16).astype(np.float32).reshape(16, 1)}
+
+    def build(seed, sparse):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = seed
+        with fluid.program_guard(main, startup):
+            ids = layers.data("ids", shape=[1], dtype="int64")
+            w8 = layers.data("w8", shape=[1], dtype="float32")
+            emb = layers.embedding(ids, size=[vocab, dim], is_sparse=sparse,
+                                   param_attr=fluid.ParamAttr(name="emb_dp"))
+            emb = layers.reshape(emb, [-1, dim])
+            loss = layers.mean(
+                layers.reduce_sum(emb * emb, dim=-1, keep_dim=True) * w8)
+        return main, startup, loss
+
+    # single-device dense baseline on the full batch
+    main, startup, loss = build(21, sparse=False)
+    with fluid.program_guard(main, startup):
+        optimizer.SGD(lr).minimize(loss)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for _ in range(3):
+            exe.run(main, feed=feed, fetch_list=[loss])
+        w_base = np.asarray(exe.run(main, feed=feed,
+                                    fetch_list=["emb_dp"])[0])
+
+    # 8-rank explicit-collective mode with sparse grads
+    from paddle_tpu.fluid.transpiler.collective import GradAllReduce
+
+    main2, startup2, loss2 = build(21, sparse=True)
+    with fluid.program_guard(main2, startup2):
+        optimizer.SGD(lr).minimize(loss2)
+    GradAllReduce(nranks=8).transpile(startup2, main2)
+    types = [op.type for op in main2.global_block().ops]
+    assert "c_allgather" in types, "sparse grad must ride allgather"
+    compiled = fluid.CompiledProgram(main2).with_explicit_collectives(
+        loss_name=loss2.name)
+    exe2 = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe2.run(startup2)
+        for _ in range(3):
+            exe2.run(compiled, feed=feed, fetch_list=[loss2])
+        w_dp = np.asarray(exe2.run(compiled, feed=feed,
+                                   fetch_list=["emb_dp"])[0])
+    np.testing.assert_allclose(w_dp, w_base, rtol=1e-5, atol=1e-6)
+
+
+def test_c_allreduce_prod_zeros_and_negatives():
+    """Product all-reduce must be exact for zero and negative entries (the
+    old exp(psum(log)) lowering NaN'd on them)."""
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = layers.data("x", shape=[2], dtype="float32")
+        out = main.global_block().create_var(name="prod_out", shape=(-1, 2),
+                                             dtype="float32")
+        main.global_block().append_op(
+            "c_allreduce_prod", inputs={"X": [x]}, outputs={"Out": [out]},
+            attrs={"ring_id": 0})
+    xv = np.array([[-1.0, 2.0], [3.0, 0.0], [1.0, 1.0], [2.0, -2.0],
+                   [1.0, 1.0], [1.0, 1.0], [-1.0, 1.0], [1.0, 1.0]],
+                  np.float32)
+    compiled = fluid.CompiledProgram(main).with_explicit_collectives()
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        (r,) = exe.run(compiled, feed={"x": xv}, fetch_list=["prod_out"])
+    r = np.asarray(r)
+    expect = np.prod(xv, axis=0)  # elementwise product across the 8 ranks
+    np.testing.assert_allclose(r[0], expect, rtol=1e-5)
+
+
+def test_model_average_windowed():
+    """ModelAverage must honor its window: the served average covers the
+    current + previous windows only, restarting every W steps."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 5
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[2], dtype="float32")
+        y = layers.fc(x, size=1, param_attr=fluid.ParamAttr(name="ma_w"),
+                      bias_attr=False)
+        loss = layers.mean(y)
+        optimizer.SGD(learning_rate=0.1).minimize(loss)
+        ma = optimizer.ModelAverage(1.0, min_average_window=3,
+                                    max_average_window=3)
+    exe = fluid.Executor()
+    feed = {"x": np.ones((4, 2), np.float32)}
+    history = []
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for _ in range(7):
+            # fetch the post-update param in the SAME run (no extra steps)
+            _, w = exe.run(main, feed=feed, fetch_list=[loss, "ma_w"])
+            history.append(np.asarray(w).copy())
+        with ma.apply(exe):
+            from paddle_tpu.fluid.executor import global_scope
+
+            served = np.asarray(global_scope().find_var("ma_w"))
+    # emulate the gated recurrence exactly: W = clip(1.0*t, 3, 3) = 3
+    s = sp = np.zeros_like(history[0])
+    n = on = 0.0
+    for p in history:
+        s1, n1 = s + p, n + 1
+        if n1 >= 3:
+            sp, on, s, n = s1, n1, np.zeros_like(s1), 0.0
+        else:
+            s, n = s1, n1
+    expect = (s + sp) / (n + on)
+    np.testing.assert_allclose(served, expect, rtol=1e-5)
+    # the running sum is windowed: the served value is NOT the mean of the
+    # whole history (the unbounded-sum bug)
+    assert np.abs(served - np.mean(history, axis=0)).max() > 1e-7
+
+
+def test_distributed_embedding_amp_scale_unwound():
+    """The PS push must be divided by the AMP loss scale (static and
+    dynamic): table rows after one step must match the scale-1.0 baseline."""
+    from paddle_tpu.fluid.contrib import mixed_precision
+
+    vocab, dim = 20, 4
+    feed = {"ids": np.array([[1, 2], [3, 1]], np.int64),
+            "label": np.array([[0.5], [1.0]], np.float32)}
+
+    def run(mode):
+        ps.reset_tables()
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 11
+        with fluid.program_guard(main, startup):
+            ids = layers.data("ids", shape=[2], dtype="int64")
+            label = layers.data("label", shape=[1], dtype="float32")
+            emb = layers.embedding(ids, size=[vocab, dim],
+                                   is_distributed=True, table_lr=0.2,
+                                   param_attr=fluid.ParamAttr(name="amp_ps"))
+            pooled = layers.reduce_sum(emb, dim=1)
+            pred = layers.fc(pooled, size=1,
+                             param_attr=fluid.ParamAttr(name="amp_ps_fc"))
+            loss = layers.mean(layers.square_error_cost(pred, label))
+            opt = optimizer.SGD(learning_rate=0.1)
+            if mode == "static":
+                opt = mixed_precision.decorate(opt, init_loss_scaling=128.0)
+            elif mode == "dynamic":
+                opt = mixed_precision.decorate(
+                    opt, init_loss_scaling=64.0,
+                    use_dynamic_loss_scaling=True)
+            opt.minimize(loss)
+        exe = fluid.Executor()
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            exe.run(main, feed=feed, fetch_list=[loss])
+        return ps.get_table("amp_ps").dump()
+
+    # AMP computes in bfloat16, so expect rounding-level differences only —
+    # a missed unscale would be off by 64x/128x, far outside this tolerance
+    base = run("none")
+    np.testing.assert_allclose(run("static"), base, rtol=0.05, atol=2e-3)
+    np.testing.assert_allclose(run("dynamic"), base, rtol=0.05, atol=2e-3)
+
+
+def test_distributed_embedding_padding_and_startup_reset():
+    vocab, dim, pad = 15, 4, 0
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 9
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", shape=[3], dtype="int64")
+        emb = layers.embedding(ids, size=[vocab, dim], is_distributed=True,
+                               padding_idx=pad, table_lr=0.5,
+                               param_attr=fluid.ParamAttr(name="pad_ps"))
+        loss = layers.mean(layers.reduce_sum(emb * emb, dim=-1))
+        optimizer.SGD(learning_rate=0.5).minimize(loss)
+    table = ps.get_table("pad_ps")
+    base = table.dump()
+    exe = fluid.Executor()
+    feed = {"ids": np.array([[0, 2, 0]], np.int64)}
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (ev,) = exe.run(main, feed=feed, fetch_list=[emb])
+        # padded positions read zeros
+        ev = np.asarray(ev)
+        assert np.abs(ev[0, 0]).max() == 0 and np.abs(ev[0, 2]).max() == 0
+        assert np.abs(ev[0, 1]).max() > 0
+    after = table.dump()
+    # the padding row received NO push; row 2 did
+    np.testing.assert_array_equal(after[pad], base[pad])
+    assert np.abs(after[2] - base[2]).max() > 0
+    # running startup again resets the table to its init distribution
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+    np.testing.assert_array_equal(table.dump(), base)
+
+
+def test_table_shape_mismatch_raises():
+    ps.ensure_table("shape_t", 10, 4)
+    with pytest.raises(ValueError):
+        ps.ensure_table("shape_t", 20, 4)
+    with pytest.raises(ValueError):
+        ps.register_table("shape_t", ps.EmbeddingTable(10, 8))
+    # same shape is fine (table reused)
+    t = ps.ensure_table("shape_t", 10, 4)
+    assert t is ps.get_table("shape_t")
+
+
+def test_table_reinit_resets_adagrad_state():
+    for force_numpy in (True, False):
+        t = ps.EmbeddingTable(6, 2, seed=7, force_numpy=force_numpy)
+        base = t.dump()
+        t.push([1], np.full((1, 2), 2.0, np.float32), lr=0.5,
+               optimizer="adagrad")
+        t.reinit()
+        np.testing.assert_array_equal(t.dump(), base)
+        # accumulator was cleared: identical push gives the identical step
+        t.push([1], np.full((1, 2), 2.0, np.float32), lr=0.5,
+               optimizer="adagrad")
+        np.testing.assert_allclose(t.dump()[1], base[1] - 0.5, rtol=1e-4)

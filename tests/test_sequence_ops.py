@@ -1,0 +1,354 @@
+"""Sequence (LoD) op family — reference ``operators/sequence_ops/`` +
+``layers/sequence_lod.py`` (16 fns), numpy-referenced per SURVEY §4.
+
+The TPU encoding under test: flattened [total_bound, D] data + @LOD lengths
+("bounded LoD", fluid/lod.py) — every op must mask physical padding rows.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+
+X = np.arange(12, dtype=np.float32).reshape(6, 2)  # two seqs: 4 + 2
+LENS = [4, 2]
+
+
+def test_sequence_pool_all_types():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[2], dtype="float32", lod_level=1)
+        fetch = [layers.sequence_pool(x, t)
+                 for t in ("sum", "average", "sqrt", "max", "first", "last")]
+    exe = fluid.Executor()
+    feed = {"x": fluid.create_lod_tensor(X, [LENS])}
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        s, a, q, m, f, l = [np.asarray(r) for r in
+                            exe.run(main, feed=feed, fetch_list=fetch)]
+    seqs = [X[:4], X[4:6]]
+    np.testing.assert_allclose(s, [sq.sum(0) for sq in seqs], rtol=1e-6)
+    np.testing.assert_allclose(a, [sq.mean(0) for sq in seqs], rtol=1e-6)
+    np.testing.assert_allclose(
+        q, [sq.sum(0) / np.sqrt(len(sq)) for sq in seqs], rtol=1e-6)
+    np.testing.assert_allclose(m, [sq.max(0) for sq in seqs], rtol=1e-6)
+    np.testing.assert_allclose(f, [sq[0] for sq in seqs], rtol=1e-6)
+    np.testing.assert_allclose(l, [sq[-1] for sq in seqs], rtol=1e-6)
+
+
+def test_sequence_pool_ignores_physical_padding():
+    """Rows past sum(lengths) must not leak into the pool."""
+    data = np.vstack([X, np.full((2, 2), 99.0, np.float32)])  # 2 pad rows
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[2], dtype="float32", lod_level=1)
+        out = layers.sequence_pool(x, "sum")
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (r,) = exe.run(main, feed={
+            "x": fluid.create_lod_tensor(data, [LENS])}, fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(r), [X[:4].sum(0), X[4:6].sum(0)],
+                               rtol=1e-6)
+
+
+def test_sequence_softmax():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[1], dtype="float32", lod_level=1)
+        out = layers.sequence_softmax(x)
+    v = np.array([[1.0], [2.0], [3.0], [0.5], [1.5], [0.0]], np.float32)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (r,) = exe.run(main, feed={
+            "x": fluid.create_lod_tensor(v, [[3, 2, 1]])}, fetch_list=[out])
+    r = np.asarray(r).ravel()
+
+    def sm(a):
+        e = np.exp(a - a.max())
+        return e / e.sum()
+
+    np.testing.assert_allclose(r[:3], sm(v.ravel()[:3]), rtol=1e-5)
+    np.testing.assert_allclose(r[3:5], sm(v.ravel()[3:5]), rtol=1e-5)
+    np.testing.assert_allclose(r[5], 1.0, rtol=1e-5)
+
+
+def test_sequence_reverse():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[2], dtype="float32", lod_level=1)
+        out = layers.sequence_reverse(x)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (r,) = exe.run(main, feed={
+            "x": fluid.create_lod_tensor(X, [LENS])}, fetch_list=[out])
+    expect = np.vstack([X[:4][::-1], X[4:6][::-1]])
+    np.testing.assert_allclose(np.asarray(r), expect, rtol=1e-6)
+
+
+def test_sequence_expand_dense_x():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[3], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="float32", lod_level=1)
+        out = layers.sequence_expand(x, y)
+    xv = np.array([[1, 2, 3], [4, 5, 6]], np.float32)
+    yv = np.zeros((5, 1), np.float32)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (r,) = exe.run(main, feed={
+            "x": xv, "y": fluid.create_lod_tensor(yv, [[3, 2]])},
+            fetch_list=[out])
+    expect = np.vstack([np.tile(xv[0], (3, 1)), np.tile(xv[1], (2, 1))])
+    np.testing.assert_allclose(np.asarray(r), expect, rtol=1e-6)
+
+
+def test_sequence_expand_as():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[2], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="float32", lod_level=1)
+        out = layers.sequence_expand_as(x, y)
+    xv = np.array([[1, 2], [3, 4]], np.float32)
+    yv = np.zeros((6, 1), np.float32)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (r,) = exe.run(main, feed={
+            "x": xv, "y": fluid.create_lod_tensor(yv, [[4, 2]])},
+            fetch_list=[out])
+    expect = np.vstack([np.tile(xv[0], (4, 1)), np.tile(xv[1], (2, 1))])
+    np.testing.assert_allclose(np.asarray(r), expect, rtol=1e-6)
+
+
+def test_sequence_pad_unpad_roundtrip():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[2], dtype="float32", lod_level=1)
+        pad_v = layers.fill_constant([1], "float32", -1.0)
+        padded, length = layers.sequence_pad(x, pad_v, maxlen=5)
+        back = layers.sequence_unpad(padded, length)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        p, ln, b = exe.run(main, feed={
+            "x": fluid.create_lod_tensor(X, [LENS])},
+            fetch_list=[padded, length, back])
+    p, ln, b = np.asarray(p), np.asarray(ln), np.asarray(b)
+    assert p.shape == (2, 5, 2)
+    np.testing.assert_allclose(p[0, :4], X[:4], rtol=1e-6)
+    np.testing.assert_allclose(p[0, 4:], -1.0)
+    np.testing.assert_allclose(p[1, :2], X[4:6], rtol=1e-6)
+    np.testing.assert_array_equal(ln, [4, 2])
+    np.testing.assert_allclose(b[:6], X, rtol=1e-6)
+
+
+def test_sequence_mask():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[], dtype="int64")
+        out = layers.sequence_mask(x, maxlen=5, dtype="float32")
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (r,) = exe.run(main, feed={"x": np.array([3, 1, 5], np.int64)},
+                       fetch_list=[out])
+    expect = np.array([[1, 1, 1, 0, 0], [1, 0, 0, 0, 0], [1, 1, 1, 1, 1]],
+                      np.float32)
+    np.testing.assert_array_equal(np.asarray(r), expect)
+
+
+def test_sequence_reshape():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4], dtype="float32", lod_level=1)
+        out = layers.sequence_reshape(x, new_dim=2)
+        pooled = layers.sequence_pool(out, "sum")
+    v = np.arange(16, dtype=np.float32).reshape(4, 4)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        r, p = exe.run(main, feed={
+            "x": fluid.create_lod_tensor(v, [[1, 3]])},
+            fetch_list=[out, pooled])
+    np.testing.assert_allclose(np.asarray(r), v.reshape(8, 2), rtol=1e-6)
+    # new lengths are [2, 6]
+    np.testing.assert_allclose(
+        np.asarray(p),
+        [v.reshape(8, 2)[:2].sum(0), v.reshape(8, 2)[2:].sum(0)], rtol=1e-6)
+
+
+def test_sequence_concat():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = layers.data("a", shape=[1], dtype="float32", lod_level=1)
+        b = layers.data("b", shape=[1], dtype="float32", lod_level=1)
+        out = layers.sequence_concat([a, b])
+    av = np.array([[1], [2], [3]], np.float32)       # lens [2,1]
+    bv = np.array([[10], [20], [30]], np.float32)    # lens [1,2]
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (r,) = exe.run(main, feed={
+            "a": fluid.create_lod_tensor(av, [[2, 1]]),
+            "b": fluid.create_lod_tensor(bv, [[1, 2]])}, fetch_list=[out])
+    # out seq0 = [1,2,10]; seq1 = [3,20,30]
+    np.testing.assert_allclose(np.asarray(r).ravel(),
+                               [1, 2, 10, 3, 20, 30], rtol=1e-6)
+
+
+def test_sequence_slice():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[2], dtype="float32", lod_level=1)
+        off = layers.data("off", shape=[1], dtype="int64")
+        ln = layers.data("ln", shape=[1], dtype="int64")
+        out = layers.sequence_slice(x, off, ln)
+        pooled = layers.sequence_pool(out, "sum")
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        r, p = exe.run(main, feed={
+            "x": fluid.create_lod_tensor(X, [LENS]),
+            "off": np.array([[1], [0]], np.int64),
+            "ln": np.array([[2], [1]], np.int64)}, fetch_list=[out, pooled])
+    r = np.asarray(r)
+    # seq0 slice = X[1:3], seq1 slice = X[4:5]
+    np.testing.assert_allclose(r[0], X[1], rtol=1e-6)
+    np.testing.assert_allclose(r[1], X[2], rtol=1e-6)
+    np.testing.assert_allclose(r[2], X[4], rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(p), [X[1:3].sum(0), X[4:5].sum(0)], rtol=1e-6)
+
+
+def test_sequence_enumerate():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[1], dtype="int64", lod_level=1)
+        out = layers.sequence_enumerate(x, win_size=2, pad_value=0)
+    v = np.array([[1], [2], [3], [7], [8]], np.int64)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (r,) = exe.run(main, feed={
+            "x": fluid.create_lod_tensor(v, [[3, 2]])}, fetch_list=[out])
+    expect = np.array([[1, 2], [2, 3], [3, 0], [7, 8], [8, 0]])
+    np.testing.assert_array_equal(np.asarray(r), expect)
+
+
+def test_sequence_erase():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[1], dtype="int64", lod_level=1)
+        out = layers.sequence_erase(x, tokens=[2, 8])
+        pooled = layers.sequence_pool(out.astype("float32"), "sum")
+    v = np.array([[1], [2], [3], [7], [8]], np.int64)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        r, p = exe.run(main, feed={
+            "x": fluid.create_lod_tensor(v, [[3, 2]])},
+            fetch_list=[out, pooled])
+    r = np.asarray(r).ravel()
+    # seq0 keeps [1,3], seq1 keeps [7]; front-packed
+    assert r[0] == 1 and r[1] == 3
+    np.testing.assert_allclose(np.asarray(p).ravel(), [4.0, 7.0], rtol=1e-6)
+
+
+def test_sequence_scatter():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4], dtype="float32")
+        ids = layers.data("ids", shape=[1], dtype="int64", lod_level=1)
+        upd = layers.data("upd", shape=[1], dtype="float32", lod_level=1)
+        out = layers.sequence_scatter(x, ids, upd)
+    xv = np.zeros((2, 4), np.float32)
+    idv = np.array([[0], [2], [1]], np.int64)       # lens [2, 1]
+    uv = np.array([[5.0], [6.0], [7.0]], np.float32)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (r,) = exe.run(main, feed={
+            "x": xv,
+            "ids": fluid.create_lod_tensor(idv, [[2, 1]]),
+            "upd": fluid.create_lod_tensor(uv, [[2, 1]])}, fetch_list=[out])
+    expect = np.array([[5, 0, 6, 0], [0, 7, 0, 0]], np.float32)
+    np.testing.assert_allclose(np.asarray(r), expect, rtol=1e-6)
+
+
+def test_sequence_conv_trains():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 3
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4], dtype="float32", lod_level=1)
+        h = layers.sequence_conv(x, num_filters=8, filter_size=3, act="relu")
+        pooled = layers.sequence_pool(h, "max")
+        loss = layers.mean(pooled)
+        from paddle_tpu.fluid import optimizer
+
+        optimizer.SGD(0.1).minimize(loss)
+    v = np.random.RandomState(0).rand(7, 4).astype(np.float32)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        vals = [float(np.asarray(exe.run(main, feed={
+            "x": fluid.create_lod_tensor(v, [[4, 3]])},
+            fetch_list=[loss])[0])) for _ in range(4)]
+    assert np.isfinite(vals).all()
+    assert vals[-1] != vals[0]  # sequence_conv grads flow
+
+
+def test_row_conv():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[2], dtype="float32", lod_level=1)
+        out = layers.row_conv(x, future_context_size=1)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (r,) = exe.run(main, feed={
+            "x": fluid.create_lod_tensor(X, [LENS])}, fetch_list=[out])
+    assert np.asarray(r).shape == X.shape
+
+
+def test_im2sequence():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[1, 4, 4], dtype="float32")
+        out = layers.im2sequence(x, filter_size=[2, 2], stride=[2, 2])
+        pooled = layers.sequence_pool(out, "sum")
+    v = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        r, p = exe.run(main, feed={"x": v}, fetch_list=[out, pooled])
+    r = np.asarray(r)
+    assert r.shape == (4, 4)
+    np.testing.assert_allclose(r[0], [0, 1, 4, 5], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(p)[0], r.sum(axis=0), rtol=1e-6)
+
+
+def test_lod_propagates_through_embedding_and_fc():
+    """The generic ShareLoD rule: token-aligned ops carry @LOD forward so
+    sequence ops compose with embedding/fc like reference LoD propagation."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 1
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", shape=[1], dtype="int64", lod_level=1)
+        emb = layers.embedding(ids, size=[20, 4])
+        emb = layers.reshape(emb, [-1, 4])
+        pooled = layers.sequence_pool(emb, "average")
+        loss = layers.mean(pooled)
+    idv = np.array([[1], [2], [3], [4], [5]], np.int64)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (p,) = exe.run(main, feed={
+            "ids": fluid.create_lod_tensor(idv, [[3, 2]])},
+            fetch_list=[pooled])
+    assert np.asarray(p).shape == (2, 4)
