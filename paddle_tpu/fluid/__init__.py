@@ -16,6 +16,7 @@ from . import (  # noqa: F401
     io,
     layers,
     metrics,
+    nets,
     optimizer,
     param_attr,
     regularizer,

@@ -1,8 +1,9 @@
 """Layers namespace (reference ``python/paddle/fluid/layers/``)."""
 
 from .. import ops as _ops  # registers all lowering rules  # noqa: F401
-from . import (control_flow, io, learning_rate_scheduler, loss, metric_op,
-               nn, ops, sequence_lod, tensor)
+from . import (control_flow, distributions, io, learning_rate_scheduler,
+               loss, metric_op,
+               nn, ops, rnn, sequence_lod, tensor)
 from .control_flow import *  # noqa: F401,F403
 from .io import data
 from .learning_rate_scheduler import *  # noqa: F401,F403
@@ -10,5 +11,6 @@ from .loss import *  # noqa: F401,F403
 from .metric_op import accuracy, auc
 from .nn import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403
+from .rnn import *  # noqa: F401,F403
 from .sequence_lod import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403

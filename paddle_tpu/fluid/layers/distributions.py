@@ -1,0 +1,220 @@
+"""Probability distributions — reference ``layers/distributions.py``
+(Uniform, Normal, Categorical, MultivariateNormalDiag).
+
+TPU-native: sampling draws from the threaded PRNG via the has_state random
+ops (uniform_random/gaussian_random), so samples replay deterministically
+under autodiff; densities/KL are closed-form op graphs.
+"""
+
+import math
+
+from ..layer_helper import LayerHelper
+from . import nn, tensor
+
+__all__ = ["Uniform", "Normal", "Categorical", "MultivariateNormalDiag"]
+
+
+def _as_var(value, dtype="float32"):
+    import numpy as np
+
+    if hasattr(value, "name"):
+        return value
+    arr = np.asarray(value, np.float32)
+    return tensor.assign(arr.reshape(arr.shape if arr.ndim else (1,)))
+
+
+class Distribution:
+    def sample(self, shape, seed=0):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    """U(low, high) (reference distributions.py Uniform)."""
+
+    def __init__(self, low, high):
+        self.low = _as_var(low)
+        self.high = _as_var(high)
+
+    def sample(self, shape, seed=0):
+        helper = LayerHelper("uniform_sample")
+        out = helper.create_variable_for_type_inference("float32")
+        out.shape = tuple(shape)
+        helper.append_op(
+            type="uniform_random", inputs={}, outputs={"Out": [out]},
+            attrs={"shape": list(shape), "min": 0.0, "max": 1.0,
+                   "seed": seed, "dtype": "float32"})
+        rng = nn.elementwise_sub(self.high, self.low)
+        return nn.elementwise_add(
+            nn.elementwise_mul(out, rng, axis=-1), self.low, axis=-1)
+
+    def log_prob(self, value):
+        rng = nn.elementwise_sub(self.high, self.low)
+        lb = tensor.cast(value > self.low, "float32")
+        ub = tensor.cast(value < self.high, "float32")
+        return nn.log(nn.elementwise_div(
+            nn.elementwise_mul(lb, ub), rng, axis=-1))
+
+    def entropy(self):
+        return nn.log(nn.elementwise_sub(self.high, self.low))
+
+
+class Normal(Distribution):
+    """N(loc, scale) (reference distributions.py Normal)."""
+
+    def __init__(self, loc, scale):
+        self.loc = _as_var(loc)
+        self.scale = _as_var(scale)
+
+    def sample(self, shape, seed=0):
+        helper = LayerHelper("normal_sample")
+        out = helper.create_variable_for_type_inference("float32")
+        out.shape = tuple(shape)
+        helper.append_op(
+            type="gaussian_random", inputs={}, outputs={"Out": [out]},
+            attrs={"shape": list(shape), "mean": 0.0, "std": 1.0,
+                   "seed": seed, "dtype": "float32"})
+        return nn.elementwise_add(
+            nn.elementwise_mul(out, self.scale, axis=-1), self.loc, axis=-1)
+
+    def log_prob(self, value):
+        var = nn.elementwise_mul(self.scale, self.scale)
+        delta = nn.elementwise_sub(value, self.loc, axis=-1)
+        return nn.elementwise_sub(
+            nn.elementwise_div(
+                nn.scale(nn.elementwise_mul(delta, delta), scale=-0.5),
+                var, axis=-1),
+            nn.elementwise_add(
+                nn.log(self.scale),
+                tensor.fill_constant([1], "float32",
+                                     0.5 * math.log(2 * math.pi)), axis=-1),
+            axis=-1)
+
+    def entropy(self):
+        return nn.elementwise_add(
+            nn.log(self.scale),
+            tensor.fill_constant([1], "float32",
+                                 0.5 + 0.5 * math.log(2 * math.pi)),
+            axis=-1)
+
+    def kl_divergence(self, other):
+        """KL(self || other) for two diagonal normals."""
+        var_ratio = nn.elementwise_div(self.scale, other.scale)
+        var_ratio = nn.elementwise_mul(var_ratio, var_ratio)
+        t1 = nn.elementwise_div(
+            nn.elementwise_sub(self.loc, other.loc),
+            other.scale, axis=-1)
+        t1 = nn.elementwise_mul(t1, t1)
+        return nn.scale(
+            nn.elementwise_sub(
+                nn.elementwise_add(var_ratio, t1),
+                nn.elementwise_add(
+                    nn.log(var_ratio),
+                    tensor.fill_constant([1], "float32", 1.0), axis=-1)),
+            scale=0.5)
+
+
+class Categorical(Distribution):
+    """Categorical over unnormalized logits (reference Categorical)."""
+
+    def __init__(self, logits):
+        self.logits = logits
+
+    def _log_softmax(self):
+        return nn.log(nn.softmax(self.logits))
+
+    def entropy(self):
+        logp = self._log_softmax()
+        p = nn.softmax(self.logits)
+        return nn.scale(nn.reduce_sum(
+            nn.elementwise_mul(p, logp), dim=-1), scale=-1.0)
+
+    def log_prob(self, value):
+        logp = self._log_softmax()
+        oh = tensor.cast(nn.one_hot(
+            tensor.cast(value, "int64"), self.logits.shape[-1]), "float32")
+        return nn.reduce_sum(nn.elementwise_mul(logp, oh), dim=-1)
+
+    def sample(self, shape=None, seed=0):
+        """One draw per logit row. The reference Categorical has no
+        sample(); a per-row draw is the natural extension — an explicit
+        ``shape`` is not supported."""
+        if shape is not None:
+            raise NotImplementedError(
+                "Categorical.sample draws one id per logit row; "
+                "shape-based sampling is not supported")
+        helper = LayerHelper("categorical_sample")
+        out = helper.create_variable_for_type_inference("int64")
+        helper.append_op(type="sampling_id",
+                         inputs={"X": [nn.softmax(self.logits)]},
+                         outputs={"Out": [out]}, attrs={"seed": seed})
+        return out
+
+    def kl_divergence(self, other):
+        p = nn.softmax(self.logits)
+        return nn.reduce_sum(
+            nn.elementwise_mul(
+                p, nn.elementwise_sub(self._log_softmax(),
+                                      other._log_softmax())), dim=-1)
+
+
+class MultivariateNormalDiag(Distribution):
+    """N(loc, scale) — reference MultivariateNormalDiag: ``scale`` is the
+    positive-definite diagonal COVARIANCE matrix [D, D] (docstring of
+    ``layers/distributions.py:530``)."""
+
+    def __init__(self, loc, scale):
+        self.loc = _as_var(loc)
+        self.scale = _as_var(scale)  # [D, D] diagonal covariance
+
+    def _var_diag(self):
+        import numpy as np
+
+        d = int(self.scale.shape[-1])
+        eye = tensor.assign(np.eye(d, dtype=np.float32))
+        return nn.reduce_sum(nn.elementwise_mul(self.scale, eye), dim=-1)
+
+    def entropy(self):
+        d = int(self.scale.shape[-1])
+        logdet = nn.reduce_sum(nn.log(self._var_diag()))
+        return nn.elementwise_add(
+            tensor.fill_constant([1], "float32",
+                                 0.5 * d * (1.0 + math.log(2 * math.pi))),
+            nn.scale(logdet, scale=0.5))
+
+    def log_prob(self, value):
+        var = self._var_diag()
+        delta = nn.elementwise_sub(value, self.loc, axis=-1)
+        quad = nn.elementwise_div(
+            nn.elementwise_mul(delta, delta), var, axis=-1)
+        d = int(self.scale.shape[-1])
+        return nn.elementwise_sub(
+            nn.scale(nn.reduce_sum(quad, dim=-1), scale=-0.5),
+            nn.elementwise_add(
+                nn.scale(nn.reduce_sum(nn.log(var)), scale=0.5),
+                tensor.fill_constant([1], "float32",
+                                     0.5 * d * math.log(2 * math.pi)),
+                axis=-1), axis=-1)
+
+    def kl_divergence(self, other):
+        """KL for diagonal-covariance normals:
+        0.5 * sum(v1/v2 + (mu2-mu1)^2/v2 - 1 - log(v1/v2))."""
+        v1, v2 = self._var_diag(), other._var_diag()
+        ratio = nn.elementwise_div(v1, v2)
+        t1 = nn.elementwise_sub(other.loc, self.loc, axis=-1)
+        t1 = nn.elementwise_div(nn.elementwise_mul(t1, t1), v2, axis=-1)
+        return nn.scale(nn.reduce_sum(
+            nn.elementwise_sub(
+                nn.elementwise_add(ratio, t1),
+                nn.elementwise_add(
+                    nn.log(ratio),
+                    tensor.fill_constant([1], "float32", 1.0), axis=-1)),
+            dim=-1), scale=0.5)

@@ -35,6 +35,7 @@ __all__ = [
     "temporal_shift", "pixel_shuffle", "where", "sign", "unfold", "shard_index",
     "hard_swish", "uniform_random", "gelu", "erf", "topk", "unique",
     "autoincreased_step_counter", "smooth_l1", "dice_loss", "py_func",
+    "linear_chain_crf", "crf_decoding", "ctc_greedy_decoder",
 ]
 
 
@@ -1429,3 +1430,61 @@ def _register_extra_ops():
 
 
 _register_extra_ops()
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None):
+    """CRF negative-log-likelihood layer (reference layers/nn.py
+    linear_chain_crf / linear_chain_crf_op.cc). Returns per-sequence
+    log-likelihood; transition param rows 0/1 are start/end weights."""
+    helper = LayerHelper("linear_chain_crf", **locals())
+    num_tags = int(input.shape[-1])
+    trans = helper.create_parameter(param_attr, [num_tags + 2, num_tags],
+                                    "float32")
+    ll = helper.create_variable_for_type_inference("float32")
+    alpha = helper.create_variable_for_type_inference("float32")
+    eexp = helper.create_variable_for_type_inference("float32")
+    texp = helper.create_variable_for_type_inference("float32")
+    ll.shape = (-1, 1)
+    helper.append_op(
+        type="linear_chain_crf",
+        inputs={"Emission": [input], "Transition": [trans],
+                "Label": [label]},
+        outputs={"LogLikelihood": [ll], "Alpha": [alpha],
+                 "EmissionExps": [eexp], "TransitionExps": [texp]})
+    return ll
+
+
+def crf_decoding(input, param_attr, label=None, length=None):
+    """Viterbi decode with a trained CRF transition (reference
+    crf_decoding_op.cc). ``param_attr`` must name the transition param
+    created by linear_chain_crf."""
+    helper = LayerHelper("crf_decoding", **locals())
+    name = param_attr.name if hasattr(param_attr, "name") else str(param_attr)
+    trans = helper.main_program.global_block().var(name)
+    path = helper.create_variable_for_type_inference("int64")
+    path.shape = (-1, 1)
+    path.lod_level = 1
+    helper.append_op(
+        type="crf_decoding",
+        inputs={"Emission": [input], "Transition": [trans]},
+        outputs={"ViterbiPath": [path]})
+    return path
+
+
+def ctc_greedy_decoder(input, blank, name=None):
+    """Greedy CTC decode: per-step argmax, collapse repeats, drop blanks
+    (reference ctc_greedy_decoder = top_k + ctc_align)."""
+    helper = LayerHelper("ctc_greedy_decoder", **locals())
+    idx = helper.create_variable_for_type_inference("int64")
+    idx.shape = (-1, 1)
+    idx.lod_level = 1
+    helper.append_op(type="arg_max", inputs={"X": [input]},
+                     outputs={"Out": [idx]},
+                     attrs={"axis": -1, "keepdims": True})
+    out = helper.create_variable_for_type_inference("int64")
+    out.shape = (-1, 1)
+    out.lod_level = 1
+    helper.append_op(type="ctc_align", inputs={"Input": [idx]},
+                     outputs={"Output": [out]},
+                     attrs={"blank": int(blank)})
+    return out

@@ -17,6 +17,8 @@ from . import (  # noqa: F401
     metrics,
     nn,
     optimizer_ops,
+    rnn_ops,
     sequence_ops,
+    structured_loss_ops,
     tensor_ops,
 )

@@ -1,0 +1,469 @@
+"""Recurrent op lowerings — reference ``operators/lstm_op.cc``,
+``gru_op.cc``, ``lstm_unit_op``, ``gru_unit_op``, ``cudnn_lstm_op``
+(math/detail/lstm_kernel.h, gru_kernel.h give the exact gate equations).
+
+TPU-native: ragged (bounded-LoD) inputs are packed to a padded
+``[n_seq, T_bound]`` layout with plain gathers, the recurrence runs as ONE
+``lax.scan`` over time (XLA compiles the body once; the MXU sees a
+[n, H] x [H, 4H] matmul per tick), state updates are masked by
+``t < length`` so padding ticks are identity, and the result is flattened
+back to token rows. This replaces the reference's batch-reordering
+``LoDTensor2BatchFunctor`` (math/sequence2batch.h) — no reorder pass, no
+per-sequence kernel launches.
+
+Gate layouts (must match the reference exactly):
+  LSTM gates[4H] = [c~ ("in"), i, f, o]   (lstm_kernel.h:30)
+      i/f/o get peephole terms checkI/F/O from prev or new cell state
+      c_t = c~ * i + c_{t-1} * f ; h_t = o * act(c_t)
+  GRU  gates[3H] = [u, r, c~]             (gru_kernel.h)
+      c~ = act(x_c + (r . h_prev) W_c) ; h = (1-u) h_prev + u c~
+      (origin_mode=True flips to h = u h_prev + (1-u) c~)
+"""
+
+import numpy as np
+
+from ..registry import register
+from .sequence_ops import _lod, _seg_info
+
+
+def _act(name):
+    import jax
+
+    return {
+        "sigmoid": jax.nn.sigmoid,
+        "tanh": jax.numpy.tanh,
+        "relu": jax.nn.relu,
+        "identity": (lambda x: x),
+    }[str(name or "tanh")]
+
+
+def _pack(x, lengths):
+    """[total_bound, D] + lengths[n] -> padded [n, Tb, D], Tb = total bound."""
+    import jax.numpy as jnp
+
+    n = lengths.shape[0]
+    T = x.shape[0]
+    seg, starts, cum, valid = _seg_info(lengths, T)
+    pos = jnp.arange(T, dtype=np.dtype("int32"))[None, :]
+    src = jnp.clip(starts[:, None] + pos, 0, T - 1)      # [n, Tb]
+    inb = pos < lengths[:, None]
+    out = jnp.where(inb[..., None], x[src], 0)
+    return out, inb
+
+
+def _unpack(h, lengths, total):
+    """[n, Tb, D] -> flattened [total_bound, D] (tokens front-packed)."""
+    import jax.numpy as jnp
+
+    n = lengths.shape[0]
+    seg, starts, cum, valid = _seg_info(lengths, total)
+    tok = jnp.arange(total, dtype=np.dtype("int32"))
+    pos = tok - starts[jnp.clip(seg, 0, n - 1)]
+    out = h[jnp.clip(seg, 0, n - 1), jnp.clip(pos, 0, h.shape[1] - 1)]
+    return jnp.where(valid[:, None], out, 0)
+
+
+def _lstm_scan(gates_pad, mask, w_h, c0, h0, checks, cell_clip,
+               act_gate, act_cell, act_cand, reverse):
+    """gates_pad [n,T,4H] = x W (+bias) precomputed; returns h,c [n,T,H]."""
+    import jax
+    import jax.numpy as jnp
+
+    n, T, H4 = gates_pad.shape
+    H = H4 // 4
+    checkI, checkF, checkO = checks
+    t_axis = jnp.arange(T)
+    if reverse:
+        gates_pad = gates_pad[:, ::-1]
+        mask = mask[:, ::-1]
+
+    def step(carry, inp):
+        h_prev, c_prev = carry
+        g, m = inp  # g [n,4H], m [n]
+        g = g + h_prev @ w_h
+        cand = act_cand(g[:, :H])
+        ig = act_gate(g[:, H:2 * H] + c_prev * checkI)
+        fg = act_gate(g[:, 2 * H:3 * H] + c_prev * checkF)
+        c = cand * ig + c_prev * fg
+        if cell_clip and cell_clip > 0:
+            c = jnp.clip(c, -cell_clip, cell_clip)
+        og = act_gate(g[:, 3 * H:] + c * checkO)
+        h = og * act_cell(c)
+        m = m[:, None].astype(h.dtype)
+        h = m * h + (1 - m) * h_prev
+        c = m * c + (1 - m) * c_prev
+        return (h, c), (h, c)
+
+    (_, _), (hs, cs) = jax.lax.scan(
+        step, (h0, c0), (gates_pad.transpose(1, 0, 2), mask.T))
+    hs = hs.transpose(1, 0, 2)
+    cs = cs.transpose(1, 0, 2)
+    if reverse:
+        hs, cs = hs[:, ::-1], cs[:, ::-1]
+    return hs, cs
+
+
+@register("dynamic_lstm")
+@register("dynamic_lstmp")
+def _dynamic_lstm(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "Input")        # [total, 4H] pre-projected
+    w = ctx.get_input(op, "Weight")       # [H, 4H] (lstmp: [P, 4H])
+    b = ctx.get_input(op, "Bias")         # [1, 4H] or [1, 7H] w/ peepholes
+    proj = ctx.get_input(op, "ProjWeight")  # lstmp only: [H, P]
+    lengths = _lod(ctx, op.input("Input")[0])
+    n = lengths.shape[0]
+    total = x.shape[0]
+    H = w.shape[1] // 4
+    P = proj.shape[1] if proj is not None else H
+    use_peep = bool(op.attr("use_peepholes", True))
+    act_gate = _act(op.attr("gate_activation", "sigmoid"))
+    act_cell = _act(op.attr("cell_activation", "tanh"))
+    act_cand = _act(op.attr("candidate_activation", "tanh"))
+    reverse = bool(op.attr("is_reverse", False))
+    cell_clip = float(op.attr("cell_clip", 0.0) or 0.0)
+
+    gates = x
+    if b is not None:
+        gates = gates + b.reshape(-1)[:4 * H][None, :]
+    if use_peep and b is not None and b.reshape(-1).shape[0] >= 7 * H:
+        flat = b.reshape(-1)
+        checks = (flat[4 * H:5 * H], flat[5 * H:6 * H], flat[6 * H:7 * H])
+    else:
+        checks = (jnp.zeros((H,), x.dtype),) * 3
+
+    gpad, mask = _pack(gates, lengths)
+    h0 = ctx.get_input(op, "H0")
+    c0 = ctx.get_input(op, "C0")
+    if h0 is None:
+        h0 = jnp.zeros((n, P), x.dtype)
+    if c0 is None:
+        c0 = jnp.zeros((n, H), x.dtype)
+
+    if proj is None:
+        hs, cs = _lstm_scan(gpad, mask, w, c0, h0, checks, cell_clip,
+                            act_gate, act_cell, act_cand, reverse)
+    else:
+        # projection: recurrent input is r = act(h) @ proj, so fold the
+        # projection into the scan
+        import jax
+
+        act_proj = _act(op.attr("proj_activation", "identity"))
+        if reverse:
+            gpad, mask = gpad[:, ::-1], mask[:, ::-1]
+
+        def step(carry, inp):
+            r_prev, c_prev = carry
+            g, m = inp
+            g = g + r_prev @ w
+            cand = act_cand(g[:, :H])
+            ig = act_gate(g[:, H:2 * H] + c_prev * checks[0])
+            fg = act_gate(g[:, 2 * H:3 * H] + c_prev * checks[1])
+            c = cand * ig + c_prev * fg
+            if cell_clip > 0:
+                c = jnp.clip(c, -cell_clip, cell_clip)
+            og = act_gate(g[:, 3 * H:] + c * checks[2])
+            h = og * act_cell(c)
+            r = act_proj(h @ proj)
+            m = m[:, None].astype(h.dtype)
+            r = m * r + (1 - m) * r_prev
+            c = m * c + (1 - m) * c_prev
+            return (r, c), (r, c)
+
+        (_, _), (hs, cs) = jax.lax.scan(
+            step, (h0, c0), (gpad.transpose(1, 0, 2), mask.T))
+        hs, cs = hs.transpose(1, 0, 2), cs.transpose(1, 0, 2)
+        if reverse:
+            hs, cs = hs[:, ::-1], cs[:, ::-1]
+
+    hflat = _unpack(hs, lengths, total)
+    cflat = _unpack(cs, lengths, total)
+    out_slot = "Projection" if proj is not None else "Hidden"
+    ctx.set_output(op, out_slot, hflat)
+    ctx.set_output(op, "Cell", cflat)
+    from ..lod import lod_name
+
+    for slot in (out_slot, "Cell"):
+        names = op.output(slot)
+        if names:
+            ctx.env[lod_name(names[0])] = lengths
+
+
+@register("dynamic_gru")
+def _dynamic_gru(ctx, op):
+    import jax
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "Input")     # [total, 3H]
+    w = ctx.get_input(op, "Weight")    # [H, 3H]
+    b = ctx.get_input(op, "Bias")
+    lengths = _lod(ctx, op.input("Input")[0])
+    n = lengths.shape[0]
+    total = x.shape[0]
+    H = w.shape[0]
+    act_gate = _act(op.attr("gate_activation", "sigmoid"))
+    act_cand = _act(op.attr("activation", "tanh"))
+    reverse = bool(op.attr("is_reverse", False))
+    origin = bool(op.attr("origin_mode", False))
+
+    gates = x if b is None else x + b.reshape(-1)[None, :]
+    gpad, mask = _pack(gates, lengths)
+    h0 = ctx.get_input(op, "H0")
+    if h0 is None:
+        h0 = jnp.zeros((n, H), x.dtype)
+    w_ur = w[:, :2 * H]   # update+reset recurrent weights
+    w_c = w[:, 2 * H:]
+    if reverse:
+        gpad, mask = gpad[:, ::-1], mask[:, ::-1]
+
+    def step(h_prev, inp):
+        g, m = inp
+        ur = act_gate(g[:, :2 * H] + h_prev @ w_ur)
+        u, r = ur[:, :H], ur[:, H:]
+        cand = act_cand(g[:, 2 * H:] + (r * h_prev) @ w_c)
+        if origin:
+            h = u * h_prev + (1 - u) * cand
+        else:
+            h = (1 - u) * h_prev + u * cand
+        m = m[:, None].astype(h.dtype)
+        h = m * h + (1 - m) * h_prev
+        return h, h
+
+    _, hs = jax.lax.scan(step, h0, (gpad.transpose(1, 0, 2), mask.T))
+    hs = hs.transpose(1, 0, 2)
+    if reverse:
+        hs = hs[:, ::-1]
+    out = _unpack(hs, lengths, total)
+    ctx.set_output(op, "Hidden", out)
+    from ..lod import lod_name
+
+    names = op.output("Hidden")
+    if names:
+        ctx.env[lod_name(names[0])] = lengths
+
+
+@register("lstm_unit")
+def _lstm_unit(ctx, op):
+    """One LSTM step from pre-computed gates [B, 4H] (reference
+    lstm_unit_op.cc: gate order i, f, c~, o with plain sigmoid/tanh)."""
+    import jax
+    import jax.numpy as jnp
+
+    g = ctx.get_input(op, "X")
+    c_prev = ctx.get_input(op, "C_prev")
+    H = c_prev.shape[-1]
+    forget_bias = float(op.attr("forget_bias", 0.0))
+    i = jax.nn.sigmoid(g[:, :H])
+    f = jax.nn.sigmoid(g[:, H:2 * H] + forget_bias)
+    cand = jnp.tanh(g[:, 2 * H:3 * H])
+    o = jax.nn.sigmoid(g[:, 3 * H:])
+    c = f * c_prev + i * cand
+    h = o * jnp.tanh(c)
+    ctx.set_output(op, "C", c)
+    ctx.set_output(op, "H", h)
+
+
+@register("gru_unit")
+def _gru_unit(ctx, op):
+    """One GRU step (reference gru_unit_op.cc): gates [B, 3H] = x W + b,
+    order (u, r, c~); h = prev - u*prev + u*c~ (origin_mode flips)."""
+    import jax.numpy as jnp
+
+    g = ctx.get_input(op, "Input")
+    h_prev = ctx.get_input(op, "HiddenPrev")
+    w = ctx.get_input(op, "Weight")
+    b = ctx.get_input(op, "Bias")
+    H = h_prev.shape[-1]
+    act_gate = _act({1: "sigmoid", 2: "tanh", 3: "relu", 0: "identity"}.get(
+        op.attr("gate_activation", 1), "sigmoid")
+        if isinstance(op.attr("gate_activation", 1), int)
+        else op.attr("gate_activation"))
+    act_cand = _act({1: "sigmoid", 2: "tanh", 3: "relu", 0: "identity"}.get(
+        op.attr("activation", 2), "tanh")
+        if isinstance(op.attr("activation", 2), int)
+        else op.attr("activation"))
+    origin = bool(op.attr("origin_mode", False))
+    if b is not None:
+        g = g + b.reshape(-1)[None, :]
+    ur = act_gate(g[:, :2 * H] + h_prev @ w[:, :2 * H])
+    u, r = ur[:, :H], ur[:, H:]
+    cand = act_cand(g[:, 2 * H:] + (r * h_prev) @ w[:, 2 * H:])
+    if origin:
+        h = u * h_prev + (1 - u) * cand
+    else:
+        h = (1 - u) * h_prev + u * cand
+    ctx.set_output(op, "Gate", jnp.concatenate([u, r, cand], axis=1))
+    ctx.set_output(op, "ResetHiddenPrev", r * h_prev)
+    ctx.set_output(op, "Hidden", h)
+
+
+@register("cudnn_lstm", has_state=True)
+@register("lstm", has_state=True)
+def _cudnn_lstm(ctx, op):
+    """Multi-layer (optionally bidirectional-free) LSTM over PADDED
+    [seq, batch, in] input — the reference's cudnn_lstm capability
+    (cudnn_lstm_op.cc) as a stacked lax.scan."""
+    import jax
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "Input")          # [T, B, I]
+    init_h = ctx.get_input(op, "InitH")     # [L, B, H]
+    init_c = ctx.get_input(op, "InitC")
+    w = ctx.get_input(op, "W")              # flat param blob
+    hidden = int(op.attr("hidden_size"))
+    layers = int(op.attr("num_layers", 1))
+    T, B, I = x.shape
+    off = 0
+    outs = x
+    last_h, last_c = [], []
+    flat = w.reshape(-1)
+    for layer in range(layers):
+        in_dim = I if layer == 0 else hidden
+        wx = flat[off:off + in_dim * 4 * hidden].reshape(in_dim, 4 * hidden)
+        off += in_dim * 4 * hidden
+        wh = flat[off:off + hidden * 4 * hidden].reshape(hidden, 4 * hidden)
+        off += hidden * 4 * hidden
+        bias = flat[off:off + 4 * hidden]
+        off += 4 * hidden
+        gates = outs @ wx + bias  # [T, B, 4H]
+        h0, c0 = init_h[layer], init_c[layer]
+
+        def step(carry, g, _wh=wh, _H=hidden):
+            h_prev, c_prev = carry
+            g = g + h_prev @ _wh
+            # cudnn gate order i, f, c~, o
+            i = jax.nn.sigmoid(g[:, :_H])
+            f = jax.nn.sigmoid(g[:, _H:2 * _H])
+            cand = jnp.tanh(g[:, 2 * _H:3 * _H])
+            o = jax.nn.sigmoid(g[:, 3 * _H:])
+            c = f * c_prev + i * cand
+            h = o * jnp.tanh(c)
+            return (h, c), h
+
+        (hT, cT), hs = jax.lax.scan(step, (h0, c0), gates)
+        outs = hs
+        # inter-layer dropout (cudnn semantics: applied to every layer's
+        # output except the last, training mode only)
+        drop = float(op.attr("dropout_prob", 0.0) or 0.0)
+        if drop > 0 and not op.attr("is_test", False) and \
+                layer < layers - 1:
+            keep = 1.0 - drop
+            mask_d = jax.random.bernoulli(ctx.next_rng(), keep, outs.shape)
+            outs = jnp.where(mask_d, outs / keep, 0.0)
+        last_h.append(hT)
+        last_c.append(cT)
+    ctx.set_output(op, "Out", outs)
+    ctx.set_output(op, "LastH", jnp.stack(last_h))
+    ctx.set_output(op, "LastC", jnp.stack(last_c))
+
+
+# ---------------------------------------------------------------------------
+# beam search (dense redesign — reference beam_search_op.cc walks LoD
+# levels on the host; here rows are [batch*beam] and selection is one
+# reshaped top-k on the device)
+# ---------------------------------------------------------------------------
+
+
+@register("beam_pos")
+def _beam_pos(ctx, op):
+    """[B*beam, 1] int — each row's position within its beam group."""
+    import jax.numpy as jnp
+
+    ref = ctx.get_input(op, "X")
+    b = int(op.attr("beam_size"))
+    bw = ref.shape[0]
+    ctx.set_output(op, "Out", (jnp.arange(bw, dtype=np.dtype("int32"))
+                               % b)[:, None].astype(np.dtype("int32")))
+
+
+@register("beam_search")
+def _beam_search(ctx, op):
+    import jax
+    import jax.numpy as jnp
+
+    pre_ids = ctx.get_input(op, "pre_ids").reshape(-1)        # [bw]
+    pre_scores = ctx.get_input(op, "pre_scores").reshape(-1)  # [bw]
+    scores = ctx.get_input(op, "scores")                      # [bw, V]
+    b = int(op.attr("beam_size"))
+    end_id = int(op.attr("end_id"))
+    accumulated = bool(op.attr("is_accumulated", True))
+    bw, V = scores.shape
+    batch = bw // b
+    if accumulated:
+        acc = scores
+    else:
+        acc = pre_scores[:, None] + jnp.log(jnp.maximum(scores, 1e-30))
+    # finished beams (pre_id == end_id) contribute exactly one candidate:
+    # (end_id, pre_score) — they neither grow nor decay
+    finished = (pre_ids == end_id)
+    neg = jnp.asarray(-1e30, acc.dtype)
+    end_onehot = (jnp.arange(V) == end_id)[None, :]
+    fin_row = jnp.where(end_onehot, pre_scores[:, None], neg)
+    acc = jnp.where(finished[:, None], fin_row, acc)
+
+    flat = acc.reshape(batch, b * V)
+    top_scores, top_idx = jax.lax.top_k(flat, b)              # [batch, b]
+    parent_in_batch = top_idx // V
+    token = top_idx % V
+    batch_base = (jnp.arange(batch, dtype=np.dtype("int32")) * b)[:, None]
+    parent = (parent_in_batch.astype(np.dtype("int32")) + batch_base)
+    ctx.set_output(op, "selected_ids",
+                   token.reshape(-1, 1).astype(np.dtype("int64")))
+    ctx.set_output(op, "selected_scores",
+                   top_scores.reshape(-1, 1).astype(np.dtype("float32")))
+    ctx.set_output(op, "parent_idx", parent.reshape(-1))
+
+
+@register("gather_tree")
+def _gather_tree(ctx, op):
+    """Backtrack beam parent pointers into full sequences (reference
+    gather_tree_op.cc) — a reverse lax.scan carrying the live row pointer."""
+    import jax
+    import jax.numpy as jnp
+
+    ids = ctx.get_input(op, "Ids")        # [T, BW] (or [T, B, beam])
+    parents = ctx.get_input(op, "Parents")
+    shape = ids.shape
+    T = shape[0]
+    flat_ids = ids.reshape(T, -1)
+    flat_par = parents.reshape(T, -1).astype(np.dtype("int32"))
+    BW = flat_ids.shape[1]
+
+    def step(ptr, x):
+        ids_t, par_t = x
+        tokens = ids_t[ptr]
+        return par_t[ptr], tokens
+
+    init = jnp.arange(BW, dtype=np.dtype("int32"))
+    _, toks = jax.lax.scan(step, init, (flat_ids[::-1], flat_par[::-1]))
+    out = toks[::-1].reshape(shape)
+    ctx.set_output(op, "Out", out)
+
+
+@register("beam_search_decode")
+def _beam_search_decode(ctx, op):
+    """Emit final sequences + scores. Dense protocol: Ids [T, BW] are the
+    per-step selected ids; optional Parents [T, BW] triggers gather_tree
+    backtracking (the reference recovered parents from LoD)."""
+    ids = ctx.get_input(op, "Ids")
+    scores = ctx.get_input(op, "Scores")
+    parents = ctx.get_input(op, "Parents")
+    if parents is not None:
+        import jax
+        import jax.numpy as jnp
+
+        T = ids.shape[0]
+        flat_ids = ids.reshape(T, -1)
+        flat_par = parents.reshape(T, -1).astype(np.dtype("int32"))
+
+        def step(ptr, x):
+            ids_t, par_t = x
+            return par_t[ptr], ids_t[ptr]
+
+        init = jnp.arange(flat_ids.shape[1], dtype=np.dtype("int32"))
+        _, toks = jax.lax.scan(step, init,
+                               (flat_ids[::-1], flat_par[::-1]))
+        ids = toks[::-1].reshape(ids.shape)
+    ctx.set_output(op, "SentenceIds", ids)
+    ctx.set_output(op, "SentenceScores", scores)

@@ -1,0 +1,387 @@
+"""Structured / sampled loss op lowerings — reference
+``linear_chain_crf_op.cc``, ``crf_decoding_op.cc``, ``warpctc_op.cc``,
+``ctc_align_op`` (greedy decode), ``edit_distance_op.cc``, ``nce_op.cc``,
+``hierarchical_sigmoid_op.cc``, ``sample_logits`` (sampled softmax).
+
+TPU-native notes:
+* CRF forward/Viterbi run in LOG space as one ``lax.scan`` over the padded
+  pack of bounded-LoD emissions (the reference works in exp space with
+  per-step renormalization on the CPU); gradients come from ``jax.grad``
+  through the scan — the reference's hand-written CRF backward is deleted.
+* warpctc maps to ``optax.ctc_loss`` (the public JAX CTC) over the padded
+  pack; no external warp-ctc library.
+* NCE / sampled softmax draw their negatives from the threaded PRNG
+  (``ctx.next_rng``) so autodiff replay sees identical samples.
+* hsigmoid uses the reference's complete-binary-tree heap code
+  (MatrixBitCodeFunctor semantics: leaf code = label + num_classes, path =
+  binary prefixes) with masked fixed-bound paths.
+"""
+
+import numpy as np
+
+from ..registry import register
+from .sequence_ops import _lod, _seg_info
+from .rnn_ops import _pack
+
+
+@register("linear_chain_crf")
+def _linear_chain_crf(ctx, op):
+    import jax
+    import jax.numpy as jnp
+
+    em = ctx.get_input(op, "Emission")       # [total, K]
+    trans = ctx.get_input(op, "Transition")  # [K+2, K] (rows 0,1 start/end)
+    label = ctx.get_input(op, "Label")       # [total, 1]
+    lengths = _lod(ctx, op.input("Emission")[0])
+    n = lengths.shape[0]
+    K = em.shape[1]
+    start_w, end_w, T = trans[0], trans[1], trans[2:]  # T[from, to]
+
+    epad, mask = _pack(em, lengths)                     # [n, Tb, K]
+    lpad, _ = _pack(label.reshape(-1, 1).astype(np.dtype("int32")), lengths)
+    lpad = lpad[..., 0]                                 # [n, Tb]
+    Tb = epad.shape[1]
+
+    # log-partition via forward algorithm
+    alpha0 = start_w[None, :] + epad[:, 0]              # [n, K]
+
+    def fwd(alpha, x):
+        e_t, m_t = x                                    # [n, K], [n]
+        nxt = jax.scipy.special.logsumexp(
+            alpha[:, :, None] + T[None, :, :], axis=1) + e_t
+        keep = m_t[:, None]
+        return jnp.where(keep, nxt, alpha), None
+
+    alphaT, _ = jax.lax.scan(
+        fwd, alpha0, (epad[:, 1:].transpose(1, 0, 2), mask[:, 1:].T))
+    logZ = jax.scipy.special.logsumexp(alphaT + end_w[None, :], axis=1)
+
+    # score of the gold path
+    rows = jnp.arange(n)
+    first_lab = lpad[:, 0]
+    gold = start_w[first_lab] + epad[:, 0][rows, first_lab]
+
+    def gold_step(carry, x):
+        score, prev_lab = carry
+        e_t, l_t, m_t = x
+        step = T[prev_lab, l_t] + e_t[rows, l_t]
+        score = jnp.where(m_t, score + step, score)
+        prev_lab = jnp.where(m_t, l_t, prev_lab)
+        return (score, prev_lab), None
+
+    (gold, last_lab), _ = jax.lax.scan(
+        gold_step, (gold, first_lab),
+        (epad[:, 1:].transpose(1, 0, 2), lpad[:, 1:].T, mask[:, 1:].T))
+    gold = gold + end_w[last_lab]
+
+    ll = (gold - logZ)[:, None]                          # [n, 1]
+    ctx.set_output(op, "LogLikelihood", ll.astype(em.dtype))
+    # aux outputs for API parity (alpha in log space)
+    ctx.set_output(op, "Alpha", alphaT.astype(em.dtype))
+    ctx.set_output(op, "EmissionExps", jnp.exp(em))
+    ctx.set_output(op, "TransitionExps", jnp.exp(trans))
+
+
+@register("crf_decoding")
+def _crf_decoding(ctx, op):
+    import jax
+    import jax.numpy as jnp
+
+    em = ctx.get_input(op, "Emission")
+    trans = ctx.get_input(op, "Transition")
+    lengths = _lod(ctx, op.input("Emission")[0])
+    n = lengths.shape[0]
+    K = em.shape[1]
+    total = em.shape[0]
+    start_w, end_w, T = trans[0], trans[1], trans[2:]
+    epad, mask = _pack(em, lengths)
+    Tb = epad.shape[1]
+
+    delta0 = start_w[None, :] + epad[:, 0]
+
+    def vit(delta, x):
+        e_t, m_t = x
+        cand = delta[:, :, None] + T[None, :, :]        # [n, from, to]
+        best = jnp.max(cand, axis=1) + e_t
+        arg = jnp.argmax(cand, axis=1).astype(np.dtype("int32"))
+        keep = m_t[:, None]
+        return jnp.where(keep, best, delta), \
+            jnp.where(keep, arg, -1)
+
+    deltaT, backp = jax.lax.scan(
+        vit, delta0, (epad[:, 1:].transpose(1, 0, 2), mask[:, 1:].T))
+    # backp: [Tb-1, n, K]; add end weights, backtrack
+    rows = jnp.arange(n)
+    last = jnp.argmax(deltaT + end_w[None, :], axis=1).astype(
+        np.dtype("int32"))
+
+    def back(lab, bp_t):
+        prev = bp_t[rows, lab]
+        lab2 = jnp.where(prev >= 0, prev, lab)
+        return lab2, lab
+
+    _, path_rev = jax.lax.scan(back, last, backp[::-1])
+    # path_rev[t] is the label at time (Tb-1-t); prepend first label
+    first = _  # final carry = label at t=0
+    path = jnp.concatenate([first[None, :], path_rev[::-1]], axis=0)  # [Tb,n]
+    path = path.T                                        # [n, Tb]
+    # flatten back to token rows
+    seg, starts, cum, valid = _seg_info(lengths, total)
+    tok = jnp.arange(total, dtype=np.dtype("int32"))
+    pos = tok - starts[jnp.clip(seg, 0, n - 1)]
+    flat = path[jnp.clip(seg, 0, n - 1), jnp.clip(pos, 0, Tb - 1)]
+    flat = jnp.where(valid, flat, 0)[:, None].astype(np.dtype("int64"))
+    ctx.set_output(op, "ViterbiPath", flat)
+    from ..lod import lod_name
+
+    names = op.output("ViterbiPath")
+    if names:
+        ctx.env[lod_name(names[0])] = lengths
+
+
+@register("warpctc")
+def _warpctc(ctx, op):
+    import jax.numpy as jnp
+    import optax
+
+    logits = ctx.get_input(op, "Logits")
+    label = ctx.get_input(op, "Label")
+    blank = int(op.attr("blank", 0))
+    norm_by_times = bool(op.attr("norm_by_times", False))
+    if op.attr("padded", False):
+        # padded-tensor API: Logits [B, T, V], Label [B, N] + lengths
+        import jax.numpy as jnp2
+
+        llen = ctx.get_input(op, "LogitsLength").reshape(-1).astype(
+            np.dtype("int32"))
+        tlen = ctx.get_input(op, "LabelLength").reshape(-1).astype(
+            np.dtype("int32"))
+        lpad = logits
+        ypad = label.reshape(label.shape[0], -1).astype(np.dtype("int32"))
+        lmask = jnp2.arange(lpad.shape[1])[None, :] < llen[:, None]
+        ymask = jnp2.arange(ypad.shape[1])[None, :] < tlen[:, None]
+    else:
+        llen = _lod(ctx, op.input("Logits")[0])
+        tlen = _lod(ctx, op.input("Label")[0])
+        lpad, lmask = _pack(logits, llen)              # [n, Tb, K+1]
+        ypad, ymask = _pack(label.reshape(-1, 1).astype(np.dtype("int32")),
+                            tlen)
+        ypad = ypad[..., 0]
+    loss = optax.ctc_loss(
+        lpad, (~lmask).astype(lpad.dtype),
+        ypad, (~ymask).astype(lpad.dtype), blank_id=blank)  # [n]
+    if norm_by_times:
+        loss = loss / jnp.maximum(llen, 1).astype(loss.dtype)
+    ctx.set_output(op, "Loss", loss[:, None])
+    ctx.set_output(op, "WarpCTCGrad", jnp.zeros_like(lpad))  # parity slot
+
+
+@register("ctc_align")
+def _ctc_align(ctx, op):
+    """Greedy CTC decode: collapse repeats then drop blanks, front-packed
+    bounded-LoD output (reference ctc_align_op.cu)."""
+    import jax
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "Input")  # [total, 1] argmaxed ids (LoD)
+    blank = int(op.attr("blank", 0))
+    lengths = _lod(ctx, op.input("Input")[0])
+    n = lengths.shape[0]
+    flat = x.reshape(-1).astype(np.dtype("int32"))
+    total = flat.shape[0]
+    seg, starts, cum, valid = _seg_info(lengths, total)
+    tok = jnp.arange(total, dtype=np.dtype("int32"))
+    pos = tok - starts[jnp.clip(seg, 0, n - 1)]
+    prev = jnp.where(pos > 0, flat[jnp.clip(tok - 1, 0, total - 1)], -1)
+    keep = valid & (flat != blank) & (flat != prev)
+    # front-pack kept tokens per sequence (same scheme as sequence_erase)
+    keep_i = keep.astype(np.dtype("int32"))
+    new_len = jax.ops.segment_sum(keep_i, seg, num_segments=n)
+    ncum = jnp.cumsum(new_len)
+    nstarts = jnp.concatenate(
+        [jnp.zeros((1,), np.dtype("int32")), ncum[:-1]]).astype(
+        np.dtype("int32"))
+    cums = jnp.cumsum(keep_i)
+    segc = jnp.clip(seg, 0, n - 1)
+    seq_prior = jnp.where(starts[segc] > 0,
+                          cums[jnp.clip(starts[segc] - 1, 0, total - 1)], 0)
+    rank = cums - 1 - seq_prior
+    dst = jnp.where(keep, nstarts[segc] + rank, total)
+    out = jnp.zeros((total,), np.dtype("int64")).at[dst].set(
+        jnp.where(keep, flat, 0).astype(np.dtype("int64")), mode="drop")
+    ctx.set_output(op, "Output", out[:, None])
+    from ..lod import lod_name
+
+    names = op.output("Output")
+    if names:
+        ctx.env[lod_name(names[0])] = new_len.astype(np.dtype("int32"))
+
+
+@register("edit_distance")
+def _edit_distance(ctx, op):
+    """Levenshtein distance per sequence pair (reference
+    edit_distance_op.cc) — DP rows as a lax.scan carry, masked to each
+    pair's true lengths."""
+    import jax
+    import jax.numpy as jnp
+
+    hyp = ctx.get_input(op, "Hyps")
+    ref = ctx.get_input(op, "Refs")
+    normalized = bool(op.attr("normalized", False))
+    if op.attr("padded", False):
+        # padded-tensor API: Hyps [B, Lh], Refs [B, Lr] + lengths
+        hlen = ctx.get_input(op, "HypsLength").reshape(-1).astype(
+            np.dtype("int32"))
+        rlen = ctx.get_input(op, "RefsLength").reshape(-1).astype(
+            np.dtype("int32"))
+        n = hlen.shape[0]
+        hpad = hyp.reshape(n, -1).astype(np.dtype("int32"))
+        rpad = ref.reshape(n, -1).astype(np.dtype("int32"))
+    else:
+        hlen = _lod(ctx, op.input("Hyps")[0])
+        rlen = _lod(ctx, op.input("Refs")[0])
+        n = hlen.shape[0]
+        hpad, _hm = _pack(hyp.reshape(-1, 1).astype(np.dtype("int32")),
+                          hlen)
+        rpad, _rm = _pack(ref.reshape(-1, 1).astype(np.dtype("int32")),
+                          rlen)
+        hpad, rpad = hpad[..., 0], rpad[..., 0]   # [n, Hb], [n, Rb]
+    Hb, Rb = hpad.shape[1], rpad.shape[1]
+    BIG = np.float32(1e9)
+
+    # dp[j] over ref prefix j; scan over hyp tokens
+    init = jnp.broadcast_to(
+        jnp.arange(Rb + 1, dtype=np.dtype("float32"))[None, :],
+        (n, Rb + 1))
+    # positions beyond rlen clamp later; run full DP then read [hlen, rlen]
+    jidx = jnp.arange(1, Rb + 1)
+
+    def row(dp, x):
+        h_t, i = x                       # [n], scalar index (1-based)
+        sub = (rpad != h_t[:, None]).astype(np.dtype("float32"))
+        # dp_new[0] = i
+        def inner(carry, jx):
+            left = carry                 # dp_new[j-1]
+            j, diag, up, s = jx
+            val = jnp.minimum(jnp.minimum(up + 1, left + 1), diag + s)
+            return val, val
+
+        diag = dp[:, :-1]                # dp[j-1]
+        up = dp[:, 1:]                   # dp[j]
+        first = jnp.full((n,), i, np.dtype("float32"))
+        _, cols = jax.lax.scan(
+            inner, first,
+            (jidx, diag.T, up.T, sub.T))
+        dp_new = jnp.concatenate([first[:, None], cols.T], axis=1)
+        return dp_new, dp_new
+
+    hidx = jnp.arange(1, Hb + 1).astype(np.dtype("float32"))
+    _, rows = jax.lax.scan(row, init, (hpad.T, hidx))
+    # rows: [Hb, n, Rb+1]; distance = dp[hlen][rlen] (hlen=0 -> init row)
+    all_rows = jnp.concatenate([init[None], rows], axis=0)  # [Hb+1, n, Rb+1]
+    d = all_rows[jnp.clip(hlen, 0, Hb), jnp.arange(n),
+                 jnp.clip(rlen, 0, Rb)]
+    if normalized:
+        d = d / jnp.maximum(rlen, 1).astype(d.dtype)
+    ctx.set_output(op, "Out", d[:, None].astype(np.dtype("float32")))
+    ctx.set_output(op, "SequenceNum", jnp.asarray(n, np.dtype("int32")))
+
+
+@register("nce", has_state=True)
+def _nce(ctx, op):
+    """Noise-contrastive estimation (reference nce_op.cc) with uniform
+    negative sampling from the threaded PRNG."""
+    import jax
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "Input")       # [B, D]
+    label = ctx.get_input(op, "Label").reshape(-1)  # [B]
+    w = ctx.get_input(op, "Weight")      # [C, D]
+    b = ctx.get_input(op, "Bias")        # [C]
+    S = int(op.attr("num_neg_samples", 10))
+    C = int(op.attr("num_total_classes"))
+    B = x.shape[0]
+    key = ctx.next_rng()
+    neg = jax.random.randint(key, (B, S), 0, C)         # [B, S]
+    lab = label.astype(np.dtype("int32"))
+    pos_logit = jnp.sum(x * w[lab], axis=1)
+    if b is not None:
+        pos_logit = pos_logit + b.reshape(-1)[lab]
+    neg_logit = jnp.einsum("bd,bsd->bs", x, w[neg])
+    if b is not None:
+        neg_logit = neg_logit + b.reshape(-1)[neg]
+    # NCE with uniform noise: P_n = 1/C
+    logq = jnp.log(jnp.asarray(S / C, x.dtype))
+    pos_p = jax.nn.log_sigmoid(pos_logit - logq)
+    neg_p = jax.nn.log_sigmoid(-(neg_logit - logq))
+    cost = -(pos_p + jnp.sum(neg_p, axis=1))
+    ctx.set_output(op, "Cost", cost[:, None])
+    ctx.set_output(op, "SampleLogits", neg_logit)
+    ctx.set_output(op, "SampleLabels", neg.astype(np.dtype("int64")))
+
+
+@register("hierarchical_sigmoid")
+def _hierarchical_sigmoid(ctx, op):
+    """Complete-binary-tree hierarchical softmax (reference
+    hierarchical_sigmoid_op.cc + MatrixBitCodeFunctor): leaf code =
+    label + num_classes; path nodes are the code's binary prefixes
+    (heap indices), sign of each step = the following bit."""
+    import jax
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")           # [B, D]
+    w = ctx.get_input(op, "W")           # [num_classes-1, D] internal nodes
+    b = ctx.get_input(op, "Bias")        # [num_classes-1, 1] or None
+    label = ctx.get_input(op, "Label").reshape(-1).astype(np.dtype("int32"))
+    C = int(op.attr("num_classes"))
+    B = x.shape[0]
+    max_len = int(np.ceil(np.log2(max(C, 2)))) + 1
+    code = label + C                     # heap leaf id
+    # path: prefixes code >> k for k = len-1 .. 1 ; bit = (code >> (k-1)) & 1
+    length = jnp.floor(jnp.log2(code.astype(np.dtype("float32")))).astype(
+        np.dtype("int32"))               # number of steps
+    ks = jnp.arange(max_len, dtype=np.dtype("int32"))  # step index j
+    # step j uses node (code >> (length - j)) and bit (code >> (length-j-1))&1
+    shift = length[:, None] - ks[None, :]
+    validp = shift >= 1
+    node = jnp.right_shift(code[:, None], jnp.maximum(shift, 1))
+    bit = jnp.right_shift(code[:, None], jnp.maximum(shift - 1, 0)) & 1
+    nidx = jnp.clip(node - 1, 0, w.shape[0] - 1)  # internal node row
+    logits = jnp.einsum("bd,bkd->bk", x, w[nidx])
+    if b is not None:
+        logits = logits + b.reshape(-1)[nidx]
+    # bit==1 -> right child: P = sigmoid(logit); bit==0 -> 1 - sigmoid
+    sign = jnp.where(bit == 1, 1.0, -1.0).astype(x.dtype)
+    logp = jax.nn.log_sigmoid(sign * logits)
+    cost = -jnp.sum(jnp.where(validp, logp, 0.0), axis=1)
+    ctx.set_output(op, "Out", cost[:, None])
+    ctx.set_output(op, "PreOut", logits)
+
+
+@register("sampled_softmax_with_cross_entropy", has_state=True)
+@register("sample_logits", has_state=True)
+def _sampled_softmax(ctx, op):
+    """Sampled-softmax CE (reference sample_logits_op.cc + Python wrapper):
+    softmax over {true, S uniform samples} with logQ correction."""
+    import jax
+    import jax.numpy as jnp
+
+    logits = ctx.get_input(op, "Logits")   # [B, C]
+    label = ctx.get_input(op, "Label").reshape(-1).astype(np.dtype("int32"))
+    S = int(op.attr("num_samples", 5))
+    B, C = logits.shape
+    key = ctx.next_rng()
+    neg = jax.random.randint(key, (B, S), 0, C)
+    rows = jnp.arange(B)
+    true_logit = logits[rows, label][:, None]
+    neg_logit = jnp.take_along_axis(logits, neg, axis=1)
+    # logQ correction (uniform proposal): q = S/C
+    logq = jnp.log(jnp.asarray(S / C, logits.dtype))
+    # mask accidental hits of the true class among samples
+    hit = (neg == label[:, None])
+    cat = jnp.concatenate(
+        [true_logit,
+         jnp.where(hit, -1e30, neg_logit - logq)], axis=1)
+    loss = -jax.nn.log_softmax(cat, axis=1)[:, 0]
+    ctx.set_output(op, "Loss", loss[:, None])
+    ctx.set_output(op, "Samples", neg.astype(np.dtype("int64")))

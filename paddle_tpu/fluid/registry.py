@@ -143,13 +143,27 @@ def propagate_lod(ctx, op):
         key = name + "@LOD"
         if key in ctx.env and name in ctx.env:
             in_lods.append((name, ctx.env[key]))
-    if len(in_lods) != 1:
+    if not in_lods:
         return
-    src_name, lengths = in_lods[0]
-    src = ctx.env[src_name]
-    lead = np.shape(src)[0] if np.ndim(src) else None
-    if lead is None:
+    # several LoD inputs (e.g. concat along features) may share one
+    # segmentation. Propagate the first input's lengths only when every
+    # LoD input agrees on sequence count and token dim — values can't be
+    # compared at trace time; like the reference's ShareLoD, equal-shape
+    # disagreement is the caller's contract violation. Disagreeing shapes
+    # propagate nothing, so downstream sequence ops raise loudly.
+    first_len = in_lods[0][1]
+    leads = set()
+    for name, lv in in_lods:
+        v = ctx.env[name]
+        if not np.ndim(v):
+            return
+        leads.add(np.shape(v)[0])
+        if np.shape(lv) != np.shape(first_len):
+            return
+    if len(leads) != 1:
         return
+    lengths = first_len
+    lead = leads.pop()
     for out in op.output_arg_names():
         key = out + "@LOD"
         if key in ctx.env or out not in ctx.env:

@@ -1,0 +1,190 @@
+"""Distributions + nets composites — reference ``layers/distributions.py``
+and ``python/paddle/fluid/nets.py``."""
+
+import math
+
+import numpy as np
+import pytest
+from scipy import stats
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers, nets, optimizer
+from paddle_tpu.fluid.layers.distributions import (
+    Categorical, MultivariateNormalDiag, Normal, Uniform)
+
+
+def _run(fetches, feed=None, seed=0):
+    main = fluid.default_main_program()
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(fluid.default_startup_program())
+        return [np.asarray(r) for r in
+                exe.run(main, feed=feed or {}, fetch_list=fetches)]
+
+
+def test_normal_log_prob_entropy_kl():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        n1 = Normal(0.0, 1.0)
+        n2 = Normal(1.0, 2.0)
+        v = layers.data("v", shape=[1], dtype="float32")
+        lp = n1.log_prob(v)
+        ent = n2.entropy()
+        kl = n1.kl_divergence(n2)
+        samp = n1.sample([500])
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        lpv, entv, klv, sv = exe.run(
+            main, feed={"v": np.array([[0.5]], np.float32)},
+            fetch_list=[lp, ent, kl, samp])
+    np.testing.assert_allclose(np.asarray(lpv).ravel()[0],
+                               stats.norm.logpdf(0.5), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(entv).ravel()[0],
+                               stats.norm(1, 2).entropy(), rtol=1e-5)
+    # KL(N(0,1) || N(1,2)) closed form
+    expect_kl = math.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+    np.testing.assert_allclose(np.asarray(klv).ravel()[0], expect_kl,
+                               rtol=1e-5)
+    s = np.asarray(sv)
+    assert abs(s.mean()) < 0.2 and abs(s.std() - 1.0) < 0.2
+
+
+def test_uniform_sample_and_log_prob():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        u = Uniform(-2.0, 3.0)
+        samp = u.sample([400])
+        v = layers.data("v", shape=[1], dtype="float32")
+        lp = u.log_prob(v)
+        ent = u.entropy()
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        sv, lpv, entv = exe.run(
+            main, feed={"v": np.array([[0.0]], np.float32)},
+            fetch_list=[samp, lp, ent])
+    s = np.asarray(sv)
+    assert s.min() >= -2.0 and s.max() <= 3.0
+    np.testing.assert_allclose(np.asarray(lpv).ravel()[0],
+                               -math.log(5.0), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(entv).ravel()[0],
+                               math.log(5.0), rtol=1e-5)
+
+
+def test_categorical_entropy_kl_sample():
+    logits = np.log(np.array([[0.2, 0.3, 0.5]], np.float32))
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        lv = layers.data("lv", shape=[3], dtype="float32")
+        c1 = Categorical(lv)
+        c2 = Categorical(layers.scale(lv, scale=0.5))
+        ent = c1.entropy()
+        kl = c1.kl_divergence(c2)
+        v = layers.data("v", shape=[1], dtype="int64")
+        lp = c1.log_prob(v)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        entv, klv, lpv = exe.run(
+            main, feed={"lv": logits, "v": np.array([[2]], np.int64)},
+            fetch_list=[ent, kl, lp])
+    p = np.array([0.2, 0.3, 0.5])
+    np.testing.assert_allclose(np.asarray(entv).ravel()[0],
+                               -(p * np.log(p)).sum(), rtol=1e-5)
+    assert np.asarray(klv).ravel()[0] > 0
+    np.testing.assert_allclose(np.asarray(lpv).ravel()[0], np.log(0.5),
+                               rtol=1e-5)
+
+
+def test_multivariate_normal_diag():
+    loc = np.array([0.0, 1.0], np.float32)
+    scale = np.diag([1.0, 2.0]).astype(np.float32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        d = MultivariateNormalDiag(loc, scale)
+        ent = d.entropy()
+        v = layers.data("v", shape=[2], dtype="float32")
+        lp = d.log_prob(v)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        entv, lpv = exe.run(main, feed={
+            "v": np.array([[0.5, 0.0]], np.float32)},
+            fetch_list=[ent, lp])
+    ref = stats.multivariate_normal(loc, np.diag([1.0, 2.0]))  # scale = cov
+    np.testing.assert_allclose(np.asarray(entv).ravel()[0], ref.entropy(),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(lpv).ravel()[0],
+                               ref.logpdf([0.5, 0.0]), rtol=1e-4)
+
+
+def test_simple_img_conv_pool_and_group():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 1
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", shape=[1, 8, 8], dtype="float32")
+        a = nets.simple_img_conv_pool(img, num_filters=4, filter_size=3,
+                                      pool_size=2, pool_stride=2, act="relu")
+        b = nets.img_conv_group(img, conv_num_filter=[4, 4], pool_size=2,
+                                conv_act="relu", conv_with_batchnorm=True)
+    exe = fluid.Executor()
+    v = np.random.RandomState(0).rand(2, 1, 8, 8).astype(np.float32)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        av, bv = exe.run(main, feed={"img": v}, fetch_list=[a, b])
+    # conv 3x3 (no pad) on 8x8 -> 6x6; pool 2/2 -> 3x3
+    assert np.asarray(av).shape == (2, 4, 3, 3)
+    # group: pad-1 convs keep 8x8; pool 2 stride 1 -> 7x7
+    assert np.asarray(bv).shape == (2, 4, 7, 7)
+
+
+def test_sequence_conv_pool_and_glu():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 2
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[6], dtype="float32", lod_level=1)
+        sp = nets.sequence_conv_pool(x, num_filters=5, filter_size=3)
+        g = layers.data("g", shape=[8], dtype="float32")
+        gl = nets.glu(g, dim=-1)
+    exe = fluid.Executor()
+    xv = np.random.RandomState(1).rand(5, 6).astype(np.float32)
+    gv = np.random.RandomState(2).rand(3, 8).astype(np.float32)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        spv, glv = exe.run(main, feed={
+            "x": fluid.create_lod_tensor(xv, [[3, 2]]), "g": gv},
+            fetch_list=[sp, gl])
+    assert np.asarray(spv).shape == (2, 5)
+    expect = gv[:, :4] * (1 / (1 + np.exp(-gv[:, 4:])))
+    np.testing.assert_allclose(np.asarray(glv), expect, rtol=1e-5)
+
+
+def test_scaled_dot_product_attention():
+    B, T, D, heads = 2, 4, 8, 2
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 3
+    with fluid.program_guard(main, startup):
+        q = layers.data("q", shape=[T, D], dtype="float32")
+        k = layers.data("k", shape=[T, D], dtype="float32")
+        v = layers.data("v", shape=[T, D], dtype="float32")
+        out = nets.scaled_dot_product_attention(q, k, v, num_heads=heads)
+    rng = np.random.RandomState(4)
+    qv, kv, vv = [rng.rand(B, T, D).astype(np.float32) for _ in range(3)]
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (r,) = exe.run(main, feed={"q": qv, "k": kv, "v": vv},
+                       fetch_list=[out])
+    r = np.asarray(r)
+    assert r.shape == (B, T, D)
+    # numpy reference
+    dk = D // heads
+    qh = qv.reshape(B, T, heads, dk).transpose(0, 2, 1, 3)
+    kh = kv.reshape(B, T, heads, dk).transpose(0, 2, 1, 3)
+    vh = vv.reshape(B, T, heads, dk).transpose(0, 2, 1, 3)
+    logits = (qh / np.sqrt(dk)) @ kh.transpose(0, 1, 3, 2)
+    w = np.exp(logits - logits.max(-1, keepdims=True))
+    w = w / w.sum(-1, keepdims=True)
+    ref = (w @ vh).transpose(0, 2, 1, 3).reshape(B, T, D)
+    np.testing.assert_allclose(r, ref, rtol=1e-4, atol=1e-5)

