@@ -67,13 +67,20 @@ class CompiledProgram:
 
     def with_data_parallel(self, loss_name=None, build_strategy=None,
                            exec_strategy=None, share_vars_from=None,
-                           places=None):
+                           places=None, mesh_axes=("dp",), mesh_shape=None):
+        """GSPMD execution. ``mesh_axes``/``mesh_shape`` open the hybrid
+        surface: e.g. mesh_axes=("dp","tp"), mesh_shape={"dp":2,"tp":4}
+        lays parameters carrying a ``ParamAttr(shard=...)`` spec over the
+        'tp' axis (Megatron-style) while the batch shards over 'dp'; XLA
+        inserts the TP collectives over ICI."""
         self._is_data_parallel = True
         self._mode = "gspmd"
         self._loss_name = loss_name
         if build_strategy is not None:
             self._build_strategy = build_strategy
         self._places = places
+        self._mesh_axes = tuple(mesh_axes)
+        self._mesh_shape = dict(mesh_shape) if mesh_shape else None
         return self
 
     def with_pipeline(self, loss_name=None, places=None, num_microbatches=2,
@@ -130,17 +137,28 @@ class CompiledProgram:
             if isinstance(devices, int):
                 devices = jax.devices()[:devices]
             axes = getattr(self, "_mesh_axes", ("dp",))
-            if len(axes) == 1:
-                self._mesh = Mesh(np.array(devices), axes)
-            else:
-                arr = np.array(devices).reshape(
-                    self._mesh_axis_sizes(len(devices), axes))
-                self._mesh = Mesh(arr, axes)
+            # single-axis meshes go through the same sizing path so an
+            # explicit mesh_shape is honored (and validated), not dropped
+            arr = np.array(devices).reshape(
+                self._mesh_axis_sizes(len(devices), axes))
+            self._mesh = Mesh(arr, axes)
         return self._mesh
 
-    @staticmethod
-    def _mesh_axis_sizes(n, axes):
-        # default: first axis takes all devices unless sizes were provided
+    def _mesh_axis_sizes(self, n, axes):
+        shape = getattr(self, "_mesh_shape", None)
+        if shape:
+            missing = [a for a in axes if a not in shape]
+            if missing:
+                raise ValueError(
+                    "mesh_shape %r is missing sizes for mesh axes %r"
+                    % (shape, missing))
+            sizes = tuple(int(shape[a]) for a in axes)
+            if int(np.prod(sizes)) != n:
+                raise ValueError(
+                    "mesh_shape %r does not multiply to %d devices"
+                    % (shape, n))
+            return sizes
+        # default: first axis takes all devices
         return (n,) + (1,) * (len(axes) - 1)
 
     def _on_trace_begin(self, ctx):
@@ -157,7 +175,8 @@ class CompiledProgram:
         if mode == "pipeline":
             return self._wrap_step_pipeline(program, block, feed,
                                             fetch_names, state_names)
-        return self._wrap_step_gspmd(step, feed, fetch_names, state_names)
+        return self._wrap_step_gspmd(step, block, feed, fetch_names,
+                                     state_names)
 
     def _wrap_step_pipeline(self, program, block, feed, fetch_names,
                             state_names):
@@ -407,8 +426,11 @@ class CompiledProgram:
 
         return fn
 
-    def _wrap_step_gspmd(self, step, feed, fetch_names, state_names):
-        """jit the lowered step under the mesh with DP shardings."""
+    def _wrap_step_gspmd(self, step, block, feed, fetch_names, state_names):
+        """jit the lowered step under the mesh: batch over 'dp', params
+        laid out by their ``shard_spec`` (TP), everything else replicated.
+        XLA/GSPMD inserts all collectives (grad allreduce over dp, TP
+        gather/reduce-scatter) from these layouts."""
         import jax
         from jax.sharding import NamedSharding
         from jax.sharding import PartitionSpec as P
@@ -419,13 +441,31 @@ class CompiledProgram:
         def feed_sharding(name):
             arr = feed[name]
             ndim = np.ndim(arr)
-            if ndim >= 1 and np.shape(arr)[0] % mesh.shape["dp"] == 0:
+            if "dp" in mesh.shape and ndim >= 1 and \
+                    np.shape(arr)[0] % mesh.shape["dp"] == 0:
                 return NamedSharding(mesh, P("dp", *([None] * (ndim - 1))))
             return repl
 
+        def state_sharding(name):
+            var = block._find_var_recursive(name) if block is not None \
+                else None
+            spec = getattr(var, "shard_spec", None) if var is not None \
+                else None
+            if spec is None:
+                return repl
+            missing = [a for a in spec if a is not None
+                       and a not in mesh.shape]
+            if missing:
+                raise ValueError(
+                    "param %r shard spec %r names mesh axes %r absent from "
+                    "the mesh %r" % (name, spec, missing,
+                                     dict(mesh.shape)))
+            return NamedSharding(mesh, P(*spec))
+
         feed_shardings = {n: feed_sharding(n) for n in feed}
+        state_shardings = {n: state_sharding(n) for n in state_names}
         in_shardings = (
-            {n: repl for n in state_names},
+            state_shardings,
             feed_shardings,
             repl,
         )
@@ -440,7 +480,8 @@ class CompiledProgram:
         def fn(state, feed_vals, rng):
             # Committed single-device arrays (e.g. from the startup program)
             # must be explicitly resharded onto the mesh before the jit call.
-            state = {k: jax.device_put(v, repl) for k, v in state.items()}
+            state = {k: jax.device_put(v, state_shardings.get(k, repl))
+                     for k, v in state.items()}
             feed_vals = {
                 k: jax.device_put(v, feed_shardings[k]) for k, v in feed_vals.items()
             }

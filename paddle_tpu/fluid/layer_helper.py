@@ -53,6 +53,12 @@ class LayerHelper:
             learning_rate=attr.learning_rate,
             do_model_average=attr.do_model_average,
         )
+        if getattr(attr, "shard", None) is not None:
+            if len(attr.shard) != len(shape):
+                raise ValueError(
+                    "ParamAttr(shard=%r) rank does not match param shape %r"
+                    % (attr.shard, shape))
+            param.shard_spec = tuple(attr.shard)
         # mirror the parameter + its init op into the startup program
         startup_block = self.startup_program.global_block()
         sp = framework.Parameter(

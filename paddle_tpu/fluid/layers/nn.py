@@ -36,6 +36,7 @@ __all__ = [
     "hard_swish", "uniform_random", "gelu", "erf", "topk", "unique",
     "autoincreased_step_counter", "smooth_l1", "dice_loss", "py_func",
     "linear_chain_crf", "crf_decoding", "ctc_greedy_decoder",
+    "shard_tensor",
 ]
 
 
@@ -1487,4 +1488,19 @@ def ctc_greedy_decoder(input, blank, name=None):
     helper.append_op(type="ctc_align", inputs={"Input": [idx]},
                      outputs={"Output": [out]},
                      attrs={"blank": int(blank)})
+    return out
+
+
+def shard_tensor(x, spec, name=None):
+    """Annotate an activation with a mesh layout (TPU-native analogue of
+    the reference's manual collective placement): ``spec`` is one mesh
+    axis name (or None) per dim, e.g. ["dp", None, "sp"] shards batch over
+    dp and sequence over sp. Lowering: lax.with_sharding_constraint."""
+    helper = LayerHelper("shard_tensor", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.shape = tuple(x.shape)
+    helper.append_op(type="shard_tensor", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"spec": ["" if s is None else str(s)
+                                     for s in spec]})
     return out

@@ -150,3 +150,27 @@ def _barrier(ctx, op):
         x = ctx.get_input(op, "X")
         # psum of zeros = synchronization point
         ctx.set_output(op, "Out", x + 0 * jax.lax.psum(x * 0, axis))
+
+
+@register("shard_tensor")
+def _shard_tensor(ctx, op):
+    """Activation sharding hint: lax.with_sharding_constraint under the
+    active mesh (identity otherwise). The TPU-native sequence/tensor-
+    parallel annotation — attrs: spec = [axis-name-or-None per dim]."""
+    x = ctx.get_input(op, "X")
+    mesh = getattr(ctx, "mesh", None)
+    # identity without a mesh, AND under shard_map (explicit-collective
+    # mode sets ctx.shard_axes): inside shard_map the axes are manual and a
+    # global sharding constraint on a per-shard value is ill-formed
+    if mesh is None or getattr(ctx, "shard_axes", None):
+        ctx.set_output(op, "Out", x)
+        return
+    import jax
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    spec = [None if s in (None, "", "None") else s
+            for s in op.attr("spec", [])]
+    out = jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(*spec)))
+    ctx.set_output(op, "Out", out)

@@ -73,6 +73,11 @@ class Optimizer:
             name=var_name, shape=shape, dtype=dtype, persistable=True,
             stop_gradient=True,
         )
+        # moments share the param's TP layout so the optimizer update is
+        # local to each shard (no resharding per step)
+        pspec = getattr(param, "shard_spec", None)
+        if pspec is not None and tuple(shape) == tuple(param.shape):
+            var.shard_spec = pspec
         sb = helper.startup_program.global_block()
         sv = sb.create_var(name=var_name, shape=shape, dtype=dtype, persistable=True)
         Constant(float(fill_value))(sv, sb)

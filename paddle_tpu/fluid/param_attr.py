@@ -11,6 +11,7 @@ class ParamAttr:
         regularizer=None,
         trainable=True,
         do_model_average=False,
+        shard=None,
     ):
         self.name = name
         self.initializer = initializer
@@ -18,6 +19,11 @@ class ParamAttr:
         self.regularizer = regularizer
         self.trainable = trainable
         self.do_model_average = do_model_average
+        # tensor-parallel sharding spec: a tuple with one entry per param
+        # dim, each a mesh axis name or None (e.g. (None, "tp") = column-
+        # parallel). Consumed by CompiledProgram's GSPMD wrap: the param is
+        # laid out over the mesh and XLA inserts the TP collectives.
+        self.shard = tuple(shard) if shard is not None else None
 
     @staticmethod
     def _to_attr(arg):

@@ -18,7 +18,7 @@ from paddle_tpu.fluid import layers, optimizer
 class BertConfig:
     def __init__(self, vocab_size=30522, hidden=768, n_layers=12, n_heads=12,
                  ffn_hidden=3072, max_seq=512, type_vocab=2,
-                 hidden_dropout=0.1, attn_dropout=0.1):
+                 hidden_dropout=0.1, attn_dropout=0.1, tp_axis=None):
         self.vocab_size = vocab_size
         self.hidden = hidden
         self.n_layers = n_layers
@@ -28,6 +28,9 @@ class BertConfig:
         self.type_vocab = type_vocab
         self.hidden_dropout = hidden_dropout
         self.attn_dropout = attn_dropout
+        # set to a mesh axis name (e.g. "tp") to lay attention/FFN weights
+        # out Megatron-style via ParamAttr(shard=...) — see _tp_attr
+        self.tp_axis = tp_axis
 
     @staticmethod
     def base():
@@ -39,12 +42,27 @@ class BertConfig:
                           ffn_hidden=128, max_seq=64)
 
 
+def _tp_attr(cfg, kind):
+    """Megatron TP layouts when cfg.tp_axis is set: column-parallel for
+    qkv/ffn-in (shard the output features), row-parallel for the
+    projections back to hidden (shard the input features); GSPMD derives
+    the all-reduce after each row-parallel matmul from these layouts."""
+    axis = getattr(cfg, "tp_axis", None)
+    if not axis:
+        return None
+    spec = (None, axis) if kind == "col" else (axis, None)
+    return fluid.ParamAttr(shard=spec)
+
+
 def _mha(x, attn_bias, cfg, prefix):
     h, n_heads = cfg.hidden, cfg.n_heads
     d = h // n_heads
-    q = layers.fc(x, h, num_flatten_dims=2, name=prefix + "_q")
-    k = layers.fc(x, h, num_flatten_dims=2, name=prefix + "_k")
-    v = layers.fc(x, h, num_flatten_dims=2, name=prefix + "_v")
+    q = layers.fc(x, h, num_flatten_dims=2, name=prefix + "_q",
+                  param_attr=_tp_attr(cfg, "col"))
+    k = layers.fc(x, h, num_flatten_dims=2, name=prefix + "_k",
+                  param_attr=_tp_attr(cfg, "col"))
+    v = layers.fc(x, h, num_flatten_dims=2, name=prefix + "_v",
+                  param_attr=_tp_attr(cfg, "col"))
 
     def split_heads(t):
         t = layers.reshape(t, [0, 0, n_heads, d])
@@ -61,7 +79,8 @@ def _mha(x, attn_bias, cfg, prefix):
     ctx = layers.matmul(weights, v)  # [B, nH, S, d]
     ctx = layers.transpose(ctx, [0, 2, 1, 3])
     ctx = layers.reshape(ctx, [0, 0, h])
-    return layers.fc(ctx, h, num_flatten_dims=2, name=prefix + "_out")
+    return layers.fc(ctx, h, num_flatten_dims=2, name=prefix + "_out",
+                     param_attr=_tp_attr(cfg, "row"))
 
 
 def _encoder_layer(x, attn_bias, cfg, prefix):
@@ -71,9 +90,11 @@ def _encoder_layer(x, attn_bias, cfg, prefix):
                               dropout_implementation="upscale_in_train")
     x = layers.layer_norm(layers.elementwise_add(x, attn), begin_norm_axis=2)
     ffn = layers.fc(x, cfg.ffn_hidden, num_flatten_dims=2, act="gelu",
-                    name=prefix + "_ffn1")
+                    name=prefix + "_ffn1",
+                    param_attr=_tp_attr(cfg, "col"))
     ffn = layers.fc(ffn, cfg.hidden, num_flatten_dims=2,
-                    name=prefix + "_ffn2")
+                    name=prefix + "_ffn2",
+                    param_attr=_tp_attr(cfg, "row"))
     if cfg.hidden_dropout:
         ffn = layers.dropout(ffn, cfg.hidden_dropout,
                              dropout_implementation="upscale_in_train")
