@@ -8,3 +8,4 @@ kernels for custom ops). Distributed: jax.sharding Mesh over ICI/DCN.
 __version__ = "0.1.0"
 
 from . import fluid  # noqa: F401
+from . import inference  # noqa: F401

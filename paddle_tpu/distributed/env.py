@@ -1,0 +1,73 @@
+"""Multi-process bootstrap — the reference's gen_nccl_id/comm-init RPC
+(``operators/collective/c_gen_nccl_id_op.cc``, ``c_comm_init_op.cc``)
+replaced by the JAX coordination service.
+
+Env contract (reference role_maker.py:327 + launch.py):
+  PADDLE_TRAINER_ID        this process's rank
+  PADDLE_TRAINERS_NUM      world size
+  PADDLE_TRAINER_ENDPOINTS comma list; endpoint 0 doubles as the
+                           coordination-service address
+  PADDLE_DIST_BACKEND      optional: "cpu" forces the virtual-CPU backend
+                           with gloo cross-process collectives (the test
+                           fake-cluster mode, SURVEY §4); unset = chips.
+
+After ``init_parallel_env()`` the global device view spans processes:
+``jax.devices()`` shows every chip in the job, and CompiledProgram meshes
+built on it run collectives over ICI within a host and DCN across hosts.
+"""
+
+import os
+
+_initialized = False
+
+
+def _env_int(name, default):
+    v = os.environ.get(name)
+    return int(v) if v else default
+
+
+def parallel_env():
+    """(rank, world_size, endpoints) from the PADDLE_* env contract."""
+    eps = [e for e in os.environ.get(
+        "PADDLE_TRAINER_ENDPOINTS", "").split(",") if e]
+    world = _env_int("PADDLE_TRAINERS_NUM", len(eps) or 1)
+    rank = _env_int("PADDLE_TRAINER_ID", 0)
+    return rank, world, eps
+
+
+def init_parallel_env(ndev_per_proc=None):
+    """Join the job's coordination service (idempotent). Returns
+    (rank, world_size). Single-process jobs return immediately."""
+    global _initialized
+    rank, world, eps = parallel_env()
+    if world <= 1:
+        return rank, world
+    if _initialized:
+        return rank, world
+    import jax
+
+    if os.environ.get("PADDLE_DIST_BACKEND", "").lower() == "cpu":
+        # fake-cluster mode: virtual CPU devices + gloo collectives (the
+        # spawn-local-subprocess test pattern, reference test_dist_base.py)
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        if ndev_per_proc is None:
+            ndev_per_proc = _env_int("PADDLE_LOCAL_DEVICES", 1)
+        jax.config.update("jax_num_cpu_devices", int(ndev_per_proc))
+    coordinator = eps[0] if eps else "127.0.0.1:12765"
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=world,
+        process_id=rank,
+    )
+    _initialized = True
+    return rank, world
+
+
+def is_multiprocess():
+    import jax
+
+    try:
+        return jax.process_count() > 1
+    except Exception:
+        return False

@@ -1,0 +1,96 @@
+"""Multi-process-per-node launcher — reference
+``python/paddle/distributed/launch.py``: spawns N trainer processes with
+the PADDLE_* env contract and streams their logs.
+
+    python -m paddle_tpu.distributed.launch --nproc_per_node=2 train.py ...
+
+Each child gets PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM /
+PADDLE_TRAINER_ENDPOINTS / PADDLE_CURRENT_ENDPOINT; endpoint 0 is the
+coordination-service address consumed by
+``paddle_tpu.distributed.env.init_parallel_env``.
+"""
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+__all__ = ["launch", "main"]
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def launch(nproc, cmd, node_ip="127.0.0.1", started_port=None, env=None,
+           backend=None, log_dir=None):
+    """Spawn ``nproc`` copies of ``cmd`` (argv list) with the trainer env.
+    Returns the list of exit codes."""
+    base = _free_port() if started_port is None else int(started_port)
+    endpoints = ",".join("%s:%d" % (node_ip, base + i) for i in range(nproc))
+    procs = []
+    logs = []
+    for rank in range(nproc):
+        child_env = dict(os.environ if env is None else env)
+        child_env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(nproc),
+            "PADDLE_TRAINER_ENDPOINTS": endpoints,
+            "PADDLE_CURRENT_ENDPOINT": "%s:%d" % (node_ip, base + rank),
+            "TRAINING_ROLE": "TRAINER",
+        })
+        if backend:
+            child_env["PADDLE_DIST_BACKEND"] = backend
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            f = open(os.path.join(log_dir, "worker.%d.log" % rank), "wb")
+            logs.append(f)
+            procs.append(subprocess.Popen(cmd, env=child_env, stdout=f,
+                                          stderr=subprocess.STDOUT))
+        else:
+            procs.append(subprocess.Popen(cmd, env=child_env))
+    codes = []
+    try:
+        for p in procs:
+            codes.append(p.wait())
+    except KeyboardInterrupt:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        raise
+    finally:
+        for f in logs:
+            f.close()
+    return codes
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="multi-process trainer launcher")
+    parser.add_argument("--nproc_per_node", type=int, default=1)
+    parser.add_argument("--node_ip", default="127.0.0.1")
+    parser.add_argument("--started_port", type=int, default=None)
+    parser.add_argument("--backend", default=None,
+                        help="'cpu' = virtual-CPU fake-cluster mode")
+    parser.add_argument("--log_dir", default=None)
+    parser.add_argument("training_script")
+    parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+    cmd = [sys.executable, "-u", args.training_script] + \
+        args.training_script_args
+    codes = launch(args.nproc_per_node, cmd, node_ip=args.node_ip,
+                   started_port=args.started_port, backend=args.backend,
+                   log_dir=args.log_dir)
+    bad = [(i, c) for i, c in enumerate(codes) if c != 0]
+    if bad:
+        sys.exit("workers failed: %r" % bad)
+
+
+if __name__ == "__main__":
+    main()

@@ -19,6 +19,7 @@ from . import (  # noqa: F401
     nets,
     optimizer,
     param_attr,
+    profiler,
     regularizer,
     unique_name,
 )
@@ -33,6 +34,7 @@ from .framework import (  # noqa: F401
     default_main_program,
     default_startup_program,
     program_guard,
+    record_op_callstacks,
 )
 from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
 

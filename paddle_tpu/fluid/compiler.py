@@ -185,7 +185,7 @@ class CompiledProgram:
         from jax.sharding import NamedSharding
         from jax.sharding import PartitionSpec as P
 
-        from .registry import LowerCtx, registry
+        from .registry import LowerCtx, lower_op, registry
 
         mesh = self.mesh
         axis = mesh.axis_names[0]
@@ -223,7 +223,7 @@ class CompiledProgram:
                     env[in_name] = x_recv
                 ctx = LowerCtx(block, env, rng)
                 for o in seg:
-                    registry.get(o.type).lower(ctx, o)
+                    lower_op(ctx, o)
                 if is_last:
                     loss = env[loss_name]
                     if loss.ndim > 0:
@@ -329,7 +329,7 @@ class CompiledProgram:
                 env[gn] = grads[wn]
             ctx = LowerCtx(block, env, rng)
             for o in post_ops:
-                registry.get(o.type).lower(ctx, o)
+                lower_op(ctx, o)
 
             new_params = {n: env[n] for n in params}
             new_rest = {n: env[n] for n in rest_state}

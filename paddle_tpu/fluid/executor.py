@@ -102,6 +102,20 @@ def _feed_signature(feed, block):
     return tuple(sig)
 
 
+def _fetch_numpy(x):
+    """np.asarray, multiprocess-safe: a replicated global array is not
+    fully addressable — read the local replica. A SHARDED global fetch has
+    no complete local value; fail loudly rather than return a slice."""
+    if hasattr(x, "is_fully_addressable") and not x.is_fully_addressable:
+        if getattr(x.sharding, "is_fully_replicated", False):
+            return np.asarray(x.addressable_shards[0].data)
+        raise ValueError(
+            "fetch is sharded across processes (%s); fetch with "
+            "return_numpy=False and gather explicitly (e.g. "
+            "multihost_utils.process_allgather)" % (x.sharding,))
+    return np.asarray(x)
+
+
 class _CompiledStep:
     """One jit-compiled (program block, feed-sig, fetch-list) entry."""
 
@@ -196,13 +210,21 @@ class Executor:
             scope.set_var(RNG_STATE_VAR, rng)
 
         state = {n: scope.find_var(n) for n in state_names}
+        from . import profiler as _prof
+
+        profiling = _prof.is_profiler_enabled()
+        t0 = _prof.now() if profiling else None
         fetches, new_state, new_rng = step.fn(state, feed, rng)
+        if profiling:
+            jax.block_until_ready(fetches)
+            _prof._record("executor_run[%s]" % ",".join(fetch_names[:3]),
+                          _prof.now() - t0)
         scope.set_var(RNG_STATE_VAR, new_rng)
         for n, v in new_state.items():
             scope.set_var(n, v)
 
         if return_numpy:
-            return [np.asarray(x) for x in fetches]
+            return [_fetch_numpy(x) for x in fetches]
         return list(fetches)
 
     # ------------------------------------------------------------------

@@ -205,6 +205,36 @@ def grad_var_name(name):
     return name + GRAD_SUFFIX
 
 
+_PKG_DIR = None
+
+
+def _user_callsite(max_frames=3):
+    """File:line of the nearest frames OUTSIDE paddle_tpu — the user's layer
+    call site (cheap: walks raw frames, no traceback formatting)."""
+    global _PKG_DIR
+    if _PKG_DIR is None:
+        import os
+        import sys  # noqa: F401
+
+        _PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    import sys
+
+    frames = []
+    f = sys._getframe(2)
+    while f is not None and len(frames) < max_frames:
+        fn = f.f_code.co_filename
+        if not fn.startswith(_PKG_DIR):
+            frames.append("%s:%d in %s" % (fn, f.f_lineno, f.f_code.co_name))
+        f = f.f_back
+    return frames
+
+
+def record_op_callstacks(enabled=True):
+    """Toggle op call-site recording (on by default; tiny per-op cost at
+    graph-build time only)."""
+    Operator._record_callstacks = bool(enabled)
+
+
 # ---------------------------------------------------------------------------
 # Operator
 # ---------------------------------------------------------------------------
@@ -217,6 +247,11 @@ class Operator:
     semantics come from the op registry's lowering rule (``registry.py``).
     """
 
+    # op-attributed errors (reference framework/op_call_stack.cc): each op
+    # records where user code created it, so lowering/runtime failures can
+    # name the layer call site. Toggle via record_op_callstacks().
+    _record_callstacks = True
+
     def __init__(self, block, type, inputs=None, outputs=None, attrs=None):
         self.block = block
         self.type = type
@@ -224,6 +259,8 @@ class Operator:
         self.inputs = {k: _as_name_list(v) for k, v in (inputs or {}).items()}
         self.outputs = {k: _as_name_list(v) for k, v in (outputs or {}).items()}
         self.attrs = dict(attrs or {})
+        self.callstack = _user_callsite() if Operator._record_callstacks \
+            else None
 
     def input(self, slot):
         return self.inputs.get(slot, [])

@@ -44,10 +44,21 @@ class Collective(Fleet):
         self.startup_program = None
         self._compiled = None
 
+    def init(self, role_maker=None):
+        super().init(role_maker)
+        # multi-process jobs join the coordination service NOW so every
+        # later mesh sees the global device view (reference: comm init at
+        # fleet.init via c_gen_nccl_id RPC)
+        from .....distributed import env as dist_env
+
+        _, world, _ = dist_env.parallel_env()
+        if world > 1:
+            dist_env.init_parallel_env()
+
     def init_worker(self):
-        # multi-host bootstrap would call jax.distributed.initialize() here;
-        # single-host (one process owning the chips) needs nothing.
-        pass
+        from .....distributed import env as dist_env
+
+        dist_env.init_parallel_env()
 
     def init_server(self, model_dir=None):
         raise NotImplementedError(

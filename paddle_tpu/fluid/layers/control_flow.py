@@ -180,7 +180,7 @@ def while_loop(cond, body, loop_vars, is_test=False, name=None,
 
 
 def _register_while_loop_op():
-    from ..registry import LowerCtx, register, registry
+    from ..registry import LowerCtx, lower_op, register, registry
 
     @register("while_loop")
     def _while_loop(ctx, op):
@@ -198,7 +198,7 @@ def _register_while_loop_op():
         def run_block(block, env):
             sub = LowerCtx(block, env, ctx.rng_key, mesh=ctx.mesh)
             for o in block.ops:
-                registry.get(o.type).lower(sub, o)
+                lower_op(sub, o)
 
         def cond_fun(carry):
             env = dict(snapshot)
@@ -357,7 +357,7 @@ class Switch:
 
 
 def _register_switch_op():
-    from ..registry import LowerCtx, register, registry
+    from ..registry import LowerCtx, lower_op, register, registry
 
     @register("switch")
     def _switch(ctx, op):
@@ -381,7 +381,7 @@ def _register_switch_op():
                 env.update(dict(zip(carried, vals)))
                 sub = LowerCtx(blk, env, ctx.rng_key, mesh=ctx.mesh)
                 for o in blk.ops:
-                    registry.get(o.type).lower(sub, o)
+                    lower_op(sub, o)
                 return tuple(env[n] for n in carried)
 
             return fn

@@ -24,11 +24,10 @@ def _run_ops(rctx, ops, wrt_names):
     """Lower `ops` in order on rctx, honoring stop_gradient markers."""
     import jax
 
-    from ..registry import propagate_lod
+    from ..registry import lower_op
 
     for o in ops:
-        registry.get(o.type).lower(rctx, o)
-        propagate_lod(rctx, o)
+        lower_op(rctx, o)
         for name in o.output_arg_names():
             v = rctx.var(name)
             if v is not None and v.stop_gradient and name not in wrt_names:

@@ -11,7 +11,7 @@ vars (the scope-mutation analogue, made explicit).
 
 import numpy as np
 
-from ..registry import LowerCtx, register, registry
+from ..registry import LowerCtx, lower_op, register, registry
 
 
 def _block_writes(block):
@@ -27,7 +27,7 @@ def _block_writes(block):
 def _lower_subblock(ctx, block, env):
     sub = LowerCtx(block, env, ctx.rng_key, mesh=ctx.mesh)
     for op in block.ops:
-        registry.get(op.type).lower(sub, op)
+        lower_op(sub, op)
     return env
 
 

@@ -1,0 +1,139 @@
+"""Profiler — reference ``python/paddle/fluid/profiler.py:228`` +
+``platform/profiler.h:81,166`` (RecordEvent, Enable/DisableProfiler,
+per-event summary table, chrome timeline via ``tools/timeline.py``).
+
+TPU-native: under XLA the per-op host interpreter is gone, so host-side
+events are step/section-level (``RecordEvent`` contexts + Executor.run
+timings hooked here), and the DEVICE timeline comes from ``jax.profiler``
+traces (XPlane — openable in TensorBoard/Perfetto, the chrome-trace
+analogue). The summary table keeps the reference's shape:
+Event / Calls / Total / Min / Max / Ave / Ratio.
+"""
+
+import contextlib
+import time
+from collections import OrderedDict
+
+__all__ = ["profiler", "start_profiler", "stop_profiler", "reset_profiler",
+           "RecordEvent", "cuda_profiler", "npu_profiler"]
+
+_enabled = False
+_events = OrderedDict()  # name -> [calls, total, min, max]
+_trace_dir = None
+
+
+def now():
+    return time.perf_counter()
+
+
+def _record(name, seconds):
+    if not _enabled:
+        return
+    e = _events.get(name)
+    if e is None:
+        _events[name] = [1, seconds, seconds, seconds]
+    else:
+        e[0] += 1
+        e[1] += seconds
+        e[2] = min(e[2], seconds)
+        e[3] = max(e[3], seconds)
+
+
+class RecordEvent:
+    """RAII host event (reference platform/profiler.h:81)."""
+
+    def __init__(self, name):
+        self.name = name
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        _record(self.name, time.perf_counter() - self._t0)
+        return False
+
+
+def record_event(name):
+    return RecordEvent(name)
+
+
+def is_profiler_enabled():
+    return _enabled
+
+
+def start_profiler(state="All", tracer_option="Default", trace_dir=None):
+    """Enable host-event collection; with ``trace_dir`` also start a
+    jax.profiler device trace (the CUPTI/DeviceTracer analogue)."""
+    global _enabled, _trace_dir
+    _enabled = True
+    _trace_dir = trace_dir
+    if trace_dir is not None:
+        import jax
+
+        jax.profiler.start_trace(trace_dir)
+
+
+def stop_profiler(sorted_key=None, profile_path=None):
+    """Disable collection, print the summary table, optionally write it to
+    ``profile_path``, and stop the device trace if one is running."""
+    global _enabled, _trace_dir
+    _enabled = False
+    if _trace_dir is not None:
+        import jax
+
+        jax.profiler.stop_trace()
+        _trace_dir = None
+    report = summary(sorted_key)
+    print(report)
+    if profile_path:
+        with open(profile_path, "w") as f:
+            f.write(report)
+    return report
+
+
+def reset_profiler():
+    _events.clear()
+
+
+def summary(sorted_key=None):
+    """Reference-shaped table: Event Calls Total Min Max Ave Ratio."""
+    total_all = sum(e[1] for e in _events.values()) or 1e-12
+    rows = []
+    for name, (calls, total, mn, mx) in _events.items():
+        rows.append((name, calls, total, mn, mx, total / calls,
+                     total / total_all))
+    if sorted_key in ("total", "calls", "max", "min", "ave"):
+        idx = {"calls": 1, "total": 2, "min": 3, "max": 4, "ave": 5}[sorted_key]
+        rows.sort(key=lambda r: r[idx], reverse=sorted_key != "min")
+    lines = ["------------------------->  Profiling Report  "
+             "<-------------------------", "",
+             "%-40s %8s %12s %12s %12s %12s %8s" % (
+                 "Event", "Calls", "Total(ms)", "Min(ms)", "Max(ms)",
+                 "Ave(ms)", "Ratio")]
+    for name, calls, total, mn, mx, ave, ratio in rows:
+        lines.append("%-40s %8d %12.4f %12.4f %12.4f %12.4f %7.2f%%" % (
+            name[:40], calls, total * 1e3, mn * 1e3, mx * 1e3, ave * 1e3,
+            ratio * 100))
+    return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path=None,
+             tracer_option="Default", trace_dir=None):
+    """Reference ``fluid.profiler.profiler`` context manager."""
+    reset_profiler()
+    start_profiler(state, tracer_option, trace_dir)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+@contextlib.contextmanager
+def cuda_profiler(*a, **kw):
+    """Device traces come from jax.profiler; kept for API parity."""
+    yield
+
+
+npu_profiler = cuda_profiler

@@ -173,12 +173,43 @@ def propagate_lod(ctx, op):
             ctx.env[key] = lengths
 
 
+class EnforceError(RuntimeError):
+    """Op-attributed error (reference PADDLE_ENFORCE + op_call_stack.cc):
+    carries which op failed and where user code created it."""
+
+
+def attribute_op_error(op, exc):
+    """Re-raise ``exc`` wrapped with the op's identity + creation site."""
+    lines = ["op %r failed during lowering: %s: %s"
+             % (op.type, type(exc).__name__, exc)]
+    ins = {k: v for k, v in op.inputs.items() if v}
+    outs = {k: v for k, v in op.outputs.items() if v}
+    lines.append("  inputs: %r  outputs: %r" % (ins, outs))
+    stack = getattr(op, "callstack", None)
+    if stack:
+        lines.append("  created at (most recent user frame first):")
+        lines.extend("    " + s for s in stack)
+    raise EnforceError("\n".join(lines)) from exc
+
+
+def lower_op(ctx, op):
+    """Lower ONE op with error attribution + LoD propagation — the single
+    entry every lowering loop (block, sub-block, replay, pipeline stage)
+    must use so failures name the failing op and its creation site."""
+    try:
+        registry.get(op.type).lower(ctx, op)
+    except EnforceError:
+        raise
+    except Exception as e:  # noqa: B902 — attribute, then re-raise
+        attribute_op_error(op, e)
+    propagate_lod(ctx, op)
+
+
 def lower_block(ctx, block):
     """Run every op's lowering rule in order (the `Executor::RunPreparedContext`
     hot-loop analogue, reference executor.cc:411 — but traced once, compiled
     by XLA, not interpreted per step)."""
     for op in block.ops:
         start = len(ctx.used_keys)
-        registry.get(op.type).lower(ctx, op)
-        propagate_lod(ctx, op)
+        lower_op(ctx, op)
         ctx.op_key_spans[id(op)] = (start, len(ctx.used_keys))

@@ -1,0 +1,159 @@
+"""Inference engine — the reference's AnalysisPredictor stack
+(``paddle/fluid/inference/api/analysis_predictor.h:47``, AnalysisConfig,
+``paddle_pass_builder.cc``) redesigned TPU-first.
+
+The reference pipeline: load -> IR fusion passes (conv+bn, multihead-matmul,
+fc fuses ...) -> param placement -> memory optimize -> NaiveExecutor. On
+TPU, XLA owns the fusion/memory work, so the analysis stage reduces to
+Paddle-semantic rewrites (prune to fetch targets at save time, eval-mode op
+flags, optional bfloat16 weight cast) and the executor stage is a
+compile-cached jit of the whole pruned program — one fused executable
+instead of an op interpreter.
+"""
+
+import numpy as np
+
+from .. import fluid
+
+__all__ = ["Config", "Predictor", "create_predictor", "PredictorPool"]
+
+
+class Config:
+    """AnalysisConfig analogue: where the model lives + which rewrites to
+    apply before compilation."""
+
+    def __init__(self, model_dir=None, prog_file=None, params_file=None):
+        self.model_dir = model_dir
+        self.prog_file = prog_file
+        self.params_file = params_file
+        self._use_bf16 = False
+
+    # -- reference-shaped toggles ------------------------------------------
+    def enable_bf16(self):
+        """Cast float parameters to bfloat16 at load (the TPU analogue of
+        the reference's fp16/TRT precision modes)."""
+        self._use_bf16 = True
+
+    def switch_ir_optim(self, flag=True):
+        pass  # XLA always optimizes; no separate IR pass pipeline to skip
+
+    def disable_glog_info(self):
+        pass
+
+    def enable_memory_optim(self):
+        pass  # XLA owns buffer lifetime
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass  # XLA threadpool is process-global
+
+
+class Predictor:
+    """Loads a saved inference model and serves ``run(feed) -> fetches``
+    through one compile-cached XLA executable per feed signature."""
+
+    def __init__(self, config, _clone_of=None):
+        self._config = config
+        exe = fluid.Executor()
+        if _clone_of is not None:
+            # share the source predictor's weights AND parsed program —
+            # no disk re-read, and scope contents (e.g. bf16-cast weights)
+            # stay exactly as the source serves them
+            self._program = _clone_of._program
+            self._scope = _clone_of._scope
+            self._feed_names = list(_clone_of._feed_names)
+            self._fetch_vars = _clone_of._fetch_vars
+        else:
+            scope = fluid.Scope()
+            with fluid.scope_guard(scope):
+                program, feeds, fetches = fluid.io.load_inference_model(
+                    config.model_dir, exe,
+                    model_filename=config.prog_file,
+                    params_filename=config.params_file)
+            if config._use_bf16:
+                self._cast_params_bf16(scope)
+            self._program = program
+            self._scope = scope
+            self._feed_names = list(feeds)
+            self._fetch_vars = fetches
+        self._exe = exe
+        self._input_data = {}
+
+    def _cast_params_bf16(self, scope):
+        import jax.numpy as jnp
+
+        for name in list(scope.vars):
+            v = scope.vars[name]
+            if hasattr(v, "dtype") and np.dtype(v.dtype) == np.float32:
+                scope.vars[name] = jnp.asarray(v).astype(jnp.bfloat16)
+
+    # -- handle-style API (reference GetInputHandle / ZeroCopyTensor) ------
+    def get_input_names(self):
+        return list(self._feed_names)
+
+    def get_output_names(self):
+        return [v.name if hasattr(v, "name") else str(v)
+                for v in self._fetch_vars]
+
+    def get_input_handle(self, name):
+        return _TensorHandle(self, name, is_input=True)
+
+    def get_output_handle(self, name):
+        return _TensorHandle(self, name, is_input=False)
+
+    # -- run ---------------------------------------------------------------
+    def run(self, feed=None):
+        """feed: {name: ndarray} (or pre-staged via input handles).
+        Returns the fetch values as numpy arrays."""
+        feed = dict(feed or self._input_data)
+        missing = [n for n in self._feed_names if n not in feed]
+        if missing:
+            raise ValueError("missing inference feeds: %r" % missing)
+        with fluid.scope_guard(self._scope):
+            outs = self._exe.run(self._program, feed=feed,
+                                 fetch_list=self._fetch_vars)
+        self._outputs = outs
+        return outs
+
+    def clone(self):
+        """A predictor sharing this one's weights (reference
+        AnalysisPredictor::Clone) — same scope, its own compile cache."""
+        return Predictor(self._config, _clone_of=self)
+
+    @property
+    def program(self):
+        return self._program
+
+
+class _TensorHandle:
+    """ZeroCopyTensor-shaped accessor."""
+
+    def __init__(self, predictor, name, is_input):
+        self._p = predictor
+        self._name = name
+        self._is_input = is_input
+
+    def copy_from_cpu(self, arr):
+        self._p._input_data[self._name] = np.asarray(arr)
+
+    def copy_to_cpu(self):
+        names = self._p.get_output_names()
+        return np.asarray(self._p._outputs[names.index(self._name)])
+
+    def reshape(self, shape):
+        pass  # shapes are taken from the fed array
+
+
+def create_predictor(config):
+    """Reference ``paddle_infer::CreatePredictor``."""
+    return Predictor(config)
+
+
+class PredictorPool:
+    """N predictors sharing one weight scope (reference PredictorPool)."""
+
+    def __init__(self, config, size=1):
+        first = Predictor(config)
+        self._predictors = [first] + [first.clone() for _ in range(size - 1)]
+
+    def retrieve(self, idx):
+        return self._predictors[idx]

@@ -1,0 +1,63 @@
+"""Fake-cluster runner (reference ``test_dist_base.py`` runner scripts):
+trains a small MLP data-parallel across the processes the launcher spawned.
+Prints one line: ``LOSSES <json list>`` — the parent test compares ranks
+against the single-process baseline.
+
+Run via:
+  python -m paddle_tpu.distributed.launch --nproc_per_node 2 --backend cpu \
+      tests/dist_runner_mlp.py
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.distributed import env as dist_env  # noqa: E402
+
+rank, world = dist_env.init_parallel_env(ndev_per_proc=2)
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu.fluid as fluid  # noqa: E402
+from paddle_tpu.fluid import layers, optimizer  # noqa: E402
+
+
+def build(seed=17):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[8], dtype="float32")
+        label = layers.data("label", shape=[1], dtype="int64")
+        h = layers.fc(x, size=16, act="relu")
+        logits = layers.fc(h, size=4)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+        optimizer.SGD(0.1).minimize(loss)
+    return main, startup, loss
+
+
+def main():
+    import jax
+
+    assert jax.process_count() == world, (jax.process_count(), world)
+    main_p, startup, loss = build()
+    compiled = fluid.CompiledProgram(main_p).with_data_parallel(
+        loss_name=loss.name)
+    exe = fluid.Executor()
+    rng = np.random.RandomState(0)
+    # every rank feeds the same GLOBAL batch; device_put shards it over the
+    # global mesh (batch 16 over 4 global devices)
+    feed = {"x": rng.rand(16, 8).astype(np.float32),
+            "label": rng.randint(0, 4, (16, 1)).astype(np.int64)}
+    losses = []
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for _ in range(4):
+            (lv,) = exe.run(compiled, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(lv).ravel()[0]))
+    print("LOSSES " + json.dumps(losses), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,192 @@
+"""Inference predictor + profiler + op-attributed errors — reference
+``inference/api/analysis_predictor.h:47``, ``fluid/profiler.py:228``,
+``framework/op_call_stack.cc``.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import inference
+from paddle_tpu.fluid import layers, optimizer, profiler
+
+
+def _train_and_save(tmpdir, seed=5):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[6], dtype="float32")
+        label = layers.data("label", shape=[1], dtype="int64")
+        h = layers.fc(x, size=16, act="relu")
+        logits = layers.fc(h, size=3)
+        prob = layers.softmax(logits)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+        optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor()
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(8, 6).astype(np.float32),
+            "label": rng.randint(0, 3, (8, 1)).astype(np.int64)}
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(3):
+            exe.run(main, feed=feed, fetch_list=[loss])
+        fluid.io.save_inference_model(str(tmpdir), ["x"], [prob], exe,
+                                      main_program=main)
+        # reference output from the full program (needs both feeds; the
+        # pruned inference program needs only x)
+        expect, _ = exe.run(main, feed=feed, fetch_list=[prob, loss])
+    return np.asarray(expect), feed["x"]
+
+
+def test_predictor_serves_saved_model(tmp_path):
+    expect, xv = _train_and_save(tmp_path)
+    config = inference.Config(str(tmp_path))
+    predictor = inference.create_predictor(config)
+    assert predictor.get_input_names() == ["x"]
+    outs = predictor.run({"x": xv})
+    np.testing.assert_allclose(np.asarray(outs[0]), expect, rtol=1e-5)
+    # handle-style API
+    h = predictor.get_input_handle("x")
+    h.copy_from_cpu(xv)
+    predictor.run()
+    out_name = predictor.get_output_names()[0]
+    np.testing.assert_allclose(
+        predictor.get_output_handle(out_name).copy_to_cpu(), expect,
+        rtol=1e-5)
+
+
+def test_predictor_clone_shares_weights(tmp_path):
+    expect, xv = _train_and_save(tmp_path, seed=6)
+    p1 = inference.Predictor(inference.Config(str(tmp_path)))
+    p2 = p1.clone()
+    assert p2._scope is p1._scope
+    np.testing.assert_allclose(np.asarray(p2.run({"x": xv})[0]),
+                               np.asarray(p1.run({"x": xv})[0]), rtol=1e-6)
+
+
+def test_predictor_bf16_mode(tmp_path):
+    expect, xv = _train_and_save(tmp_path, seed=7)
+    config = inference.Config(str(tmp_path))
+    config.enable_bf16()
+    p = inference.create_predictor(config)
+    out = np.asarray(p.run({"x": xv})[0], np.float32)
+    # bf16 weights: close but not bit-equal
+    np.testing.assert_allclose(out, expect, rtol=0.05, atol=0.02)
+
+
+def test_predictor_pool(tmp_path):
+    _train_and_save(tmp_path, seed=8)
+    pool = inference.PredictorPool(inference.Config(str(tmp_path)), size=3)
+    assert pool.retrieve(0)._scope is pool.retrieve(2)._scope
+
+
+def test_predictor_missing_feed_raises(tmp_path):
+    _train_and_save(tmp_path, seed=9)
+    p = inference.create_predictor(inference.Config(str(tmp_path)))
+    with pytest.raises(ValueError, match="missing inference feeds"):
+        p.run({})
+
+
+def test_profiler_table_and_events(tmp_path, capsys):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 1
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4], dtype="float32")
+        loss = layers.mean(layers.fc(x, size=8))
+        optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor()
+    feed = {"x": np.ones((4, 4), np.float32)}
+    path = str(tmp_path / "profile.txt")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        with profiler.profiler(sorted_key="total", profile_path=path):
+            for _ in range(3):
+                exe.run(main, feed=feed, fetch_list=[loss])
+            with profiler.RecordEvent("my_section"):
+                pass
+    report = open(path).read()
+    assert "Profiling Report" in report
+    assert "executor_run" in report and "my_section" in report
+    # 3 (+1 startup? startup ran outside) executor_run calls recorded
+    line = next(l for l in report.splitlines() if "executor_run" in l)
+    assert " 3 " in line
+
+
+def test_op_attributed_error_names_call_site():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4], dtype="float32")
+        y = layers.data("y", shape=[5], dtype="float32")
+        # shape-incompatible add -> the lowering must fail WITH attribution
+        bad = layers.elementwise_add(x, y)   # <-- creation site
+        loss = layers.mean(bad)
+    exe = fluid.Executor()
+    from paddle_tpu.fluid.registry import EnforceError
+
+    with fluid.scope_guard(fluid.Scope()):
+        with pytest.raises(EnforceError) as ei:
+            exe.run(main, feed={"x": np.ones((2, 4), np.float32),
+                                "y": np.ones((2, 5), np.float32)},
+                    fetch_list=[loss])
+    msg = str(ei.value)
+    assert "elementwise_add" in msg
+    assert "test_inference_profiler.py" in msg  # the user call site
+    assert "created at" in msg
+
+
+def test_callstack_recording_can_be_disabled():
+    fluid.record_op_callstacks(False)
+    try:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", shape=[4], dtype="float32")
+            y = layers.fc(x, size=2)
+        op = main.global_block().ops[-1]
+        assert op.callstack is None
+    finally:
+        fluid.record_op_callstacks(True)
+
+
+def test_predictor_clone_keeps_bf16(tmp_path):
+    """clone() must not reload fp32 weights over the bf16-cast scope."""
+    _train_and_save(tmp_path, seed=10)
+    config = inference.Config(str(tmp_path))
+    config.enable_bf16()
+    p1 = inference.create_predictor(config)
+    p2 = p1.clone()
+    import jax.numpy as jnp
+
+    dtypes = {np.dtype(getattr(v, "dtype", np.float32))
+              for v in p2._scope.vars.values()
+              if hasattr(v, "dtype")}
+    assert np.dtype(jnp.bfloat16) in dtypes, dtypes
+    assert np.float32 not in dtypes
+
+
+def test_sub_block_op_error_attributed():
+    """A failure INSIDE a cond sub-block must name the inner op."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[3], dtype="float32")
+        y = layers.data("y", shape=[4], dtype="float32")
+        pred = layers.less_than(layers.reduce_sum(x), layers.reduce_sum(y))
+
+        def bad_branch():
+            return layers.elementwise_add(x, y)  # shape mismatch
+
+        def ok_branch():
+            return layers.scale(x, scale=2.0)
+
+        out = layers.cond(pred, bad_branch, ok_branch)
+    exe = fluid.Executor()
+    from paddle_tpu.fluid.registry import EnforceError
+
+    with fluid.scope_guard(fluid.Scope()):
+        with pytest.raises(EnforceError) as ei:
+            exe.run(main, feed={"x": np.ones((2, 3), np.float32),
+                                "y": np.ones((2, 4), np.float32)},
+                    fetch_list=[out])
+    assert "elementwise_add" in str(ei.value)

@@ -1,0 +1,77 @@
+"""Multi-process execution — reference ``test_dist_base.py:500``: spawn a
+localhost fake cluster (2 trainer subprocesses, virtual CPU devices + gloo
+collectives), assert losses match the single-process baseline.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RUNNER = os.path.join(REPO, "tests", "dist_runner_mlp.py")
+
+
+def _single_process_baseline():
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers, optimizer
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 17
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[8], dtype="float32")
+        label = layers.data("label", shape=[1], dtype="int64")
+        h = layers.fc(x, size=16, act="relu")
+        logits = layers.fc(h, size=4)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+        optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor()
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(16, 8).astype(np.float32),
+            "label": rng.randint(0, 4, (16, 1)).astype(np.int64)}
+    out = []
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for _ in range(4):
+            (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
+            out.append(float(np.asarray(lv).ravel()[0]))
+    return out
+
+
+def test_two_process_dp_matches_single_process(tmp_path):
+    base = _single_process_baseline()
+
+    env = dict(os.environ)
+    # children must NOT inherit the parent's single-backend pins
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    log_dir = str(tmp_path / "logs")
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--nproc_per_node", "2", "--backend", "cpu",
+           "--log_dir", log_dir, RUNNER]
+    r = subprocess.run(cmd, env=env, cwd=REPO, capture_output=True,
+                       timeout=600)
+    logs = ""
+    for i in range(2):
+        with open(os.path.join(log_dir, "worker.%d.log" % i)) as f:
+            logs += "--- worker %d ---\n%s\n" % (i, f.read())
+    assert r.returncode == 0, logs
+
+    per_rank = re.findall(r"LOSSES (\[.*\])", logs)
+    assert len(per_rank) == 2, logs
+    l0, l1 = json.loads(per_rank[0]), json.loads(per_rank[1])
+    # both ranks observe the same global loss, equal to the baseline
+    np.testing.assert_allclose(l0, l1, rtol=1e-5)
+    np.testing.assert_allclose(l0, base, rtol=1e-4)
+
+
+def test_launch_module_help():
+    r = subprocess.run([sys.executable, "-m",
+                        "paddle_tpu.distributed.launch", "--help"],
+                       capture_output=True, cwd=REPO, timeout=120)
+    assert r.returncode == 0
+    assert b"nproc_per_node" in r.stdout
