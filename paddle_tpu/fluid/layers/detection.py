@@ -1,0 +1,562 @@
+"""Detection layers — reference ``python/paddle/fluid/layers/detection.py``
+(27 public fns). Op semantics live in ``ops/detection_ops.py``; the
+static-shape deviations from the reference's LoD outputs are documented
+there (NMS/proposal outputs are fixed top-N, padded with label -1 / zero
+boxes).
+"""
+
+import numpy as np
+
+from ..layer_helper import LayerHelper
+from . import nn, tensor
+
+__all__ = [
+    "prior_box", "density_prior_box", "multi_box_head", "bipartite_match",
+    "target_assign", "detection_output", "ssd_loss", "rpn_target_assign",
+    "retinanet_target_assign", "sigmoid_focal_loss", "anchor_generator",
+    "roi_perspective_transform", "generate_proposal_labels",
+    "generate_proposals", "generate_mask_labels", "iou_similarity",
+    "box_coder", "polygon_box_transform", "yolov3_loss", "yolo_box",
+    "box_clip", "multiclass_nms", "locality_aware_nms",
+    "retinanet_detection_output", "distribute_fpn_proposals",
+    "box_decoder_and_assign", "collect_fpn_proposals",
+    "roi_align", "roi_pool",
+]
+
+
+def _mk(helper, dtype="float32", shape=None, lod_level=0):
+    v = helper.create_variable_for_type_inference(dtype)
+    if shape is not None:
+        v.shape = tuple(shape)
+    v.lod_level = lod_level
+    return v
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    helper = LayerHelper("iou_similarity", **locals())
+    out = _mk(helper)
+    helper.append_op(type="iou_similarity", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]},
+                     attrs={"box_normalized": box_normalized})
+    return out
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, name=None,
+              min_max_aspect_ratios_order=False):
+    helper = LayerHelper("prior_box", **locals())
+    boxes = _mk(helper)
+    var = _mk(helper)
+    helper.append_op(
+        type="prior_box", inputs={"Input": [input], "Image": [image]},
+        outputs={"Boxes": [boxes], "Variances": [var]},
+        attrs={"min_sizes": [float(s) for s in
+                             (min_sizes if isinstance(min_sizes,
+                                                      (list, tuple))
+                              else [min_sizes])],
+               "max_sizes": [float(s) for s in (max_sizes or [])]
+               if max_sizes else [],
+               "aspect_ratios": [float(a) for a in aspect_ratios],
+               "variances": [float(v) for v in variance],
+               "flip": flip, "clip": clip,
+               "step_w": float(steps[0]), "step_h": float(steps[1]),
+               "offset": float(offset),
+               "min_max_aspect_ratios_order": min_max_aspect_ratios_order})
+    return boxes, var
+
+
+def density_prior_box(input, image, densities=None, fixed_sizes=None,
+                      fixed_ratios=None, variance=(0.1, 0.1, 0.2, 0.2),
+                      clip=False, steps=(0.0, 0.0), offset=0.5,
+                      flatten_to_2d=False, name=None):
+    helper = LayerHelper("density_prior_box", **locals())
+    boxes = _mk(helper)
+    var = _mk(helper)
+    helper.append_op(
+        type="density_prior_box",
+        inputs={"Input": [input], "Image": [image]},
+        outputs={"Boxes": [boxes], "Variances": [var]},
+        attrs={"densities": [int(d) for d in densities or []],
+               "fixed_sizes": [float(s) for s in fixed_sizes or []],
+               "fixed_ratios": [float(r) for r in fixed_ratios or [1.0]],
+               "variances": [float(v) for v in variance],
+               "clip": clip, "step_w": float(steps[0]),
+               "step_h": float(steps[1]), "offset": float(offset)})
+    if flatten_to_2d:
+        boxes = nn.reshape(boxes, [-1, 4])
+        var = nn.reshape(var, [-1, 4])
+    return boxes, var
+
+
+def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
+                     variance=(0.1, 0.1, 0.2, 0.2), stride=None,
+                     offset=0.5, name=None):
+    helper = LayerHelper("anchor_generator", **locals())
+    anchors = _mk(helper)
+    var = _mk(helper)
+    helper.append_op(
+        type="anchor_generator", inputs={"Input": [input]},
+        outputs={"Anchors": [anchors], "Variances": [var]},
+        attrs={"anchor_sizes": [float(s) for s in anchor_sizes],
+               "aspect_ratios": [float(a) for a in aspect_ratios or [1.0]],
+               "variances": [float(v) for v in variance],
+               "stride": [float(s) for s in stride],
+               "offset": float(offset)})
+    return anchors, var
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              name=None, axis=0):
+    helper = LayerHelper("box_coder", **locals())
+    out = _mk(helper)
+    inputs = {"PriorBox": [prior_box], "TargetBox": [target_box]}
+    attrs = {"code_type": code_type, "box_normalized": box_normalized,
+             "axis": int(axis)}
+    if prior_box_var is None:
+        pass
+    elif hasattr(prior_box_var, "name"):
+        inputs["PriorBoxVar"] = [prior_box_var]
+    elif isinstance(prior_box_var, (list, tuple)):
+        # the reference API also accepts a 4-float list; it rides as an attr
+        attrs["variance"] = [float(v) for v in prior_box_var]
+    else:
+        raise TypeError("prior_box_var must be a Variable, a 4-float "
+                        "list/tuple, or None; got %r" % (prior_box_var,))
+    helper.append_op(type="box_coder", inputs=inputs,
+                     outputs={"OutputBox": [out]}, attrs=attrs)
+    return out
+
+
+def box_clip(input, im_info, name=None):
+    helper = LayerHelper("box_clip", **locals())
+    out = _mk(helper, shape=input.shape)
+    helper.append_op(type="box_clip",
+                     inputs={"Input": [input], "ImInfo": [im_info]},
+                     outputs={"Output": [out]})
+    return out
+
+
+def polygon_box_transform(input, name=None):
+    helper = LayerHelper("polygon_box_transform", **locals())
+    out = _mk(helper, shape=input.shape)
+    helper.append_op(type="polygon_box_transform", inputs={"Input": [input]},
+                     outputs={"Output": [out]})
+    return out
+
+
+def bipartite_match(dist_matrix, match_type="bipartite",
+                    dist_threshold=0.5, name=None):
+    helper = LayerHelper("bipartite_match", **locals())
+    idx = _mk(helper, dtype="int32")
+    dist = _mk(helper)
+    helper.append_op(
+        type="bipartite_match", inputs={"DistMat": [dist_matrix]},
+        outputs={"ColToRowMatchIndices": [idx],
+                 "ColToRowMatchDist": [dist]},
+        attrs={"match_type": match_type,
+               "dist_threshold": float(dist_threshold)})
+    return idx, dist
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=0, name=None):
+    helper = LayerHelper("target_assign", **locals())
+    out = _mk(helper)
+    out_w = _mk(helper)
+    helper.append_op(
+        type="target_assign",
+        inputs={"X": [input], "MatchIndices": [matched_indices]},
+        outputs={"Out": [out], "OutWeight": [out_w]},
+        attrs={"mismatch_value": mismatch_value})
+    return out, out_w
+
+
+def sigmoid_focal_loss(x, label, fg_num, gamma=2.0, alpha=0.25):
+    helper = LayerHelper("sigmoid_focal_loss", **locals())
+    out = _mk(helper, shape=x.shape)
+    helper.append_op(
+        type="sigmoid_focal_loss",
+        inputs={"X": [x], "Label": [label], "FgNum": [fg_num]},
+        outputs={"Out": [out]},
+        attrs={"gamma": float(gamma), "alpha": float(alpha)})
+    return out
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None):
+    helper = LayerHelper("yolo_box", **locals())
+    boxes = _mk(helper)
+    scores = _mk(helper)
+    helper.append_op(
+        type="yolo_box", inputs={"X": [x], "ImgSize": [img_size]},
+        outputs={"Boxes": [boxes], "Scores": [scores]},
+        attrs={"anchors": [int(a) for a in anchors],
+               "class_num": int(class_num),
+               "conf_thresh": float(conf_thresh),
+               "downsample_ratio": int(downsample_ratio),
+               "clip_bbox": clip_bbox})
+    return boxes, scores
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=False, name=None):
+    helper = LayerHelper("yolov3_loss", **locals())
+    loss = _mk(helper, shape=(-1,))
+    helper.append_op(
+        type="yolov3_loss",
+        inputs={"X": [x], "GTBox": [gt_box], "GTLabel": [gt_label]},
+        outputs={"Loss": [loss]},
+        attrs={"anchors": [int(a) for a in anchors],
+               "anchor_mask": [int(m) for m in anchor_mask],
+               "class_num": int(class_num),
+               "ignore_thresh": float(ignore_thresh),
+               "downsample_ratio": int(downsample_ratio)})
+    return loss
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                   nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                   background_label=0, return_index=False, name=None):
+    """Fixed-size output [N, keep_top_k, 6] (label, score, box), padded
+    with label -1 (TPU static-shape redesign of the LoD output). With
+    ``return_index`` also returns the [N, keep_top_k] source-box index
+    (-1 on pad rows) — the multiclass_nms2 surface."""
+    helper = LayerHelper("multiclass_nms", **locals())
+    out = _mk(helper)
+    outputs = {"Out": [out]}
+    if return_index:
+        index = _mk(helper, dtype="int32")
+        outputs["Index"] = [index]
+    helper.append_op(
+        type="multiclass_nms2" if return_index else "multiclass_nms",
+        inputs={"BBoxes": [bboxes], "Scores": [scores]},
+        outputs=outputs,
+        attrs={"score_threshold": float(score_threshold),
+               "nms_top_k": int(nms_top_k),
+               "keep_top_k": int(keep_top_k),
+               "nms_threshold": float(nms_threshold),
+               "nms_eta": float(nms_eta),
+               "background_label": int(background_label),
+               "normalized": normalized})
+    if return_index:
+        return out, index
+    return out
+
+
+def locality_aware_nms(bboxes, scores, score_threshold, nms_top_k,
+                       keep_top_k, nms_threshold=0.3, normalized=True,
+                       nms_eta=1.0, background_label=-1, name=None):
+    helper = LayerHelper("locality_aware_nms", **locals())
+    out = _mk(helper)
+    helper.append_op(
+        type="locality_aware_nms",
+        inputs={"BBoxes": [bboxes], "Scores": [scores]},
+        outputs={"Out": [out]},
+        attrs={"score_threshold": float(score_threshold),
+               "nms_top_k": int(nms_top_k), "keep_top_k": int(keep_top_k),
+               "nms_threshold": float(nms_threshold),
+               "nms_eta": float(nms_eta),
+               "background_label": int(background_label),
+               "normalized": normalized})
+    return out
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0,
+                     return_index=False):
+    """Decode + per-class NMS (reference detection.py detection_output).
+    With ``return_index`` returns ``(out, index)`` like the reference."""
+    decoded = box_coder(prior_box, prior_box_var, loc,
+                        code_type="decode_center_size")
+    return multiclass_nms(decoded, scores, score_threshold, nms_top_k,
+                          keep_top_k, nms_threshold,
+                          background_label=background_label,
+                          nms_eta=nms_eta, return_index=return_index)
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, match_type="per_prediction",
+             mining_type="max_negative", normalize=True,
+             sample_size=None):
+    """TPU-native: gt_box/gt_label are DENSE [N, B, 4]/[N, B] (pad with
+    zero-area boxes) instead of LoD; mining is mask-based (see op)."""
+    helper = LayerHelper("ssd_loss", **locals())
+    loss = _mk(helper)
+    inputs = {"Location": [location], "Confidence": [confidence],
+              "GtBox": [gt_box], "GtLabel": [gt_label],
+              "PriorBox": [prior_box]}
+    if prior_box_var is not None:
+        inputs["PriorBoxVar"] = [prior_box_var]
+    helper.append_op(
+        type="ssd_loss", inputs=inputs, outputs={"Loss": [loss]},
+        attrs={"background_label": int(background_label),
+               "overlap_threshold": float(overlap_threshold),
+               "neg_pos_ratio": float(neg_pos_ratio),
+               "loc_loss_weight": float(loc_loss_weight),
+               "conf_loss_weight": float(conf_loss_weight)})
+    return loss
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD heads (reference detection.py multi_box_head): conv loc/conf
+    per feature map + concatenated priors."""
+    if min_sizes is None:
+        # reference ratio interpolation
+        num_layer = len(inputs)
+        min_sizes, max_sizes = [], []
+        if num_layer > 2:
+            step = int((max_ratio - min_ratio) / (num_layer - 2))
+            for ratio in range(min_ratio, max_ratio + 1, step):
+                min_sizes.append(base_size * ratio / 100.0)
+                max_sizes.append(base_size * (ratio + step) / 100.0)
+            min_sizes = [base_size * 0.1] + min_sizes
+            max_sizes = [base_size * 0.2] + max_sizes
+        else:
+            min_sizes = [base_size * 0.1, base_size * 0.2]
+            max_sizes = [base_size * 0.2, base_size * 0.3]
+    locs, confs, boxes_l, vars_l = [], [], [], []
+    for i, feat in enumerate(inputs):
+        ms = min_sizes[i] if isinstance(min_sizes[i], (list, tuple)) \
+            else [min_sizes[i]]
+        mx = None
+        if max_sizes:
+            mx = max_sizes[i] if isinstance(max_sizes[i], (list, tuple)) \
+                else [max_sizes[i]]
+        ar = aspect_ratios[i] if isinstance(
+            aspect_ratios[i], (list, tuple)) else [aspect_ratios[i]]
+        stp = steps[i] if steps else (step_w[i] if step_w else 0.0,
+                                      step_h[i] if step_h else 0.0)
+        if not isinstance(stp, (list, tuple)):
+            stp = (stp, stp)
+        box, var = prior_box(feat, image, ms, mx, ar, variance, flip, clip,
+                             stp, offset,
+                             min_max_aspect_ratios_order=(
+                                 min_max_aspect_ratios_order))
+        n_priors = 1
+        full = 1 + (len([a for a in ar if abs(a - 1.0) > 1e-6]) *
+                    (2 if flip else 1))
+        n_priors = len(ms) * full + (len(mx) if mx else 0)
+        loc = nn.conv2d(feat, n_priors * 4, kernel_size, stride=stride,
+                        padding=pad)
+        conf = nn.conv2d(feat, n_priors * num_classes, kernel_size,
+                         stride=stride, padding=pad)
+        # [N, P*4, H, W] -> [N, H*W*P, 4]
+        loc = nn.transpose(loc, [0, 2, 3, 1])
+        loc = nn.reshape(loc, [0, -1, 4])
+        conf = nn.transpose(conf, [0, 2, 3, 1])
+        conf = nn.reshape(conf, [0, -1, num_classes])
+        locs.append(loc)
+        confs.append(conf)
+        boxes_l.append(nn.reshape(box, [-1, 4]))
+        vars_l.append(nn.reshape(var, [-1, 4]))
+    mbox_locs = tensor.concat(locs, axis=1)
+    mbox_confs = tensor.concat(confs, axis=1)
+    all_boxes = tensor.concat(boxes_l, axis=0)
+    all_vars = tensor.concat(vars_l, axis=0)
+    return mbox_locs, mbox_confs, all_boxes, all_vars
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd=None, im_info=None,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True):
+    helper = LayerHelper("rpn_target_assign", **locals())
+    loc_idx = _mk(helper, dtype="int32")
+    score_idx = _mk(helper, dtype="int32")
+    tgt_lbl = _mk(helper, dtype="int32")
+    tgt_box = _mk(helper)
+    in_w = _mk(helper)
+    helper.append_op(
+        type="rpn_target_assign",
+        inputs={"Anchor": [anchor_box], "GtBoxes": [gt_boxes]},
+        outputs={"LocationIndex": [loc_idx], "ScoreIndex": [score_idx],
+                 "TargetLabel": [tgt_lbl], "TargetBBox": [tgt_box],
+                 "BBoxInsideWeight": [in_w]},
+        attrs={"rpn_batch_size_per_im": int(rpn_batch_size_per_im),
+               "rpn_fg_fraction": float(rpn_fg_fraction),
+               "rpn_positive_overlap": float(rpn_positive_overlap),
+               "rpn_negative_overlap": float(rpn_negative_overlap)})
+    return loc_idx, score_idx, tgt_lbl, tgt_box, in_w
+
+
+def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                            gt_boxes, gt_labels, is_crowd=None,
+                            im_info=None, num_classes=1,
+                            positive_overlap=0.5, negative_overlap=0.4):
+    helper = LayerHelper("retinanet_target_assign", **locals())
+    loc_idx = _mk(helper, dtype="int32")
+    score_idx = _mk(helper, dtype="int32")
+    tgt_lbl = _mk(helper, dtype="int32")
+    tgt_box = _mk(helper)
+    in_w = _mk(helper)
+    fg = _mk(helper, dtype="int32")
+    helper.append_op(
+        type="retinanet_target_assign",
+        inputs={"Anchor": [anchor_box], "GtBoxes": [gt_boxes]},
+        outputs={"LocationIndex": [loc_idx], "ScoreIndex": [score_idx],
+                 "TargetLabel": [tgt_lbl], "TargetBBox": [tgt_box],
+                 "BBoxInsideWeight": [in_w], "ForegroundNumber": [fg]},
+        attrs={"rpn_positive_overlap": float(positive_overlap),
+               "rpn_negative_overlap": float(negative_overlap)})
+    return loc_idx, score_idx, tgt_lbl, tgt_box, in_w, fg
+
+
+def retinanet_detection_output(bboxes, scores, im_info, score_threshold=0.05,
+                               nms_top_k=1000, keep_top_k=100,
+                               nms_threshold=0.3, nms_eta=1.0):
+    """Decode-free variant: inputs are already per-level boxes+scores;
+    concatenate levels, then the shared fixed-size NMS core."""
+    all_b = tensor.concat(bboxes, axis=1) if isinstance(bboxes, (list,
+                                                                 tuple)) \
+        else bboxes
+    all_s = tensor.concat(scores, axis=1) if isinstance(scores, (list,
+                                                                 tuple)) \
+        else scores
+    # scores [N, M, C] -> [N, C, M]
+    all_s = nn.transpose(all_s, [0, 2, 1])
+    return multiclass_nms(all_b, all_s, score_threshold, nms_top_k,
+                          keep_top_k, nms_threshold, nms_eta=nms_eta,
+                          background_label=-1)
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0, name=None,
+                       return_rois_num=False):
+    helper = LayerHelper("generate_proposals", **locals())
+    rois = _mk(helper)
+    probs = _mk(helper)
+    rois_num = _mk(helper, dtype="int32")
+    helper.append_op(
+        type="generate_proposals",
+        inputs={"Scores": [scores], "BboxDeltas": [bbox_deltas],
+                "ImInfo": [im_info], "Anchors": [anchors],
+                "Variances": [variances]},
+        outputs={"RpnRois": [rois], "RpnRoiProbs": [probs],
+                 "RpnRoisNum": [rois_num]},
+        attrs={"pre_nms_topN": int(pre_nms_top_n),
+               "post_nms_topN": int(post_nms_top_n),
+               "nms_thresh": float(nms_thresh),
+               "min_size": float(min_size), "eta": float(eta)})
+    if return_rois_num:
+        return rois, probs, rois_num
+    return rois, probs
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info, batch_size_per_im=256,
+                             fg_fraction=0.25, fg_thresh=0.5,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=None, use_random=True,
+                             is_cls_agnostic=False, is_cascade_rcnn=False):
+    """Static-shape redesign: labels/targets for ALL rois; sampling is
+    expressed by the returned weights (reference samples an index list)."""
+    # DistMat rows are gt, columns are rois (see ops _bipartite_match)
+    iou = iou_similarity(gt_boxes, rpn_rois)
+    idx, dist = bipartite_match(iou, "per_prediction", fg_thresh)
+    labels, lw = target_assign(gt_classes, idx, mismatch_value=0)
+    tgts, tw = target_assign(gt_boxes, idx, mismatch_value=0)
+    return rpn_rois, labels, tgts, tw, lw
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
+                         labels_int32, num_classes, resolution):
+    raise NotImplementedError(
+        "generate_mask_labels needs polygon rasterization; Mask R-CNN "
+        "targets are out of scope for the TPU build (open an issue with "
+        "your use case)")
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0):
+    raise NotImplementedError(
+        "roi_perspective_transform (OCR quad warping) is not implemented "
+        "on TPU; use roi_align for axis-aligned regions")
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, rois_num=None, name=None):
+    helper = LayerHelper("distribute_fpn_proposals", **locals())
+    n = max_level - min_level + 1
+    outs = [_mk(helper) for _ in range(n)]
+    restore = _mk(helper, dtype="int32")
+    helper.append_op(
+        type="distribute_fpn_proposals",
+        inputs={"FpnRois": [fpn_rois]},
+        outputs={"MultiFpnRois": outs, "RestoreIndex": [restore]},
+        attrs={"min_level": int(min_level), "max_level": int(max_level),
+               "refer_level": int(refer_level),
+               "refer_scale": float(refer_scale)})
+    return outs, restore
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, rois_num_per_level=None,
+                          name=None):
+    helper = LayerHelper("collect_fpn_proposals", **locals())
+    out = _mk(helper)
+    helper.append_op(
+        type="collect_fpn_proposals",
+        inputs={"MultiLevelRois": multi_rois,
+                "MultiLevelScores": multi_scores},
+        outputs={"FpnRois": [out]},
+        attrs={"post_nms_topN": int(post_nms_top_n)})
+    return out
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
+                           box_clip_v=None, name=None):
+    helper = LayerHelper("box_decoder_and_assign", **locals())
+    decoded = _mk(helper)
+    assigned = _mk(helper)
+    helper.append_op(
+        type="box_decoder_and_assign",
+        inputs={"PriorBox": [prior_box], "PriorBoxVar": [prior_box_var],
+                "TargetBox": [target_box], "BoxScore": [box_score]},
+        outputs={"DecodeBox": [decoded], "OutputAssignBox": [assigned]})
+    return decoded, assigned
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, rois_num=None,
+              name=None):
+    helper = LayerHelper("roi_align", **locals())
+    out = _mk(helper)
+    inputs = {"X": [input], "ROIs": [rois]}
+    if rois_num is not None:
+        inputs["RoisNum"] = [rois_num]
+    helper.append_op(
+        type="roi_align", inputs=inputs, outputs={"Out": [out]},
+        attrs={"pooled_height": int(pooled_height),
+               "pooled_width": int(pooled_width),
+               "spatial_scale": float(spatial_scale),
+               "sampling_ratio": int(sampling_ratio)})
+    return out
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0, rois_num=None, name=None):
+    helper = LayerHelper("roi_pool", **locals())
+    out = _mk(helper)
+    inputs = {"X": [input], "ROIs": [rois]}
+    if rois_num is not None:
+        inputs["RoisNum"] = [rois_num]
+    helper.append_op(
+        type="roi_pool", inputs=inputs, outputs={"Out": [out]},
+        attrs={"pooled_height": int(pooled_height),
+               "pooled_width": int(pooled_width),
+               "spatial_scale": float(spatial_scale)})
+    return out

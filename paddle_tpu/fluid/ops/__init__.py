@@ -10,6 +10,7 @@ from . import (  # noqa: F401
     collective,
     control_flow,
     creation,
+    detection_ops,
     distributed_ops,
     elementwise,
     loss,
@@ -17,6 +18,7 @@ from . import (  # noqa: F401
     metrics,
     nn,
     optimizer_ops,
+    quant_ops,
     rnn_ops,
     sequence_ops,
     structured_loss_ops,

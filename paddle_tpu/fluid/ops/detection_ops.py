@@ -1,0 +1,1012 @@
+"""Detection op family — reference ``paddle/fluid/operators/detection/``
+(~27 public layer fns, 15.9k LoC of CPU/CUDA kernels).
+
+TPU-native design rules:
+* Every output is FIXED-shape. The reference emits LoD tensors whose size
+  depends on the data (NMS survivors, generated proposals); here selection
+  ops keep a static top-N and pad the tail (label -1 / zero boxes), which
+  is what XLA can compile and what batched TPU serving wants anyway.
+* Suppression loops (NMS, bipartite match) are ``lax`` loops over static
+  bounds — O(N^2) IoU matrices ride the vector units instead of the
+  reference's per-box host loops.
+* roi_align/roi_pool sample with gather + bilinear arithmetic (no atomic
+  scatter like the CUDA backward; autodiff differentiates the gather).
+"""
+
+import numpy as np
+
+from ..registry import register
+
+
+def _iou_matrix(a, b):
+    """[N,4] x [M,4] -> [N,M] IoU (boxes xmin,ymin,xmax,ymax)."""
+    import jax.numpy as jnp
+
+    area_a = jnp.maximum(a[:, 2] - a[:, 0], 0) * \
+        jnp.maximum(a[:, 3] - a[:, 1], 0)
+    area_b = jnp.maximum(b[:, 2] - b[:, 0], 0) * \
+        jnp.maximum(b[:, 3] - b[:, 1], 0)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_a[:, None] + area_b[None, :] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+@register("iou_similarity")
+def _iou_similarity(ctx, op):
+    x = ctx.get_input(op, "X")
+    y = ctx.get_input(op, "Y")
+    ctx.set_output(op, "Out", _iou_matrix(x.reshape(-1, 4),
+                                          y.reshape(-1, 4)))
+
+
+@register("prior_box")
+def _prior_box(ctx, op):
+    """SSD prior boxes (reference prior_box_op.cc): one box per
+    (pixel, aspect_ratio/size) on the feature map, normalized."""
+    import jax.numpy as jnp
+
+    feat = ctx.get_input(op, "Input")    # [N, C, H, W]
+    image = ctx.get_input(op, "Image")   # [N, C, IH, IW]
+    min_sizes = [float(s) for s in op.attr("min_sizes")]
+    max_sizes = [float(s) for s in op.attr("max_sizes", []) or []]
+    ars = [float(a) for a in op.attr("aspect_ratios", [1.0])]
+    variances = [float(v) for v in op.attr("variances",
+                                           [0.1, 0.1, 0.2, 0.2])]
+    flip = bool(op.attr("flip", False))
+    clip = bool(op.attr("clip", False))
+    step_w = float(op.attr("step_w", 0.0))
+    step_h = float(op.attr("step_h", 0.0))
+    offset = float(op.attr("offset", 0.5))
+    min_max_ar_order = bool(op.attr("min_max_aspect_ratios_order", False))
+
+    H, W = int(feat.shape[2]), int(feat.shape[3])
+    IH, IW = int(image.shape[2]), int(image.shape[3])
+    sw = step_w or IW / W
+    sh = step_h or IH / H
+
+    full_ars = [1.0]
+    for ar in ars:
+        if abs(ar - 1.0) < 1e-6:
+            continue
+        full_ars.append(ar)
+        if flip:
+            full_ars.append(1.0 / ar)
+
+    whs = []  # per-prior (w, h) in pixels
+    for si, ms in enumerate(min_sizes):
+        if min_max_ar_order:
+            whs.append((ms, ms))
+            if max_sizes:
+                mx = max_sizes[si]
+                whs.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+            for ar in full_ars[1:]:
+                whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        else:
+            for ar in full_ars:
+                whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+            if max_sizes:
+                mx = max_sizes[si]
+                whs.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+    n_priors = len(whs)
+    wh = jnp.asarray(whs, np.dtype("float32"))  # [P, 2]
+
+    cx = (jnp.arange(W, dtype=np.dtype("float32")) + offset) * sw
+    cy = (jnp.arange(H, dtype=np.dtype("float32")) + offset) * sh
+    cxg, cyg = jnp.meshgrid(cx, cy)            # [H, W]
+    cxg = cxg[..., None]
+    cyg = cyg[..., None]
+    w2 = wh[None, None, :, 0] / 2.0
+    h2 = wh[None, None, :, 1] / 2.0
+    boxes = jnp.stack([(cxg - w2) / IW, (cyg - h2) / IH,
+                       (cxg + w2) / IW, (cyg + h2) / IH],
+                      axis=-1)                  # [H, W, P, 4]
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, np.dtype("float32")),
+                           (H, W, n_priors, 4))
+    ctx.set_output(op, "Boxes", boxes)
+    ctx.set_output(op, "Variances", var)
+
+
+@register("density_prior_box")
+def _density_prior_box(ctx, op):
+    """Density prior boxes (reference density_prior_box_op.cc): each
+    fixed_size gets density^2 shifted boxes per cell."""
+    import jax.numpy as jnp
+
+    feat = ctx.get_input(op, "Input")
+    image = ctx.get_input(op, "Image")
+    fixed_sizes = [float(s) for s in op.attr("fixed_sizes", [])]
+    fixed_ratios = [float(r) for r in op.attr("fixed_ratios", [1.0])]
+    densities = [int(d) for d in op.attr("densities", [])]
+    variances = [float(v) for v in op.attr("variances",
+                                           [0.1, 0.1, 0.2, 0.2])]
+    clip = bool(op.attr("clip", False))
+    step_w = float(op.attr("step_w", 0.0))
+    step_h = float(op.attr("step_h", 0.0))
+    offset = float(op.attr("offset", 0.5))
+    H, W = int(feat.shape[2]), int(feat.shape[3])
+    IH, IW = int(image.shape[2]), int(image.shape[3])
+    sw = step_w or IW / W
+    sh = step_h or IH / H
+
+    shifts = []  # (dx, dy, w, h) per prior, offsets relative to cell center
+    for size, density in zip(fixed_sizes, densities):
+        step = size / density
+        for r in fixed_ratios:
+            bw = size * np.sqrt(r)
+            bh = size / np.sqrt(r)
+            for di in range(density):
+                for dj in range(density):
+                    dx = -size / 2.0 + step / 2.0 + dj * step
+                    dy = -size / 2.0 + step / 2.0 + di * step
+                    shifts.append((dx, dy, bw, bh))
+    P = len(shifts)
+    sh_arr = jnp.asarray(shifts, np.dtype("float32"))
+    cx = (jnp.arange(W, dtype=np.dtype("float32")) + offset) * sw
+    cy = (jnp.arange(H, dtype=np.dtype("float32")) + offset) * sh
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    ctrx = cxg[..., None] + sh_arr[None, None, :, 0]
+    ctry = cyg[..., None] + sh_arr[None, None, :, 1]
+    w2 = sh_arr[None, None, :, 2] / 2.0
+    h2 = sh_arr[None, None, :, 3] / 2.0
+    boxes = jnp.stack([(ctrx - w2) / IW, (ctry - h2) / IH,
+                       (ctrx + w2) / IW, (ctry + h2) / IH], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, np.dtype("float32")),
+                           (H, W, P, 4))
+    ctx.set_output(op, "Boxes", boxes)
+    ctx.set_output(op, "Variances", var)
+
+
+@register("anchor_generator")
+def _anchor_generator(ctx, op):
+    """RPN anchors (reference anchor_generator_op.cc): pixel-space anchors
+    per feature cell from anchor_sizes x aspect_ratios."""
+    import jax.numpy as jnp
+
+    feat = ctx.get_input(op, "Input")
+    sizes = [float(s) for s in op.attr("anchor_sizes")]
+    ars = [float(a) for a in op.attr("aspect_ratios", [1.0])]
+    variances = [float(v) for v in op.attr("variances",
+                                           [0.1, 0.1, 0.2, 0.2])]
+    stride = [float(s) for s in op.attr("stride")]
+    offset = float(op.attr("offset", 0.5))
+    H, W = int(feat.shape[2]), int(feat.shape[3])
+    whs = []
+    for ar in ars:
+        for s in sizes:
+            w = s * np.sqrt(ar)
+            h = s / np.sqrt(ar)
+            whs.append((w, h))
+    A = len(whs)
+    wh = jnp.asarray(whs, np.dtype("float32"))
+    cx = (jnp.arange(W, dtype=np.dtype("float32")) + offset) * stride[0]
+    cy = (jnp.arange(H, dtype=np.dtype("float32")) + offset) * stride[1]
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    w2 = wh[None, None, :, 0] / 2.0
+    h2 = wh[None, None, :, 1] / 2.0
+    anchors = jnp.stack([cxg[..., None] - w2, cyg[..., None] - h2,
+                         cxg[..., None] + w2, cyg[..., None] + h2], axis=-1)
+    var = jnp.broadcast_to(jnp.asarray(variances, np.dtype("float32")),
+                           (H, W, A, 4))
+    ctx.set_output(op, "Anchors", anchors)
+    ctx.set_output(op, "Variances", var)
+
+
+def _rois_num_to_batch_idx(rois_num, R):
+    """RoisNum is the PER-IMAGE RoI count [N]; convert to a per-RoI batch
+    index [R] (roi r belongs to the image whose count window covers r)."""
+    import jax.numpy as jnp
+
+    if rois_num is None:
+        return jnp.zeros((R,), np.dtype("int32"))
+    bounds = jnp.cumsum(rois_num.reshape(-1).astype(np.dtype("int32")))
+    return (jnp.arange(R)[:, None] >= bounds[None, :]).sum(
+        axis=1).astype(np.dtype("int32"))
+
+
+def _decode_center_size(prior, var, target, norm):
+    """box_coder decode_center_size (reference box_coder_op.h)."""
+    import jax.numpy as jnp
+
+    pw = prior[..., 2] - prior[..., 0] + (0.0 if norm else 1.0)
+    ph = prior[..., 3] - prior[..., 1] + (0.0 if norm else 1.0)
+    pcx = prior[..., 0] + pw / 2.0
+    pcy = prior[..., 1] + ph / 2.0
+    tx, ty, tw, th = (target[..., 0], target[..., 1], target[..., 2],
+                      target[..., 3])
+    vx, vy, vw, vh = var[..., 0], var[..., 1], var[..., 2], var[..., 3]
+    cx = vx * tx * pw + pcx
+    cy = vy * ty * ph + pcy
+    w = jnp.exp(vw * tw) * pw
+    h = jnp.exp(vh * th) * ph
+    return jnp.stack([cx - w / 2.0, cy - h / 2.0,
+                      cx + w / 2.0 - (0.0 if norm else 1.0),
+                      cy + h / 2.0 - (0.0 if norm else 1.0)], axis=-1)
+
+
+@register("box_coder")
+def _box_coder(ctx, op):
+    import jax.numpy as jnp
+
+    prior = ctx.get_input(op, "PriorBox").reshape(-1, 4)
+    pvar = ctx.get_input(op, "PriorBoxVar")
+    target = ctx.get_input(op, "TargetBox")
+    code_type = str(op.attr("code_type", "encode_center_size"))
+    norm = bool(op.attr("box_normalized", True))
+    axis = int(op.attr("axis", 0))
+    attr_var = op.attr("variance", [])
+    if pvar is not None:
+        var_arr = pvar.reshape(-1, 4)
+    elif attr_var:
+        var_arr = jnp.asarray([float(v) for v in attr_var],
+                              np.dtype("float32")).reshape(1, 4)
+    else:
+        var_arr = jnp.ones((1, 4), np.dtype("float32"))
+    if "encode" in code_type:
+        # target [M, 4] gt boxes; output [M, N, 4] offsets vs each prior
+        t = target.reshape(-1, 4)
+        pw = prior[:, 2] - prior[:, 0] + (0.0 if norm else 1.0)
+        ph = prior[:, 3] - prior[:, 1] + (0.0 if norm else 1.0)
+        pcx = prior[:, 0] + pw / 2.0
+        pcy = prior[:, 1] + ph / 2.0
+        tw = t[:, 2] - t[:, 0] + (0.0 if norm else 1.0)
+        th = t[:, 3] - t[:, 1] + (0.0 if norm else 1.0)
+        tcx = t[:, 0] + tw / 2.0
+        tcy = t[:, 1] + th / 2.0
+        ex = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        ey = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        ew = jnp.log(jnp.maximum(tw[:, None] / pw[None, :], 1e-10))
+        eh = jnp.log(jnp.maximum(th[:, None] / ph[None, :], 1e-10))
+        out = jnp.stack([ex, ey, ew, eh], axis=-1)
+        out = out / var_arr[None, :, :] if var_arr.shape[0] > 1 else \
+            out / var_arr[None, None, 0]
+    else:
+        # decode: target [N, M, 4]; axis picks which target dim the priors
+        # line up with (axis=0 -> dim 1, the SSD layout; axis=1 -> dim 0)
+        t = target
+        if t.ndim == 2:
+            t = t[None]
+        if axis == 0:
+            p = prior[None, :, :]
+            v = (var_arr[None, :, :] if var_arr.shape[0] > 1
+                 else var_arr[None, None, 0, :])
+        else:
+            p = prior[:, None, :]
+            v = (var_arr[:, None, :] if var_arr.shape[0] > 1
+                 else var_arr[None, None, 0, :])
+        out = _decode_center_size(p, v, t, norm)
+        if target.ndim == 2:
+            out = out[0]
+    ctx.set_output(op, "OutputBox", out)
+
+
+@register("box_clip")
+def _box_clip(ctx, op):
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "Input")
+    im_info = ctx.get_input(op, "ImInfo")  # [N, 3] (h, w, scale)
+    h = im_info[..., 0] - 1.0
+    w = im_info[..., 1] - 1.0
+    # x[..., 0::4] has shape x.shape[:-1] + (k,); the per-image bound must
+    # sit on the leading (batch) axis with singletons everywhere else
+    shape = (-1,) + (1,) * (x.ndim - 1)
+    hx = h.reshape(shape)
+    wx = w.reshape(shape)
+    out = jnp.stack([
+        jnp.clip(x[..., 0::4], 0, wx), jnp.clip(x[..., 1::4], 0, hx),
+        jnp.clip(x[..., 2::4], 0, wx), jnp.clip(x[..., 3::4], 0, hx),
+    ], axis=-1).reshape(x.shape)
+    ctx.set_output(op, "Output", out)
+
+
+@register("polygon_box_transform")
+def _polygon_box_transform(ctx, op):
+    """Quad geometry map -> absolute coords (reference
+    polygon_box_transform_op.cc): out = 4*pixel_coord - offset."""
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "Input")  # [N, 8, H, W]
+    N, C, H, W = x.shape
+    xs = jnp.arange(W, dtype=x.dtype)[None, None, None, :] * 4.0
+    ys = jnp.arange(H, dtype=x.dtype)[None, None, :, None] * 4.0
+    idx = jnp.arange(C) % 2
+    grid = jnp.where(idx[None, :, None, None] == 0, xs, ys)
+    ctx.set_output(op, "Output", grid - x)
+
+
+@register("sigmoid_focal_loss")
+def _sigmoid_focal_loss(ctx, op):
+    """Reference sigmoid_focal_loss_op.cc: per-class focal BCE; label is
+    the 1-based positive class id (0 = background), fg_num normalizes."""
+    import jax
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")              # [N, C]
+    label = ctx.get_input(op, "Label").reshape(-1)  # [N]
+    fg = ctx.get_input(op, "FgNum")
+    gamma = float(op.attr("gamma", 2.0))
+    alpha = float(op.attr("alpha", 0.25))
+    C = x.shape[1]
+    fg = jnp.maximum(fg.reshape(()).astype(x.dtype), 1.0)
+    cls = jnp.arange(1, C + 1, dtype=np.dtype("int32"))[None, :]
+    pos = (label[:, None].astype(np.dtype("int32")) == cls).astype(x.dtype)
+    p = jax.nn.sigmoid(x)
+    ce_pos = -jnp.log(jnp.maximum(p, 1e-10))
+    ce_neg = -jnp.log(jnp.maximum(1 - p, 1e-10))
+    loss = pos * alpha * ((1 - p) ** gamma) * ce_pos + \
+        (1 - pos) * (1 - alpha) * (p ** gamma) * ce_neg
+    ctx.set_output(op, "Out", loss / fg)
+
+
+@register("yolo_box")
+def _yolo_box(ctx, op):
+    """Decode YOLOv3 head to boxes+scores (reference yolo_box_op.cc)."""
+    import jax
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")            # [N, A*(5+C), H, W]
+    img_size = ctx.get_input(op, "ImgSize")  # [N, 2] (h, w)
+    anchors = [int(a) for a in op.attr("anchors")]
+    class_num = int(op.attr("class_num"))
+    conf_thresh = float(op.attr("conf_thresh", 0.01))
+    downsample = int(op.attr("downsample_ratio", 32))
+    clip_bbox = bool(op.attr("clip_bbox", True))
+    N, _, H, W = x.shape
+    A = len(anchors) // 2
+    an = jnp.asarray(anchors, x.dtype).reshape(A, 2)
+    x5 = x.reshape(N, A, 5 + class_num, H, W)
+    tx, ty, tw, th, tconf = (x5[:, :, 0], x5[:, :, 1], x5[:, :, 2],
+                             x5[:, :, 3], x5[:, :, 4])
+    gx = jnp.arange(W, dtype=x.dtype)[None, None, None, :]
+    gy = jnp.arange(H, dtype=x.dtype)[None, None, :, None]
+    input_w = downsample * W
+    input_h = downsample * H
+    imh = img_size[:, 0].astype(x.dtype)[:, None, None, None]
+    imw = img_size[:, 1].astype(x.dtype)[:, None, None, None]
+    cx = (jax.nn.sigmoid(tx) + gx) / W * imw
+    cy = (jax.nn.sigmoid(ty) + gy) / H * imh
+    bw = jnp.exp(tw) * an[None, :, 0, None, None] / input_w * imw
+    bh = jnp.exp(th) * an[None, :, 1, None, None] / input_h * imh
+    x0, y0 = cx - bw / 2.0, cy - bh / 2.0
+    x1, y1 = cx + bw / 2.0, cy + bh / 2.0
+    if clip_bbox:
+        x0 = jnp.clip(x0, 0, imw - 1)
+        y0 = jnp.clip(y0, 0, imh - 1)
+        x1 = jnp.clip(x1, 0, imw - 1)
+        y1 = jnp.clip(y1, 0, imh - 1)
+    boxes = jnp.stack([x0, y0, x1, y1], axis=-1).reshape(N, -1, 4)
+    conf = jax.nn.sigmoid(tconf)
+    probs = jax.nn.sigmoid(x5[:, :, 5:]) * conf[:, :, None]
+    mask = (conf >= conf_thresh).astype(x.dtype)[:, :, None]
+    probs = probs * mask
+    scores = probs.transpose(0, 1, 3, 4, 2).reshape(N, -1, class_num)
+    ctx.set_output(op, "Boxes", boxes)
+    ctx.set_output(op, "Scores", scores)
+
+
+@register("multiclass_nms")
+@register("multiclass_nms2")
+@register("locality_aware_nms")
+def _multiclass_nms(ctx, op):
+    """Per-class NMS with a FIXED keep_top_k output (reference
+    multiclass_nms_op.cc emits an LoD with data-dependent size; here the
+    output is [N, keep_top_k, 6] padded with label -1 rows — the
+    static-shape TPU serving format). locality_aware_nms shares this
+    selection core (its score-fusion step degenerates under static
+    shapes)."""
+    import jax
+    import jax.numpy as jnp
+
+    boxes = ctx.get_input(op, "BBoxes")   # [N, M, 4]
+    scores = ctx.get_input(op, "Scores")  # [N, C, M]
+    bg = int(op.attr("background_label", 0))
+    score_thresh = float(op.attr("score_threshold", 0.0))
+    nms_thresh = float(op.attr("nms_threshold", 0.3))
+    nms_top_k = int(op.attr("nms_top_k", 64))
+    keep_top_k = int(op.attr("keep_top_k", 16))
+    eta = float(op.attr("nms_eta", 1.0))
+    if keep_top_k <= 0:
+        keep_top_k = 16
+    N, C, M = scores.shape
+    nms_top_k = min(nms_top_k if nms_top_k > 0 else M, M)
+
+    def one_class(b, s):
+        # b [M,4], s [M] -> (scores_kept [nms_top_k], idx)
+        top_s, top_i = jax.lax.top_k(s, nms_top_k)
+        cand = b[top_i]
+        iou = _iou_matrix(cand, cand)
+
+        def body(i, keep):
+            # suppress j>i overlapping an earlier kept i
+            sup = (iou[i] > nms_thresh) & keep[i] & \
+                (jnp.arange(nms_top_k) > i)
+            return keep & ~sup
+
+        keep0 = top_s > score_thresh
+        keep = jax.lax.fori_loop(0, nms_top_k, body, keep0)
+        return jnp.where(keep, top_s, -1.0), top_i
+
+    def one_image(b, s):
+        # all classes in one vmapped NMS; the background row is forced to
+        # score -1 so it can never be selected (cheaper than a C-loop that
+        # unrolls the suppression graph per class)
+        ks, ki = jax.vmap(one_class, in_axes=(None, 0))(b, s)  # [C, top_k]
+        lbl = jnp.broadcast_to(
+            jnp.arange(C, dtype=np.dtype("int32"))[:, None], ki.shape)
+        if 0 <= bg < C:
+            ks = ks.at[bg].set(-1.0)
+        all_s = ks.reshape(-1)
+        all_i = ki.reshape(-1)
+        all_l = lbl.reshape(-1)
+        k = min(keep_top_k, all_s.shape[0])
+        fs, fi = jax.lax.top_k(all_s, k)
+        sel = all_i[fi]
+        lab = jnp.where(fs > 0, all_l[fi], -1)
+        idx = jnp.where(fs > 0, sel, -1).astype(np.dtype("int32"))
+        bsel = b[sel]
+        row = jnp.concatenate([
+            lab[:, None].astype(b.dtype), fs[:, None], bsel], axis=1)
+        # pad to keep_top_k
+        if k < keep_top_k:
+            pad = jnp.full((keep_top_k - k, 6), -1.0, b.dtype)
+            row = jnp.concatenate([row, pad], axis=0)
+            idx = jnp.concatenate(
+                [idx, jnp.full((keep_top_k - k,), -1, np.dtype("int32"))])
+        return row, idx
+
+    out, index = jax.vmap(one_image)(boxes, scores)
+    ctx.set_output(op, "Out", out)
+    if op.output("Index"):
+        ctx.set_output(op, "Index", index)
+    if op.output("NmsRoisNum"):
+        valid = (out[:, :, 0] >= 0).sum(axis=1).astype(np.dtype("int32"))
+        ctx.set_output(op, "NmsRoisNum", valid)
+
+
+@register("bipartite_match")
+def _bipartite_match(ctx, op):
+    """Greedy bipartite matching (reference bipartite_match_op.cc): each
+    column (prior) gets at most one row (gt); max-IoU pairs first."""
+    import jax
+    import jax.numpy as jnp
+
+    dist = ctx.get_input(op, "DistMat")   # [M_gt, N_prior] (single image)
+    match_type = str(op.attr("match_type", "bipartite"))
+    overlap_thresh = float(op.attr("dist_threshold", 0.5))
+    M, N = dist.shape
+
+    def body(_, carry):
+        row_match, col_match, d = carry
+        flat = jnp.argmax(d)
+        i, j = flat // N, flat % N
+        ok = d[i, j] > 0
+        row_match = jnp.where(ok, row_match.at[i].set(j), row_match)
+        col_match = jnp.where(ok, col_match.at[j].set(i), col_match)
+        d = jnp.where(ok, d.at[i, :].set(-1.0).at[:, j].set(-1.0), d)
+        return row_match, col_match, d
+
+    init = (jnp.full((M,), -1, np.dtype("int32")),
+            jnp.full((N,), -1, np.dtype("int32")), dist)
+    row_match, col_match, _ = jax.lax.fori_loop(0, min(M, N), body, init)
+    if match_type == "per_prediction":
+        # additionally match any unmatched column whose best gt overlap
+        # exceeds the threshold
+        best_gt = jnp.argmax(dist, axis=0).astype(np.dtype("int32"))
+        best_val = jnp.max(dist, axis=0)
+        extra = (col_match < 0) & (best_val > overlap_thresh)
+        col_match = jnp.where(extra, best_gt, col_match)
+    dmat = jnp.where(col_match >= 0,
+                     dist[jnp.clip(col_match, 0, M - 1),
+                          jnp.arange(N)], 0.0)
+    ctx.set_output(op, "ColToRowMatchIndices", col_match[None, :])
+    ctx.set_output(op, "ColToRowMatchDist", dmat[None, :])
+
+
+@register("target_assign")
+def _target_assign(ctx, op):
+    """Assign per-prior targets from matched gt (reference
+    target_assign_op.cc): out[j] = X[match[j]] where matched, else
+    mismatch_value."""
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")            # [M, K] gt rows (single image)
+    match = ctx.get_input(op, "MatchIndices")  # [1, N]
+    mismatch = op.attr("mismatch_value", 0)
+    m = match.reshape(-1).astype(np.dtype("int32"))
+    x2 = x.reshape(x.shape[0], -1) if x.ndim > 1 else x.reshape(-1, 1)
+    gathered = x2[jnp.clip(m, 0, x2.shape[0] - 1)]
+    matched = (m >= 0)[:, None]
+    out = jnp.where(matched, gathered,
+                    jnp.asarray(mismatch, x2.dtype))
+    ctx.set_output(op, "Out", out[None])
+    ctx.set_output(op, "OutWeight",
+                   matched.astype(np.dtype("float32"))[None])
+
+
+@register("roi_align")
+def _roi_align(ctx, op):
+    """RoIAlign (reference roi_align_op.cc): bilinear sampling on a
+    sampling_ratio x sampling_ratio grid per output bin."""
+    import jax
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")        # [N, C, H, W]
+    rois = ctx.get_input(op, "ROIs")  # [R, 4] (x0,y0,x1,y1) image coords
+    roi_batch = ctx.get_input(op, "RoisNum")
+    ph = int(op.attr("pooled_height", 1))
+    pw = int(op.attr("pooled_width", 1))
+    scale = float(op.attr("spatial_scale", 1.0))
+    ratio = int(op.attr("sampling_ratio", -1))
+    if ratio <= 0:
+        ratio = 2
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    batch_idx = _rois_num_to_batch_idx(roi_batch, R)
+
+    def one_roi(roi, bidx):
+        x0, y0, x1, y1 = roi[0] * scale, roi[1] * scale, roi[2] * scale, \
+            roi[3] * scale
+        rw = jnp.maximum(x1 - x0, 1.0)
+        rh = jnp.maximum(y1 - y0, 1.0)
+        bw = rw / pw
+        bh = rh / ph
+        # sample grid [ph, pw, ratio, ratio]
+        iy = jnp.arange(ph, dtype=x.dtype)[:, None, None, None]
+        ix = jnp.arange(pw, dtype=x.dtype)[None, :, None, None]
+        sy = jnp.arange(ratio, dtype=x.dtype)[None, None, :, None]
+        sx = jnp.arange(ratio, dtype=x.dtype)[None, None, None, :]
+        yy = y0 + iy * bh + (sy + 0.5) * bh / ratio
+        xx = x0 + ix * bw + (sx + 0.5) * bw / ratio
+        yy = jnp.clip(yy, 0.0, H - 1.0)
+        xx = jnp.clip(xx, 0.0, W - 1.0)
+        y0i = jnp.floor(yy).astype(np.dtype("int32"))
+        x0i = jnp.floor(xx).astype(np.dtype("int32"))
+        y1i = jnp.clip(y0i + 1, 0, H - 1)
+        x1i = jnp.clip(x0i + 1, 0, W - 1)
+        ly = yy - y0i
+        lx = xx - x0i
+        img = x[bidx]  # [C, H, W]
+        v00 = img[:, y0i, x0i]
+        v01 = img[:, y0i, x1i]
+        v10 = img[:, y1i, x0i]
+        v11 = img[:, y1i, x1i]
+        val = (v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx +
+               v10 * ly * (1 - lx) + v11 * ly * lx)
+        return val.mean(axis=(-2, -1))  # [C, ph, pw]
+
+    out = jax.vmap(one_roi)(rois.reshape(R, 4), batch_idx)
+    ctx.set_output(op, "Out", out)
+
+
+@register("roi_pool")
+def _roi_pool(ctx, op):
+    """RoI max pooling (reference roi_pool_op.cc)."""
+    import jax
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    rois = ctx.get_input(op, "ROIs")
+    roi_batch = ctx.get_input(op, "RoisNum")
+    ph = int(op.attr("pooled_height", 1))
+    pw = int(op.attr("pooled_width", 1))
+    scale = float(op.attr("spatial_scale", 1.0))
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    batch_idx = _rois_num_to_batch_idx(roi_batch, R)
+
+    def one_roi(roi, bidx):
+        x0 = jnp.round(roi[0] * scale).astype(np.dtype("int32"))
+        y0 = jnp.round(roi[1] * scale).astype(np.dtype("int32"))
+        x1 = jnp.round(roi[2] * scale).astype(np.dtype("int32"))
+        y1 = jnp.round(roi[3] * scale).astype(np.dtype("int32"))
+        rw = jnp.maximum(x1 - x0 + 1, 1)
+        rh = jnp.maximum(y1 - y0 + 1, 1)
+        img = x[bidx]
+        yy = jnp.arange(H)[None, :]
+        xx = jnp.arange(W)[None, :]
+        iy = jnp.arange(ph)[:, None]
+        ix = jnp.arange(pw)[:, None]
+        ys0 = y0 + (iy * rh) // ph
+        ys1 = y0 + ((iy + 1) * rh + ph - 1) // ph
+        xs0 = x0 + (ix * rw) // pw
+        xs1 = x0 + ((ix + 1) * rw + pw - 1) // pw
+        ymask = (yy >= ys0) & (yy < jnp.maximum(ys1, ys0 + 1))  # [ph, H]
+        xmask = (xx >= xs0) & (xx < jnp.maximum(xs1, xs0 + 1))  # [pw, W]
+        neg = jnp.asarray(-3.4e38, x.dtype)
+        masked = jnp.where(ymask[None, :, :, None, None] &
+                           xmask[None, None, None, :, :],
+                           img[:, None, :, None, :], neg)
+        return masked.max(axis=(2, 4))  # [C, ph, pw]
+
+    out = jax.vmap(one_roi)(rois.reshape(R, 4), batch_idx)
+    ctx.set_output(op, "Out", out)
+
+
+@register("generate_proposals")
+def _generate_proposals(ctx, op):
+    """RPN proposal generation (reference generate_proposals_op.cc):
+    decode deltas at anchors, clip, filter small, NMS — FIXED
+    post_nms_topN output (padded with zero boxes)."""
+    import jax
+    import jax.numpy as jnp
+
+    scores = ctx.get_input(op, "Scores")       # [N, A, H, W]
+    deltas = ctx.get_input(op, "BboxDeltas")   # [N, A*4, H, W]
+    im_info = ctx.get_input(op, "ImInfo")      # [N, 3]
+    anchors = ctx.get_input(op, "Anchors").reshape(-1, 4)
+    variances = ctx.get_input(op, "Variances")
+    pre_n = int(op.attr("pre_nms_topN", 6000))
+    post_n = int(op.attr("post_nms_topN", 1000))
+    nms_thresh = float(op.attr("nms_thresh", 0.7))
+    min_size = float(op.attr("min_size", 0.1))
+    var = (variances.reshape(-1, 4) if variances is not None
+           else jnp.ones_like(anchors))
+    N = scores.shape[0]
+    K = anchors.shape[0]
+    sc = scores.transpose(0, 2, 3, 1).reshape(N, -1)
+    dl = deltas.transpose(0, 2, 3, 1).reshape(N, -1, 4)
+    pre_n = min(pre_n if pre_n > 0 else K, K)
+    post_n = min(post_n if post_n > 0 else pre_n, pre_n)
+
+    def one(s, d, info):
+        top_s, top_i = jax.lax.top_k(s, pre_n)
+        a = anchors[top_i]
+        v = var[top_i]
+        boxes = _decode_center_size(a, v, d[top_i], norm=False)
+        h, w = info[0], info[1]
+        boxes = jnp.stack([
+            jnp.clip(boxes[:, 0], 0, w - 1), jnp.clip(boxes[:, 1], 0, h - 1),
+            jnp.clip(boxes[:, 2], 0, w - 1), jnp.clip(boxes[:, 3], 0, h - 1),
+        ], axis=1)
+        ws = boxes[:, 2] - boxes[:, 0] + 1
+        hs = boxes[:, 3] - boxes[:, 1] + 1
+        # reference scales min_size by the image's resize scale im_info[2]
+        ms = min_size * info[2]
+        valid = (ws >= ms) & (hs >= ms)
+        s2 = jnp.where(valid, top_s, -1e10)
+        iou = _iou_matrix(boxes, boxes)
+
+        def body(i, keep):
+            sup = (iou[i] > nms_thresh) & keep[i] & (jnp.arange(pre_n) > i)
+            return keep & ~sup
+
+        keep = jax.lax.fori_loop(0, pre_n, body, s2 > -1e9)
+        s3 = jnp.where(keep, s2, -1e10)
+        fs, fi = jax.lax.top_k(s3, post_n)
+        return boxes[fi], jnp.maximum(fs, 0.0)
+
+    rois, rscores = jax.vmap(one)(sc, dl, im_info)
+    ctx.set_output(op, "RpnRois", rois.reshape(-1, 4))
+    ctx.set_output(op, "RpnRoiProbs", rscores.reshape(-1, 1))
+    if op.output("RpnRoisNum"):
+        ctx.set_output(op, "RpnRoisNum",
+                       jnp.full((N,), post_n, np.dtype("int32")))
+
+
+@register("distribute_fpn_proposals")
+def _distribute_fpn_proposals(ctx, op):
+    """Route each RoI to its FPN level (reference
+    distribute_fpn_proposals_op.cc). Static-shape redesign: every level
+    output keeps ALL R slots; off-level rows are zeroed and the restore
+    index reassembles the original order."""
+    import jax.numpy as jnp
+
+    rois = ctx.get_input(op, "FpnRois").reshape(-1, 4)
+    min_level = int(op.attr("min_level", 2))
+    max_level = int(op.attr("max_level", 5))
+    refer_level = int(op.attr("refer_level", 4))
+    refer_scale = float(op.attr("refer_scale", 224))
+    R = rois.shape[0]
+    ws = rois[:, 2] - rois[:, 0]
+    hs = rois[:, 3] - rois[:, 1]
+    scale = jnp.sqrt(jnp.maximum(ws * hs, 1e-6))
+    lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-6)) + refer_level
+    lvl = jnp.clip(lvl, min_level, max_level).astype(np.dtype("int32"))
+    n_levels = max_level - min_level + 1
+    outs = []
+    for i in range(n_levels):
+        mask = (lvl == (min_level + i)).astype(rois.dtype)[:, None]
+        outs.append(rois * mask)
+    for i, o in enumerate(outs):
+        names = op.output("MultiFpnRois")
+        if i < len(names):
+            ctx.set(names[i], o)
+    ctx.set_output(op, "RestoreIndex",
+                   jnp.arange(R, dtype=np.dtype("int32"))[:, None])
+    if op.output("MultiLevelRoIsNum"):
+        for i, name in enumerate(op.output("MultiLevelRoIsNum")):
+            ctx.set(name, (lvl == (min_level + i)).sum().astype(
+                np.dtype("int32"))[None])
+
+
+@register("collect_fpn_proposals")
+def _collect_fpn_proposals(ctx, op):
+    """Merge per-level RoIs by score, keep post_nms_topN (reference
+    collect_fpn_proposals_op.cc)."""
+    import jax
+    import jax.numpy as jnp
+
+    rois = [ctx.get(n) for n in op.input("MultiLevelRois")]
+    scores = [ctx.get(n).reshape(-1) for n in op.input("MultiLevelScores")]
+    post_n = int(op.attr("post_nms_topN", 100))
+    all_r = jnp.concatenate([r.reshape(-1, 4) for r in rois])
+    all_s = jnp.concatenate(scores)
+    k = min(post_n, all_s.shape[0])
+    top_s, top_i = jax.lax.top_k(all_s, k)
+    ctx.set_output(op, "FpnRois", all_r[top_i])
+    if op.output("RoisNum"):
+        ctx.set_output(op, "RoisNum",
+                       jnp.asarray([k], np.dtype("int32")))
+
+
+@register("box_decoder_and_assign")
+def _box_decoder_and_assign(ctx, op):
+    """Decode per-class deltas and pick the best class's box (reference
+    box_decoder_and_assign_op.cc)."""
+    import jax.numpy as jnp
+
+    prior = ctx.get_input(op, "PriorBox").reshape(-1, 4)
+    pvar = ctx.get_input(op, "PriorBoxVar")
+    target = ctx.get_input(op, "TargetBox")   # [R, C*4]
+    score = ctx.get_input(op, "BoxScore")     # [R, C]
+    R, C4 = target.shape
+    C = C4 // 4
+    var = pvar.reshape(-1, 4) if pvar is not None else jnp.ones((1, 4))
+    t = target.reshape(R, C, 4)
+    decoded = _decode_center_size(
+        prior[:, None, :], var[:, None, :] if var.shape[0] > 1
+        else var[None, :, :], t, norm=False)  # [R, C, 4]
+    best = jnp.argmax(score, axis=1)
+    assigned = decoded[jnp.arange(R), best]
+    ctx.set_output(op, "DecodeBox", decoded.reshape(R, C4))
+    ctx.set_output(op, "OutputAssignBox", assigned)
+
+
+@register("yolov3_loss")
+def _yolov3_loss(ctx, op):
+    """YOLOv3 training loss (reference yolov3_loss_op.cc): each gt box is
+    assigned to its best-IoU anchor shape at its center cell; coordinate
+    (sigmoid/log space), objectness (with ignore_thresh) and class BCE
+    terms. gt rows with zero area are padding and contribute nothing."""
+    import jax
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")              # [N, A*(5+C), H, W]
+    gtbox = ctx.get_input(op, "GTBox")      # [N, B, 4] (cx,cy,w,h, 0..1)
+    gtlabel = ctx.get_input(op, "GTLabel")  # [N, B]
+    anchors = [int(a) for a in op.attr("anchors")]
+    mask_ids = [int(m) for m in op.attr("anchor_mask")]
+    class_num = int(op.attr("class_num"))
+    ignore_thresh = float(op.attr("ignore_thresh", 0.7))
+    downsample = int(op.attr("downsample_ratio", 32))
+    N, _, H, W = x.shape
+    B = gtbox.shape[1]
+    A = len(mask_ids)
+    input_h, input_w = downsample * H, downsample * W
+    all_an = jnp.asarray(anchors, x.dtype).reshape(-1, 2)
+    an = all_an[jnp.asarray(mask_ids)]
+    x5 = x.reshape(N, A, 5 + class_num, H, W)
+    px, py, pw_, ph_, pobj = (x5[:, :, 0], x5[:, :, 1], x5[:, :, 2],
+                              x5[:, :, 3], x5[:, :, 4])
+    pcls = x5[:, :, 5:]  # [N, A, C, H, W]
+
+    # per-gt best anchor (IoU of wh against ALL anchors, centered)
+    gw = gtbox[..., 2] * input_w
+    gh = gtbox[..., 3] * input_h
+    inter = jnp.minimum(gw[..., None], all_an[None, None, :, 0]) * \
+        jnp.minimum(gh[..., None], all_an[None, None, :, 1])
+    union = gw[..., None] * gh[..., None] + \
+        all_an[None, None, :, 0] * all_an[None, None, :, 1] - inter
+    best = jnp.argmax(inter / jnp.maximum(union, 1e-10), axis=-1)  # [N, B]
+    gt_valid = (gtbox[..., 2] * gtbox[..., 3] > 0)
+
+    gi = jnp.clip((gtbox[..., 0] * W).astype(np.dtype("int32")), 0, W - 1)
+    gj = jnp.clip((gtbox[..., 1] * H).astype(np.dtype("int32")), 0, H - 1)
+
+    bce = lambda logit, t: jnp.maximum(logit, 0) - logit * t + \
+        jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+    mask_arr = jnp.asarray(mask_ids)
+
+    def per_image(px_, py_, pw2, ph2, pobj_, pcls_, gt, lab, bst, gi_, gj_,
+                  gv):
+        # positive terms, vectorized over the B gt slots (one gather per
+        # prediction tensor instead of a B-times unrolled graph)
+        in_mask = jnp.any(bst[:, None] == mask_arr[None, :], axis=1)
+        valid = (gv & in_mask).astype(x.dtype)                   # [B]
+        la = jnp.argmax(bst[:, None] == mask_arr[None, :], axis=1)
+        tx = gt[:, 0] * W - gi_
+        ty = gt[:, 1] * H - gj_
+        tw = jnp.log(jnp.maximum(
+            gt[:, 2] * input_w / all_an[bst, 0], 1e-9))
+        th = jnp.log(jnp.maximum(
+            gt[:, 3] * input_h / all_an[bst, 1], 1e-9))
+        scale = 2.0 - gt[:, 2] * gt[:, 3]
+        vx = px_[la, gj_, gi_]                                   # [B]
+        vy = py_[la, gj_, gi_]
+        vw = pw2[la, gj_, gi_]
+        vh = ph2[la, gj_, gi_]
+        l_xy = bce(vx, tx) + bce(vy, ty)
+        l_wh = jnp.abs(vw - tw) + jnp.abs(vh - th)
+        vc = pcls_[la, :, gj_, gi_]                              # [B, C]
+        onehot = (jnp.arange(class_num)[None, :] ==
+                  lab[:, None]).astype(x.dtype)
+        l_cls = jnp.sum(bce(vc, onehot), axis=1)
+        loss = jnp.sum(valid * (scale * (l_xy + l_wh) + l_cls))
+        # scatter-max folds duplicate gt cells exactly like repeated set(1)
+        obj_pos = jnp.zeros((A, H, W), x.dtype).at[la, gj_, gi_].max(valid)
+        obj_target = obj_pos
+        # objectness: positives target 1; negatives with best pred-IoU over
+        # gt above ignore_thresh are ignored
+        boxes_pred = None
+        gx = (jax.nn.sigmoid(px_) +
+              jnp.arange(W, dtype=x.dtype)[None, None, :]) / W
+        gy = (jax.nn.sigmoid(py_) +
+              jnp.arange(H, dtype=x.dtype)[None, :, None]) / H
+        bw = jnp.exp(pw2) * an[:, 0, None, None] / input_w
+        bh = jnp.exp(ph2) * an[:, 1, None, None] / input_h
+        pred = jnp.stack([gx - bw / 2, gy - bh / 2,
+                          gx + bw / 2, gy + bh / 2], axis=-1)  # [A,H,W,4]
+        gt_c = jnp.stack([gt[:, 0] - gt[:, 2] / 2, gt[:, 1] - gt[:, 3] / 2,
+                          gt[:, 0] + gt[:, 2] / 2, gt[:, 1] + gt[:, 3] / 2],
+                         axis=-1)  # [B, 4]
+        iou = _iou_matrix(pred.reshape(-1, 4), gt_c)  # [AHW, B]
+        iou = jnp.where(gv[None, :], iou, 0.0)
+        best_iou = iou.max(axis=1).reshape(A, H, W)
+        ignore = (best_iou > ignore_thresh) & (obj_pos < 0.5)
+        l_obj = bce(pobj_, obj_target)
+        l_obj = jnp.where(ignore, 0.0, l_obj)
+        return loss + jnp.sum(l_obj)
+
+    losses = jax.vmap(per_image)(px, py, pw_, ph_, pobj, pcls, gtbox,
+                                 gtlabel.astype(np.dtype("int32")), best,
+                                 gi, gj, gt_valid)
+    ctx.set_output(op, "Loss", losses)
+
+
+@register("rpn_target_assign")
+@register("retinanet_target_assign")
+def _rpn_target_assign(ctx, op):
+    """Anchor-gt assignment with subsampling (reference
+    rpn_target_assign_op.cc). Static-shape redesign: emits FIXED-size
+    per-anchor label/weight arrays — weights play the role of the
+    reference's sampled index lists (weight 0 = not sampled)."""
+    import jax
+    import jax.numpy as jnp
+
+    anchors = ctx.get_input(op, "Anchor").reshape(-1, 4)
+    gt = ctx.get_input(op, "GtBoxes").reshape(-1, 4)
+    is_retina = op.type == "retinanet_target_assign"
+    pos_thresh = float(op.attr("rpn_positive_overlap",
+                               0.5 if is_retina else 0.7))
+    neg_thresh = float(op.attr("rpn_negative_overlap",
+                               0.4 if is_retina else 0.3))
+    batch_per_im = int(op.attr("rpn_batch_size_per_im", 256))
+    fg_frac = float(op.attr("rpn_fg_fraction", 0.5))
+    K = anchors.shape[0]
+    iou = _iou_matrix(anchors, gt)  # [K, M]
+    gt_valid = (gt[:, 2] > gt[:, 0]) & (gt[:, 3] > gt[:, 1])
+    iou = jnp.where(gt_valid[None, :], iou, 0.0)
+    best_gt = jnp.argmax(iou, axis=1)
+    best_iou = iou.max(axis=1)
+    # anchors that are some gt's argmax are positive too
+    gt_best = jnp.where(gt_valid, iou.max(axis=0), -1.0)
+    is_gt_best = jnp.any(
+        (iou == gt_best[None, :]) & gt_valid[None, :] &
+        (gt_best[None, :] > 0), axis=1)
+    pos = (best_iou >= pos_thresh) | is_gt_best
+    neg = best_iou < neg_thresh
+    labels = jnp.where(pos, 1, jnp.where(neg, 0, -1))
+    # subsample via weights (deterministic: highest-IoU positives, lowest-
+    # IoU negatives first — the reference samples randomly)
+    n_fg = int(batch_per_im * fg_frac)
+    n_bg = batch_per_im - n_fg
+    pos_rank_scores = jnp.where(pos, best_iou, -1.0)
+    _, pos_sel = jax.lax.top_k(pos_rank_scores, min(n_fg, K))
+    neg_rank_scores = jnp.where(neg, 1.0 - best_iou, -1.0)
+    _, neg_sel = jax.lax.top_k(neg_rank_scores, min(n_bg, K))
+    # top_k pads its result with filler indices when fewer than n_fg/n_bg
+    # candidates exist; only ever RAISE a weight so filler slots can't
+    # zero out an anchor selected by the other pass
+    w = jnp.zeros((K,), np.dtype("float32"))
+    w = w.at[pos_sel].max(pos[pos_sel].astype(np.dtype("float32")))
+    w = w.at[neg_sel].max(neg[neg_sel].astype(np.dtype("float32")))
+    tgt = gt[jnp.clip(best_gt, 0, gt.shape[0] - 1)]
+    ctx.set_output(op, "LocationIndex",
+                   jnp.arange(K, dtype=np.dtype("int32")))
+    ctx.set_output(op, "ScoreIndex",
+                   jnp.arange(K, dtype=np.dtype("int32")))
+    ctx.set_output(op, "TargetLabel", labels.astype(np.dtype("int32")))
+    ctx.set_output(op, "TargetBBox", tgt)
+    ctx.set_output(op, "BBoxInsideWeight",
+                   (w * pos.astype(np.dtype("float32")))[:, None] *
+                   jnp.ones((1, 4), np.dtype("float32")))
+    if op.output("ScoreWeight"):
+        ctx.set_output(op, "ScoreWeight", w)
+    if op.output("ForegroundNumber"):
+        ctx.set_output(op, "ForegroundNumber",
+                       jnp.maximum(pos.sum(), 1).astype(
+                           np.dtype("int32"))[None])
+
+
+@register("ssd_loss")
+def _ssd_loss(ctx, op):
+    """SSD multibox loss (reference ssd_loss_op via Python composition):
+    per-prior match to gt (best-IoU + threshold), smooth-L1 localization
+    on positives, softmax confidence with mask-based hard negative mining
+    (rank < neg_pos_ratio * n_pos) — all static shapes; gt rows with zero
+    area are padding."""
+    import jax
+    import jax.numpy as jnp
+
+    loc = ctx.get_input(op, "Location")      # [N, P, 4]
+    conf = ctx.get_input(op, "Confidence")   # [N, P, C]
+    gtbox = ctx.get_input(op, "GtBox")       # [N, B, 4]
+    gtlabel = ctx.get_input(op, "GtLabel")   # [N, B]
+    prior = ctx.get_input(op, "PriorBox").reshape(-1, 4)
+    pvar = ctx.get_input(op, "PriorBoxVar")
+    overlap_thresh = float(op.attr("overlap_threshold", 0.5))
+    neg_ratio = float(op.attr("neg_pos_ratio", 3.0))
+    background = int(op.attr("background_label", 0))
+    loc_w = float(op.attr("loc_loss_weight", 1.0))
+    conf_w = float(op.attr("conf_loss_weight", 1.0))
+    var = pvar.reshape(-1, 4) if pvar is not None else \
+        jnp.asarray([[0.1, 0.1, 0.2, 0.2]], np.dtype("float32"))
+    P = prior.shape[0]
+    C = conf.shape[-1]
+    pw = prior[:, 2] - prior[:, 0]
+    ph = prior[:, 3] - prior[:, 1]
+    pcx = prior[:, 0] + pw / 2
+    pcy = prior[:, 1] + ph / 2
+    v = var if var.shape[0] > 1 else jnp.broadcast_to(var, (P, 4))
+
+    def one(loc_i, conf_i, gt_i, lab_i):
+        valid = (gt_i[:, 2] - gt_i[:, 0]) * (gt_i[:, 3] - gt_i[:, 1]) > 0
+        iou = _iou_matrix(gt_i, prior)             # [B, P]
+        iou = jnp.where(valid[:, None], iou, 0.0)
+        best_gt = jnp.argmax(iou, axis=0)
+        best_iou = iou.max(axis=0)
+        matched = best_iou > overlap_thresh
+        g = gt_i[best_gt]
+        glab = lab_i[best_gt]
+        # encode matched gt against priors
+        gw = jnp.maximum(g[:, 2] - g[:, 0], 1e-6)
+        gh = jnp.maximum(g[:, 3] - g[:, 1], 1e-6)
+        gcx = g[:, 0] + gw / 2
+        gcy = g[:, 1] + gh / 2
+        tx = (gcx - pcx) / pw / v[:, 0]
+        ty = (gcy - pcy) / ph / v[:, 1]
+        tw = jnp.log(gw / pw) / v[:, 2]
+        th = jnp.log(gh / ph) / v[:, 3]
+        t = jnp.stack([tx, ty, tw, th], axis=1)
+        diff = loc_i - t
+        ad = jnp.abs(diff)
+        sl1 = jnp.where(ad < 1.0, 0.5 * ad * ad, ad - 0.5).sum(axis=1)
+        n_pos = jnp.maximum(matched.sum(), 1)
+        l_loc = jnp.sum(jnp.where(matched, sl1, 0.0))
+        # confidence CE: positives -> gt label, negatives -> background
+        tgt = jnp.where(matched, glab.astype(np.dtype("int32")),
+                        background)
+        logp = jax.nn.log_softmax(conf_i, axis=-1)
+        ce = -logp[jnp.arange(P), tgt]
+        # hard negative mining: rank negatives by CE, keep top
+        # neg_ratio * n_pos
+        neg_score = jnp.where(matched, -1e10, ce)
+        order = jnp.argsort(-neg_score)
+        rank = jnp.zeros((P,), np.dtype("int32")).at[order].set(
+            jnp.arange(P, dtype=np.dtype("int32")))
+        keep_neg = (~matched) & (rank < (neg_ratio * n_pos).astype(
+            np.dtype("int32")))
+        l_conf = jnp.sum(jnp.where(matched | keep_neg, ce, 0.0))
+        return (loc_w * l_loc + conf_w * l_conf) / n_pos.astype(loc.dtype)
+
+    losses = jax.vmap(one)(loc, conf, gtbox,
+                           gtlabel.astype(np.dtype("int32")))
+    ctx.set_output(op, "Loss", losses[:, None])

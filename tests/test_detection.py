@@ -1,0 +1,683 @@
+"""Detection op family — reference ``operators/detection/`` +
+``layers/detection.py`` (27 fns), numpy-referenced per SURVEY §4.
+
+Static-shape deviations under test: NMS/proposal outputs are fixed top-N
+padded with label -1 / zero boxes (see ops/detection_ops.py docstring).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+
+def _run(build, feed):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        fetch = build()
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        res = exe.run(main, feed=feed, fetch_list=list(fetch))
+    return [np.asarray(r) for r in res]
+
+
+def _np_iou(a, b):
+    area_a = np.maximum(a[:, 2] - a[:, 0], 0) * np.maximum(
+        a[:, 3] - a[:, 1], 0)
+    area_b = np.maximum(b[:, 2] - b[:, 0], 0) * np.maximum(
+        b[:, 3] - b[:, 1], 0)
+    lt = np.maximum(a[:, None, :2], b[None, :, :2])
+    rb = np.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = np.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / np.maximum(area_a[:, None] + area_b[None, :] - inter,
+                              1e-10)
+
+
+BOXES_A = np.array([[0, 0, 10, 10], [5, 5, 15, 15], [20, 20, 30, 30]],
+                   np.float32)
+BOXES_B = np.array([[0, 0, 10, 10], [6, 6, 14, 14]], np.float32)
+
+
+def test_iou_similarity():
+    (out,) = _run(
+        lambda: [layers.iou_similarity(
+            layers.data("a", [4], append_batch_size=False, dtype="float32"),
+            layers.data("b", [4], append_batch_size=False,
+                        dtype="float32"))],
+        {"a": BOXES_A, "b": BOXES_B})
+    np.testing.assert_allclose(out, _np_iou(BOXES_A, BOXES_B), rtol=1e-5)
+
+
+def test_prior_box_shapes_and_values():
+    feat = np.zeros((1, 8, 2, 2), np.float32)
+    img = np.zeros((1, 3, 32, 32), np.float32)
+
+    def build():
+        f = layers.data("f", feat.shape, append_batch_size=False)
+        im = layers.data("im", img.shape, append_batch_size=False)
+        b, v = layers.prior_box(f, im, min_sizes=[4.0], max_sizes=[8.0],
+                                aspect_ratios=[2.0], flip=True, clip=True)
+        return [b, v]
+
+    b, v = _run(build, {"f": feat, "im": img})
+    # priors per cell: ar=1 + ar=2 + ar=1/2 + max-size = 4
+    assert b.shape == (2, 2, 4, 4)
+    assert v.shape == (2, 2, 4, 4)
+    # cell (0,0): center at offset 0.5 * step 16 = (8, 8); ar=1 min_size 4
+    np.testing.assert_allclose(
+        b[0, 0, 0], [(8 - 2) / 32, (8 - 2) / 32, (8 + 2) / 32, (8 + 2) / 32],
+        rtol=1e-5)
+    assert (b >= 0).all() and (b <= 1).all()
+    np.testing.assert_allclose(v[0, 0, 0], [0.1, 0.1, 0.2, 0.2], rtol=1e-6)
+
+
+def test_density_prior_box():
+    feat = np.zeros((1, 8, 2, 2), np.float32)
+    img = np.zeros((1, 3, 32, 32), np.float32)
+
+    def build():
+        f = layers.data("f", feat.shape, append_batch_size=False)
+        im = layers.data("im", img.shape, append_batch_size=False)
+        b, v = layers.density_prior_box(
+            f, im, densities=[2], fixed_sizes=[4.0], fixed_ratios=[1.0])
+        return [b, v]
+
+    b, v = _run(build, {"f": feat, "im": img})
+    assert b.shape == (2, 2, 4, 4)  # density^2 = 4 priors
+
+
+def test_anchor_generator():
+    feat = np.zeros((1, 8, 2, 3), np.float32)
+
+    def build():
+        f = layers.data("f", feat.shape, append_batch_size=False)
+        a, v = layers.anchor_generator(f, anchor_sizes=[32.0, 64.0],
+                                       aspect_ratios=[1.0],
+                                       stride=[16.0, 16.0])
+        return [a, v]
+
+    a, v = _run(build, {"f": feat})
+    assert a.shape == (2, 3, 2, 4)
+    # first cell center (0.5*16, 0.5*16) = (8, 8), size 32 -> [-8,-8,24,24]
+    np.testing.assert_allclose(a[0, 0, 0], [-8, -8, 24, 24], rtol=1e-5)
+
+
+def test_box_coder_decode_matches_numpy():
+    prior = np.array([[0, 0, 10, 10], [10, 10, 30, 30]], np.float32)
+    pvar = np.tile(np.array([[0.1, 0.1, 0.2, 0.2]], np.float32), (2, 1))
+    target = np.array([[[0.5, 0.5, 0.1, 0.1], [-0.2, 0.3, 0.0, -0.1]]],
+                      np.float32)  # [1, 2, 4]
+
+    def build():
+        p = layers.data("p", prior.shape, append_batch_size=False)
+        v = layers.data("v", pvar.shape, append_batch_size=False)
+        t = layers.data("t", target.shape, append_batch_size=False)
+        return [layers.box_coder(p, v, t, code_type="decode_center_size")]
+
+    (out,) = _run(build, {"p": prior, "v": pvar, "t": target})
+    pw = prior[:, 2] - prior[:, 0]
+    ph = prior[:, 3] - prior[:, 1]
+    pcx = prior[:, 0] + pw / 2
+    pcy = prior[:, 1] + ph / 2
+    t = target[0]
+    cx = pvar[:, 0] * t[:, 0] * pw + pcx
+    cy = pvar[:, 1] * t[:, 1] * ph + pcy
+    w = np.exp(pvar[:, 2] * t[:, 2]) * pw
+    h = np.exp(pvar[:, 3] * t[:, 3]) * ph
+    ref = np.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=1)
+    np.testing.assert_allclose(out[0], ref, rtol=1e-4)
+
+
+def test_box_coder_encode_roundtrip():
+    """decode(encode(gt)) == gt."""
+    prior = np.array([[0, 0, 10, 10]], np.float32)
+    gt = np.array([[2, 2, 8, 9]], np.float32)
+
+    def build():
+        p = layers.data("p", prior.shape, append_batch_size=False)
+        g = layers.data("g", gt.shape, append_batch_size=False)
+        enc = layers.box_coder(p, None, g, code_type="encode_center_size")
+        dec = layers.box_coder(p, None, enc,
+                               code_type="decode_center_size")
+        return [enc, dec]
+
+    enc, dec = _run(build, {"p": prior, "g": gt})
+    np.testing.assert_allclose(dec.reshape(-1, 4), gt, rtol=1e-4, atol=1e-4)
+
+
+def test_box_clip():
+    x = np.array([[[-5, -5, 40, 40], [2, 2, 8, 8]]], np.float32)
+    im_info = np.array([[20, 30, 1.0]], np.float32)
+
+    def build():
+        b = layers.data("b", x.shape, append_batch_size=False)
+        info = layers.data("i", im_info.shape, append_batch_size=False)
+        return [layers.box_clip(b, info)]
+
+    (out,) = _run(build, {"b": x, "i": im_info})
+    np.testing.assert_allclose(out[0, 0], [0, 0, 29, 19], rtol=1e-6)
+    np.testing.assert_allclose(out[0, 1], [2, 2, 8, 8], rtol=1e-6)
+
+
+def test_bipartite_match():
+    dist = np.array([[0.9, 0.1, 0.3],
+                     [0.2, 0.8, 0.4]], np.float32)  # 2 gt x 3 priors
+
+    def build():
+        d = layers.data("d", dist.shape, append_batch_size=False)
+        idx, dv = layers.bipartite_match(d)
+        return [idx, dv]
+
+    idx, dv = _run(build, {"d": dist})
+    np.testing.assert_array_equal(idx[0], [0, 1, -1])
+    np.testing.assert_allclose(dv[0], [0.9, 0.8, 0.0], rtol=1e-6)
+
+
+def test_bipartite_match_per_prediction():
+    dist = np.array([[0.9, 0.1, 0.7],
+                     [0.2, 0.8, 0.6]], np.float32)
+
+    def build():
+        d = layers.data("d", dist.shape, append_batch_size=False)
+        idx, dv = layers.bipartite_match(d, "per_prediction", 0.5)
+        return [idx, dv]
+
+    idx, dv = _run(build, {"d": dist})
+    # col 2 unmatched by greedy but best gt 0 has 0.7 > 0.5
+    np.testing.assert_array_equal(idx[0], [0, 1, 0])
+
+
+def test_target_assign():
+    gt = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    match = np.array([[0, -1, 1]], np.int32)
+
+    def build():
+        g = layers.data("g", gt.shape, append_batch_size=False)
+        m = layers.data("m", match.shape, append_batch_size=False,
+                        dtype="int32")
+        out, w = layers.target_assign(g, m, mismatch_value=0)
+        return [out, w]
+
+    out, w = _run(build, {"g": gt, "m": match})
+    np.testing.assert_allclose(out[0], [[1, 2], [0, 0], [3, 4]], rtol=1e-6)
+    np.testing.assert_allclose(w[0], [[1], [0], [1]], rtol=1e-6)
+
+
+def test_sigmoid_focal_loss_matches_numpy():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 3).astype(np.float32)
+    label = np.array([[1], [0], [3], [2]], np.int32)  # 1-based; 0 = bg
+    fg = np.array([2], np.int32)
+
+    def build():
+        xv = layers.data("x", x.shape, append_batch_size=False)
+        lv = layers.data("l", label.shape, append_batch_size=False,
+                         dtype="int32")
+        fv = layers.data("f", fg.shape, append_batch_size=False,
+                         dtype="int32")
+        return [layers.sigmoid_focal_loss(xv, lv, fv)]
+
+    (out,) = _run(build, {"x": x, "l": label, "f": fg})
+    p = 1 / (1 + np.exp(-x))
+    pos = (label.reshape(-1, 1) == np.arange(1, 4)[None, :])
+    gamma, alpha = 2.0, 0.25
+    loss = np.where(pos, alpha * (1 - p) ** gamma * -np.log(p),
+                    (1 - alpha) * p ** gamma * -np.log(1 - p)) / 2.0
+    np.testing.assert_allclose(out, loss, rtol=1e-4, atol=1e-6)
+
+
+def test_yolo_box_decodes_centers():
+    N, A, C, H, W = 1, 1, 2, 2, 2
+    x = np.zeros((N, A * (5 + C), H, W), np.float32)
+    x[:, 4] = 10.0  # conf sigmoid ~1
+    img_size = np.array([[64, 64]], np.int32)
+
+    def build():
+        xv = layers.data("x", x.shape, append_batch_size=False)
+        sv = layers.data("s", img_size.shape, append_batch_size=False,
+                         dtype="int32")
+        b, s = layers.yolo_box(xv, sv, anchors=[16, 16], class_num=C,
+                               conf_thresh=0.5, downsample_ratio=32)
+        return [b, s]
+
+    b, s = _run(build, {"x": x, "s": img_size})
+    assert b.shape == (1, A * H * W, 4)
+    # tx=ty=0 -> sigmoid 0.5; cell (0,0) center = 0.5/2*64 = 16
+    # bw = exp(0)*16/64*64 = 16
+    np.testing.assert_allclose(b[0, 0], [8, 8, 24, 24], rtol=1e-5)
+    assert s.shape == (1, A * H * W, C)
+
+
+def test_multiclass_nms_suppresses_and_pads():
+    # 3 boxes, 2 heavily overlap; 2 classes (class 0 = background)
+    boxes = np.array([[[0, 0, 10, 10], [1, 1, 10.5, 10.5],
+                       [20, 20, 30, 30]]], np.float32)
+    scores = np.array([[[0.0, 0.0, 0.0],      # background scores
+                        [0.9, 0.8, 0.6]]], np.float32)  # class 1 scores
+
+    def build():
+        b = layers.data("b", boxes.shape, append_batch_size=False)
+        s = layers.data("s", scores.shape, append_batch_size=False)
+        return [layers.multiclass_nms(b, s, score_threshold=0.1,
+                                      nms_top_k=3, keep_top_k=3,
+                                      nms_threshold=0.5)]
+
+    (out,) = _run(build, {"b": boxes, "s": scores})
+    assert out.shape == (1, 3, 6)
+    labels = out[0, :, 0]
+    kept = labels >= 0
+    assert kept.sum() == 2  # the overlapping pair collapsed
+    np.testing.assert_allclose(out[0, 0, 1], 0.9, rtol=1e-5)
+    np.testing.assert_allclose(out[0, 0, 2:], [0, 0, 10, 10], rtol=1e-5)
+    assert (out[0, ~kept, 0] == -1).all()  # pad rows
+
+
+def test_detection_output_runs():
+    P = 4
+    prior = np.array([[0, 0, .2, .2], [.2, .2, .4, .4],
+                      [.4, .4, .6, .6], [.6, .6, .8, .8]], np.float32)
+    pvar = np.tile(np.array([[.1, .1, .2, .2]], np.float32), (P, 1))
+    loc = np.zeros((1, P, 4), np.float32)
+    scores = np.random.RandomState(1).rand(1, 2, P).astype(np.float32)
+
+    def build():
+        p = layers.data("p", prior.shape, append_batch_size=False)
+        v = layers.data("v", pvar.shape, append_batch_size=False)
+        lo = layers.data("lo", loc.shape, append_batch_size=False)
+        s = layers.data("s", scores.shape, append_batch_size=False)
+        return [layers.detection_output(lo, s, p, v, keep_top_k=4)]
+
+    (out,) = _run(build, {"p": prior, "v": pvar, "lo": loc, "s": scores})
+    assert out.shape == (1, 4, 6)
+
+
+def test_roi_align_uniform_map():
+    """On a constant feature map every RoI bin pools that constant."""
+    x = np.full((1, 3, 8, 8), 7.0, np.float32)
+    rois = np.array([[0, 0, 4, 4], [2, 2, 6, 6]], np.float32)
+
+    def build():
+        xv = layers.data("x", x.shape, append_batch_size=False)
+        r = layers.data("r", rois.shape, append_batch_size=False)
+        return [layers.roi_align(xv, r, pooled_height=2, pooled_width=2,
+                                 spatial_scale=1.0, sampling_ratio=2)]
+
+    (out,) = _run(build, {"x": x, "r": rois})
+    assert out.shape == (2, 3, 2, 2)
+    np.testing.assert_allclose(out, 7.0, rtol=1e-6)
+
+
+def test_roi_align_gradient_flows():
+    """RoIAlign backprops through the bilinear gather into a parameter."""
+    x = np.arange(64, dtype=np.float32).reshape(1, 1, 8, 8)
+    rois = np.array([[1, 1, 5, 5]], np.float32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = layers.data("x", x.shape, append_batch_size=False)
+        w = layers.create_parameter([1], "float32", name="w_roi",
+                                    default_initializer=fluid.initializer.
+                                    ConstantInitializer(1.0))
+        r = layers.data("r", rois.shape, append_batch_size=False)
+        out = layers.roi_align(xv * w, r, 2, 2, 1.0, 2)
+        loss = layers.reduce_sum(out)
+        grads = fluid.backward.append_backward(loss)
+    gmap = {p.name: g for p, g in grads}
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (g,) = exe.run(main, feed={"x": x, "r": rois},
+                       fetch_list=[gmap["w_roi"]])
+    # d(sum(roi_align(w*x)))/dw = sum(roi_align(x)) -- nonzero on this map
+    assert np.asarray(g)[0] > 0
+
+def test_roi_pool_max():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    rois = np.array([[0, 0, 3, 3]], np.float32)
+
+    def build():
+        xv = layers.data("x", x.shape, append_batch_size=False)
+        r = layers.data("r", rois.shape, append_batch_size=False)
+        return [layers.roi_pool(xv, r, pooled_height=2, pooled_width=2)]
+
+    (out,) = _run(build, {"x": x, "r": rois})
+    np.testing.assert_allclose(out[0, 0], [[5, 7], [13, 15]], rtol=1e-6)
+
+
+def test_generate_proposals_shapes():
+    N, A, H, W = 1, 2, 4, 4
+    rng = np.random.RandomState(2)
+    scores = rng.rand(N, A, H, W).astype(np.float32)
+    deltas = (rng.randn(N, A * 4, H, W) * 0.1).astype(np.float32)
+    im_info = np.array([[64, 64, 1.0]], np.float32)
+    anchors = rng.rand(H, W, A, 4).astype(np.float32) * 32
+    anchors[..., 2:] += 33  # ensure x1>x0, y1>y0 and min-size pass
+    variances = np.full((H, W, A, 4), 1.0, np.float32)
+
+    def build():
+        s = layers.data("s", scores.shape, append_batch_size=False)
+        d = layers.data("d", deltas.shape, append_batch_size=False)
+        i = layers.data("i", im_info.shape, append_batch_size=False)
+        a = layers.data("a", anchors.shape, append_batch_size=False)
+        v = layers.data("v", variances.shape, append_batch_size=False)
+        rois, probs = layers.generate_proposals(
+            s, d, i, a, v, pre_nms_top_n=16, post_nms_top_n=8,
+            nms_thresh=0.7, min_size=1.0)
+        return [rois, probs]
+
+    rois, probs = _run(build, {"s": scores, "d": deltas, "i": im_info,
+                               "a": anchors, "v": variances})
+    assert rois.shape == (8, 4)
+    assert probs.shape == (8, 1)
+    assert (rois[:, 2] >= rois[:, 0]).all()
+
+
+def test_rpn_target_assign_labels():
+    anchors = np.array([[0, 0, 10, 10], [0, 0, 1, 1], [20, 20, 30, 30]],
+                       np.float32)
+    gt = np.array([[0, 0, 10, 10]], np.float32)
+
+    def build():
+        a = layers.data("a", anchors.shape, append_batch_size=False)
+        g = layers.data("g", gt.shape, append_batch_size=False)
+        res = layers.rpn_target_assign(None, None, a, None, g)
+        return [res[2], res[3]]
+
+    lbl, tgt = _run(build, {"a": anchors, "g": gt})
+    assert lbl[0] == 1          # perfect overlap -> positive
+    assert lbl[1] in (0, -1)    # tiny overlap -> negative/ignored
+    assert lbl[2] == 0          # no overlap -> negative
+    np.testing.assert_allclose(tgt[0], gt[0], rtol=1e-6)
+
+
+def test_ssd_loss_positive_matching_lowers_loss():
+    """Perfect localization must have lower loss than bad localization."""
+    P = 2
+    prior = np.array([[0, 0, .5, .5], [.5, .5, 1, 1]], np.float32)
+    gt = np.array([[[0, 0, .5, .5]]], np.float32)  # matches prior 0
+    lab = np.array([[1]], np.int32)
+    conf_good = np.array([[[0., 5.], [5., 0.]]], np.float32)
+    loc_zero = np.zeros((1, P, 4), np.float32)  # encoded target is 0 here
+
+    def build():
+        lo = layers.data("lo", loc_zero.shape, append_batch_size=False)
+        c = layers.data("c", conf_good.shape, append_batch_size=False)
+        g = layers.data("g", gt.shape, append_batch_size=False)
+        lv = layers.data("lv", lab.shape, append_batch_size=False,
+                         dtype="int32")
+        p = layers.data("p", prior.shape, append_batch_size=False)
+        return [layers.ssd_loss(lo, c, g, lv, p)]
+
+    (good,) = _run(build, {"lo": loc_zero, "c": conf_good, "g": gt,
+                           "lv": lab, "p": prior})
+    bad_loc = np.full((1, P, 4), 3.0, np.float32)
+    (bad,) = _run(build, {"lo": bad_loc, "c": conf_good, "g": gt,
+                          "lv": lab, "p": prior})
+    assert good[0] < bad[0]
+
+
+def test_yolov3_loss_zero_gt_ignored():
+    """All-padding gt must give a loss driven only by objectness negatives,
+    and a confident empty prediction should beat a confident full one."""
+    N, A, C, H, W = 1, 3, 2, 2, 2
+    x_quiet = np.zeros((N, A * (5 + C), H, W), np.float32)
+    x_quiet.reshape(N, A, 5 + C, H, W)[:, :, 4] = -10.0  # low objectness
+    x_loud = x_quiet.copy()
+    x_loud.reshape(N, A, 5 + C, H, W)[:, :, 4] = 10.0
+    gt = np.zeros((N, 2, 4), np.float32)
+    lab = np.zeros((N, 2), np.int32)
+
+    def build():
+        xv = layers.data("x", x_quiet.shape, append_batch_size=False)
+        g = layers.data("g", gt.shape, append_batch_size=False)
+        lv = layers.data("l", lab.shape, append_batch_size=False,
+                         dtype="int32")
+        return [layers.yolov3_loss(xv, g, lv,
+                                   anchors=[10, 13, 16, 30, 33, 23],
+                                   anchor_mask=[0, 1, 2], class_num=C,
+                                   ignore_thresh=0.7, downsample_ratio=32)]
+
+    (quiet,) = _run(build, {"x": x_quiet, "g": gt, "l": lab})
+    (loud,) = _run(build, {"x": x_loud, "g": gt, "l": lab})
+    assert quiet[0] < loud[0]
+
+
+def test_distribute_and_collect_fpn_proposals():
+    rois = np.array([[0, 0, 20, 20],      # small -> low level
+                     [0, 0, 500, 500]], np.float32)  # large -> high level
+    scores = np.array([0.9, 0.8], np.float32)
+
+    def build():
+        r = layers.data("r", rois.shape, append_batch_size=False)
+        s = layers.data("s", scores.shape, append_batch_size=False)
+        outs, restore = layers.distribute_fpn_proposals(r, 2, 5, 4, 224)
+        merged = layers.collect_fpn_proposals(
+            list(outs), [s, s, s, s], 2, 5, post_nms_top_n=2)
+        return list(outs) + [restore, merged]
+
+    res = _run(build, {"r": rois, "s": scores})
+    lv2, lv3, lv4, lv5, restore, merged = res
+    np.testing.assert_allclose(lv2[0], rois[0], rtol=1e-6)  # small at lvl2
+    np.testing.assert_allclose(lv2[1], 0.0)                  # zeroed slot
+    np.testing.assert_allclose(lv5[1], rois[1], rtol=1e-6)  # big at lvl5
+    assert merged.shape == (2, 4)
+
+
+def test_box_decoder_and_assign():
+    prior = np.array([[0, 0, 10, 10]], np.float32)
+    pvar = np.array([[1, 1, 1, 1]], np.float32)
+    target = np.zeros((1, 8), np.float32)  # 2 classes x 4
+    score = np.array([[0.2, 0.8]], np.float32)
+
+    def build():
+        p = layers.data("p", prior.shape, append_batch_size=False)
+        v = layers.data("v", pvar.shape, append_batch_size=False)
+        t = layers.data("t", target.shape, append_batch_size=False)
+        s = layers.data("s", score.shape, append_batch_size=False)
+        d, a = layers.box_decoder_and_assign(p, v, t, s)
+        return [d, a]
+
+    d, a = _run(build, {"p": prior, "v": pvar, "t": target, "s": score})
+    assert d.shape == (1, 8)
+    # zero deltas decode back to the prior (pixel convention: pw = 11,
+    # cx = 5.5, x1 = cx + pw/2 - 1 = 10)
+    np.testing.assert_allclose(a[0], [0, 0, 10, 10], rtol=1e-5)
+
+
+def test_polygon_box_transform():
+    x = np.ones((1, 8, 2, 2), np.float32)
+
+    def build():
+        xv = layers.data("x", x.shape, append_batch_size=False)
+        return [layers.polygon_box_transform(xv)]
+
+    (out,) = _run(build, {"x": x})
+    # channel 0 is an x-coordinate: out = 4*j - x
+    np.testing.assert_allclose(out[0, 0], [[-1, 3], [-1, 3]], rtol=1e-6)
+    # channel 1 is a y-coordinate: out = 4*i - x
+    np.testing.assert_allclose(out[0, 1], [[-1, -1], [3, 3]], rtol=1e-6)
+
+
+def test_multi_box_head_shapes():
+    img = np.zeros((2, 3, 32, 32), np.float32)
+    f1 = np.zeros((2, 8, 4, 4), np.float32)
+    f2 = np.zeros((2, 8, 2, 2), np.float32)
+
+    def build():
+        im = layers.data("im", img.shape, append_batch_size=False)
+        a = layers.data("f1", f1.shape, append_batch_size=False)
+        b = layers.data("f2", f2.shape, append_batch_size=False)
+        locs, confs, boxes, vars_ = layers.multi_box_head(
+            [a, b], im, base_size=32, num_classes=3,
+            aspect_ratios=[[2.0], [2.0]], min_ratio=20, max_ratio=90,
+            flip=True)
+        return [locs, confs, boxes, vars_]
+
+    locs, confs, boxes, vars_ = _run(build, {"im": img, "f1": f1, "f2": f2})
+    n_priors_per_cell = 1 + 2 + 1  # ar1 + (ar2, flip) + max size
+    total = (16 + 4) * n_priors_per_cell
+    assert locs.shape == (2, total, 4)
+    assert confs.shape == (2, total, 3)
+    assert boxes.shape == (total, 4)
+    assert vars_.shape == (total, 4)
+
+
+def test_retinanet_detection_output_runs():
+    b1 = np.random.RandomState(3).rand(1, 4, 4).astype(np.float32) * 10
+    b1[..., 2:] += 10
+    s1 = np.random.RandomState(4).rand(1, 4, 3).astype(np.float32)
+
+    def build():
+        b = layers.data("b", b1.shape, append_batch_size=False)
+        s = layers.data("s", s1.shape, append_batch_size=False)
+        im = layers.data("im", [1, 3], append_batch_size=False)
+        return [layers.retinanet_detection_output(
+            [b], [s], im, keep_top_k=4)]
+
+    (out,) = _run(build, {"b": b1, "s": s1,
+                          "im": np.array([[32, 32, 1]], np.float32)})
+    assert out.shape == (1, 4, 6)
+
+
+def test_box_clip_batched():
+    """Per-image bounds must broadcast over the box axis (N=2, M=3)."""
+    x = np.tile(np.array([[[-5, -5, 40, 40], [2, 2, 8, 8],
+                           [0, 0, 100, 100]]], np.float32), (2, 1, 1))
+    im_info = np.array([[20, 30, 1.0], [50, 60, 1.0]], np.float32)
+
+    def build():
+        b = layers.data("b", x.shape, append_batch_size=False)
+        info = layers.data("i", im_info.shape, append_batch_size=False)
+        return [layers.box_clip(b, info)]
+
+    (out,) = _run(build, {"b": x, "i": im_info})
+    np.testing.assert_allclose(out[0, 0], [0, 0, 29, 19], rtol=1e-6)
+    np.testing.assert_allclose(out[1, 0], [0, 0, 40, 40], rtol=1e-6)
+    np.testing.assert_allclose(out[1, 2], [0, 0, 59, 49], rtol=1e-6)
+
+
+def test_roi_align_rois_num_is_per_image_count():
+    """RoisNum [N] holds counts; roi r maps to the covering image."""
+    x = np.stack([np.full((1, 4, 4), 1.0, np.float32),
+                  np.full((1, 4, 4), 9.0, np.float32)])  # [2, 1, 4, 4]
+    rois = np.array([[0, 0, 3, 3], [0, 0, 2, 2], [1, 1, 3, 3]], np.float32)
+    counts = np.array([2, 1], np.int32)  # rois 0-1 -> img 0, roi 2 -> img 1
+
+    def build():
+        xv = layers.data("x", x.shape, append_batch_size=False)
+        r = layers.data("r", rois.shape, append_batch_size=False)
+        n = layers.data("n", counts.shape, append_batch_size=False,
+                        dtype="int32")
+        return [layers.roi_align(xv, r, 1, 1, 1.0, 2, rois_num=n)]
+
+    (out,) = _run(build, {"x": x, "r": rois, "n": counts})
+    np.testing.assert_allclose(out[:, 0, 0, 0], [1.0, 1.0, 9.0], rtol=1e-6)
+
+
+def test_rpn_target_assign_positive_weight_survives_bg_fill():
+    """When there are fewer negatives than the bg quota, top_k filler
+    indices must not zero out a positive anchor's sampling weight."""
+    anchors = np.array([[0, 0, 10, 10], [0, 0, 1, 1], [20, 20, 30, 30]],
+                       np.float32)
+    gt = np.array([[0, 0, 10, 10]], np.float32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = layers.data("a", anchors.shape, append_batch_size=False)
+        g = layers.data("g", gt.shape, append_batch_size=False)
+        helper = fluid.layer_helper.LayerHelper("rpn_target_assign")
+        outs = {k: helper.create_variable_for_type_inference(
+            "int32" if k in ("LocationIndex", "ScoreIndex", "TargetLabel")
+            else "float32") for k in
+            ("LocationIndex", "ScoreIndex", "TargetLabel", "TargetBBox",
+             "BBoxInsideWeight", "ScoreWeight")}
+        helper.append_op(
+            type="rpn_target_assign",
+            inputs={"Anchor": [a], "GtBoxes": [g]},
+            outputs={k: [v] for k, v in outs.items()},
+            attrs={"rpn_batch_size_per_im": 256, "rpn_fg_fraction": 0.5,
+                   "rpn_positive_overlap": 0.7,
+                   "rpn_negative_overlap": 0.3})
+        fetch = [outs["ScoreWeight"], outs["BBoxInsideWeight"]]
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        sw, bw = [np.asarray(r) for r in
+                  exe.run(main, feed={"a": anchors, "g": gt},
+                          fetch_list=fetch)]
+    assert sw[0] == 1.0          # the positive anchor stays sampled
+    np.testing.assert_allclose(bw[0], 1.0, rtol=1e-6)
+
+
+def test_box_coder_list_variance():
+    """A 4-float list prior_box_var must ride through as the variance."""
+    prior = np.array([[0, 0, 10, 10]], np.float32)
+    target = np.array([[[1.0, 0.0, 0.0, 0.0]]], np.float32)
+
+    def build():
+        p = layers.data("p", prior.shape, append_batch_size=False)
+        t = layers.data("t", target.shape, append_batch_size=False)
+        return [layers.box_coder(p, [0.1, 0.1, 0.2, 0.2], t,
+                                 code_type="decode_center_size")]
+
+    (out,) = _run(build, {"p": prior, "t": target})
+    # cx = 0.1 * 1.0 * 10 + 5 = 6 (not 15 as with variance 1.0)
+    np.testing.assert_allclose(out[0, 0, 0], 6 - 5, rtol=1e-5)  # x0 = cx-w/2
+
+
+def test_box_coder_axis1():
+    """axis=1: priors align with target dim 0 (one prior per row)."""
+    prior = np.array([[0, 0, 10, 10], [0, 0, 20, 20]], np.float32)
+    target = np.zeros((2, 3, 4), np.float32)  # M=3 != N=2
+
+    def build():
+        p = layers.data("p", prior.shape, append_batch_size=False)
+        t = layers.data("t", target.shape, append_batch_size=False)
+        return [layers.box_coder(p, None, t,
+                                 code_type="decode_center_size", axis=1)]
+
+    (out,) = _run(build, {"p": prior, "t": target})
+    assert out.shape == (2, 3, 4)
+    np.testing.assert_allclose(out[0, 0], [0, 0, 10, 10], rtol=1e-5)
+    np.testing.assert_allclose(out[1, 0], [0, 0, 20, 20], rtol=1e-5)
+
+
+def test_multiclass_nms_return_index():
+    boxes = np.array([[[0, 0, 10, 10], [20, 20, 30, 30]]], np.float32)
+    scores = np.array([[[0.0, 0.0], [0.3, 0.9]]], np.float32)
+
+    def build():
+        b = layers.data("b", boxes.shape, append_batch_size=False)
+        s = layers.data("s", scores.shape, append_batch_size=False)
+        out, idx = layers.multiclass_nms(b, s, 0.1, 2, 2,
+                                         return_index=True)
+        return [out, idx]
+
+    out, idx = _run(build, {"b": boxes, "s": scores})
+    assert idx.shape == (1, 2)
+    assert idx[0, 0] == 1  # highest score is box 1
+    assert idx[0, 1] == 0
+
+
+def test_generate_proposal_labels_per_roi():
+    """Labels/targets must be per-ROI (not per-gt)."""
+    rois = np.array([[0, 0, 10, 10], [0, 0, 2, 2], [20, 20, 30, 30],
+                     [21, 21, 29, 29]], np.float32)
+    gt_boxes = np.array([[0, 0, 10, 10], [20, 20, 30, 30]], np.float32)
+    gt_classes = np.array([[3.0], [5.0]], np.float32)
+    im_info = np.array([[40, 40, 1.0]], np.float32)
+
+    def build():
+        r = layers.data("r", rois.shape, append_batch_size=False)
+        g = layers.data("g", gt_boxes.shape, append_batch_size=False)
+        c = layers.data("c", gt_classes.shape, append_batch_size=False)
+        i = layers.data("i", im_info.shape, append_batch_size=False)
+        res = layers.generate_proposal_labels(r, c, None, g, i)
+        return [res[1], res[2]]
+
+    labels, tgts = _run(build, {"r": rois, "g": gt_boxes, "c": gt_classes,
+                                "i": im_info})
+    assert labels.shape[1] == rois.shape[0]   # one label per roi
+    assert labels[0, 0, 0] == 3.0             # roi 0 matches gt 0
+    assert labels[0, 2, 0] == 5.0             # roi 2 matches gt 1
