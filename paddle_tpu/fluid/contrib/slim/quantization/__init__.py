@@ -1,0 +1,11 @@
+from .quantization_pass import (AddQuantDequantPass, ConvertToInt8Pass,
+                                QuantizationFreezePass,
+                                QuantizationTransformPass,
+                                ScaleForInferencePass, ScaleForTrainingPass)
+from .post_training_quantization import PostTrainingQuantization
+
+__all__ = [
+    "QuantizationTransformPass", "QuantizationFreezePass",
+    "ConvertToInt8Pass", "AddQuantDequantPass", "ScaleForTrainingPass",
+    "ScaleForInferencePass", "PostTrainingQuantization",
+]

@@ -1,0 +1,219 @@
+"""Post-training quantization — reference
+``contrib/slim/quantization/post_training_quantization.py`` (~520 LoC,
+KL/abs_max calibration over sample batches, then transform+freeze).
+
+Flow (same capability, Program-native):
+1. load (or take) an inference program,
+2. run calibration batches fetching every quantizable op's input/output
+   activations, accumulating per-var statistics on host,
+3. pick per-tensor scales (``abs_max`` | ``avg`` | ``min_max`` | ``KL``
+   — KL is the TensorRT-style histogram divergence sweep),
+4. seed the scale scope vars and apply QuantizationTransformPass
+   (is_test) + QuantizationFreezePass,
+5. ``save_quantized_model``.
+"""
+
+import numpy as np
+
+from .... import io
+from ....executor import global_scope
+from .quantization_pass import (_QUANT_SLOTS, QuantizationFreezePass,
+                                QuantizationTransformPass)
+
+__all__ = ["PostTrainingQuantization"]
+
+
+def _kl_threshold(hist, bin_width, bits=8):
+    """TensorRT-style KL calibration: find the clip bin minimizing
+    KL(P||Q) between the fp distribution P and its int-``bits``
+    quantization Q."""
+    target = 1 << (bits - 1)  # 128 quant bins for int8
+    hist = hist.astype(np.float64)
+    n = len(hist)
+    if n <= target:
+        return n * bin_width
+    best_i, best_kl = n, np.inf
+    for i in range(target, n + 1):
+        ref = hist[:i].copy()
+        ref[i - 1] += hist[i:].sum()  # clip outliers into the last bin
+        p = ref / max(ref.sum(), 1e-12)
+        # quantize the CLIPPED candidate down to `target` buckets, then
+        # expand (Q must carry the absorbed outlier mass P carries)
+        chunk = i / target
+        q = np.zeros(i)
+        for b in range(target):
+            lo, hi = int(np.floor(b * chunk)), int(np.ceil((b + 1) * chunk))
+            hi = min(hi, i)
+            seg = ref[lo:hi]
+            nz = seg > 0
+            if nz.any():
+                q[lo:hi][nz] = seg[nz].sum() / nz.sum()
+        q = q / max(q.sum(), 1e-12)
+        mask = p > 0
+        kl = float(np.sum(p[mask] * np.log(p[mask] /
+                                           np.maximum(q[mask], 1e-12))))
+        if kl < best_kl:
+            best_kl, best_i = kl, i
+    return (best_i + 0.5) * bin_width
+
+
+class PostTrainingQuantization:
+    def __init__(self, executor, sample_generator=None, model_dir=None,
+                 model_filename=None, params_filename=None, program=None,
+                 feed_list=None, fetch_list=None, batch_size=10,
+                 batch_nums=None, scope=None, algo="KL", hist_bins=2048,
+                 quantizable_op_type=("conv2d", "depthwise_conv2d", "mul"),
+                 weight_bits=8, activation_bits=8,
+                 weight_quantize_type="channel_wise_abs_max",
+                 is_use_cache_file=False, cache_dir=None):
+        if algo not in ("KL", "abs_max", "min_max", "avg"):
+            raise ValueError("algo must be KL|abs_max|min_max|avg, got %r"
+                             % (algo,))
+        self._exe = executor
+        self._scope = scope if scope is not None else global_scope()
+        self._algo = algo
+        self._bins = int(hist_bins)
+        self._batch_nums = batch_nums
+        self._batch_size = batch_size
+        self._sample_generator = sample_generator
+        self._types = tuple(quantizable_op_type)
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._weight_type = weight_quantize_type
+        if program is not None:
+            self._program = program
+            self._feed_names = list(feed_list or [])
+            self._fetch = list(fetch_list or [])
+        else:
+            self._program, self._feed_names, self._fetch = \
+                io.load_inference_model(model_dir, executor,
+                                        model_filename=model_filename,
+                                        params_filename=params_filename)
+        self._scales = {}
+
+    # -- calibration --------------------------------------------------------
+
+    def _observed_vars(self):
+        block = self._program.global_block()
+        names = []
+        for op in block.ops:
+            if op.type in self._types:
+                slots, out_slot = _QUANT_SLOTS[op.type]
+                for s in slots:
+                    for n in op.input(s):
+                        v = block._find_var_recursive(n)
+                        if v is not None and not v.persistable:
+                            names.append(n)
+                names.extend(op.output(out_slot))
+        seen, uniq = set(), []
+        for n in names:
+            if n not in seen:
+                seen.add(n)
+                uniq.append(n)
+        return uniq
+
+    def _batches(self):
+        assert self._sample_generator is not None, \
+            "PostTrainingQuantization needs sample_generator for calibration"
+        batch, count = [], 0
+        for sample in self._sample_generator():
+            batch.append(sample)
+            if len(batch) == self._batch_size:
+                yield batch
+                count += 1
+                if self._batch_nums and count >= self._batch_nums:
+                    return
+                batch = []
+        if batch:
+            yield batch
+            count += 1
+        if count == 0:
+            raise ValueError(
+                "sample_generator yielded no calibration samples")
+
+    def _feed_dict(self, batch):
+        cols = list(zip(*batch)) if isinstance(batch[0], (tuple, list)) \
+            else [batch]
+        return {name: np.stack([np.asarray(c) for c in col])
+                for name, col in zip(self._feed_names, cols)}
+
+    def _collect(self):
+        observed = self._observed_vars()
+        absmax = {n: 0.0 for n in observed}
+        per_batch = {n: [] for n in observed}
+        lo = {n: np.inf for n in observed}
+        hi = {n: -np.inf for n in observed}
+        feeds = []  # retained only for KL's second (histogram) pass
+        for batch in self._batches():
+            feed = self._feed_dict(batch)
+            if self._algo == "KL":
+                feeds.append(feed)
+            vals = self._exe.run(self._program, feed=feed,
+                                 fetch_list=observed)
+            for n, v in zip(observed, vals):
+                a = np.asarray(v)
+                absmax[n] = max(absmax[n], float(np.abs(a).max()))
+                per_batch[n].append(float(np.abs(a).max()))
+                lo[n] = min(lo[n], float(a.min()))
+                hi[n] = max(hi[n], float(a.max()))
+        if self._algo == "abs_max":
+            self._scales = dict(absmax)
+        elif self._algo == "avg":
+            self._scales = {n: float(np.mean(v)) for n, v in
+                            per_batch.items()}
+        elif self._algo == "min_max":
+            self._scales = {n: max(abs(lo[n]), abs(hi[n]))
+                            for n in observed}
+        else:  # KL: second pass builds histograms against the abs max
+            hists = {n: np.zeros(self._bins, np.int64) for n in observed}
+            for feed in feeds:
+                vals = self._exe.run(self._program, feed=feed,
+                                     fetch_list=observed)
+                for n, v in zip(observed, vals):
+                    a = np.abs(np.asarray(v)).ravel()
+                    h, _ = np.histogram(a, bins=self._bins,
+                                        range=(0.0, max(absmax[n], 1e-9)))
+                    hists[n] += h
+            self._scales = {
+                n: _kl_threshold(hists[n], absmax[n] / self._bins,
+                                 self._abits)
+                for n in observed}
+        self._scales = {n: max(s, 1e-9) for n, s in self._scales.items()}
+
+    # -- the driver ----------------------------------------------------------
+
+    def quantize(self):
+        self._collect()
+        scope = self._scope
+        # seed activation scale vars, then transform in is_test mode so the
+        # fake ops read them; freeze folds weights + records thresholds
+        transform = QuantizationTransformPass(
+            scope=scope, weight_bits=self._wbits,
+            activation_bits=self._abits,
+            activation_quantize_type="moving_average_abs_max",
+            weight_quantize_type=self._weight_type,
+            quantizable_op_type=self._types, is_test=True)
+        transform.apply(self._program)
+        for n, s in self._scales.items():
+            scope.set_var(n + ".quant_scale",
+                          np.asarray([s], np.float32))
+        freeze = QuantizationFreezePass(
+            scope=scope, weight_bits=self._wbits,
+            activation_bits=self._abits,
+            weight_quantize_type=self._weight_type,
+            quantizable_op_type=self._types)
+        freeze.apply(self._program)
+        # out_threshold for every quantized op output
+        block = self._program.global_block()
+        for op in block.ops:
+            if op.type in self._types:
+                out = op.output(_QUANT_SLOTS[op.type][1])[0]
+                if out in self._scales:
+                    op.attrs["out_threshold"] = float(self._scales[out])
+        return self._program
+
+    def save_quantized_model(self, save_model_path):
+        io.save_inference_model(save_model_path, self._feed_names,
+                                self._fetch, self._exe,
+                                main_program=self._program)
+        return save_model_path

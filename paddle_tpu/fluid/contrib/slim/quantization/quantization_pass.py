@@ -1,0 +1,460 @@
+"""Quantization-aware-training program rewrites — reference
+``contrib/slim/quantization/quantization_pass.py`` (QuantizationTransformPass
+:90, QuantizationFreezePass :630, ConvertToInt8Pass :940,
+AddQuantDequantPass :1233, Scale passes :1084/:1191).
+
+TPU-first redesign: the reference rewrites an ``IrGraph`` over C++ OpDesc
+nodes and registers grad kernels for every fake-quant op. Here the passes
+rewrite the Program's op list directly (same machinery as the AMP pass,
+``mixed_precision/fp16_utils.py``) and the fake-quant lowerings carry
+straight-through gradients internally (``ops/quant_ops.py``), so the
+``autodiff`` replay differentiates the quantized forward with zero extra
+pass work — transform composes with ``minimize`` in either order.
+
+Scale/accumulator state lives in persistable scope vars threaded through
+the compiled step exactly like optimizer accumulators (buffer-donated,
+updated in-graph).
+"""
+
+import numpy as np
+
+from .... import framework
+from ....executor import global_scope
+
+__all__ = [
+    "QuantizationTransformPass", "QuantizationFreezePass",
+    "ConvertToInt8Pass", "AddQuantDequantPass", "ScaleForTrainingPass",
+    "ScaleForInferencePass",
+]
+
+# op type -> (activation/weight input slots, output slot) actually quantized
+_QUANT_SLOTS = {
+    "conv2d": (["Input", "Filter"], "Output"),
+    "depthwise_conv2d": (["Input", "Filter"], "Output"),
+    "mul": (["X", "Y"], "Out"),
+    "matmul": (["X", "Y"], "Out"),
+}
+
+_ACT_TYPES = ("abs_max", "range_abs_max", "moving_average_abs_max")
+_WEIGHT_TYPES = ("abs_max", "channel_wise_abs_max")
+
+
+def _scope_init(scope, name, value, dtype="float32"):
+    if scope is not None and scope.find_var(name) is None:
+        scope.set_var(name, np.asarray(value, np.dtype(dtype)).reshape(-1))
+
+
+def _mkvar(block, name, shape, dtype="float32", persistable=False):
+    v = block._find_var_recursive(name)
+    if v is None:
+        v = block.create_var(name=name, shape=list(shape), dtype=dtype,
+                             persistable=persistable, stop_gradient=False)
+    return v
+
+
+class _QuantInserter:
+    """Shared fake-quant insertion machinery; dedups per (var, config)."""
+
+    def __init__(self, scope, weight_bits, activation_bits, moving_rate,
+                 window_size):
+        self._scope = scope
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._rate = moving_rate
+        self._window = window_size
+        self._cache = {}
+
+    def insert(self, block, new_ops, name, kind, is_test=False,
+               quant_axis=0):
+        """Quant-dequant var ``name``; returns the rewired name."""
+        key = (name, kind, quant_axis)
+        if key in self._cache:
+            return self._cache[key]
+        src = block._find_var_recursive(name)
+        bits = self._wbits if kind == "channel_wise_abs_max" else self._abits
+        out_name = name + ".quantized.dequantized"
+        out = _mkvar(block, out_name, src.shape, src.dtype)
+        out.stop_gradient = bool(getattr(src, "stop_gradient", False))
+        scale_name = name + ".quant_scale"
+        inputs = {"X": [name]}
+        outputs = {"Out": [out_name]}
+        attrs = {"bit_length": bits, "is_test": is_test}
+
+        if kind == "abs_max":
+            op_type = "fake_quantize_dequantize_abs_max"
+            _mkvar(block, scale_name, [1], persistable=True)
+            outputs["OutScale"] = [scale_name]
+        elif kind == "channel_wise_abs_max":
+            op_type = "fake_channel_wise_quantize_dequantize_abs_max"
+            axis = quant_axis % len(src.shape)
+            _mkvar(block, scale_name, [src.shape[axis]], persistable=True)
+            outputs["OutScale"] = [scale_name]
+            attrs["quant_axis"] = axis
+        elif kind == "moving_average_abs_max":
+            op_type = "fake_quantize_dequantize_moving_average_abs_max"
+            accum, state = name + ".quant_accum", name + ".quant_state"
+            for n, init in ((scale_name, 0.001), (accum, 0.001),
+                            (state, 1.0)):
+                _mkvar(block, n, [1], persistable=True)
+                _scope_init(self._scope, n, [init])
+            inputs.update({"InScale": [scale_name], "InAccum": [accum],
+                           "InState": [state]})
+            outputs.update({"OutScale": [scale_name], "OutAccum": [accum],
+                            "OutState": [state]})
+            attrs["moving_rate"] = self._rate
+        elif kind == "range_abs_max":
+            op_type = "fake_quantize_range_abs_max"
+            it = name + ".quant_iter"
+            _mkvar(block, scale_name, [1], persistable=True)
+            _scope_init(self._scope, scale_name, [0.001])
+            _mkvar(block, it, [1], dtype="int32", persistable=True)
+            _scope_init(self._scope, it, [0], dtype="int32")
+            inputs.update({"InScale": [scale_name], "Iter": [it]})
+            outputs.update({"OutScale": [scale_name], "OutIter": [it]})
+            attrs["window_size"] = self._window
+        else:
+            raise ValueError("unknown quantize type %r" % (kind,))
+
+        new_ops.append(framework.Operator(block, op_type, inputs, outputs,
+                                          attrs))
+        self._cache[key] = out_name
+        return out_name
+
+
+class QuantizationTransformPass:
+    """Insert fake quant-dequant on the inputs (activations + weights) of
+    quantizable ops, for quantization-aware training."""
+
+    _supported_quantizable_op_type = list(_QUANT_SLOTS)
+
+    def __init__(self, scope=None, place=None, weight_bits=8,
+                 activation_bits=8, activation_quantize_type="abs_max",
+                 weight_quantize_type="abs_max", window_size=10000,
+                 moving_rate=0.9, skip_pattern="skip_quant",
+                 quantizable_op_type=("conv2d", "depthwise_conv2d", "mul"),
+                 is_test=False):
+        if activation_quantize_type not in _ACT_TYPES:
+            raise ValueError(
+                "activation_quantize_type must be one of %s, got %r"
+                % (_ACT_TYPES, activation_quantize_type))
+        if weight_quantize_type not in _WEIGHT_TYPES:
+            raise ValueError(
+                "weight_quantize_type must be one of %s, got %r"
+                % (_WEIGHT_TYPES, weight_quantize_type))
+        for t in quantizable_op_type:
+            if t not in _QUANT_SLOTS:
+                raise ValueError("unsupported quantizable op type %r" % t)
+        self._scope = scope if scope is not None else global_scope()
+        self._act_type = activation_quantize_type
+        self._weight_type = weight_quantize_type
+        self._skip_pattern = skip_pattern
+        self._types = tuple(quantizable_op_type)
+        self._is_test = is_test
+        self._ins = _QuantInserter(self._scope, weight_bits, activation_bits,
+                                   moving_rate, window_size)
+
+    def apply(self, program):
+        block = program.global_block()
+        new_ops = []
+        for op in list(block.ops):
+            if op.type in self._types and not op.attr(self._skip_pattern,
+                                                      False):
+                slots, _ = _QUANT_SLOTS[op.type]
+                # output channels: last dim of mul/matmul weights, dim 0
+                # of conv filters
+                w_axis = -1 if op.type in ("mul", "matmul") else 0
+                for slot in slots:
+                    names = op.inputs.get(slot, [])
+                    rewired = []
+                    for n in names:
+                        v = block._find_var_recursive(n)
+                        is_w = v is not None and v.persistable
+                        kind = self._weight_type if is_w else self._act_type
+                        rewired.append(self._ins.insert(
+                            block, new_ops, n, kind,
+                            is_test=self._is_test,
+                            quant_axis=w_axis if is_w else 0))
+                    if names:
+                        op.inputs[slot] = rewired
+            new_ops.append(op)
+        block.ops = new_ops
+        program._bump()
+        return program
+
+
+class QuantizationFreezePass:
+    """Convert a QAT program for inference: strip activation fake-quant
+    ops (recording their scales), quantize weights to int values in the
+    scope, and append a channel-wise/tensor dequant after each quantized
+    op (reference quantization_pass.py:630)."""
+
+    def __init__(self, scope=None, place=None, weight_bits=8,
+                 activation_bits=8, weight_quantize_type="abs_max",
+                 quantizable_op_type=("conv2d", "depthwise_conv2d", "mul")):
+        self._scope = scope if scope is not None else global_scope()
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._weight_type = weight_quantize_type
+        self._types = tuple(quantizable_op_type)
+        self._act_scales = {}     # original var name -> scale value (float)
+        self._weight_scales = {}  # weight var name -> per-channel np array
+
+    def _unwrap(self, name):
+        return name[:-len(".quantized.dequantized")] \
+            if name.endswith(".quantized.dequantized") else name
+
+    def apply(self, program):
+        block = program.global_block()
+        scope = self._scope
+        qmax_w = float((1 << (self._wbits - 1)) - 1)
+        qmax_a = float((1 << (self._abits - 1)) - 1)
+
+        # pass 1: harvest scales from fake ops, drop activation fakes,
+        # quantize weights in-scope
+        kept = []
+        for op in list(block.ops):
+            if op.type.startswith("fake_quantize") or \
+                    op.type.startswith("fake_channel_wise_quantize"):
+                src = op.input("X")[0]
+                v = block._find_var_recursive(src)
+                if v is not None and v.persistable and \
+                        scope.find_var(src) is not None:
+                    w = np.asarray(scope.find_var(src))
+                    if self._weight_type == "channel_wise_abs_max":
+                        axis = int(op.attr("quant_axis", 0)) % w.ndim
+                        rdims = tuple(d for d in range(w.ndim)
+                                      if d != axis)
+                        scale = np.maximum(
+                            np.abs(w).max(axis=rdims), 1e-9)
+                        bshape = tuple(w.shape[d] if d == axis else 1
+                                       for d in range(w.ndim))
+                        q = np.clip(np.round(w / scale.reshape(bshape)
+                                             * qmax_w), -qmax_w, qmax_w)
+                    else:
+                        scale = np.maximum(np.abs(w).max(), 1e-9)
+                        q = np.clip(np.round(w / scale * qmax_w),
+                                    -qmax_w, qmax_w)
+                    scope.set_var(src, q.astype(w.dtype))
+                    self._weight_scales[src] = np.atleast_1d(scale)
+                else:
+                    sc_names = op.output("OutScale")
+                    if sc_names and scope.find_var(sc_names[0]) is not None:
+                        self._act_scales[src] = float(
+                            np.asarray(scope.find_var(sc_names[0]))[0])
+                continue  # fake op removed either way
+            kept.append(op)
+
+        # pass 2: rewire quantized-op inputs back to the original vars and
+        # append the post-op dequant
+        new_ops = []
+        for op in kept:
+            for slot, names in list(op.inputs.items()):
+                op.inputs[slot] = [self._unwrap(n) for n in names]
+            new_ops.append(op)
+            if op.type in self._types:
+                slots, out_slot = _QUANT_SLOTS[op.type]
+                weight_name = None
+                for s in slots:
+                    for n in op.inputs.get(s, []):
+                        if n in self._weight_scales:
+                            weight_name = n
+                if weight_name is None:
+                    continue
+                # the op writes its integer-scaled product into a fresh
+                # var; the dequant writes back into the ORIGINAL output
+                # name so every reader — downstream ops, fetch targets,
+                # saved-model outputs — sees real-scale values
+                out_name = op.output(out_slot)[0]
+                out_v = block._find_var_recursive(out_name)
+                raw_name = out_name + ".quantized_raw"
+                _mkvar(block, raw_name, out_v.shape, out_v.dtype)
+                op.outputs[out_slot] = [raw_name]
+                wscale = self._weight_scales[weight_name]
+                wscale_var = weight_name + ".wscale"
+                _mkvar(block, wscale_var, [wscale.shape[0]],
+                       persistable=True)
+                scope.set_var(wscale_var, wscale.astype(np.float32))
+                out_ndim = len(out_v.shape)
+                # weight channels land on the last output dim for
+                # mul/matmul, dim 1 for NCHW conv
+                out_axis = out_ndim - 1 if op.type in ("mul", "matmul") \
+                    else min(1, out_ndim - 1)
+                new_ops.append(framework.Operator(
+                    block, "fake_channel_wise_dequantize_max_abs",
+                    {"X": [raw_name], "Scales": [wscale_var]},
+                    {"Out": [out_name]},
+                    {"quant_bits": [self._wbits],
+                     "quant_axis": out_axis}))
+                # out_threshold only when actually observed (a fake-quant
+                # consumed this var downstream); ScaleForInferencePass /
+                # PostTrainingQuantization fill the general case
+                if out_name in self._act_scales:
+                    op.attrs["out_threshold"] = self._act_scales[out_name]
+        block.ops = new_ops
+        # later ops still referencing .quantized.dequantized names
+        for op in block.ops:
+            for slot, names in list(op.inputs.items()):
+                op.inputs[slot] = [self._unwrap(n) for n in names]
+        program._bump()
+        return program
+
+class ConvertToInt8Pass:
+    """Store frozen int-valued weights as int8 (the reference casts the
+    var dtype; here an explicit int8->float cast op is inserted before
+    each consumer so XLA widens at the matmul read — the layout-friendly
+    way to hold int8 weights in HBM)."""
+
+    def __init__(self, scope=None, place=None,
+                 quantizable_op_type=("conv2d", "depthwise_conv2d", "mul")):
+        self._scope = scope if scope is not None else global_scope()
+        self._types = tuple(quantizable_op_type)
+
+    def apply(self, program, weight_names=None):
+        block = program.global_block()
+        scope = self._scope
+        targets = set()
+        for op in block.ops:
+            if op.type in self._types:
+                for slot in _QUANT_SLOTS[op.type][0]:
+                    for n in op.inputs.get(slot, []):
+                        v = block._find_var_recursive(n)
+                        if v is None or not v.persistable or \
+                                scope.find_var(n) is None:
+                            continue
+                        # only weights the freeze pass actually put on the
+                        # int grid — casting a float weight to int8 would
+                        # silently truncate it to ~0
+                        w = np.asarray(scope.find_var(n))
+                        if np.abs(w).max() <= 127 and \
+                                np.allclose(w, np.round(w), atol=1e-4):
+                            targets.add(n)
+        if weight_names is not None:
+            targets &= set(weight_names)
+        new_ops = []
+        casted = {}
+        for op in block.ops:
+            for slot, names in list(op.inputs.items()):
+                rew = []
+                for n in names:
+                    if n in targets:
+                        if n not in casted:
+                            w = np.asarray(scope.find_var(n))
+                            scope.set_var(n, w.astype(np.int8))
+                            v = block._find_var_recursive(n)
+                            v.dtype = "int8"
+                            fname = n + ".int8_dequant"
+                            _mkvar(block, fname, v.shape, "float32")
+                            new_ops.append(framework.Operator(
+                                block, "cast", {"X": [n]}, {"Out": [fname]},
+                                {"out_dtype": "float32"}))
+                            casted[n] = fname
+                        rew.append(casted[n])
+                    else:
+                        rew.append(n)
+                op.inputs[slot] = rew
+            new_ops.append(op)
+        block.ops = new_ops
+        program._bump()
+        return program
+
+
+class AddQuantDequantPass:
+    """Quant-dequant the inputs of the broader op set (pool, elementwise,
+    concat, ...) so their int8 behavior is modeled during QAT (reference
+    quantization_pass.py:1233)."""
+
+    _supported_quantizable_op_type = [
+        "pool2d", "elementwise_add", "elementwise_mul", "concat", "softmax",
+        "relu", "relu6", "leaky_relu", "tanh", "swish", "mean",
+        "transpose", "reshape",
+    ]
+
+    def __init__(self, scope=None, place=None, moving_rate=0.9,
+                 quant_bits=8, skip_pattern="skip_quant",
+                 quantizable_op_type=("elementwise_add", "pool2d",
+                                     "concat")):
+        self._scope = scope if scope is not None else global_scope()
+        self._types = tuple(quantizable_op_type)
+        self._skip_pattern = skip_pattern
+        self._ins = _QuantInserter(self._scope, quant_bits, quant_bits,
+                                   moving_rate, 10000)
+
+    def apply(self, program):
+        block = program.global_block()
+        new_ops = []
+        for op in list(block.ops):
+            if op.type in self._types and not op.attr(self._skip_pattern,
+                                                      False):
+                for slot, names in list(op.inputs.items()):
+                    rew = []
+                    for n in names:
+                        v = block._find_var_recursive(n)
+                        ok = (v is not None and not v.persistable
+                              and v.dtype is not None
+                              and "float" in str(v.dtype)
+                              and not n.endswith(".quantized.dequantized"))
+                        rew.append(self._ins.insert(
+                            block, new_ops, n, "moving_average_abs_max")
+                            if ok else n)
+                    op.inputs[slot] = rew
+            new_ops.append(op)
+        block.ops = new_ops
+        program._bump()
+        return program
+
+
+class ScaleForTrainingPass:
+    """Attach a moving-average abs-max observer to every quantizable op
+    output so inference knows each tensor's threshold (reference
+    quantization_pass.py:1084)."""
+
+    def __init__(self, scope=None, place=None, moving_rate=0.9):
+        self._scope = scope if scope is not None else global_scope()
+        self._rate = moving_rate
+
+    def apply(self, program):
+        block = program.global_block()
+        new_ops = []
+        for op in list(block.ops):
+            new_ops.append(op)
+            if op.type in _QUANT_SLOTS:
+                out = op.output(_QUANT_SLOTS[op.type][1])[0]
+                scale = out + ".out_scale"
+                accum, state = out + ".scale_accum", out + ".scale_state"
+                for n, init in ((scale, 0.001), (accum, 0.001),
+                                (state, 1.0)):
+                    _mkvar(block, n, [1], persistable=True)
+                    _scope_init(self._scope, n, [init])
+                pass_out = out + ".scaled"
+                v = block._find_var_recursive(out)
+                _mkvar(block, pass_out, v.shape, v.dtype)
+                new_ops.append(framework.Operator(
+                    block, "moving_average_abs_max_scale",
+                    {"X": [out], "InAccum": [accum], "InState": [state],
+                     "InScale": [scale]},
+                    {"Out": [pass_out], "OutScale": [scale],
+                     "OutAccum": [accum], "OutState": [state]},
+                    {"moving_rate": self._rate}))
+        block.ops = new_ops
+        program._bump()
+        return program
+
+
+class ScaleForInferencePass:
+    """Copy recorded output scales onto the ops as ``out_threshold`` attrs
+    (reference quantization_pass.py:1191)."""
+
+    def __init__(self, scope=None):
+        self._scope = scope if scope is not None else global_scope()
+
+    def apply(self, program):
+        block = program.global_block()
+        for op in block.ops:
+            if op.type in _QUANT_SLOTS:
+                out = op.output(_QUANT_SLOTS[op.type][1])[0]
+                sv = self._scope.find_var(out + ".out_scale")
+                if sv is not None:
+                    op.attrs = dict(op.attrs)
+                    op.attrs["out_threshold"] = float(np.asarray(sv)[0])
+        program._bump()
+        return program

@@ -1,0 +1,218 @@
+"""Fake-quantization op family — reference
+``paddle/fluid/operators/fake_quantize_op.cc`` and
+``fake_dequantize_op.cc`` (the kernels behind the slim QAT passes).
+
+TPU-native design:
+* Quant-dequant in training is the straight-through estimator expressed
+  functionally: ``out = x + stop_gradient(qd(x) - x)``. The ``autodiff``
+  replay then differentiates it as identity — no ``FakeQuantGradOp``
+  registration needed (the reference synthesizes one per op).
+* Scale state (moving averages, accumulators) are persistable scope vars
+  threaded through the step function like optimizer accumulators — the
+  in-place buffer mutation of the reference's CUDA kernels becomes buffer
+  donation.
+* Everything stays static-shape and fuses into the surrounding matmul —
+  a fake-quant on a conv input is a handful of elementwise ops on the
+  VPU, free next to the MXU work.
+"""
+
+import numpy as np
+
+from ..registry import register
+
+
+def _qrange(bits):
+    return float((1 << (bits - 1)) - 1)  # 8 bits -> 127
+
+
+def _quant_dequant(x, scale, qmax):
+    """Symmetric uniform quant-dequant with straight-through gradient."""
+    import jax
+    import jax.numpy as jnp
+
+    s = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax) * s / qmax
+    return x + jax.lax.stop_gradient(q - x)
+
+
+@register("fake_quantize_dequantize_abs_max")
+def _fake_qdq_abs_max(ctx, op):
+    """Per-tensor dynamic abs-max quant-dequant (activations)."""
+    import jax
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    bits = int(op.attr("bit_length", 8))
+    qmax = _qrange(bits)
+    scale = jax.lax.stop_gradient(jnp.max(jnp.abs(x)))
+    ctx.set_output(op, "Out", _quant_dequant(x, scale, qmax))
+    if op.output("OutScale"):
+        ctx.set_output(op, "OutScale", scale.reshape(1))
+
+
+@register("fake_channel_wise_quantize_dequantize_abs_max")
+def _fake_qdq_channel_abs_max(ctx, op):
+    """Per-output-channel abs-max quant-dequant (weights). ``quant_axis``
+    picks the channel dim: 0 for conv filters [O,I,H,W], ndim-1 for
+    mul/matmul weights [in, out]."""
+    import jax
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    bits = int(op.attr("bit_length", 8))
+    axis = int(op.attr("quant_axis", 0)) % x.ndim
+    qmax = _qrange(bits)
+    reduce_dims = tuple(d for d in range(x.ndim) if d != axis)
+    scale = jax.lax.stop_gradient(jnp.max(jnp.abs(x), axis=reduce_dims))
+    bshape = tuple(x.shape[d] if d == axis else 1 for d in range(x.ndim))
+    ctx.set_output(op, "Out",
+                   _quant_dequant(x, scale.reshape(bshape), qmax))
+    if op.output("OutScale"):
+        ctx.set_output(op, "OutScale", scale)
+
+
+@register("fake_quantize_dequantize_moving_average_abs_max")
+def _fake_qdq_moving_avg(ctx, op):
+    """EMA-scale quant-dequant (reference FakeQuantOrWithDequantMovingAverageAbsMaxOp):
+    state = state*rate + 1; accum = accum*rate + max|x|; scale = accum/state.
+    ``is_test`` freezes the scale at InScale."""
+    import jax
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    in_scale = ctx.get_input(op, "InScale")
+    bits = int(op.attr("bit_length", 8))
+    rate = float(op.attr("moving_rate", 0.9))
+    is_test = bool(op.attr("is_test", False))
+    qmax = _qrange(bits)
+    if is_test:
+        scale = jnp.reshape(in_scale, ())
+        ctx.set_output(op, "Out", _quant_dequant(x, scale, qmax))
+        if op.output("OutScale"):
+            ctx.set_output(op, "OutScale", jnp.reshape(scale, (1,)))
+        return
+    accum = ctx.get_input(op, "InAccum")
+    state = ctx.get_input(op, "InState")
+    cur = jax.lax.stop_gradient(jnp.max(jnp.abs(x)))
+    new_state = jnp.reshape(state, ()) * rate + 1.0
+    new_accum = jnp.reshape(accum, ()) * rate + cur
+    scale = new_accum / new_state
+    ctx.set_output(op, "Out", _quant_dequant(x, scale, qmax))
+    ctx.set_output(op, "OutScale", jnp.reshape(scale, (1,)))
+    ctx.set_output(op, "OutAccum", jnp.reshape(new_accum, (1,)))
+    ctx.set_output(op, "OutState", jnp.reshape(new_state, (1,)))
+
+
+@register("moving_average_abs_max_scale")
+def _moving_avg_scale(ctx, op):
+    """Scale observer WITHOUT quantization (ScaleForTrainingPass): records
+    the EMA abs-max of a var so inference knows its output threshold."""
+    import jax
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    rate = float(op.attr("moving_rate", 0.9))
+    is_test = bool(op.attr("is_test", False))
+    ctx.set_output(op, "Out", x)  # pass-through
+    if is_test:
+        if op.output("OutScale"):
+            ctx.set_output(op, "OutScale",
+                           jnp.reshape(ctx.get_input(op, "InScale"), (1,)))
+        return
+    accum = ctx.get_input(op, "InAccum")
+    state = ctx.get_input(op, "InState")
+    cur = jax.lax.stop_gradient(jnp.max(jnp.abs(x)))
+    new_state = jnp.reshape(state, ()) * rate + 1.0
+    new_accum = jnp.reshape(accum, ()) * rate + cur
+    ctx.set_output(op, "OutScale", jnp.reshape(new_accum / new_state, (1,)))
+    ctx.set_output(op, "OutAccum", jnp.reshape(new_accum, (1,)))
+    ctx.set_output(op, "OutState", jnp.reshape(new_state, (1,)))
+
+
+@register("fake_quantize_range_abs_max")
+def _fake_quant_range_abs_max(ctx, op):
+    """Windowed running-max scale (reference FakeQuantizeRangeAbsMaxOp).
+    TPU simplification: the scale is a running max that decays every
+    ``window_size`` steps instead of a host-side scale history array —
+    same steady-state behavior, no dynamic indexing."""
+    import jax
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    in_scale = ctx.get_input(op, "InScale")
+    bits = int(op.attr("bit_length", 8))
+    window = int(op.attr("window_size", 10000))
+    is_test = bool(op.attr("is_test", False))
+    qmax = _qrange(bits)
+    if is_test:
+        scale = jnp.reshape(in_scale, ())
+        ctx.set_output(op, "Out", _quant_dequant(x, scale, qmax))
+        if op.output("OutScale"):
+            ctx.set_output(op, "OutScale", jnp.reshape(scale, (1,)))
+        return
+    it = ctx.get_input(op, "Iter")
+    cur = jax.lax.stop_gradient(jnp.max(jnp.abs(x)))
+    prev = jnp.reshape(in_scale, ())
+    itv = jnp.reshape(it, ()).astype(np.dtype("int32"))
+    decay = (itv % window) == 0
+    scale = jnp.where(decay, cur, jnp.maximum(prev, cur))
+    ctx.set_output(op, "Out", _quant_dequant(x, scale, qmax))
+    ctx.set_output(op, "OutScale", jnp.reshape(scale, (1,)))
+    if op.output("OutIter"):
+        ctx.set_output(op, "OutIter", jnp.reshape(itv + 1, (1,)))
+
+
+@register("fake_quantize_abs_max")
+def _fake_quant_abs_max(ctx, op):
+    """Quantize ONLY (int values in a float container + scale) — the
+    freeze-path op."""
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    bits = int(op.attr("bit_length", 8))
+    qmax = _qrange(bits)
+    scale = jnp.maximum(jnp.max(jnp.abs(x)), 1e-9)
+    ctx.set_output(op, "Out",
+                   jnp.clip(jnp.round(x / scale * qmax), -qmax, qmax))
+    ctx.set_output(op, "OutScale", scale.reshape(1))
+
+
+@register("fake_dequantize_max_abs")
+def _fake_dequant_max_abs(ctx, op):
+    """out = x * scale / max_range (reference fake_dequantize_op.cc)."""
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    scale = ctx.get_input(op, "Scale")
+    max_range = float(op.attr("max_range", 127.0))
+    ctx.set_output(op, "Out",
+                   x.astype(np.dtype("float32")) *
+                   jnp.reshape(scale, ()) / max_range)
+
+
+@register("fake_channel_wise_dequantize_max_abs")
+def _fake_channel_wise_dequant(ctx, op):
+    """Two-level channel-wise dequant: Scales = [weight_scales(per-channel),
+    activation_scale(optional)] (reference fake_dequantize_op.cc:
+    FakeChannelWiseDequantizeMaxAbsOp)."""
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    scale_names = op.input("Scales")
+    bits = [int(b) for b in op.attr("quant_bits", [8, 8])]
+    wscale = ctx.get(scale_names[0])
+    out = x.astype(np.dtype("float32"))
+    # quant_axis: the op-OUTPUT dim the weight channels land on (last dim
+    # for mul/matmul, dim 1 for NCHW conv); default keeps the shape-match
+    # heuristic for single-scale tensors
+    axis = op.attr("quant_axis", None)
+    if axis is None:
+        axis = out.ndim - 1 if (out.ndim >= 2 and
+                                wscale.shape[0] == out.shape[-1]) else 0
+    axis = int(axis) % out.ndim
+    bshape = tuple(-1 if d == axis else 1 for d in range(out.ndim))
+    out = out * wscale.reshape(bshape) / _qrange(bits[0])
+    if len(scale_names) > 1:
+        ascale = ctx.get(scale_names[1])
+        out = out * jnp.reshape(ascale, ()) / _qrange(bits[1])
+    ctx.set_output(op, "Out", out)

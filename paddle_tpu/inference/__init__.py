@@ -52,6 +52,8 @@ class Predictor:
     through one compile-cached XLA executable per feed signature."""
 
     def __init__(self, config, _clone_of=None):
+        if isinstance(config, str):  # convenience: a bare model_dir path
+            config = Config(model_dir=config)
         self._config = config
         exe = fluid.Executor()
         if _clone_of is not None:
