@@ -10,6 +10,7 @@ from . import (  # noqa: F401
     contrib,
     compiler,
     data_feeder,
+    dataset,
     executor,
     framework,
     initializer,
@@ -26,6 +27,8 @@ from . import (  # noqa: F401
 from .backward import append_backward, calc_gradient, gradients  # noqa: F401
 from .compiler import BuildStrategy, CompiledProgram, ExecutionStrategy  # noqa: F401
 from .data_feeder import DataFeeder  # noqa: F401
+from .dataset import DatasetFactory  # noqa: F401
+from .reader import DataLoader, PyReader  # noqa: F401
 from .executor import Executor, Scope, global_scope, scope_guard  # noqa: F401
 from .lod import LoDTensor, create_lod_tensor  # noqa: F401
 from .framework import (  # noqa: F401

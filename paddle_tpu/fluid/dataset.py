@@ -1,0 +1,391 @@
+"""Dataset engine — reference ``python/paddle/fluid/dataset.py`` +
+C++ ``framework/data_set.h:135`` / ``data_feed.cc`` (MultiSlotDataFeed).
+
+The reference streams multi-slot text files through C++ channels into
+per-thread DeviceWorkers. TPU-native redesign: files parse on the host
+(native C++ line parser, ``native/data_feed.cc``, with a numpy fallback),
+samples shuffle in host memory, and batches assemble into the executor's
+feed dicts — dense slots stack to ``[N, d]``, ragged slots flatten to the
+bounded-LoD encoding (``fluid/lod.py``) so every device shape stays
+static. ``Executor.train_from_dataset`` drives one pass end-to-end.
+
+Line format (reference MultiSlotDataFeed): per slot ``<num> <v>*num``;
+'u' (int64 feasign) slots come from int64 use_vars, 'f' slots otherwise.
+"""
+
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+from . import lod as _lod
+from .framework import Variable, convert_dtype
+
+__all__ = ["DatasetFactory", "DatasetBase", "InMemoryDataset",
+           "QueueDataset", "FileInstantDataset"]
+
+
+class DatasetFactory:
+    """Reference ``dataset.py:22``: name -> dataset instance."""
+
+    def create_dataset(self, datafeed_class="QueueDataset"):
+        kinds = {"InMemoryDataset": InMemoryDataset,
+                 "QueueDataset": QueueDataset,
+                 "FileInstantDataset": FileInstantDataset}
+        if datafeed_class not in kinds:
+            raise ValueError("unknown dataset class %r (one of %s)"
+                             % (datafeed_class, sorted(kinds)))
+        return kinds[datafeed_class]()
+
+
+def _numpy_parse(text, types):
+    """Fallback multislot parser: returns per-slot (values, offsets)."""
+    n_slots = len(types)
+    vals = [[] for _ in range(n_slots)]
+    offs = [[0] for _ in range(n_slots)]
+    for ln, line in enumerate(text.splitlines()):
+        tok = line.split()
+        if not tok:
+            continue
+        i = 0
+        for s in range(n_slots):
+            if i >= len(tok):
+                raise ValueError("line %d: missing slot %d" % (ln, s))
+            num = int(tok[i])
+            i += 1
+            if num <= 0:
+                raise ValueError("line %d: slot %d has num=%d" % (ln, s,
+                                                                  num))
+            seg = tok[i:i + num]
+            if len(seg) != num:
+                raise ValueError("line %d: slot %d truncated" % (ln, s))
+            conv = int if types[s] == "u" else float
+            vals[s].extend(conv(t) for t in seg)
+            offs[s].append(offs[s][-1] + num)
+            i += num
+    out = []
+    for s in range(n_slots):
+        dt = np.int64 if types[s] == "u" else np.float32
+        out.append((np.asarray(vals[s], dt),
+                    np.asarray(offs[s], np.int64)))
+    return out
+
+
+def _native_parse(lib, data, types):
+    import ctypes
+
+    n_slots = len(types)
+    i64 = ctypes.c_int64
+    counts = (i64 * n_slots)()
+    n_lines = lib.dfd_count(data, len(data), n_slots, counts)
+    if n_lines < 0:
+        raise ValueError("malformed multislot line %d" % (-n_lines - 1))
+    fbufs, ubufs, obufs = [], [], []
+    fptrs = (ctypes.POINTER(ctypes.c_float) * n_slots)()
+    uptrs = (ctypes.POINTER(i64) * n_slots)()
+    optrs = (ctypes.POINTER(i64) * n_slots)()
+    for s in range(n_slots):
+        fa = np.zeros(counts[s] if types[s] == "f" else 0, np.float32)
+        ua = np.zeros(counts[s] if types[s] == "u" else 0, np.int64)
+        oa = np.zeros(n_lines + 1, np.int64)
+        fbufs.append(fa)
+        ubufs.append(ua)
+        obufs.append(oa)
+        fptrs[s] = fa.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+        uptrs[s] = ua.ctypes.data_as(ctypes.POINTER(i64))
+        optrs[s] = oa.ctypes.data_as(ctypes.POINTER(i64))
+    rc = lib.dfd_parse(data, len(data), n_slots,
+                       "".join(types).encode(), fptrs, uptrs, optrs)
+    if rc != 0:
+        raise ValueError("multislot parse failed")
+    return [(fbufs[s] if types[s] == "f" else ubufs[s], obufs[s])
+            for s in range(n_slots)]
+
+
+class DatasetBase:
+    """Reference ``dataset.py:64``: config (vars/files/batch) + parsing."""
+
+    def __init__(self):
+        self._batch_size = 1
+        self._thread_num = 1
+        self._filelist = []
+        self._use_vars = []
+        self._pipe_command = None
+        self._hdfs_config = None
+        self._parse_lib = None
+        self._parse_lib_tried = False
+        self._rng = np.random.RandomState(0)
+
+    # -- config (reference-shaped setters) ---------------------------------
+    def set_pipe_command(self, pipe_command):
+        """Shell filter each file streams through before parsing (the
+        reference pipes every file through this command)."""
+        self._pipe_command = pipe_command
+
+    def set_batch_size(self, batch_size):
+        self._batch_size = int(batch_size)
+
+    def set_thread(self, thread_num):
+        self._thread_num = max(1, int(thread_num))
+
+    def set_filelist(self, filelist):
+        self._filelist = list(filelist)
+
+    def set_use_var(self, var_list):
+        for v in var_list:
+            if not isinstance(v, Variable):
+                raise TypeError("set_use_var takes Variables, got %r" % v)
+        self._use_vars = list(var_list)
+
+    def set_hdfs_config(self, fs_name, fs_ugi):
+        self._hdfs_config = (fs_name, fs_ugi)
+
+    def set_seed(self, seed):
+        self._rng = np.random.RandomState(seed)
+
+    # -- parsing ------------------------------------------------------------
+    def _slot_types(self):
+        types = []
+        for v in self._use_vars:
+            dt = convert_dtype(v.dtype or "float32")
+            types.append("u" if np.issubdtype(np.dtype(dt), np.integer)
+                         else "f")
+        return types
+
+    def _read_file(self, fname):
+        if self._hdfs_config is not None and fname.startswith("hdfs:"):
+            from ..fs import HDFSClient
+
+            client = HDFSClient(self._hdfs_config[0], self._hdfs_config[1])
+            raw = client.cat(fname)
+        else:
+            with open(fname, "rb") as f:
+                raw = f.read()
+        if self._pipe_command:
+            raw = subprocess.run(self._pipe_command, shell=True, input=raw,
+                                 capture_output=True, check=True).stdout
+        return raw
+
+    def _parse_file(self, fname):
+        """-> list over samples; each sample is a tuple of per-slot 1-D
+        numpy arrays."""
+        if not self._use_vars:
+            raise RuntimeError("set_use_var must be called before loading")
+        types = self._slot_types()
+        raw = self._read_file(fname)
+        if not self._parse_lib_tried:
+            from .. import native
+
+            self._parse_lib = native.load_data_feed()
+            self._parse_lib_tried = True
+        if self._parse_lib is not None:
+            slots = _native_parse(self._parse_lib, raw, types)
+        else:
+            slots = _numpy_parse(raw.decode(), types)
+        n_lines = len(slots[0][1]) - 1
+        samples = []
+        for i in range(n_lines):
+            samples.append(tuple(
+                vals[offs[i]:offs[i + 1]] for vals, offs in slots))
+        return samples
+
+    # -- batching ------------------------------------------------------------
+    @staticmethod
+    def _lod_bound(n):
+        """Static physical bound for a ragged batch's flat rows: next
+        power of two (min 16). Without this every distinct token total
+        would be a fresh feed signature -> a fresh XLA compile per batch;
+        bucketing collapses the signatures to O(log max_len)."""
+        b = 16
+        while b < n:
+            b *= 2
+        return b
+
+    def _batch_to_feed(self, batch):
+        """samples -> executor feed dict honoring each use_var's shape:
+        ragged (lod_level>0) slots go bounded-LoD (zero-padded to a
+        power-of-two row bound), dense slots stack."""
+        feed = {}
+        for si, var in enumerate(self._use_vars):
+            cols = [s[si] for s in batch]
+            if getattr(var, "lod_level", 0) and var.lod_level > 0:
+                flat = np.concatenate(cols)
+                if flat.ndim == 1:
+                    flat = flat[:, None]
+                bound = self._lod_bound(flat.shape[0])
+                if bound > flat.shape[0]:
+                    pad = np.zeros((bound - flat.shape[0],) + flat.shape[1:],
+                                   flat.dtype)
+                    flat = np.concatenate([flat, pad])
+                feed[var.name] = _lod.LoDTensor(
+                    flat, [[len(c) for c in cols]])
+            else:
+                arrs = [np.asarray(c) for c in cols]
+                shape = [d for d in (var.shape or []) if d not in (-1,
+                                                                   None)]
+                if shape:
+                    arrs = [a.reshape(shape) for a in arrs]
+                feed[var.name] = np.stack(arrs)
+        return feed
+
+    def _iter_batches(self, samples, drop_last=False):
+        buf = []
+        for s in samples:
+            buf.append(s)
+            if len(buf) == self._batch_size:
+                yield self._batch_to_feed(buf)
+                buf = []
+        if buf and not drop_last:
+            yield self._batch_to_feed(buf)
+
+    def batch_reader(self, drop_last=False):
+        raise NotImplementedError
+
+    def desc(self):
+        return {"batch_size": self._batch_size, "thread": self._thread_num,
+                "files": list(self._filelist),
+                "slots": [v.name for v in self._use_vars],
+                "types": self._slot_types() if self._use_vars else []}
+
+
+class InMemoryDataset(DatasetBase):
+    """Reference ``dataset.py:276``: load all files to host memory, then
+    shuffle locally or across trainers."""
+
+    def __init__(self):
+        super().__init__()
+        self._samples = []
+        self._preload_threads = None
+
+    def load_into_memory(self):
+        if self._thread_num <= 1 or len(self._filelist) <= 1:
+            self._samples = [s for f in self._filelist
+                             for s in self._parse_file(f)]
+            return
+        results = [None] * len(self._filelist)
+        errors = []
+
+        def work(idx, fname):
+            try:
+                results[idx] = self._parse_file(fname)
+            except Exception as e:  # surfaced below with the filename
+                errors.append((fname, e))
+
+        threads = []
+        for i, f in enumerate(self._filelist):
+            t = threading.Thread(target=work, args=(i, f))
+            t.start()
+            threads.append(t)
+            if len(threads) >= self._thread_num:
+                threads.pop(0).join()
+        for t in threads:
+            t.join()
+        if errors:
+            fname, err = errors[0]
+            raise RuntimeError("failed to parse %r: %s" % (fname, err)) \
+                from err
+        self._samples = [s for r in results for s in r]
+
+    def preload_into_memory(self, thread_num=None):
+        if thread_num:
+            self.set_thread(thread_num)
+        t = threading.Thread(target=self.load_into_memory)
+        t.start()
+        self._preload_threads = [t]
+
+    def wait_preload_done(self):
+        for t in self._preload_threads or []:
+            t.join()
+        self._preload_threads = None
+
+    def local_shuffle(self):
+        self._rng.shuffle(self._samples)
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        """Distributed shuffle: shuffle locally, then keep the samples
+        this trainer owns by hash — every trainer sees a disjoint 1/N of
+        the (virtually concatenated) global data, like the reference's
+        fleet send/receive exchange (dataset.py:504) without the RPC
+        round-trip (each trainer loads the full filelist; the hash does
+        the partitioning)."""
+        self._rng.shuffle(self._samples)
+        if fleet is None:
+            return
+        trainer_id = fleet.worker_index()
+        n = max(1, fleet.worker_num())
+        self._samples = [s for i, s in enumerate(self._samples)
+                         if i % n == trainer_id]
+
+    def release_memory(self):
+        self._samples = []
+
+    def get_memory_data_size(self, fleet=None):
+        n = len(self._samples)
+        return n * fleet.worker_num() if fleet is not None else n
+
+    def get_shuffle_data_size(self, fleet=None):
+        return len(self._samples)
+
+    def batch_reader(self, drop_last=False):
+        def reader():
+            for feed in self._iter_batches(self._samples, drop_last):
+                yield feed
+
+        return reader
+
+
+class QueueDataset(DatasetBase):
+    """Reference ``dataset.py:646``: streaming — files parse on a
+    background thread and batches queue ahead of the consumer; nothing is
+    retained."""
+
+    def local_shuffle(self):
+        raise NotImplementedError(
+            "QueueDataset streams; use InMemoryDataset for local_shuffle "
+            "(reference raises the same)")
+
+    def global_shuffle(self, fleet=None):
+        raise NotImplementedError(
+            "QueueDataset streams; use InMemoryDataset for global_shuffle")
+
+    def batch_reader(self, drop_last=False):
+        import queue as _q
+
+        def reader():
+            q = _q.Queue(maxsize=max(2, self._thread_num * 2))
+            end = object()
+
+            def produce():
+                try:
+                    buf = []
+                    for f in self._filelist:
+                        for s in self._parse_file(f):
+                            buf.append(s)
+                            if len(buf) == self._batch_size:
+                                q.put(self._batch_to_feed(buf))
+                                buf = []
+                    if buf and not drop_last:
+                        q.put(self._batch_to_feed(buf))
+                    q.put(end)
+                except Exception as e:  # surfaced in the consumer
+                    q.put(("__dataset_error__", e))
+
+            threading.Thread(target=produce, daemon=True).start()
+            while True:
+                item = q.get()
+                if item is end:
+                    break
+                if isinstance(item, tuple) and len(item) == 2 and \
+                        item[0] == "__dataset_error__":
+                    raise RuntimeError(
+                        "QueueDataset stream failed") from item[1]
+                yield item
+
+        return reader
+
+
+class FileInstantDataset(QueueDataset):
+    """Reference ``dataset.py:729``: QueueDataset flavor whose feed reads
+    instances straight from the file worker — same streaming semantics
+    here."""

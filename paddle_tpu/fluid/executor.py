@@ -267,6 +267,45 @@ class Executor:
         return _CompiledStep(jfn, state_names, fetch_names)
 
     # convenience ------------------------------------------------------
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """One pass over ``dataset`` (reference ``executor.py:920`` +
+        trainer/DeviceWorker stack). The reference spawns per-thread C++
+        workers over dataset channels; here each batch runs through the
+        same compile-cached XLA step ``run()`` uses — thread-level
+        parallelism lives in the dataset's parsing/prefetch side, device
+        parallelism in the compiled step's shardings."""
+        if dataset is None:
+            raise ValueError("dataset is required")
+        if thread:
+            dataset.set_thread(thread)
+        fetch_list = list(fetch_list or [])
+        fetch_info = list(fetch_info or
+                          [getattr(v, "name", str(v)) for v in fetch_list])
+        n_batches = 0
+        for feed in dataset.batch_reader()():
+            res = self.run(program, feed=feed, fetch_list=fetch_list,
+                           scope=scope)
+            n_batches += 1
+            if debug and fetch_list and n_batches % print_period == 0:
+                import numpy as _np
+
+                msg = ", ".join(
+                    "%s=%s" % (info, _np.asarray(val).ravel()[:4])
+                    for info, val in zip(fetch_info, res))
+                print("batch %d: %s" % (n_batches, msg))
+        return n_batches
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Reference ``executor.py:847``: identical drive, inference
+        program (no optimizer ops — the program decides, not the call)."""
+        return self.train_from_dataset(program, dataset, scope, thread,
+                                       debug, fetch_list, fetch_info,
+                                       print_period)
+
     def as_function(self, program, feed_specs, fetch_list, scope=None):
         """Exposes a Program block as a pure jittable function
         ``fn(state_dict, feed_dict, rng_key) -> (fetches, new_state, key)``

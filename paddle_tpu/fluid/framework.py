@@ -82,6 +82,7 @@ class Variable:
         is_data=False,
         initializer=None,
         type="lod_tensor",
+        lod_level=0,
     ):
         self.block = block
         self.name = name or unique_name.generate("_generated_var")
@@ -90,6 +91,7 @@ class Variable:
         self.persistable = persistable
         self.stop_gradient = stop_gradient
         self.is_data = is_data
+        self.lod_level = lod_level
         # "lod_tensor" (dense) or "selected_rows" (sparse rows+values pair;
         # a selected_rows var NAME binds the values array in the env and
         # NAME + "@ROWS" binds the int32 row-index array — the TPU-native
@@ -131,7 +133,7 @@ class Variable:
         return self._binary(other, "elementwise_pow")
 
     def __neg__(self):
-        from .layers.tensor import scale as _scale
+        from .layers.nn import scale as _scale
 
         return _scale(self, scale=-1.0)
 

@@ -19,5 +19,6 @@ def data(name, shape, dtype="float32", append_batch_size=True,
         shape=shape,
         dtype=dtype,
         stop_gradient=stop_gradient,
+        lod_level=lod_level,
         is_data=True,
     )

@@ -17,14 +17,39 @@ import numpy as np
 
 from .framework import Variable
 
-__all__ = ["DataLoader", "PyReader", "GeneratorLoader"]
+__all__ = ["DataLoader", "PyReader", "GeneratorLoader", "WorkerInfo",
+           "get_worker_info"]
+
+
+class WorkerInfo:
+    """Identity of the current DataLoader worker process. A generator
+    that wants to avoid duplicate parsing shards its own input by
+    ``get_worker_info()`` and then calls ``mark_sharded()`` so the loader
+    keeps every batch it yields instead of round-robin filtering."""
+
+    def __init__(self, rank, num_workers):
+        self.id = rank
+        self.num_workers = num_workers
+        self.consumed_shard = False
+
+    def mark_sharded(self):
+        self.consumed_shard = True
+
+
+_worker_info = None
+
+
+def get_worker_info():
+    """None in the main process; a WorkerInfo inside an mp worker."""
+    return _worker_info
 
 
 class GeneratorLoader:
     """Iterable loader: wraps a sample/batch generator into prefetched,
     device-staged feed dicts."""
 
-    def __init__(self, feed_list, capacity=4, stage_on_device=True):
+    def __init__(self, feed_list, capacity=4, stage_on_device=True,
+                 use_multiprocess=False, num_workers=2):
         self._feed_names = [v.name if isinstance(v, Variable) else str(v)
                             for v in feed_list]
         self._feed_vars = feed_list
@@ -32,6 +57,8 @@ class GeneratorLoader:
         self._stage = stage_on_device
         self._gen = None
         self._kind = None
+        self._use_multiprocess = use_multiprocess
+        self._num_workers = max(1, int(num_workers))
 
     # -- generator registration (reference reader.py:419-520) -----------
     def set_sample_generator(self, generator, batch_size, drop_last=True):
@@ -65,27 +92,33 @@ class GeneratorLoader:
         return self
 
     # -- iteration -------------------------------------------------------
-    def __iter__(self):
-        if self._gen is None:
-            raise RuntimeError("no generator set (set_batch_generator / "
-                               "set_sample_generator / set_sample_list_generator)")
+    def _to_feed(self, batch):
+        items = ([batch[n] for n in self._feed_names]
+                 if isinstance(batch, dict) else list(batch))
+        arrays = []
+        for a in items:
+            # LoDTensors pass through whole; the executor decomposes them
+            # into data + @LOD lengths itself
+            if hasattr(a, "recursive_sequence_lengths"):
+                arrays.append(a)
+                continue
+            a = np.asarray(a)
+            if self._stage:
+                import jax
+
+                # async H2D: stages ahead while the step runs
+                a = jax.device_put(a)
+            arrays.append(a)
+        return dict(zip(self._feed_names, arrays))
+
+    def _iter_threaded(self):
         end = object()
         q = _queue.Queue(maxsize=self._capacity)
 
         def produce():
             try:
                 for batch in self._gen():
-                    if isinstance(batch, dict):
-                        arrays = [np.asarray(batch[n])
-                                  for n in self._feed_names]
-                    else:
-                        arrays = [np.asarray(a) for a in batch]
-                    if self._stage:
-                        import jax
-
-                        # async H2D: stages ahead while the step runs
-                        arrays = [jax.device_put(a) for a in arrays]
-                    q.put(dict(zip(self._feed_names, arrays)))
+                    q.put(self._to_feed(batch))
             finally:
                 q.put(end)
 
@@ -97,6 +130,87 @@ class GeneratorLoader:
                 break
             yield item
 
+    def _iter_multiprocess(self):
+        """Worker processes run the generator and ship numpy batches over
+        an mp queue; device staging stays in the parent (reference
+        reader.py:73 _DataLoaderIterMultiProcess + shared-memory channel;
+        fork + pickle is the TPU-host equivalent — parsing/augmentation
+        escapes the GIL, the H2D stays on the process that owns the
+        device client).
+
+        Sharding: each worker runs the full generator and keeps batches
+        round-robin by index — correct for any generator, but parse work
+        multiplies by num_workers unless the generator shards itself via
+        ``get_worker_info()`` (then every yielded batch is kept)."""
+        import multiprocessing as mp
+        import traceback
+
+        ctx = mp.get_context("fork")
+        q = ctx.Queue(maxsize=max(2, self._capacity))
+        n = self._num_workers
+
+        def pack(a):
+            # LoDTensors must survive the queue with their lengths
+            if hasattr(a, "recursive_sequence_lengths"):
+                return ("__lod__", np.asarray(a),
+                        a.recursive_sequence_lengths())
+            return np.asarray(a)
+
+        def worker(rank, gen, nworkers):
+            global _worker_info
+            _worker_info = WorkerInfo(rank, nworkers)
+            try:
+                for i, batch in enumerate(gen()):
+                    if _worker_info.consumed_shard is False and \
+                            i % nworkers != rank:
+                        continue  # round-robin split of the batch stream
+                    if isinstance(batch, dict):
+                        items = [batch[k] for k in self._feed_names]
+                    else:
+                        items = list(batch)
+                    q.put([pack(a) for a in items])
+                q.put(None)
+            except BaseException:
+                q.put(("__worker_error__", rank,
+                       traceback.format_exc()))
+
+        procs = [ctx.Process(target=worker, args=(r, self._gen, n),
+                             daemon=True) for r in range(n)]
+        for p in procs:
+            p.start()
+
+        def unpack(a):
+            if isinstance(a, tuple) and len(a) == 3 and a[0] == "__lod__":
+                from .lod import LoDTensor
+
+                return LoDTensor(a[1], a[2])
+            return a
+
+        done = 0
+        try:
+            while done < n:
+                item = q.get()
+                if item is None:
+                    done += 1
+                    continue
+                if isinstance(item, tuple) and item[0] == "__worker_error__":
+                    raise RuntimeError(
+                        "DataLoader worker %d died:\n%s"
+                        % (item[1], item[2]))
+                yield self._to_feed([unpack(a) for a in item])
+        finally:
+            for p in procs:
+                p.terminate()
+                p.join()
+
+    def __iter__(self):
+        if self._gen is None:
+            raise RuntimeError("no generator set (set_batch_generator / "
+                               "set_sample_generator / set_sample_list_generator)")
+        if self._use_multiprocess:
+            return self._iter_multiprocess()
+        return self._iter_threaded()
+
 
 class DataLoader:
     """Reference ``reader.py:73``. ``from_generator`` is the supported
@@ -105,17 +219,23 @@ class DataLoader:
     @staticmethod
     def from_generator(feed_list=None, capacity=4, use_double_buffer=True,
                        iterable=True, return_list=False,
-                       stage_on_device=True):
+                       stage_on_device=True, use_multiprocess=False,
+                       num_workers=2):
         if not feed_list:
             raise ValueError("feed_list is required")
         cap = capacity if use_double_buffer else 1
         return GeneratorLoader(feed_list, capacity=cap,
-                               stage_on_device=stage_on_device)
+                               stage_on_device=stage_on_device,
+                               use_multiprocess=use_multiprocess,
+                               num_workers=num_workers)
 
     @staticmethod
     def from_dataset(dataset, places=None, drop_last=True):
-        raise NotImplementedError(
-            "from_dataset requires the Dataset trainer stack")
+        """Iterate a Dataset's batches as prefetched, device-staged feed
+        dicts (reference ``reader.py:145``)."""
+        loader = GeneratorLoader(dataset._use_vars)
+        loader.set_batch_generator(dataset.batch_reader(drop_last))
+        return loader
 
 
 class PyReader:

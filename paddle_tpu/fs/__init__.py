@@ -1,0 +1,173 @@
+"""Filesystem shell io — reference ``incubate/fleet/utils/hdfs.py`` +
+``fluid/contrib/utils/hdfs_utils.py`` (hadoop-shell wrappers) and the C++
+``framework/io/fs.{h,cc}`` / ``shell.{h,cc}`` tier.
+
+``LocalFS`` implements the same surface on the local filesystem (what CI
+and single-host TPU jobs use); ``HDFSClient`` shells out to ``hadoop fs``
+with retries and raises a clear error when no hadoop binary is present
+(zero-egress images). ``split_files`` is the trainer-sharding helper the
+dataset/fleet tier uses."""
+
+import os
+import shutil
+import subprocess
+
+__all__ = ["LocalFS", "HDFSClient", "ExecuteError", "split_files"]
+
+
+class ExecuteError(RuntimeError):
+    pass
+
+
+def split_files(files, trainer_id, trainers):
+    """Deterministic round-robin file shard for one trainer (reference
+    hdfs.py:394)."""
+    if not 0 <= trainer_id < trainers:
+        raise ValueError("bad trainer_id %d of %d" % (trainer_id, trainers))
+    return [f for i, f in enumerate(sorted(files))
+            if i % trainers == trainer_id]
+
+
+class LocalFS:
+    """Local filesystem with the fs-client surface."""
+
+    def ls_dir(self, path):
+        if not os.path.isdir(path):
+            return []
+        return sorted(os.listdir(path))
+
+    def is_exist(self, path):
+        return os.path.exists(path)
+
+    def is_dir(self, path):
+        return os.path.isdir(path)
+
+    def is_file(self, path):
+        return os.path.isfile(path)
+
+    def makedirs(self, path):
+        os.makedirs(path, exist_ok=True)
+
+    def mkdirs(self, path):
+        self.makedirs(path)
+
+    def delete(self, path):
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def rename(self, src, dst, overwrite=False):
+        if os.path.exists(dst):
+            if not overwrite:
+                raise ExecuteError("destination exists: %s" % dst)
+            self.delete(dst)
+        os.replace(src, dst)
+
+    def cat(self, path):
+        with open(path, "rb") as f:
+            return f.read()
+
+    def upload(self, local_path, dest_path, overwrite=False):
+        if os.path.exists(dest_path) and not overwrite:
+            raise ExecuteError("destination exists: %s" % dest_path)
+        os.makedirs(os.path.dirname(dest_path) or ".", exist_ok=True)
+        shutil.copy2(local_path, dest_path)
+
+    def download(self, src_path, local_path, overwrite=False):
+        self.upload(src_path, local_path, overwrite)
+
+    def touch(self, path):
+        open(path, "ab").close()
+
+
+class HDFSClient:
+    """``hadoop fs`` shell wrapper (reference hdfs.py:68). Commands run
+    with ``-D fs.default.name=`` / ``-D hadoop.job.ugi=`` like the
+    reference; every call raises ``ExecuteError`` after ``retry_times``
+    failures."""
+
+    def __init__(self, fs_name_or_hadoop_home="hadoop", configs=None,
+                 retry_times=3):
+        # two reference-compatible call shapes:
+        #   HDFSClient(hadoop_home, {"fs.default.name":..., "hadoop.job.ugi":...})
+        #   HDFSClient(fs_name, fs_ugi)   (dataset.set_hdfs_config style)
+        if isinstance(configs, str):
+            self._hadoop = "hadoop"
+            self._configs = {"fs.default.name": fs_name_or_hadoop_home,
+                             "hadoop.job.ugi": configs}
+        else:
+            self._hadoop = os.path.join(fs_name_or_hadoop_home, "bin",
+                                        "hadoop") \
+                if os.path.isdir(fs_name_or_hadoop_home) \
+                else fs_name_or_hadoop_home
+            self._configs = dict(configs or {})
+        self._retry = max(1, retry_times)
+
+    def _cmd(self, args, capture=True, retries=None):
+        pre = [self._hadoop, "fs"]
+        for k, v in self._configs.items():
+            pre += ["-D", "%s=%s" % (k, v)]
+        last = None
+        for _ in range(retries if retries is not None else self._retry):
+            try:
+                r = subprocess.run(pre + args, capture_output=capture,
+                                   timeout=300)
+            except FileNotFoundError:
+                raise ExecuteError(
+                    "no %r binary on PATH — HDFS access needs a hadoop "
+                    "install; use LocalFS or mount the data locally"
+                    % (self._hadoop,))
+            if r.returncode == 0:
+                return r.stdout if capture else b""
+            last = r
+        raise ExecuteError("hadoop fs %s failed rc=%d: %s"
+                           % (args, last.returncode,
+                              (last.stderr or b"").decode(errors="replace")))
+
+    def _test(self, flag, path):
+        # `-test` exits 1 to mean "no" — that's an answer, not a transient
+        # failure; retrying it would spin the JVM for every miss
+        try:
+            self._cmd(["-test", flag, path], retries=1)
+            return True
+        except ExecuteError:
+            return False
+
+    def cat(self, path):
+        return self._cmd(["-cat", path])
+
+    def ls(self, path):
+        out = self._cmd(["-ls", path]).decode()
+        return [ln.split()[-1] for ln in out.splitlines()
+                if ln and not ln.startswith("Found")]
+
+    def is_exist(self, path):
+        return self._test("-e", path)
+
+    def is_dir(self, path):
+        return self._test("-d", path)
+
+    def is_file(self, path):
+        return self.is_exist(path) and not self.is_dir(path)
+
+    def makedirs(self, path):
+        self._cmd(["-mkdir", "-p", path])
+
+    def delete(self, path):
+        self._cmd(["-rm", "-r", "-f", path])
+
+    def rename(self, src, dst, overwrite=False):
+        if overwrite and self.is_exist(dst):
+            self.delete(dst)
+        self._cmd(["-mv", src, dst])
+
+    def upload(self, local_path, hdfs_path, overwrite=False):
+        if overwrite and self.is_exist(hdfs_path):
+            self.delete(hdfs_path)
+        self._cmd(["-put", local_path, hdfs_path])
+
+    def download(self, hdfs_path, local_path, overwrite=False):
+        if overwrite and os.path.exists(local_path):
+            LocalFS().delete(local_path)
+        self._cmd(["-get", hdfs_path, local_path])

@@ -28,6 +28,28 @@ def _build(name, srcs):
     return so
 
 
+def load_data_feed():
+    """ctypes handle to the multislot text parser, or None."""
+    import ctypes
+
+    so = _build("libdata_feed", ["data_feed.cc"])
+    if so is None:
+        return None
+    lib = ctypes.CDLL(so)
+    i64 = ctypes.c_int64
+    lib.dfd_count.restype = i64
+    lib.dfd_count.argtypes = [ctypes.c_char_p, i64, ctypes.c_int,
+                              ctypes.POINTER(i64)]
+    lib.dfd_parse.restype = ctypes.c_int
+    lib.dfd_parse.argtypes = [
+        ctypes.c_char_p, i64, ctypes.c_int, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+        ctypes.POINTER(ctypes.POINTER(i64)),
+        ctypes.POINTER(ctypes.POINTER(i64)),
+    ]
+    return lib
+
+
 def load_ps_store():
     """ctypes handle to the embedding-store library, or None."""
     import ctypes

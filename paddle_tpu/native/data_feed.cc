@@ -1,0 +1,116 @@
+// Multi-slot text parsing — the hot loop of the reference's
+// MultiSlotDataFeed (paddle/fluid/framework/data_feed.cc:
+// ParseOneInstance). Line format, per slot: "<num> <v1> ... <vnum>",
+// values are floats ('f' slots) or uint64 feasign ids ('u' slots).
+//
+// Two-phase C API (caller allocates):
+//   dfd_count: scan the buffer, count lines + per-slot totals
+//   dfd_parse: fill per-slot flat value arrays + per-line offsets
+// Loaded via ctypes (paddle_tpu/native/__init__.py); the Python engine
+// falls back to a numpy parser when no toolchain is present.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+// Skip spaces/tabs only — a token chase must NEVER cross a newline, or a
+// truncated line would silently merge with the next sample (strtod's own
+// whitespace skip accepts '\n').
+inline const char* skip_sp(const char* p, const char* end) {
+  while (p < end && (*p == ' ' || *p == '\t')) ++p;
+  return p;
+}
+inline bool at_eol(const char* p, const char* end) {
+  return p >= end || *p == '\n' || *p == '\r';
+}
+}  // namespace
+
+extern "C" {
+
+// Returns the number of lines, or -(1+line_index) on a malformed line.
+long long dfd_count(const char* buf, long long len, int n_slots,
+                    long long* value_counts) {
+  for (int s = 0; s < n_slots; ++s) value_counts[s] = 0;
+  long long lines = 0;
+  const char* p = buf;
+  const char* end = buf + len;
+  while (p < end) {
+    // skip blank lines
+    while (p < end && (*p == '\n' || *p == '\r')) ++p;
+    if (p >= end) break;
+    for (int s = 0; s < n_slots; ++s) {
+      char* next = nullptr;
+      p = skip_sp(p, end);
+      if (at_eol(p, end)) return -(1 + lines);
+      long long num = strtoll(p, &next, 10);
+      if (next == p || num <= 0) return -(1 + lines);
+      p = next;
+      value_counts[s] += num;
+      for (long long j = 0; j < num; ++j) {
+        // values are consumed generically here; typed in dfd_parse
+        p = skip_sp(p, end);
+        if (at_eol(p, end)) return -(1 + lines);
+        strtod(p, &next);
+        if (next == p) return -(1 + lines);
+        p = next;
+      }
+    }
+    ++lines;
+    p = skip_sp(p, end);
+    if (!at_eol(p, end)) return -(1 + lines);  // extra tokens on the line
+    while (p < end && *p != '\n') ++p;
+  }
+  return lines;
+}
+
+// types: one char per slot, 'f' (float32) or 'u' (int64 feasign).
+// fvals[s] / uvals[s]: flat output for slot s (only the matching type is
+// written). offsets[s]: [n_lines+1] prefix of per-line value counts.
+int dfd_parse(const char* buf, long long len, int n_slots,
+              const char* types, float** fvals, long long** uvals,
+              long long** offsets) {
+  long long line = 0;
+  const char* p = buf;
+  const char* end = buf + len;
+  long long* pos = (long long*)calloc(n_slots, sizeof(long long));
+  if (!pos) return -1;
+  for (int s = 0; s < n_slots; ++s) offsets[s][0] = 0;
+  while (p < end) {
+    while (p < end && (*p == '\n' || *p == '\r')) ++p;
+    if (p >= end) break;
+    for (int s = 0; s < n_slots; ++s) {
+      char* next = nullptr;
+      p = skip_sp(p, end);
+      if (at_eol(p, end)) { free(pos); return -1; }
+      long long num = strtoll(p, &next, 10);
+      if (next == p || num <= 0) { free(pos); return -1; }
+      p = next;
+      if (types[s] == 'f') {
+        for (long long j = 0; j < num; ++j) {
+          p = skip_sp(p, end);
+          if (at_eol(p, end)) { free(pos); return -1; }
+          fvals[s][pos[s] + j] = strtof(p, &next);
+          if (next == p) { free(pos); return -1; }
+          p = next;
+        }
+      } else {
+        for (long long j = 0; j < num; ++j) {
+          p = skip_sp(p, end);
+          if (at_eol(p, end)) { free(pos); return -1; }
+          uvals[s][pos[s] + j] = strtoll(p, &next, 10);
+          if (next == p) { free(pos); return -1; }
+          p = next;
+        }
+      }
+      pos[s] += num;
+      offsets[s][line + 1] = pos[s];
+    }
+    ++line;
+    while (p < end && *p != '\n') ++p;
+  }
+  free(pos);
+  return 0;
+}
+
+}  // extern "C"

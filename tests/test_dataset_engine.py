@@ -1,0 +1,366 @@
+"""Dataset engine + multiprocess DataLoader + train_from_dataset —
+reference ``dataset.py``/``data_feed.cc``/``executor.py:920`` per
+SURVEY §2 (Dataset/DataFeed engine, Trainer stack rows)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fs import LocalFS, split_files
+
+
+def _write_multislot(path, n_lines, seed, dense_dim=3, ragged=False):
+    """Lines: dense float slot [dense_dim] + int64 id slot (1 or ragged
+    1-3 ids) + float label slot [1]."""
+    rng = np.random.RandomState(seed)
+    rows = []
+    for _ in range(n_lines):
+        dense = rng.rand(dense_dim)
+        n_ids = rng.randint(1, 4) if ragged else 1
+        ids = rng.randint(0, 50, size=n_ids)
+        label = [float(rng.randint(0, 2))]
+        parts = [str(dense_dim)] + ["%.6f" % v for v in dense]
+        parts += [str(n_ids)] + [str(i) for i in ids]
+        parts += ["1"] + ["%.1f" % label[0]]
+        rows.append(" ".join(parts))
+    with open(path, "w") as f:
+        f.write("\n".join(rows) + "\n")
+    return rows
+
+
+def _use_vars(ragged=False):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        dense = layers.data("dense", [3])
+        ids = layers.data("ids", [1], dtype="int64",
+                          lod_level=1 if ragged else 0)
+        label = layers.data("label", [1])
+    return main, startup, [dense, ids, label]
+
+
+def test_in_memory_dataset_load_and_batch(tmp_path):
+    f1 = str(tmp_path / "a.txt")
+    f2 = str(tmp_path / "b.txt")
+    _write_multislot(f1, 5, seed=1)
+    _write_multislot(f2, 3, seed=2)
+    _, _, use_vars = _use_vars()
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(4)
+    ds.set_use_var(use_vars)
+    ds.set_filelist([f1, f2])
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 8
+    batches = list(ds.batch_reader()())
+    assert len(batches) == 2
+    assert batches[0]["dense"].shape == (4, 3)
+    assert batches[0]["ids"].dtype == np.int64
+    assert batches[1]["dense"].shape == (4, 3)
+    # drop_last drops the trailing partial batch
+    ds.set_batch_size(3)
+    assert len(list(ds.batch_reader(drop_last=True)())) == 2
+
+
+def test_native_and_numpy_parsers_agree(tmp_path):
+    from paddle_tpu import native
+    from paddle_tpu.fluid.dataset import _native_parse, _numpy_parse
+
+    lib = native.load_data_feed()
+    assert lib is not None, "native toolchain expected in this image"
+    f = str(tmp_path / "c.txt")
+    _write_multislot(f, 7, seed=3, ragged=True)
+    raw = open(f, "rb").read()
+    nat = _native_parse(lib, raw, ["f", "u", "f"])
+    ref = _numpy_parse(raw.decode(), ["f", "u", "f"])
+    for (nv, no), (rv, ro) in zip(nat, ref):
+        np.testing.assert_allclose(nv, rv, rtol=1e-6)
+        np.testing.assert_array_equal(no, ro)
+
+
+def test_local_shuffle_deterministic(tmp_path):
+    f = str(tmp_path / "d.txt")
+    _write_multislot(f, 20, seed=4)
+    _, _, use_vars = _use_vars()
+
+    def mk():
+        ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+        ds.set_batch_size(20)
+        ds.set_use_var(use_vars)
+        ds.set_filelist([f])
+        ds.set_seed(123)
+        ds.load_into_memory()
+        ds.local_shuffle()
+        return next(ds.batch_reader()())["dense"]
+
+    a, b = mk(), mk()
+    np.testing.assert_allclose(a, b)  # same seed -> same order
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(20)
+    ds.set_use_var(use_vars)
+    ds.set_filelist([f])
+    ds.load_into_memory()
+    unshuffled = next(ds.batch_reader()())["dense"]
+    assert not np.allclose(a, unshuffled)  # shuffle moved something
+
+
+def test_global_shuffle_partitions(tmp_path):
+    f = str(tmp_path / "e.txt")
+    _write_multislot(f, 10, seed=5)
+    _, _, use_vars = _use_vars()
+
+    class FakeFleet:
+        def __init__(self, idx, num):
+            self._i, self._n = idx, num
+
+        def worker_index(self):
+            return self._i
+
+        def worker_num(self):
+            return self._n
+
+    seen = []
+    for r in range(2):
+        ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+        ds.set_batch_size(100)
+        ds.set_use_var(use_vars)
+        ds.set_filelist([f])
+        ds.set_seed(7)
+        ds.load_into_memory()
+        ds.global_shuffle(FakeFleet(r, 2))
+        assert ds.get_shuffle_data_size() == 5
+        seen.append(next(ds.batch_reader()())["dense"])
+    # the two trainers' shards are disjoint and cover everything
+    allrows = np.concatenate(seen)
+    assert allrows.shape == (10, 3)
+    assert len({tuple(np.round(r, 5)) for r in allrows}) == 10
+
+
+def test_queue_dataset_streams(tmp_path):
+    f = str(tmp_path / "f.txt")
+    _write_multislot(f, 6, seed=6)
+    _, _, use_vars = _use_vars()
+    ds = fluid.DatasetFactory().create_dataset("QueueDataset")
+    ds.set_batch_size(2)
+    ds.set_use_var(use_vars)
+    ds.set_filelist([f])
+    assert len(list(ds.batch_reader()())) == 3
+    with pytest.raises(NotImplementedError):
+        ds.local_shuffle()
+
+
+def test_ragged_slot_feeds_lod(tmp_path):
+    f = str(tmp_path / "g.txt")
+    _write_multislot(f, 4, seed=8, ragged=True)
+    _, _, use_vars = _use_vars(ragged=True)
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(4)
+    ds.set_use_var(use_vars)
+    ds.set_filelist([f])
+    ds.load_into_memory()
+    feed = next(ds.batch_reader()())
+    ids = feed["ids"]
+    assert hasattr(ids, "recursive_sequence_lengths")
+    lens = ids.recursive_sequence_lengths()[-1]
+    assert len(lens) == 4 and all(1 <= n <= 3 for n in lens)
+
+
+def test_pipe_command_filters(tmp_path):
+    f = str(tmp_path / "h.txt")
+    _write_multislot(f, 6, seed=9)
+    _, _, use_vars = _use_vars()
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(100)
+    ds.set_use_var(use_vars)
+    ds.set_filelist([f])
+    ds.set_pipe_command("head -n 2")
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 2
+
+
+def test_train_from_dataset_e2e(tmp_path):
+    """Executor.train_from_dataset: a linear model fits multislot data."""
+    f = str(tmp_path / "train.txt")
+    rng = np.random.RandomState(11)
+    w_true = np.array([1.5, -2.0, 0.5])
+    with open(f, "w") as fh:
+        for _ in range(64):
+            x = rng.rand(3)
+            y = float(x @ w_true)
+            fh.write("3 %f %f %f 1 0 1 %f\n" % (x[0], x[1], x[2], y))
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        dense = layers.data("dense", [3])
+        ids = layers.data("ids", [1], dtype="int64")
+        label = layers.data("label", [1])
+        pred = layers.fc(dense, 1)
+        loss = layers.reduce_mean(layers.square(pred - label))
+        fluid.optimizer.SGD(learning_rate=0.5).minimize(loss)
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(16)
+    ds.set_use_var([dense, ids, label])
+    ds.set_filelist([f])
+    ds.load_into_memory()
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        first = None
+        for epoch in range(15):
+            ds.local_shuffle()
+            n = exe.train_from_dataset(main, ds, fetch_list=[loss])
+            assert n == 4
+        feed = next(ds.batch_reader()())
+        (final_loss,) = exe.run(main, feed=feed, fetch_list=[loss])
+    assert float(np.asarray(final_loss)) < 0.01
+
+
+def test_dataloader_from_dataset(tmp_path):
+    f = str(tmp_path / "i.txt")
+    _write_multislot(f, 8, seed=12)
+    _, _, use_vars = _use_vars()
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(4)
+    ds.set_use_var(use_vars)
+    ds.set_filelist([f])
+    ds.load_into_memory()
+    loader = fluid.DataLoader.from_dataset(ds)
+    batches = list(loader)
+    assert len(batches) == 2
+    assert np.asarray(batches[0]["dense"]).shape == (4, 3)
+
+
+def test_multiprocess_dataloader_covers_stream():
+    """mp workers split the batch stream round-robin with no loss."""
+    data = np.arange(40, dtype=np.float32).reshape(10, 4)
+
+    def gen():
+        for i in range(10):
+            yield [data[i:i + 1]]
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4])
+    loader = fluid.DataLoader.from_generator(
+        feed_list=[x], use_multiprocess=True, num_workers=3,
+        stage_on_device=False)
+    loader.set_batch_generator(gen)
+    rows = sorted(float(np.asarray(b["x"])[0, 0]) for b in loader)
+    assert rows == [float(v) for v in data[:, 0]]
+
+
+def test_local_fs_and_split_files(tmp_path):
+    fs = LocalFS()
+    d = str(tmp_path / "sub")
+    fs.makedirs(d)
+    p = os.path.join(d, "x.bin")
+    with open(p, "wb") as f:
+        f.write(b"hello")
+    assert fs.is_file(p) and fs.is_dir(d) and fs.is_exist(p)
+    assert fs.cat(p) == b"hello"
+    assert fs.ls_dir(d) == ["x.bin"]
+    p2 = os.path.join(d, "y.bin")
+    fs.rename(p, p2)
+    assert fs.is_exist(p2) and not fs.is_exist(p)
+    fs.delete(d)
+    assert not fs.is_exist(d)
+    files = ["f%d" % i for i in range(7)]
+    s0 = split_files(files, 0, 3)
+    s1 = split_files(files, 1, 3)
+    s2 = split_files(files, 2, 3)
+    assert sorted(s0 + s1 + s2) == sorted(files)
+    assert not (set(s0) & set(s1))
+
+
+def test_hdfs_client_without_hadoop_errors():
+    from paddle_tpu.fs import ExecuteError, HDFSClient
+
+    client = HDFSClient("hdfs://nowhere:9000", "user,passwd")
+    client._hadoop = "definitely_not_a_real_binary"
+    with pytest.raises(ExecuteError):
+        client.cat("hdfs://nowhere:9000/x")
+
+
+def test_native_parser_rejects_truncated_line(tmp_path):
+    from paddle_tpu import native
+    from paddle_tpu.fluid.dataset import _native_parse
+
+    lib = native.load_data_feed()
+    assert lib is not None
+    # slot 0 claims 2 floats but only has 1; next line must NOT be merged
+    bad = b"2 1.0\n2 3.0 4.0\n"
+    with pytest.raises(ValueError):
+        _native_parse(lib, bad, ["f"])
+
+
+def test_ragged_batches_share_feed_signature(tmp_path):
+    """Different token totals pad to the same power-of-two bound, so the
+    executor compiles once, not per batch."""
+    f = str(tmp_path / "sig.txt")
+    _write_multislot(f, 8, seed=20, ragged=True)
+    _, _, use_vars = _use_vars(ragged=True)
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(4)
+    ds.set_use_var(use_vars)
+    ds.set_filelist([f])
+    ds.load_into_memory()
+    shapes = {np.asarray(feed["ids"]).shape
+              for feed in ds.batch_reader()()}
+    assert len(shapes) == 1, shapes  # both batches hit the same bucket
+
+
+def test_multiprocess_worker_error_propagates():
+    def bad_gen():
+        yield [np.zeros((1, 4), np.float32)]
+        raise RuntimeError("boom in worker")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4])
+    loader = fluid.DataLoader.from_generator(
+        feed_list=[x], use_multiprocess=True, num_workers=1,
+        stage_on_device=False)
+    loader.set_batch_generator(bad_gen)
+    with pytest.raises(RuntimeError, match="worker 0 died"):
+        list(loader)
+
+
+def test_multiprocess_preserves_lod():
+    from paddle_tpu.fluid.lod import LoDTensor
+
+    def gen():
+        yield [LoDTensor(np.arange(6, dtype=np.float32)[:, None],
+                         [[4, 2]])]
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [1], lod_level=1)
+    loader = fluid.DataLoader.from_generator(
+        feed_list=[x], use_multiprocess=True, num_workers=1,
+        stage_on_device=False)
+    loader.set_batch_generator(gen)
+    (batch,) = list(loader)
+    assert hasattr(batch["x"], "recursive_sequence_lengths")
+    assert batch["x"].recursive_sequence_lengths() == [[4, 2]]
+
+
+def test_worker_info_sharding():
+    """A shard-aware generator keeps every batch it yields."""
+    from paddle_tpu.fluid.reader import get_worker_info
+
+    def gen():
+        info = get_worker_info()
+        assert info is not None
+        info.mark_sharded()
+        for i in range(info.id, 6, info.num_workers):
+            yield [np.full((1, 2), float(i), np.float32)]
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [2])
+    loader = fluid.DataLoader.from_generator(
+        feed_list=[x], use_multiprocess=True, num_workers=2,
+        stage_on_device=False)
+    loader.set_batch_generator(gen)
+    vals = sorted(float(np.asarray(b["x"])[0, 0]) for b in loader)
+    assert vals == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0]
