@@ -12,6 +12,10 @@ __all__ = ["AutoMixedPrecisionLists"]
 white_list = {
     "conv2d", "conv3d", "depthwise_conv2d", "conv2d_transpose",
     "conv3d_transpose", "matmul", "mul", "bmm",
+    # the pallas kernel does its matmuls in the INPUT dtype with f32
+    # accumulation (softmax stays f32 internally), so bf16 inputs hit
+    # the MXU at full rate
+    "fused_multihead_attention",
 }
 
 # numerically sensitive ops kept in fp32

@@ -36,7 +36,7 @@ __all__ = [
     "hard_swish", "uniform_random", "gelu", "erf", "topk", "unique",
     "autoincreased_step_counter", "smooth_l1", "dice_loss", "py_func",
     "linear_chain_crf", "crf_decoding", "ctc_greedy_decoder",
-    "shard_tensor",
+    "shard_tensor", "fused_attention",
 ]
 
 
@@ -232,11 +232,14 @@ def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
                      padding=0, stride=1, dilation=1, groups=None,
                      param_attr=None, bias_attr=None, act=None, name=None):
     helper = LayerHelper("conv3d_transpose", **locals())
+    groups = groups or 1
     if isinstance(filter_size, int):
         filter_size = [filter_size] * 3
     num_channels = input.shape[1]
     w = helper.create_parameter(
-        param_attr, [num_channels, num_filters] + list(filter_size), _data_type(input)
+        param_attr,
+        [num_channels, num_filters // groups] + list(filter_size),
+        _data_type(input),
     )
     out = helper.create_variable_for_type_inference(input.dtype)
     helper.append_op(
@@ -246,6 +249,9 @@ def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
         attrs={
             "strides": [stride] * 3 if isinstance(stride, int) else list(stride),
             "paddings": [padding] * 3 if isinstance(padding, int) else list(padding),
+            "dilations": [dilation] * 3 if isinstance(dilation, int)
+            else list(dilation),
+            "groups": groups,
         },
     )
     out = _append_bias(helper, out, bias_attr, channel_dim=1)
@@ -1503,4 +1509,22 @@ def shard_tensor(x, spec, name=None):
                      outputs={"Out": [out]},
                      attrs={"spec": ["" if s is None else str(s)
                                      for s in spec]})
+    return out
+
+
+def fused_attention(q, k, v, attn_bias=None, scale=None, dropout_prob=0.0,
+                    is_test=False, name=None):
+    """Fused softmax(q·kᵀ·scale + bias)·v over [B, H, S, d] heads — a
+    single Pallas TPU kernel per (batch, head) with in-kernel dropout;
+    falls back to the unfused jnp math off-TPU (kernels/attention.py)."""
+    helper = LayerHelper("fused_multihead_attention", **locals())
+    out = helper.create_variable_for_type_inference(q.dtype)
+    inputs = {"Q": [q], "K": [k], "V": [v]}
+    if attn_bias is not None:
+        inputs["Bias"] = [attn_bias]
+    attrs = {"dropout_prob": float(dropout_prob), "is_test": is_test}
+    if scale is not None:
+        attrs["scale"] = float(scale)
+    helper.append_op(type="fused_multihead_attention", inputs=inputs,
+                     outputs={"Out": [out]}, attrs=attrs)
     return out

@@ -188,14 +188,20 @@ def isfinite(x):
 
 
 def range(start, end, step, dtype):
+    """XLA needs a static output length, so python-scalar bounds ride as
+    attrs (trace-time constants); Variable bounds are rejected at the op
+    (a data-dependent length can never compile)."""
     helper = LayerHelper("range")
-    start = assign(np.asarray([start], framework.convert_dtype(dtype))) if not isinstance(start, Variable) else start
-    end = assign(np.asarray([end], framework.convert_dtype(dtype))) if not isinstance(end, Variable) else end
-    step = assign(np.asarray([step], framework.convert_dtype(dtype))) if not isinstance(step, Variable) else step
+    inputs, attrs = {}, {"dtype": framework.dtype_str(
+        framework.convert_dtype(dtype))}
+    for key, val in (("Start", start), ("End", end), ("Step", step)):
+        if isinstance(val, Variable):
+            inputs[key] = [val]
+        else:
+            attrs[key.lower()] = float(val)
     out = helper.create_variable_for_type_inference(dtype)
-    helper.append_op(type="range",
-                     inputs={"Start": [start], "End": [end], "Step": [step]},
-                     outputs={"Out": [out]})
+    helper.append_op(type="range", inputs=inputs, outputs={"Out": [out]},
+                     attrs=attrs)
     return out
 
 
@@ -228,6 +234,8 @@ def ones_like(x, out=None):
 
 def diag(diagonal):
     helper = LayerHelper("diag", **locals())
+    if not isinstance(diagonal, Variable):  # reference accepts ndarray/list
+        diagonal = assign(np.asarray(diagonal))
     out = helper.create_variable_for_type_inference(diagonal.dtype)
     helper.append_op(type="diag", inputs={"Diagonal": [diagonal]},
                      outputs={"Out": [out]})

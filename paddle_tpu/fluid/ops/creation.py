@@ -137,12 +137,33 @@ def _assign_value(ctx, op):
 def _range(ctx, op):
     import jax.numpy as jnp
 
-    start = ctx.get_input(op, "Start")
-    end = ctx.get_input(op, "End")
-    step = ctx.get_input(op, "Step")
-    # XLA needs static shapes: range bounds must be trace-time constants.
-    start, end, step = (np.asarray(v).item() if not hasattr(v, "aval") else v for v in (start, end, step))
-    ctx.set_output(op, "Out", jnp.arange(start, end, step))
+    # XLA needs static shapes: bounds come as attrs (python scalars); a
+    # Variable bound resolves statically through its producing
+    # assign_value/fill_constant op (everything in the traced block is a
+    # Tracer, so runtime values can't size the output)
+    def _static_bound(name):
+        for o in ctx.block.ops:
+            if name in o.output_arg_names():
+                if o.type == "assign_value":
+                    return float(np.asarray(o.attr("values")).ravel()[0])
+                if o.type == "fill_constant":
+                    return float(o.attr("value"))
+        return None
+
+    vals = []
+    for slot, attr in (("Start", "start"), ("End", "end"), ("Step", "step")):
+        v = op.attr(attr)
+        if v is None:
+            names = op.input(slot)
+            v = _static_bound(names[0]) if names else None
+            if v is None:
+                raise NotImplementedError(
+                    "range bounds must be python scalars or "
+                    "assign_value/fill_constant Variables — a "
+                    "runtime-variable bound cannot have a static shape")
+        vals.append(v)
+    dtype = np.dtype(op.attr("dtype", "float32"))
+    ctx.set_output(op, "Out", jnp.arange(*vals, dtype=dtype))
 
 
 @register("linspace")

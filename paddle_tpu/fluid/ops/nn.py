@@ -69,45 +69,53 @@ def _conv3d(ctx, op):
     ctx.set_output(op, "Output", out)
 
 
-@register("conv2d_transpose")
-def _conv2d_transpose(ctx, op):
+def _deconv(x, w, strides, pads, dils, groups):
+    """Fractionally-strided conv (reference conv_transpose semantics:
+    out = (H-1)*s + k_eff - 2p): a conv over the lhs-dilated input with a
+    spatially FLIPPED kernel. Fluid deconv filters are [C_in, C_out/g,
+    *k]; the equivalent forward conv wants [C_out, C_in/g, *k]."""
     import jax
 
+    nd = len(strides)
+    cin = w.shape[0]
+    cog = w.shape[1]  # C_out / groups
+    # [g, C_in/g, C_out/g, *k] -> [g, C_out/g, C_in/g, *k] -> flat OI*k
+    wg = w.reshape((groups, cin // groups, cog) + w.shape[2:])
+    wg = wg.swapaxes(1, 2).reshape((groups * cog, cin // groups) +
+                                   w.shape[2:])
+    flip = (slice(None), slice(None)) + (slice(None, None, -1),) * nd
+    wg = wg[flip]
+    k_eff = [(w.shape[2 + i] - 1) * dils[i] + 1 for i in range(nd)]
+    pad = [(k_eff[i] - 1 - pads[i], k_eff[i] - 1 - pads[i])
+           for i in range(nd)]
+    spatial = "DHW"[-nd:]
+    spec = ("NC" + spatial, "OI" + spatial, "NC" + spatial)
+    return jax.lax.conv_general_dilated(
+        x, wg, window_strides=(1,) * nd, padding=pad,
+        lhs_dilation=tuple(strides), rhs_dilation=tuple(dils),
+        dimension_numbers=spec, feature_group_count=groups)
+
+
+@register("conv2d_transpose")
+def _conv2d_transpose(ctx, op):
     x = ctx.get_input(op, "Input")
     w = ctx.get_input(op, "Filter")  # IOHW in fluid transpose convs
     strides = _pair(op.attr("strides", [1, 1]))
     pads = _pair(op.attr("paddings", [0, 0]))
     dil = _pair(op.attr("dilations", [1, 1]))
     groups = op.attr("groups", 1) or 1
-    out = jax.lax.conv_transpose(
-        x,
-        w,
-        strides=strides,
-        padding=((pads[0], pads[0]), (pads[1], pads[1])),
-        rhs_dilation=dil,
-        dimension_numbers=("NCHW", "IOHW", "NCHW"),
-        transpose_kernel=True,
-    )
-    ctx.set_output(op, "Output", out)
+    ctx.set_output(op, "Output", _deconv(x, w, strides, pads, dil, groups))
 
 
 @register("conv3d_transpose")
 def _conv3d_transpose(ctx, op):
-    import jax
-
     x = ctx.get_input(op, "Input")
     w = ctx.get_input(op, "Filter")
     strides = tuple(op.attr("strides", [1, 1, 1]))
-    pads = op.attr("paddings", [0, 0, 0])
-    out = jax.lax.conv_transpose(
-        x,
-        w,
-        strides=strides,
-        padding=tuple((p, p) for p in pads),
-        dimension_numbers=("NCDHW", "IODHW", "NCDHW"),
-        transpose_kernel=True,
-    )
-    ctx.set_output(op, "Output", out)
+    pads = list(op.attr("paddings", [0, 0, 0]))
+    dil = tuple(op.attr("dilations", [1, 1, 1]))
+    groups = op.attr("groups", 1) or 1
+    ctx.set_output(op, "Output", _deconv(x, w, strides, pads, dil, groups))
 
 
 def _pool(x, pooling_type, ksize, strides, pads, ceil_mode, exclusive, global_pool, adaptive):
@@ -556,3 +564,23 @@ def _multiplex(ctx, op):
     idx = ids.reshape(-1).astype(np.dtype("int32"))
     rows = jnp.arange(idx.shape[0])
     ctx.set_output(op, "Out", xs[idx, rows])
+
+
+@register("fused_multihead_attention", has_state=True)
+def _fused_multihead_attention(ctx, op):
+    """One-kernel attention (paddle_tpu/kernels/attention.py) — the
+    in-framework form of the reference's multihead_matmul fusion
+    (``ir/multihead_matmul_fuse_pass.cc``), available in training too."""
+    from ...kernels.attention import fused_attention
+
+    q = ctx.get_input(op, "Q")
+    k = ctx.get_input(op, "K")
+    v = ctx.get_input(op, "V")
+    bias = ctx.get_input(op, "Bias")
+    p = float(op.attr("dropout_prob", 0.0))
+    is_test = bool(op.attr("is_test", False))
+    scale = op.attr("scale", None)
+    drop = 0.0 if is_test else p
+    key = ctx.next_rng() if drop > 0.0 else None
+    ctx.set_output(op, "Out", fused_attention(
+        q, k, v, bias, scale=scale, dropout_prob=drop, rng_key=key))

@@ -229,7 +229,9 @@ def _expand_as(ctx, op):
     import jax.numpy as jnp
 
     x = ctx.get_input(op, "X")
-    y = ctx.get_input(op, "target_tensor") or ctx.get_input(op, "Y")
+    y = ctx.get_input(op, "target_tensor")
+    if y is None:
+        y = ctx.get_input(op, "Y")
     times = [t // s for t, s in zip(y.shape, x.shape)]
     ctx.set_output(op, "Out", jnp.tile(x, times))
 

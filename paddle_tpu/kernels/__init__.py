@@ -1,0 +1,5 @@
+"""Pallas TPU kernels for the ops XLA's generic fusions leave on the
+table (SURVEY §7 dispatch tier (b)). Every kernel has a pure-jnp fallback
+with identical semantics so CPU tests and non-TPU backends keep working."""
+
+from .attention import fused_attention  # noqa: F401

@@ -1,0 +1,313 @@
+"""Fused multi-head attention kernel (Pallas TPU).
+
+Replaces the 5-op attention chain (matmul → +bias → softmax → dropout →
+matmul) the reference computes as separate CUDA kernels (and its
+``multihead_matmul_fuse_pass`` fuses for inference) with ONE kernel per
+(batch, head): scores, softmax, dropout, and the PV matmul all stay in
+VMEM, so the [S, S] probability tile never round-trips HBM. The backward
+is a second single-block kernel that recomputes the probabilities
+(flash-style: residuals are just q/k/v, not the S×S matrix) and emits
+dq/dk/dv/dbias.
+
+Dropout inside the kernel draws from the TPU PRNG
+(``pltpu.prng_seed``/``prng_random_bits``) seeded per (batch, head); the
+backward reseeds identically, so the regenerated mask is bit-exact.
+
+Bounds: a single block holds the full [S, S] score tile in VMEM, which is
+the right call up to S ≈ 1024 fp32 (4 MB of 16 MB); longer sequences fall
+back to the jnp path (the ring/Ulysses layers in ``paddle_tpu.parallel``
+are the long-context answer — SURVEY §5.7).
+"""
+
+import functools
+import math
+import os
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+_MAX_FUSED_SEQ = 1024
+
+
+def _interpret():
+    """PADDLE_TPU_PALLAS_INTERPRET=1 runs the kernels through the pallas
+    interpreter (CPU CI exercises the real kernel bodies)."""
+    return os.environ.get("PADDLE_TPU_PALLAS_INTERPRET", "") == "1"
+
+
+def _supports_pallas():
+    try:
+        from jax.experimental import pallas as pl  # noqa: F401
+        from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+    except Exception:
+        return False
+    if _interpret():
+        return True
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def _uniform_from_bits(bits):
+    """uint32 random bits -> uniform [0, 1) float32 (24-bit mantissa)."""
+    return (bits >> jnp.uint32(8)).astype(jnp.float32) * (1.0 / (1 << 24))
+
+
+def _ref_attention(q, k, v, bias, scale, p_drop, seed):
+    """jnp reference (the fallback and the numerics oracle in tests)."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    p = jax.nn.softmax(s, axis=-1)
+    if p_drop > 0.0:
+        key = jax.random.fold_in(jax.random.PRNGKey(0), seed[0])
+        keep = jax.random.bernoulli(key, 1.0 - p_drop, p.shape)
+        p = jnp.where(keep, p / (1.0 - p_drop), 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(
+        q.dtype)
+
+
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, *,
+                scale, p_drop, n_heads):
+    """One grid step = a BLOCK of batches for one head: batched matmuls
+    keep the MXU busy (a single (b, h) pair at S=128 is DMA-bound)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    q = q_ref[:, 0]                              # [Bb, S, d] native dtype
+    k = k_ref[:, 0]
+    v = v_ref[:, 0]
+    dn = (((2,), (2,)), ((0,), (0,)))            # batched q·kᵀ
+    # matmuls in the input dtype (bf16 MXU under AMP), f32 accumulate
+    s = jax.lax.dot_general(q, k, dn,
+                            preferred_element_type=jnp.float32) * scale
+    s = s + bias_ref[:, 0]                       # [Bb, Sq|1, S]
+    m = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.exp(s - m)
+    p = e / jnp.sum(e, axis=-1, keepdims=True)
+    if p_drop > 0.0:
+        b, h = pl.program_id(0), pl.program_id(1)
+        pltpu.prng_seed(seed_ref[0] + b * n_heads + h)
+        u = _uniform_from_bits(pltpu.prng_random_bits(p.shape))
+        p = jnp.where(u >= p_drop, p / (1.0 - p_drop), 0.0)
+    o_ref[:, 0] = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32).astype(o_ref.dtype)
+
+
+def _bwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
+                dq_ref, dk_ref, dv_ref, dbias_ref, *, scale, p_drop,
+                n_heads, acc_heads, reduce_rows):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    q = q_ref[:, 0]                              # [Bb, S, d] native dtype
+    k = k_ref[:, 0]
+    v = v_ref[:, 0]
+    do = do_ref[:, 0]
+    dn_qk = (((2,), (2,)), ((0,), (0,)))
+    s = jax.lax.dot_general(q, k, dn_qk,
+                            preferred_element_type=jnp.float32) * scale
+    s = s + bias_ref[:, 0]
+    m = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.exp(s - m)
+    p = e / jnp.sum(e, axis=-1, keepdims=True)   # pre-dropout probs
+    if p_drop > 0.0:
+        b, h = pl.program_id(0), pl.program_id(1)
+        pltpu.prng_seed(seed_ref[0] + b * n_heads + h)  # same stream as fwd
+        u = _uniform_from_bits(pltpu.prng_random_bits(p.shape))
+        keep = u >= p_drop
+        pd = jnp.where(keep, p / (1.0 - p_drop), 0.0)
+    else:
+        keep = None
+        pd = p
+    # dV = Pd^T dO ; dPd = dO V^T ; undo dropout ; softmax vjp ; dQ/dK
+    lp = q.dtype  # matmul operand precision (bf16 under AMP, f32 accum)
+    dv = jax.lax.dot_general(pd.astype(lp), do,
+                             (((1,), (1,)), ((0,), (0,))),
+                             preferred_element_type=jnp.float32)
+    dpd = jax.lax.dot_general(do, v, (((2,), (2,)), ((0,), (0,))),
+                              preferred_element_type=jnp.float32)
+    dp = dpd if keep is None else jnp.where(keep, dpd / (1.0 - p_drop), 0.0)
+    ds = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))
+    ds_lp = ds.astype(lp)
+    dq = jax.lax.dot_general(ds_lp, k, (((2,), (1,)), ((0,), (0,))),
+                             preferred_element_type=jnp.float32) * scale
+    dk = jax.lax.dot_general(ds_lp, q, (((1,), (1,)), ((0,), (0,))),
+                             preferred_element_type=jnp.float32) * scale
+    dq_ref[:, 0] = dq.astype(dq_ref.dtype)
+    dk_ref[:, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[:, 0] = dv.astype(dv_ref.dtype)
+    # dbias reduced IN-kernel to the bias's broadcast shape: sum over the
+    # query rows when bias rows broadcast, accumulate across the head
+    # grid when bias heads broadcast (h is the fastest grid dim, so the
+    # output block is revisited in order)
+    contrib = ds
+    if reduce_rows:
+        contrib = jnp.sum(contrib, axis=1, keepdims=True)  # [Bb, 1, S]
+    if acc_heads:
+        @pl.when(pl.program_id(1) == 0)
+        def _init():
+            dbias_ref[:, 0] = contrib
+
+        @pl.when(pl.program_id(1) != 0)
+        def _acc():
+            dbias_ref[:, 0] += contrib
+    else:
+        dbias_ref[:, 0] = contrib
+
+
+def _batch_block(B, S, tile_budget):
+    """Largest divisor of B whose [Bb, S, S] fp32 score tile stays under
+    ``tile_budget`` bytes (the fwd kernel holds ~4 such temporaries, the
+    bwd ~8 — budgets sized so either fits 16 MB VMEM)."""
+    cap = max(1, tile_budget // (S * S * 4))
+    bb = 1
+    for c in range(1, min(B, cap) + 1):
+        if B % c == 0:
+            bb = c
+    return bb
+
+
+def _specs(q, bias, tile_budget=2 * 1024 * 1024):
+    from jax.experimental import pallas as pl
+
+    B, H, S, d = q.shape
+    Bb = _batch_block(B, S, tile_budget)
+    grid = (B // Bb, H)
+    qspec = pl.BlockSpec((Bb, 1, S, d), lambda b, h: (b, h, 0, 0))
+    sspec = pl.BlockSpec((Bb, 1, S, S), lambda b, h: (b, h, 0, 0))
+    bspec = pl.BlockSpec((Bb, 1, bias.shape[2], S),
+                         lambda b, h, _nb=bias.shape[1]:
+                         (b, h if _nb > 1 else 0, 0, 0))
+    return grid, qspec, sspec, bspec
+
+
+_BWD_BUDGET = 512 * 1024  # ~8 live [Bb, S, S] f32 temporaries
+
+
+def _fwd_budget(p_drop):
+    """With dropout the fwd must pick the SAME batch block as the bwd —
+    the per-(block, head) PRNG draw shapes must line up for the
+    regenerated mask to be bit-exact."""
+    return _BWD_BUDGET if p_drop > 0.0 else 2 * 1024 * 1024
+
+
+def _pallas_attention(q, k, v, bias, scale, p_drop, seed):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, S, d = q.shape
+    grid, qspec, _, bspec = _specs(q, bias,
+                                   tile_budget=_fwd_budget(p_drop))
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, p_drop=p_drop,
+                          n_heads=H),
+        grid=grid,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  qspec, qspec, qspec, bspec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=_interpret(),
+    )(seed, q, k, v, bias)
+
+
+def _pallas_attention_bwd(q, k, v, bias, seed, do, scale, p_drop):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, S, d = q.shape
+    grid, qspec, sspec, bspec = _specs(q, bias, tile_budget=_BWD_BUDGET)
+    acc_heads = bias.shape[1] == 1
+    reduce_rows = bias.shape[2] == 1
+    dbias_shape = (B, bias.shape[1], bias.shape[2], S)
+    f32 = jnp.float32
+    dq, dk, dv, dbias = pl.pallas_call(
+        functools.partial(_bwd_kernel, scale=scale, p_drop=p_drop,
+                          n_heads=H, acc_heads=acc_heads,
+                          reduce_rows=reduce_rows),
+        grid=grid,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  qspec, qspec, qspec, bspec, qspec],
+        out_specs=[qspec, qspec, qspec, bspec],
+        out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype),
+                   jax.ShapeDtypeStruct(q.shape, q.dtype),
+                   jax.ShapeDtypeStruct(q.shape, q.dtype),
+                   jax.ShapeDtypeStruct(dbias_shape, f32)],
+        interpret=_interpret(),
+    )(seed, q, k, v, bias, do)
+    return dq, dk, dv, dbias
+
+
+def _use_kernel(q, p_drop):
+    """The TPU PRNG primitives have no CPU-interpreter lowering, so
+    dropout kernels only run on real TPU; everything else also runs
+    under interpret mode in CI."""
+    if not _supports_pallas() or q.shape[2] > _MAX_FUSED_SEQ:
+        return False
+    return not (_interpret() and p_drop > 0.0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _fused(q, k, v, bias, scale, p_drop, seed):
+    if _use_kernel(q, p_drop):
+        return _pallas_attention(q, k, v, bias, scale, p_drop, seed)
+    return _ref_attention(q, k, v, bias, scale, p_drop, seed)
+
+
+def _fused_fwd(q, k, v, bias, scale, p_drop, seed):
+    return _fused(q, k, v, bias, scale, p_drop, seed), (q, k, v, bias, seed)
+
+
+def _fused_bwd(scale, p_drop, res, do):
+    q, k, v, bias, seed = res
+    if _use_kernel(q, p_drop):
+        dq, dk, dv, dbias = _pallas_attention_bwd(q, k, v, bias, seed, do,
+                                               scale, p_drop)
+    else:
+        # recompute-based vjp through the reference path
+        def f(q_, k_, v_, bias_):
+            return _ref_attention(q_, k_, v_, bias_, scale, p_drop, seed)
+
+        _, vjp = jax.vjp(f, q, k, v, bias)
+        dq, dk, dv, dbias = vjp(do)
+        return dq, dk, dv, dbias, _seed_ct(seed)
+    # dbias is already reduced to the bias broadcast shape in-kernel
+    return dq, dk, dv, dbias.astype(bias.dtype), _seed_ct(seed)
+
+
+def _seed_ct(seed):
+    """Cotangent for an integer input is float0 (jax's tangent type)."""
+    return np.zeros(seed.shape, dtype=jax.dtypes.float0)
+
+
+_fused.defvjp(_fused_fwd, _fused_bwd)
+
+
+def fused_attention(q, k, v, bias=None, scale=None, dropout_prob=0.0,
+                    rng_key=None):
+    """softmax(q·kᵀ·scale + bias)·v fused per (batch, head).
+
+    q/k/v: [B, H, S, d]; bias broadcastable [B, 1|H, 1|S, S] additive
+    (0 keep / -1e4 mask); returns [B, H, S, d] in q's dtype.
+    """
+    B, H, S, d = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    if bias is None:
+        bias = jnp.zeros((B, 1, 1, S), jnp.float32)
+    bias = jnp.broadcast_to(
+        bias.astype(jnp.float32),
+        (B, bias.shape[1], bias.shape[2], S))
+    if dropout_prob > 0.0:
+        if rng_key is None:
+            raise ValueError("dropout_prob > 0 requires rng_key")
+        seed = jax.random.randint(rng_key, (1,), 0, 2 ** 31 - 1,
+                                  dtype=jnp.int32)
+    else:
+        seed = jnp.zeros((1,), jnp.int32)
+    return _fused(q, k, v, bias, float(scale), float(dropout_prob), seed)
