@@ -7,4 +7,6 @@ pipelines and tests runnable (set ``PADDLE_TPU_DATASET_STRICT=1`` to
 error instead).
 """
 
-from . import cifar, common, imdb, mnist, uci_housing  # noqa: F401
+from . import (cifar, common, conll05, flowers, image, imdb,  # noqa: F401
+               imikolov, mnist, movielens, sentiment, uci_housing,
+               voc2012, wmt14, wmt16)

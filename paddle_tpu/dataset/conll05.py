@@ -1,0 +1,81 @@
+"""CoNLL-2005 SRL reader — reference ``dataset/conll05.py``: per-token
+(word, ctx windows, predicate, mark) id sequences + BIO label ids."""
+
+import numpy as np
+
+from . import common
+
+__all__ = ["get_dict", "get_embedding", "test"]
+
+_LABELS = ["B-A0", "I-A0", "B-A1", "I-A1", "B-V", "O"]
+
+
+def _synthetic(seed, n):
+    rng = np.random.RandomState(seed)
+    sents = []
+    for _ in range(n):
+        length = rng.randint(4, 12)
+        words = ["word%02d" % w for w in rng.randint(0, 80, length)]
+        verb_pos = int(rng.randint(0, length))
+        labels = ["O"] * length
+        labels[verb_pos] = "B-V"
+        if verb_pos > 0:
+            labels[0] = "B-A0"
+        if verb_pos < length - 1:
+            labels[-1] = "B-A1"
+        sents.append((words, verb_pos, labels))
+    return sents
+
+
+_CACHE = None
+
+
+def _load():
+    global _CACHE
+    if _CACHE is not None:
+        return _CACHE
+    if not common.synthetic_allowed():
+        raise IOError("conll05 requires the licensed corpus on disk")
+    common._warn_synthetic("conll05")
+    sents = _synthetic(0, 200)
+    words = sorted({w for s, _, _ in sents for w in s})
+    word_dict = {w: i for i, w in enumerate(words)}
+    word_dict["<unk>"] = len(word_dict)
+    verb_dict = {w: i for i, w in enumerate(words)}
+    label_dict = {l: i for i, l in enumerate(_LABELS)}
+    _CACHE = (sents, word_dict, verb_dict, label_dict)
+    return _CACHE
+
+
+def get_dict():
+    _, wd, vd, ld = _load()
+    return dict(wd), dict(vd), dict(ld)
+
+
+def get_embedding():
+    """Pretrained word embeddings are not redistributable; callers get a
+    deterministic random table of the right shape."""
+    _, wd, _, _ = _load()
+    return np.random.RandomState(7).rand(len(wd), 32).astype("float32")
+
+
+def test():
+    """Yields the reference's 9-slot sample: word ids, 5 context-window
+    id sequences, predicate id, mark, label ids."""
+
+    def rd():
+        sents, wd, vd, ld = _load()
+        unk = wd["<unk>"]
+        for words, vpos, labels in sents:
+            ids = [wd.get(w, unk) for w in words]
+            n = len(ids)
+
+            def ctx(off):
+                return [ids[min(max(i + off, 0), n - 1)] for i in range(n)]
+
+            pred = vd.get(words[vpos], 0)
+            mark = [1 if i == vpos else 0 for i in range(n)]
+            yield (ids, ctx(-2), ctx(-1), ctx(0), ctx(1), ctx(2),
+                   [pred] * n, mark, [ld[l] for l in labels])
+
+    return rd

@@ -1,0 +1,44 @@
+"""Oxford-102 flowers reader — reference ``dataset/flowers.py``:
+(CHW float32 image, label) with train/valid/test splits."""
+
+import numpy as np
+
+from . import common, image
+
+__all__ = ["train", "test", "valid"]
+
+_N_CLASSES = 102
+
+
+def _synthetic_split(seed, n):
+    rng = np.random.RandomState(seed)
+    for _ in range(n):
+        label = int(rng.randint(0, _N_CLASSES))
+        img = (rng.rand(3, 64, 64) * 0.2 +
+               (label / _N_CLASSES)).astype("float32")
+        yield img, label
+
+
+def _reader(seed, n, mapper=None):
+    def rd():
+        if not common.synthetic_allowed():
+            raise IOError("flowers requires the cached Oxford-102 archive")
+        common._warn_synthetic("flowers")
+        for img, label in _synthetic_split(seed, n):
+            if mapper is not None:
+                img = mapper(img)
+            yield img, label
+
+    return rd
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=False):
+    return _reader(0, 300, mapper)
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=False):
+    return _reader(1, 60, mapper)
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=False):
+    return _reader(2, 60, mapper)

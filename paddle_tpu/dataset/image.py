@@ -1,0 +1,62 @@
+"""Image transform helpers — reference ``dataset/image.py`` (cv2-based);
+numpy-only here (no cv2 in the image): CHW float arrays throughout."""
+
+import numpy as np
+
+__all__ = ["resize_short", "center_crop", "random_crop", "left_right_flip",
+           "to_chw", "simple_transform"]
+
+
+def to_chw(img, order=(2, 0, 1)):
+    return np.transpose(img, order)
+
+
+def _resize_nearest(img, h, w):
+    """img CHW -> CHW nearest-neighbor resize (pure numpy)."""
+    c, ih, iw = img.shape
+    ys = (np.arange(h) * ih / h).astype(int).clip(0, ih - 1)
+    xs = (np.arange(w) * iw / w).astype(int).clip(0, iw - 1)
+    return img[:, ys][:, :, xs]
+
+
+def resize_short(img, size):
+    """Resize so the SHORT side equals ``size`` (aspect preserved)."""
+    c, h, w = img.shape
+    if h <= w:
+        return _resize_nearest(img, size, max(1, int(w * size / h)))
+    return _resize_nearest(img, max(1, int(h * size / w)), size)
+
+
+def center_crop(img, size, is_color=True):
+    c, h, w = img.shape
+    y0 = max(0, (h - size) // 2)
+    x0 = max(0, (w - size) // 2)
+    return img[:, y0:y0 + size, x0:x0 + size]
+
+
+def random_crop(img, size, rng=None):
+    rng = rng or np.random
+    c, h, w = img.shape
+    y0 = int(rng.randint(0, max(1, h - size + 1)))
+    x0 = int(rng.randint(0, max(1, w - size + 1)))
+    return img[:, y0:y0 + size, x0:x0 + size]
+
+
+def left_right_flip(img, is_color=True):
+    return img[..., ::-1].copy()
+
+
+def simple_transform(img, resize_size, crop_size, is_train,
+                     is_color=True, mean=None, rng=None):
+    """resize_short -> (random|center) crop -> (train) random flip ->
+    mean subtract — the reference's standard pipeline."""
+    img = resize_short(img, resize_size)
+    if is_train:
+        img = random_crop(img, crop_size, rng)
+        if (rng or np.random).randint(2):
+            img = left_right_flip(img, is_color)
+    else:
+        img = center_crop(img, crop_size, is_color)
+    if mean is not None:
+        img = img - np.asarray(mean, img.dtype).reshape(-1, 1, 1)
+    return img

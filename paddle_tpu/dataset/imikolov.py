@@ -1,0 +1,77 @@
+"""PTB (imikolov) language-model reader — reference
+``dataset/imikolov.py``: ``build_dict`` then n-gram or sequence samples
+of word ids."""
+
+import collections
+import tarfile
+
+import numpy as np
+
+from . import common
+
+__all__ = ["build_dict", "train", "test", "NGRAM", "SEQ"]
+
+URL = "https://dataset.bj.bcebos.com/imikolov%2Fsimple-examples.tgz"
+MD5 = "30177ea32e27c525793142b6bf2c8e2d"
+
+NGRAM = "ngram"
+SEQ = "seq"
+
+_TRAIN = "./simple-examples/data/ptb.train.txt"
+_TEST = "./simple-examples/data/ptb.valid.txt"
+
+
+def _synthetic_corpus(seed, n_lines):
+    rng = np.random.RandomState(seed)
+    words = ["w%03d" % i for i in range(200)]
+    return [" ".join(rng.choice(words, rng.randint(4, 12)))
+            for _ in range(n_lines)]
+
+
+def _lines(path_in_tar):
+    try:
+        tar = tarfile.open(common.download(URL, "imikolov", MD5))
+        with tar.extractfile(path_in_tar) as f:
+            return [ln.decode().strip() for ln in f]
+    except IOError:
+        if not common.synthetic_allowed():
+            raise
+        common._warn_synthetic("imikolov")
+        return _synthetic_corpus(0 if "train" in path_in_tar else 1,
+                                 500 if "train" in path_in_tar else 100)
+
+
+def build_dict(min_word_freq=50):
+    freq = collections.Counter()
+    for ln in _lines(_TRAIN):
+        freq.update(ln.split())
+    freq.pop("<unk>", None)
+    kept = sorted((w for w, c in freq.items() if c > min_word_freq),
+                  key=lambda w: (-freq[w], w))
+    word_idx = {w: i for i, w in enumerate(kept)}
+    word_idx["<unk>"] = len(word_idx)
+    return word_idx
+
+
+def _reader(path, word_idx, n, data_type):
+    def rd():
+        unk = word_idx["<unk>"]
+        for ln in _lines(path):
+            if data_type == NGRAM:
+                ids = [word_idx.get(w, unk)
+                       for w in ["<s>"] * (n - 1) + ln.split() + ["<e>"]]
+                for i in range(n, len(ids) + 1):
+                    yield tuple(ids[i - n:i])
+            else:
+                ids = [word_idx.get(w, unk) for w in ln.split()]
+                yield ids[:-1], ids[1:]
+
+    return rd
+
+
+def train(word_idx, n, data_type=NGRAM):
+    return _reader(_TRAIN, word_idx, n, data_type)
+
+
+def test(word_idx, n, data_type=NGRAM):
+    return _reader(_TEST, word_idx, n, data_type)

@@ -1,0 +1,156 @@
+"""MovieLens-1M reader — reference ``dataset/movielens.py``: user/movie
+feature tuples + rating for the recommender workloads."""
+
+import re
+import zipfile
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "get_movie_title_dict", "max_movie_id",
+           "max_user_id", "max_job_id", "movie_categories", "user_info",
+           "movie_info", "age_table"]
+
+URL = "https://dataset.bj.bcebos.com/movielens%2Fml-1m.zip"
+MD5 = "c4d9eecfca2ab87c1945afe126590906"
+
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+_CATEGORIES = [
+    "Action", "Adventure", "Animation", "Children's", "Comedy", "Crime",
+    "Documentary", "Drama", "Fantasy", "Film-Noir", "Horror", "Musical",
+    "Mystery", "Romance", "Sci-Fi", "Thriller", "War", "Western",
+]
+
+
+class MovieInfo:
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self):
+        return [self.index,
+                [_CATEGORIES.index(c) for c in self.categories
+                 if c in _CATEGORIES],
+                [_TITLE_DICT[w] for w in self.title.split()]]
+
+
+class UserInfo:
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == "M"
+        self.age = age_table.index(int(age)) if int(age) in age_table else 0
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [self.index, 0 if self.is_male else 1, self.age, self.job_id]
+
+
+_MOVIES, _USERS, _RATINGS, _TITLE_DICT = None, None, None, None
+
+
+def _synthetic(rng):
+    movies, users, ratings = {}, {}, []
+    for i in range(1, 81):
+        movies[i] = MovieInfo(i, [_CATEGORIES[i % len(_CATEGORIES)]],
+                              "title %d word%d" % (i % 7, i % 13))
+    for u in range(1, 41):
+        users[u] = UserInfo(u, "M" if u % 2 else "F",
+                            age_table[u % len(age_table)], u % 21)
+    for _ in range(600):
+        ratings.append((int(rng.randint(1, 41)), int(rng.randint(1, 81)),
+                        float(rng.randint(1, 6))))
+    return movies, users, ratings
+
+
+def _load():
+    global _MOVIES, _USERS, _RATINGS, _TITLE_DICT
+    if _MOVIES is not None:
+        return
+    try:
+        path = common.download(URL, "movielens", MD5)
+        movies, users, ratings = {}, {}, []
+        pat = re.compile(r"(.*)\((\d{4})\)$")
+        with zipfile.ZipFile(path) as z:
+            with z.open("ml-1m/movies.dat") as f:
+                for ln in f:
+                    mid, title, cats = ln.decode(
+                        "latin1").strip().split("::")
+                    m = pat.match(title)
+                    movies[int(mid)] = MovieInfo(
+                        mid, cats.split("|"),
+                        (m.group(1) if m else title).strip().lower())
+            with z.open("ml-1m/users.dat") as f:
+                for ln in f:
+                    uid, gender, age, job, _zip = ln.decode(
+                        "latin1").strip().split("::")
+                    users[int(uid)] = UserInfo(uid, gender, age, job)
+            with z.open("ml-1m/ratings.dat") as f:
+                for ln in f:
+                    uid, mid, score, _ts = ln.decode().strip().split("::")
+                    ratings.append((int(uid), int(mid), float(score)))
+    except IOError:
+        if not common.synthetic_allowed():
+            raise
+        common._warn_synthetic("movielens")
+        movies, users, ratings = _synthetic(np.random.RandomState(0))
+    words = {w for m in movies.values() for w in m.title.split()}
+    _TITLE_DICT = {w: i for i, w in enumerate(sorted(words))}
+    _MOVIES, _USERS, _RATINGS = movies, users, ratings
+
+
+def _reader(is_test):
+    def rd():
+        _load()
+        rng = np.random.RandomState(42)
+        mask = rng.rand(len(_RATINGS)) < 0.1
+        for (uid, mid, score), te in zip(_RATINGS, mask):
+            if te != is_test or uid not in _USERS or mid not in _MOVIES:
+                continue
+            yield _USERS[uid].value() + _MOVIES[mid].value() + [score]
+
+    return rd
+
+
+def train():
+    return _reader(False)
+
+
+def test():
+    return _reader(True)
+
+
+def movie_info():
+    _load()
+    return dict(_MOVIES)
+
+
+def user_info():
+    _load()
+    return dict(_USERS)
+
+
+def get_movie_title_dict():
+    _load()
+    return dict(_TITLE_DICT)
+
+
+def max_movie_id():
+    _load()
+    return max(_MOVIES)
+
+
+def max_user_id():
+    _load()
+    return max(_USERS)
+
+
+def max_job_id():
+    _load()
+    return max(u.job_id for u in _USERS.values())
+
+
+def movie_categories():
+    return {c: i for i, c in enumerate(_CATEGORIES)}

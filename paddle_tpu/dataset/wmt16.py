@@ -1,0 +1,76 @@
+"""WMT16 EN↔DE reader — reference ``dataset/wmt16.py``: same triple
+format as wmt14 with per-language dicts and selectable direction."""
+
+import numpy as np
+
+from . import common
+from . import wmt14 as _w14
+
+__all__ = ["train", "test", "validation", "get_dict"]
+
+
+def _pairs(seed, n):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        src = ["en%02d" % w for w in rng.randint(0, 80,
+                                                 rng.randint(3, 10))]
+        trg = ["de%02d" % w for w in rng.randint(0, 80,
+                                                 rng.randint(3, 10))]
+        out.append((src, trg))
+    return out
+
+
+def _load(src_dict_size, trg_dict_size, src_lang):
+    if not common.synthetic_allowed():
+        raise IOError("wmt16 requires the cached archive")
+    common._warn_synthetic("wmt16")
+    tr, te, va = _pairs(0, 300), _pairs(1, 60), _pairs(2, 60)
+    if src_lang != "en":
+        tr = [(b, a) for a, b in tr]
+        te = [(b, a) for a, b in te]
+        va = [(b, a) for a, b in va]
+
+    def mkdict(side, size):
+        freq = {}
+        for pair in tr:
+            for w in pair[side]:
+                freq[w] = freq.get(w, 0) + 1
+        kept = sorted(freq, key=lambda w: (-freq[w], w))[:size - 3]
+        ids = {_w14.START: 0, _w14.END: 1, _w14.UNK: 2}
+        for w in kept:
+            ids[w] = len(ids)
+        return ids
+
+    return (tr, te, va, mkdict(0, src_dict_size),
+            mkdict(1, trg_dict_size))
+
+
+def get_dict(lang, dict_size, reverse=False):
+    _, _, _, sd, td = _load(dict_size, dict_size,
+                            "en" if lang == "en" else "de")
+    d = sd if lang == "en" else td
+    return {v: k for k, v in d.items()} if reverse else d
+
+
+def _reader(idx, src_dict_size, trg_dict_size, src_lang):
+    def rd():
+        tr, te, va, sd, td = _load(src_dict_size, trg_dict_size, src_lang)
+        for src, trg in (tr, te, va)[idx]:
+            s = [sd.get(w, 2) for w in src]
+            t = [td.get(w, 2) for w in trg]
+            yield s, [0] + t, t + [1]
+
+    return rd
+
+
+def train(src_dict_size, trg_dict_size, src_lang="en"):
+    return _reader(0, src_dict_size, trg_dict_size, src_lang)
+
+
+def test(src_dict_size, trg_dict_size, src_lang="en"):
+    return _reader(1, src_dict_size, trg_dict_size, src_lang)
+
+
+def validation(src_dict_size, trg_dict_size, src_lang="en"):
+    return _reader(2, src_dict_size, trg_dict_size, src_lang)

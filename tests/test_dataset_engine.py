@@ -364,3 +364,53 @@ def test_worker_info_sharding():
     loader.set_batch_generator(gen)
     vals = sorted(float(np.asarray(b["x"])[0, 0]) for b in loader)
     assert vals == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0]
+
+
+def test_dataset_library_modules():
+    """Every reference dataset module exists and yields the documented
+    sample shapes (synthetic fallback in this sealed image)."""
+    from paddle_tpu import dataset as D
+
+    wd = D.imikolov.build_dict(min_word_freq=1)
+    grams = list(D.imikolov.train(wd, 4)())[:5]
+    assert all(len(g) == 4 for g in grams)
+    seqs = list(D.imikolov.train(wd, 4, D.imikolov.SEQ)())[:2]
+    assert len(seqs[0]) == 2
+
+    rows = list(D.movielens.train()())[:3]
+    assert len(rows[0]) == 8  # 4 user + 3 movie + score
+    assert D.movielens.max_user_id() >= 1
+
+    s, t_in, t_out = next(iter(D.wmt14.train(100)()))
+    assert t_in[0] == 0 and t_out[-1] == 1  # <s> prefix, <e> suffix
+    s16 = next(iter(D.wmt16.train(100, 100)()))
+    assert len(s16) == 3
+
+    sample = next(iter(D.conll05.test()()))
+    assert len(sample) == 9
+    n = len(sample[0])
+    assert all(len(col) == n for col in sample[1:])
+
+    wd2 = D.sentiment.get_word_dict()
+    ids, label = next(iter(D.sentiment.train()()))
+    assert label in (0, 1) and max(ids) < len(wd2)
+
+    img, lbl = next(iter(D.flowers.train()()))
+    assert img.shape == (3, 64, 64)
+    img2, mask = next(iter(D.voc2012.train()()))
+    assert mask.shape == (64, 64) and mask.max() > 0
+
+
+def test_image_transforms():
+    from paddle_tpu.dataset import image as I
+
+    img = np.arange(3 * 40 * 60, dtype=np.float32).reshape(3, 40, 60)
+    r = I.resize_short(img, 20)
+    assert r.shape == (3, 20, 30)  # short side 20, aspect kept
+    c = I.center_crop(r, 16)
+    assert c.shape == (3, 16, 16)
+    f = I.left_right_flip(c)
+    np.testing.assert_allclose(f[:, :, 0], c[:, :, -1])
+    t = I.simple_transform(img, 24, 16, is_train=True,
+                           rng=np.random.RandomState(0))
+    assert t.shape == (3, 16, 16)
