@@ -12,6 +12,7 @@ from . import (  # noqa: F401
     data_feeder,
     dataset,
     executor,
+    flags,
     framework,
     initializer,
     io,
@@ -30,6 +31,7 @@ from .data_feeder import DataFeeder  # noqa: F401
 from .dataset import DatasetFactory  # noqa: F401
 from .reader import DataLoader, PyReader  # noqa: F401
 from .executor import Executor, Scope, global_scope, scope_guard  # noqa: F401
+from .flags import get_flags, set_flags  # noqa: F401
 from .lod import LoDTensor, create_lod_tensor  # noqa: F401
 from .framework import (  # noqa: F401
     Program,

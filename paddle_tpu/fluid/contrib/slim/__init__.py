@@ -1,4 +1,4 @@
 """Slim: model compression (reference ``contrib/slim/``) — quantization,
 pruning, distillation."""
 
-from . import quantization  # noqa: F401
+from . import distillation, prune, quantization  # noqa: F401

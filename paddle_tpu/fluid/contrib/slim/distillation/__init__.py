@@ -1,0 +1,4 @@
+from .distiller import (FSPDistiller, L2Distiller, SoftLabelDistiller,
+                        merge)
+
+__all__ = ["merge", "L2Distiller", "FSPDistiller", "SoftLabelDistiller"]

@@ -1,0 +1,132 @@
+"""Knowledge distillation — reference
+``contrib/slim/distillation/distiller.py`` (L2/FSP/SoftLabel distillers)
+and ``distillation_strategy.py`` (teacher-graph merge).
+
+``merge`` clones the teacher program's ops/vars into the student program
+under a name prefix with every teacher var stop-gradient (the reference
+merges IrGraphs the same way); the distillers then build a combined loss
+from (student var, teacher var) pairs. Everything compiles into ONE XLA
+program, so teacher+student run as a single fused step on the chip.
+"""
+
+from .... import framework
+from ....executor import global_scope
+from ....framework import Operator
+from .... import layers
+
+__all__ = ["merge", "L2Distiller", "FSPDistiller", "SoftLabelDistiller"]
+
+
+def merge(teacher_program, student_program, data_name_map=None,
+          scope=None, name_prefix="teacher_"):
+    """Clone teacher ops/vars into the student program. ``data_name_map``
+    maps teacher feed names -> student feed names so both nets read the
+    same inputs. Teacher params keep their (prefixed) scope values;
+    everything teacher-side is stop_gradient."""
+    scope = scope if scope is not None else global_scope()
+    data_name_map = dict(data_name_map or {})
+    sblock = student_program.global_block()
+    tblock = teacher_program.global_block()
+
+    def rename(n):
+        return data_name_map.get(n, name_prefix + n)
+
+    for name, var in tblock.vars.items():
+        if name in data_name_map:
+            continue
+        nv = sblock.create_var(
+            name=rename(name), shape=list(var.shape), dtype=var.dtype,
+            persistable=var.persistable, stop_gradient=True)
+        nv.lod_level = getattr(var, "lod_level", 0)
+        if var.persistable:
+            tv = scope.find_var(name)
+            if tv is not None:
+                scope.set_var(rename(name), tv)
+    for op in tblock.ops:
+        inputs = {slot: [rename(n) for n in names]
+                  for slot, names in op.inputs.items()}
+        outputs = {slot: [rename(n) for n in names]
+                   for slot, names in op.outputs.items()}
+        sblock.ops.append(Operator(sblock, op.type, inputs, outputs,
+                                   dict(op.attrs)))
+    student_program._bump()
+    return student_program
+
+
+class L2Distiller:
+    """||student_feature - teacher_feature||² (reference L2Distiller)."""
+
+    def __init__(self, student_var_name, teacher_var_name,
+                 distillation_loss_weight=1.0):
+        self.student = student_var_name
+        self.teacher = teacher_var_name
+        self.weight = distillation_loss_weight
+
+    def distiller_loss(self, program):
+        block = program.global_block()
+        s = block._find_var_recursive(self.student)
+        t = block._find_var_recursive(self.teacher)
+        diff = layers.elementwise_sub(s, t)
+        return layers.scale(layers.reduce_mean(layers.square(diff)),
+                            scale=self.weight)
+
+
+class SoftLabelDistiller:
+    """KL between temperature-softened teacher/student logits (reference
+    SoftLabelDistiller)."""
+
+    def __init__(self, student_var_name, teacher_var_name,
+                 student_temperature=1.0, teacher_temperature=1.0,
+                 distillation_loss_weight=1.0):
+        self.student = student_var_name
+        self.teacher = teacher_var_name
+        self.t_s = student_temperature
+        self.t_t = teacher_temperature
+        self.weight = distillation_loss_weight
+
+    def distiller_loss(self, program):
+        block = program.global_block()
+        s = block._find_var_recursive(self.student)
+        t = block._find_var_recursive(self.teacher)
+        s_soft = layers.softmax(layers.scale(s, scale=1.0 / self.t_s))
+        t_soft = layers.softmax(layers.scale(t, scale=1.0 / self.t_t))
+        t_soft.stop_gradient = True
+        ce = layers.cross_entropy(s_soft, t_soft, soft_label=True)
+        return layers.scale(layers.reduce_mean(ce), scale=self.weight)
+
+
+class FSPDistiller:
+    """Flow-of-solution-procedure matrices matched in L2 (reference
+    FSPDistiller): fsp(a, b) = aᵀb / HW over spatial positions."""
+
+    def __init__(self, student_pairs, teacher_pairs,
+                 distillation_loss_weight=1.0):
+        self.student_pairs = list(student_pairs)
+        self.teacher_pairs = list(teacher_pairs)
+        self.weight = distillation_loss_weight
+
+    @staticmethod
+    def _fsp_matrix(a, b):
+        # a [N, C1, H, W], b [N, C2, H, W] -> [N, C1, C2]
+        n, c1 = a.shape[0], a.shape[1]
+        c2 = b.shape[1]
+        hw = int(a.shape[2]) * int(a.shape[3])
+        fa = layers.reshape(a, [-1, c1, hw])
+        fb = layers.transpose(layers.reshape(b, [-1, c2, hw]), [0, 2, 1])
+        return layers.scale(layers.matmul(fa, fb), scale=1.0 / hw)
+
+    def distiller_loss(self, program):
+        block = program.global_block()
+        losses = []
+        for (s0, s1), (t0, t1) in zip(self.student_pairs,
+                                      self.teacher_pairs):
+            sm = self._fsp_matrix(block._find_var_recursive(s0),
+                                  block._find_var_recursive(s1))
+            tm = self._fsp_matrix(block._find_var_recursive(t0),
+                                  block._find_var_recursive(t1))
+            losses.append(layers.reduce_mean(
+                layers.square(layers.elementwise_sub(sm, tm))))
+        total = losses[0]
+        for l in losses[1:]:
+            total = layers.elementwise_add(total, l)
+        return layers.scale(total, scale=self.weight)

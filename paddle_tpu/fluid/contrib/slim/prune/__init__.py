@@ -1,0 +1,3 @@
+from .pruner import Pruner, StructurePruner, sensitivity
+
+__all__ = ["Pruner", "StructurePruner", "sensitivity"]

@@ -1,0 +1,129 @@
+"""Structured pruning — reference ``contrib/slim/prune/pruner.py``
+(StructurePruner: rank channels by a criterion, zero or drop them) and
+``prune_strategy.py`` (sensitivity analysis).
+
+TPU-native design: pruning is MASK-based (channels zeroed, shapes kept).
+XLA specializes on static shapes, so physically shrinking a conv's
+filter would recompile every downstream op per pruned network — the
+mask form keeps one executable and still removes the channels'
+contribution exactly; ``apply_masks`` re-zeroes after optimizer steps so
+pruned channels cannot regrow. (The reference's GPU path rewrites
+tensor shapes; its ``lazy`` mode is exactly this mask form.)
+"""
+
+import numpy as np
+
+from ....executor import global_scope
+
+__all__ = ["Pruner", "StructurePruner", "sensitivity"]
+
+
+class Pruner:
+    def prune(self, program, scope, params, ratios):
+        raise NotImplementedError
+
+
+class StructurePruner(Pruner):
+    """Rank slices of a parameter along ``pruning_axis`` by a criterion
+    ('l1_norm' | 'l2_norm' | 'abs_max') and zero the lowest ``ratio``."""
+
+    def __init__(self, pruning_axis=None, criterions=None):
+        self.pruning_axis = dict(pruning_axis or {"*": 0})
+        self.criterions = dict(criterions or {"*": "l1_norm"})
+        self._masks = {}
+
+    def _axis(self, name):
+        return self.pruning_axis.get(name, self.pruning_axis.get("*", 0))
+
+    def _criterion(self, name):
+        return self.criterions.get(name, self.criterions.get("*",
+                                                             "l1_norm"))
+
+    def cal_pruned_idx(self, name, param, ratio, axis=None):
+        """Indices of the channels to prune (lowest-scoring first)."""
+        axis = self._axis(name) if axis is None else axis
+        w = np.asarray(param)
+        moved = np.moveaxis(w, axis, 0).reshape(w.shape[axis], -1)
+        crit = self._criterion(name)
+        if crit == "l1_norm":
+            scores = np.abs(moved).sum(axis=1)
+        elif crit == "l2_norm":
+            scores = np.sqrt((moved ** 2).sum(axis=1))
+        elif crit == "abs_max":
+            scores = np.abs(moved).max(axis=1)
+        else:
+            raise ValueError("unknown criterion %r" % (crit,))
+        n_prune = int(w.shape[axis] * ratio)
+        return np.argsort(scores)[:n_prune]
+
+    def prune_tensor(self, tensor, pruned_idx, pruned_axis, lazy=True):
+        """Zero (lazy) or physically drop the given channels."""
+        w = np.asarray(tensor)
+        if lazy:
+            out = w.copy()
+            sl = [slice(None)] * w.ndim
+            sl[pruned_axis] = pruned_idx
+            out[tuple(sl)] = 0
+            return out
+        return np.delete(w, pruned_idx, axis=pruned_axis)
+
+    def prune(self, program, scope=None, params=None, ratios=None,
+              lazy=True):
+        """Apply channel pruning to ``params`` (names) at ``ratios`` in
+        ``scope``. ``lazy=True`` (the TPU default) zeroes channels and
+        records masks so ``apply_masks`` can re-zero after optimizer
+        updates; ``lazy=False`` physically deletes the channels — the
+        tensor SHRINKS, so the consuming Program must be rebuilt for the
+        new shapes (XLA recompiles either way). Returns
+        {param: pruned channel indices}."""
+        scope = scope if scope is not None else global_scope()
+        pruned = {}
+        for name, ratio in zip(params, ratios):
+            val = scope.find_var(name)
+            if val is None:
+                raise KeyError("param %r not in scope" % (name,))
+            axis = self._axis(name)
+            idx = self.cal_pruned_idx(name, val, ratio, axis)
+            scope.set_var(name, self.prune_tensor(val, idx, axis,
+                                                  lazy=lazy))
+            if lazy:
+                w = np.asarray(scope.find_var(name))
+                mask = np.ones(w.shape[axis], w.dtype)
+                mask[idx] = 0
+                self._masks[name] = (axis, mask)
+            pruned[name] = idx
+        return pruned
+
+    def apply_masks(self, scope=None):
+        """Re-zero pruned channels (call after optimizer steps so weight
+        updates cannot regrow them)."""
+        scope = scope if scope is not None else global_scope()
+        for name, (axis, mask) in self._masks.items():
+            w = np.asarray(scope.find_var(name))
+            shape = [1] * w.ndim
+            shape[axis] = -1
+            scope.set_var(name, w * mask.reshape(shape))
+
+    def flops_ratio(self, name):
+        """Fraction of the parameter's channels still live (from the
+        recorded mask)."""
+        axis, mask = self._masks[name]
+        return float(mask.sum() / mask.size)
+
+
+def sensitivity(program, scope, param_name, ratios, eval_fn,
+                pruner=None):
+    """Per-ratio quality loss of pruning one parameter (reference
+    ``prune_strategy.py`` SensitivePruneStrategy's measurement loop):
+    prunes at each ratio, runs ``eval_fn() -> metric``, restores."""
+    scope = scope if scope is not None else global_scope()
+    pruner = pruner or StructurePruner()
+    baseline = float(eval_fn())
+    original = np.asarray(scope.find_var(param_name)).copy()
+    out = {}
+    for r in ratios:
+        pruner.prune(program, scope, [param_name], [r])
+        out[r] = float(eval_fn()) - baseline
+        scope.set_var(param_name, original.copy())
+        pruner._masks.pop(param_name, None)
+    return out

@@ -1,6 +1,7 @@
 """DyGraph (eager) mode — reference ``python/paddle/fluid/dygraph/``."""
 
-from . import base, checkpoint, jit, layers, nn
+from . import base, checkpoint, jit, layers, nn, parallel
+from .parallel import DataParallel, ParallelEnv, prepare_context  # noqa: F401
 from .base import (  # noqa: F401
     Tracer,
     VarBase,

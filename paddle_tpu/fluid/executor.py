@@ -223,6 +223,30 @@ class Executor:
         for n, v in new_state.items():
             scope.set_var(n, v)
 
+        from . import flags as _flags
+
+        if _flags.check_nan_inf_enabled():
+            # debug mode (reference FLAGS_check_nan_inf / nan_inf_utils):
+            # force-materialize every fetch and updated persistable and
+            # name the first offender — costs a sync per step by design.
+            # Multi-process arrays are checked shard-locally (every SPMD
+            # process runs this, so together they cover the array).
+            def _local_view(x):
+                if hasattr(x, "is_fully_addressable") and \
+                        not x.is_fully_addressable:
+                    return np.asarray(x.addressable_shards[0].data)
+                return np.asarray(x)
+
+            for label, vals in (("fetch", zip(fetch_names, fetches)),
+                                ("state", new_state.items())):
+                for n, v in vals:
+                    arr = _local_view(v)
+                    if np.issubdtype(arr.dtype, np.floating) and \
+                            not np.isfinite(arr).all():
+                        raise FloatingPointError(
+                            "FLAGS_check_nan_inf: non-finite values in "
+                            "%s var %r after running program" % (label, n))
+
         if return_numpy:
             return [_fetch_numpy(x) for x in fetches]
         return list(fetches)

@@ -1,0 +1,44 @@
+"""Global flags — reference gflags surface (``fluid.set_flags`` /
+``get_flags``, ``platform/flags.cc``). Flags either map to real behavior
+here (listed below) or are accepted-and-recorded for API compatibility
+(reference flags that tune CUDA allocators etc. have no TPU meaning —
+XLA owns memory).
+
+Live flags:
+  FLAGS_check_nan_inf      executor checks every fetched value and every
+                           persistable update for non-finite numbers and
+                           raises naming the program (reference
+                           ``framework/details/nan_inf_utils_detail``)
+  FLAGS_cudnn_deterministic  accepted (XLA is deterministic by default)
+  FLAGS_eager_delete_tensor_gb  accepted (XLA buffer lifetime)
+"""
+
+import os
+
+__all__ = ["set_flags", "get_flags"]
+
+_FLAGS = {
+    "FLAGS_check_nan_inf": os.environ.get("FLAGS_check_nan_inf",
+                                          "0") in ("1", "true", "True"),
+    "FLAGS_cudnn_deterministic": False,
+    "FLAGS_eager_delete_tensor_gb": 0.0,
+    "FLAGS_fraction_of_gpu_memory_to_use": 0.92,
+    "FLAGS_paddle_num_threads": 1,
+}
+
+
+def set_flags(flags):
+    """Set one or more global flags (dict of name -> value)."""
+    for name, value in flags.items():
+        _FLAGS[name] = value
+
+
+def get_flags(names):
+    """Read flags by name (str or list of str)."""
+    if isinstance(names, str):
+        return {names: _FLAGS.get(names)}
+    return {n: _FLAGS.get(n) for n in names}
+
+
+def check_nan_inf_enabled():
+    return bool(_FLAGS.get("FLAGS_check_nan_inf"))

@@ -1,0 +1,219 @@
+"""slim prune/distillation, global flags (check_nan_inf), and dygraph
+DataParallel — reference ``contrib/slim/prune``, ``slim/distillation``,
+``platform/flags``, ``dygraph/parallel.py`` per SURVEY §2."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.contrib.slim.distillation import (FSPDistiller,
+                                                        L2Distiller,
+                                                        SoftLabelDistiller,
+                                                        merge)
+from paddle_tpu.fluid.contrib.slim.prune import (StructurePruner,
+                                                 sensitivity)
+
+RNG = np.random.RandomState(0)
+
+
+# ---------------------------------------------------------------- prune
+def test_structure_pruner_masks_lowest_channels():
+    w = np.stack([np.full((3, 3), 0.01, np.float32),
+                  np.full((3, 3), 1.0, np.float32),
+                  np.full((3, 3), 0.5, np.float32),
+                  np.full((3, 3), 2.0, np.float32)])  # [4, 3, 3]
+    scope = fluid.Scope()
+    scope.set_var("w", w)
+    pruner = StructurePruner()
+    pruned = pruner.prune(None, scope, ["w"], [0.5])
+    np.testing.assert_array_equal(sorted(pruned["w"]), [0, 2])
+    out = np.asarray(scope.find_var("w"))
+    assert (out[0] == 0).all() and (out[2] == 0).all()
+    assert (out[1] == 1.0).all() and (out[3] == 2.0).all()
+    # masks survive optimizer-style updates
+    scope.set_var("w", np.asarray(scope.find_var("w")) + 0.3)
+    pruner.apply_masks(scope)
+    out = np.asarray(scope.find_var("w"))
+    assert (out[0] == 0).all() and (out[3] == 2.3).all()
+    assert pruner.flops_ratio("w") == 0.5
+
+
+def test_pruned_conv_trains_with_dead_channels():
+    """End to end: prune half a conv's filters, keep training, masked
+    channels stay silent."""
+    img = RNG.rand(4, 1, 8, 8).astype(np.float32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [1, 8, 8])
+        c = layers.conv2d(x, 4, 3, padding=1, name="pconv",
+                          bias_attr=False)
+        loss = layers.reduce_mean(layers.square(c))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        pruner = StructurePruner()
+        pruner.prune(main, scope, ["pconv.w_0"], [0.5])
+        for _ in range(3):
+            exe.run(main, feed={"x": img}, fetch_list=[loss])
+            pruner.apply_masks(scope)
+        w = np.asarray(scope.find_var("pconv.w_0"))
+        axis, mask = pruner._masks["pconv.w_0"]
+        assert (w[mask == 0] == 0).all()
+        assert np.abs(w[mask == 1]).sum() > 0
+
+
+def test_sensitivity_analysis():
+    scope = fluid.Scope()
+    w = RNG.rand(8, 4).astype(np.float32)
+    scope.set_var("w", w)
+
+    def eval_fn():
+        return float(np.abs(np.asarray(scope.find_var("w"))).sum())
+
+    sens = sensitivity(None, scope, "w", [0.25, 0.5], eval_fn)
+    assert sens[0.5] < sens[0.25] < 0  # pruning more loses more mass
+    np.testing.assert_allclose(np.asarray(scope.find_var("w")), w)
+
+
+# --------------------------------------------------------- distillation
+def _student_teacher():
+    teacher, t_startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(teacher, t_startup):
+        x = layers.data("x", [4])
+        t_logits = layers.fc(x, 3, name="t_fc")
+    student, s_startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(student, s_startup):
+        x = layers.data("x", [4])
+        s_logits = layers.fc(x, 3, name="s_fc")
+    return (teacher, t_startup, t_logits), (student, s_startup, s_logits)
+
+
+def test_merge_and_soft_label_distillation():
+    (teacher, t_startup, t_logits), (student, s_startup, s_logits) = \
+        _student_teacher()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(t_startup)   # teacher params in scope
+        merge(teacher, student, data_name_map={"x": "x"}, scope=scope)
+        with fluid.program_guard(student, s_startup):
+            dist = SoftLabelDistiller(s_logits.name,
+                                      "teacher_" + t_logits.name,
+                                      distillation_loss_weight=1.0)
+            dloss = dist.distiller_loss(student)
+            fluid.optimizer.SGD(learning_rate=0.5).minimize(dloss)
+        exe.run(s_startup)
+        x = RNG.rand(8, 4).astype(np.float32)
+        t_w0 = np.asarray(scope.find_var("teacher_t_fc.w_0")).copy()
+        losses = []
+        for _ in range(20):
+            (l,) = exe.run(student, feed={"x": x}, fetch_list=[dloss])
+            losses.append(float(np.asarray(l)))
+        assert losses[-1] < losses[0]  # student moves toward teacher
+        # teacher stayed frozen
+        np.testing.assert_allclose(
+            np.asarray(scope.find_var("teacher_t_fc.w_0")), t_w0)
+
+
+def test_l2_and_fsp_distillers_build():
+    (teacher, t_startup, t_logits), (student, s_startup, s_logits) = \
+        _student_teacher()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(t_startup)
+        exe.run(s_startup)
+        merge(teacher, student, data_name_map={"x": "x"}, scope=scope)
+        with fluid.program_guard(student):
+            l2 = L2Distiller(s_logits.name, "teacher_" + t_logits.name)
+            loss = l2.distiller_loss(student)
+        x = RNG.rand(8, 4).astype(np.float32)
+        (lv,) = exe.run(student, feed={"x": x}, fetch_list=[loss])
+        assert float(np.asarray(lv)) >= 0
+
+    # FSP over two conv feature maps
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("img", [2, 8, 8])
+        a = layers.conv2d(x, 4, 3, padding=1, name="fa")
+        b = layers.conv2d(a, 4, 3, padding=1, name="fb")
+        ta = layers.conv2d(x, 4, 3, padding=1, name="ta")
+        tb = layers.conv2d(ta, 4, 3, padding=1, name="tb")
+        fsp = FSPDistiller([(a.name, b.name)], [(ta.name, tb.name)])
+        floss = fsp.distiller_loss(main)
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        exe = fluid.Executor()
+        exe.run(startup)
+        (fv,) = exe.run(main,
+                        feed={"img": RNG.rand(2, 2, 8, 8).astype(
+                            np.float32)},
+                        fetch_list=[floss])
+    assert float(np.asarray(fv)) >= 0
+
+
+# ---------------------------------------------------------------- flags
+def test_flags_roundtrip_and_check_nan_inf():
+    fluid.set_flags({"FLAGS_check_nan_inf": True})
+    assert fluid.get_flags("FLAGS_check_nan_inf")[
+        "FLAGS_check_nan_inf"] is True
+    try:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", [2])
+            out = layers.log(x)  # log(-1) -> nan
+        exe = fluid.Executor()
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            with pytest.raises(FloatingPointError, match="check_nan_inf"):
+                exe.run(main, feed={"x": -np.ones((1, 2), np.float32)},
+                        fetch_list=[out])
+            # clean values pass
+            (r,) = exe.run(main,
+                           feed={"x": np.ones((1, 2), np.float32)},
+                           fetch_list=[out])
+            np.testing.assert_allclose(np.asarray(r), 0.0, atol=1e-6)
+    finally:
+        fluid.set_flags({"FLAGS_check_nan_inf": False})
+
+
+# ----------------------------------------------------- dygraph parallel
+def test_dygraph_data_parallel_single_process():
+    """nranks=1: wrapper is transparent — loss unscaled, grads intact."""
+    from paddle_tpu.fluid import dygraph
+
+    with dygraph.guard():
+        layer = dygraph.nn.Linear(4, 2)
+        model = dygraph.DataParallel(layer)
+        env = dygraph.ParallelEnv()
+        assert env.nranks == 1 and env.local_rank == 0
+        x = dygraph.to_variable(RNG.rand(3, 4).astype(np.float32))
+        out = model(x)
+        loss = out.mean() if hasattr(out, "mean") else out
+        from paddle_tpu.fluid.layers import reduce_mean  # noqa: F401
+        scaled = model.scale_loss(loss)
+        assert scaled is loss  # no scaling at nranks == 1
+        model.apply_collective_grads()  # no-op, must not raise
+        assert model.state_dict()  # passthrough to the wrapped layer
+
+
+def test_pruner_physical_prune():
+    """lazy=False actually deletes channels (shapes shrink, no mask)."""
+    scope = fluid.Scope()
+    scope.set_var("w", RNG.rand(8, 3).astype(np.float32))
+    pruner = StructurePruner()
+    pruner.prune(None, scope, ["w"], [0.25], lazy=False)
+    assert np.asarray(scope.find_var("w")).shape == (6, 3)
+    assert "w" not in pruner._masks
+
+
+def test_parallel_env_reads_launcher_vars(monkeypatch):
+    from paddle_tpu.fluid import dygraph
+
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "4")
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "2")
+    env = dygraph.ParallelEnv()
+    assert env.nranks == 4 and env.local_rank == 2
