@@ -8,5 +8,5 @@ error instead).
 """
 
 from . import (cifar, common, conll05, flowers, image, imdb,  # noqa: F401
-               imikolov, mnist, movielens, sentiment, uci_housing,
+               imikolov, mnist, movielens, mq2007, sentiment, uci_housing,
                voc2012, wmt14, wmt16)

@@ -1,0 +1,94 @@
+"""MQ2007 learning-to-rank reader — reference ``dataset/mq2007.py``:
+per-query (label, 46-dim feature) lists in pointwise/pairwise/listwise
+form."""
+
+import os
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test"]
+
+URL = "http://research.microsoft.com/en-us/um/beijing/projects/letor/LETOR4.0/Data/MQ2007.rar"
+FEATURE_DIM = 46
+
+_FORMATS = ("pointwise", "pairwise", "listwise")
+
+
+def _parse_letor(path):
+    """Parse a LETOR text file: '<label> qid:<q> 1:<v> ... 46:<v> ...'."""
+    queries = {}
+    with open(path) as f:
+        for line in f:
+            body = line.split("#")[0].strip()
+            if not body:
+                continue
+            toks = body.split()
+            label = int(float(toks[0]))
+            qid = toks[1].split(":")[1]
+            feat = np.zeros(FEATURE_DIM, "float32")
+            for t in toks[2:]:
+                k, v = t.split(":")
+                idx = int(k) - 1
+                if 0 <= idx < FEATURE_DIM:
+                    feat[idx] = float(v)
+            queries.setdefault(qid, []).append((label, feat))
+    for docs in queries.values():
+        labels = np.asarray([d[0] for d in docs])
+        feats = np.stack([d[1] for d in docs])
+        yield labels, feats
+
+
+def _synthetic(seed, n_queries):
+    rng = np.random.RandomState(seed)
+    for _ in range(n_queries):
+        n_docs = int(rng.randint(5, 15))
+        feats = rng.rand(n_docs, FEATURE_DIM).astype("float32")
+        labels = rng.randint(0, 3, n_docs)
+        yield labels, feats
+
+
+def _queries(seed, n_queries, split):
+    """Real data when a LETOR text file sits in the cache dir
+    (``<DATA_HOME>/mq2007/<split>.txt`` — the .rar archive needs manual
+    extraction; no unrar in this stack), else synthetic fallback."""
+    cached = os.path.join(common.DATA_HOME, "mq2007", split + ".txt")
+    if os.path.exists(cached):
+        yield from _parse_letor(cached)
+        return
+    if not common.synthetic_allowed():
+        raise IOError(
+            "mq2007: extract the LETOR MQ2007 archive (%s) and place the "
+            "split at %s" % (URL, cached))
+    common._warn_synthetic("mq2007")
+    yield from _synthetic(seed, n_queries)
+
+
+def _reader(seed, n_queries, format, split):
+    if format not in _FORMATS:
+        raise ValueError("format must be one of %s, got %r"
+                         % (_FORMATS, format))
+
+    def rd():
+        for labels, feats in _queries(seed, n_queries, split):
+            if format == "listwise":
+                yield labels.astype("float32"), feats
+            elif format == "pairwise":
+                for i in range(len(labels)):
+                    for j in range(len(labels)):
+                        if labels[i] > labels[j]:
+                            yield feats[i], feats[j]
+            else:  # pointwise
+                for l, f in zip(labels, feats):
+                    yield f, float(l)
+
+    return rd
+
+
+def train(format="pairwise"):
+    return _reader(0, 60, format, "train")
+
+
+def test(format="pairwise"):
+    return _reader(1, 20, format, "test")

@@ -1,0 +1,22 @@
+
+
+def memory_stats(device_index=0):
+    """Device memory counters (reference memory/stat.h STAT_* surface):
+    {bytes_in_use, peak_bytes_in_use, bytes_limit, ...} from the XLA
+    allocator; {} on backends that do not report (CPU)."""
+    import jax
+
+    dev = jax.devices()[device_index]
+    stats = getattr(dev, "memory_stats", lambda: None)()
+    return dict(stats) if stats else {}
+
+
+def max_memory_allocated(device_index=0):
+    """Peak bytes in use on the device (0 when the backend has no
+    counters)."""
+    return int(memory_stats(device_index).get("peak_bytes_in_use", 0))
+
+
+def memory_allocated(device_index=0):
+    """Current bytes in use on the device."""
+    return int(memory_stats(device_index).get("bytes_in_use", 0))

@@ -414,3 +414,42 @@ def test_image_transforms():
     t = I.simple_transform(img, 24, 16, is_train=True,
                            rng=np.random.RandomState(0))
     assert t.shape == (3, 16, 16)
+
+
+def test_mq2007_formats():
+    from paddle_tpu.dataset import mq2007
+
+    a, b = next(iter(mq2007.train("pairwise")()))
+    assert a.shape == (46,) and b.shape == (46,)
+    f, l = next(iter(mq2007.train("pointwise")()))
+    assert f.shape == (46,) and isinstance(l, float)
+    labels, feats = next(iter(mq2007.train("listwise")()))
+    assert feats.shape == (len(labels), 46)
+
+
+def test_core_memory_stats_surface():
+    import paddle_tpu.fluid.core as core
+
+    stats = core.memory_stats()
+    assert isinstance(stats, dict)
+    assert core.memory_allocated() >= 0
+    assert core.max_memory_allocated() >= 0
+
+
+def test_mq2007_rejects_bad_format_and_reads_cached(tmp_path,
+                                                    monkeypatch):
+    from paddle_tpu.dataset import common, mq2007
+
+    with pytest.raises(ValueError):
+        mq2007.train("list_wise")
+    # a cached LETOR split is parsed as real data (no synthetic warning)
+    monkeypatch.setattr(common, "DATA_HOME", str(tmp_path))
+    d = tmp_path / "mq2007"
+    d.mkdir()
+    (d / "train.txt").write_text(
+        "2 qid:1 1:0.5 2:0.25 #doc\n0 qid:1 1:0.1 2:0.9\n"
+        "1 qid:2 1:0.7 2:0.3\n")
+    labels, feats = next(iter(mq2007.train("listwise")()))
+    assert feats.shape == (2, 46)
+    np.testing.assert_allclose(feats[0, :2], [0.5, 0.25])
+    np.testing.assert_array_equal(sorted(labels), [0, 2])
