@@ -1,0 +1,6 @@
+from .controller_server import ControllerServer
+from .search_agent import SearchAgent
+from .search_space import SearchSpace
+from .light_nas import LightNAS
+
+__all__ = ["SearchSpace", "ControllerServer", "SearchAgent", "LightNAS"]

@@ -1,0 +1,82 @@
+"""Socket token service — reference
+``contrib/slim/nas/controller_server.py``: one controller process hands
+candidate tokens to distributed search agents and folds their rewards
+back in. Line protocol: ``tokens`` -> "t0,t1,..."; ``update
+t0,t1,... reward`` -> "ok best:..."; ``best`` -> best tokens."""
+
+import socket
+import threading
+
+__all__ = ["ControllerServer"]
+
+
+class ControllerServer:
+    def __init__(self, controller, address=("127.0.0.1", 0),
+                 max_client_num=64):
+        self._controller = controller
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(address)
+        self._sock.listen(max_client_num)
+        self._lock = threading.Lock()
+        self._thread = None
+        self._closed = False
+
+    def ip(self):
+        return self._sock.getsockname()[0]
+
+    def port(self):
+        return self._sock.getsockname()[1]
+
+    def start(self):
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+        return self
+
+    def close(self):
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _serve(self):
+        while not self._closed:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn):
+        with conn:
+            # read to EOF: the client half-closes after sending, and a
+            # request may span several TCP segments
+            chunks = []
+            while True:
+                b = conn.recv(65536)
+                if not b:
+                    break
+                chunks.append(b)
+            data = b"".join(chunks).decode().strip()
+            try:
+                with self._lock:
+                    reply = self._dispatch(data)
+            except Exception as e:  # surface the real error to the agent
+                reply = "err %s" % (e,)
+            conn.sendall(reply.encode())
+
+    def _dispatch(self, data):
+        if data == "tokens":
+            return ",".join(str(t)
+                            for t in self._controller.next_tokens())
+        if data == "best":
+            best = self._controller.best_tokens or []
+            return ",".join(str(t) for t in best)
+        if data.startswith("update "):
+            _, tok_s, reward_s = data.split(" ")
+            tokens = [int(t) for t in tok_s.split(",")]
+            self._controller.update(tokens, float(reward_s))
+            return "ok"
+        return "err unknown command"

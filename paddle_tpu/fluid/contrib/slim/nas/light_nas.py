@@ -1,0 +1,29 @@
+"""Light-NAS driver — reference ``contrib/slim/nas/light_nas_strategy.py``
+condensed to the search loop: controller proposes tokens, the search
+space builds the candidate net, the caller's ``eval_fn`` trains/scores
+it on the TPU, SA folds the reward back. Single-process by default;
+pass a started ControllerServer + agents for the distributed form."""
+
+from ..searcher import SAController
+
+__all__ = ["LightNAS"]
+
+
+class LightNAS:
+    def __init__(self, search_space, controller=None, max_steps=20,
+                 constrain_func=None):
+        self._space = search_space
+        self._controller = controller or SAController(seed=0)
+        self._controller.reset(search_space.range_table(),
+                               search_space.init_tokens(),
+                               constrain_func)
+        self._max_steps = max_steps
+
+    def search(self, eval_fn):
+        """eval_fn(net) -> reward, where net = space.create_net(tokens).
+        Returns (best_tokens, best_reward)."""
+        for _ in range(self._max_steps):
+            tokens = self._controller.next_tokens()
+            reward = float(eval_fn(self._space.create_net(tokens)))
+            self._controller.update(tokens, reward)
+        return self._controller.best_tokens, self._controller.max_reward

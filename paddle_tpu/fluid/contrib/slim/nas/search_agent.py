@@ -1,0 +1,40 @@
+"""Search agent (client) — reference
+``contrib/slim/nas/search_agent.py``: pulls candidate tokens from the
+controller server, reports rewards."""
+
+import socket
+
+__all__ = ["SearchAgent"]
+
+
+class SearchAgent:
+    def __init__(self, server_ip, server_port, timeout=30):
+        self._addr = (server_ip, int(server_port))
+        self._timeout = timeout
+
+    def _rpc(self, msg):
+        with socket.create_connection(self._addr,
+                                      timeout=self._timeout) as s:
+            s.sendall(msg.encode())
+            s.shutdown(socket.SHUT_WR)
+            chunks = []
+            while True:
+                b = s.recv(65536)
+                if not b:
+                    break
+                chunks.append(b)
+        return b"".join(chunks).decode()
+
+    def next_tokens(self):
+        return [int(t) for t in self._rpc("tokens").split(",")]
+
+    def update(self, tokens, reward):
+        reply = self._rpc("update %s %s"
+                          % (",".join(str(t) for t in tokens),
+                             repr(float(reward))))
+        if not reply.startswith("ok"):
+            raise RuntimeError("controller rejected update: %r" % reply)
+
+    def best_tokens(self):
+        reply = self._rpc("best")
+        return [int(t) for t in reply.split(",")] if reply else []

@@ -1,0 +1,21 @@
+"""NAS search-space interface — reference
+``contrib/slim/nas/search_space.py``: tokens describe a candidate net;
+the space knows the token ranges and how to build the train/eval
+programs for a token vector."""
+
+__all__ = ["SearchSpace"]
+
+
+class SearchSpace:
+    def init_tokens(self):
+        """Initial token vector."""
+        raise NotImplementedError
+
+    def range_table(self):
+        """Exclusive upper bound per token position."""
+        raise NotImplementedError
+
+    def create_net(self, tokens):
+        """tokens -> objects the trainer needs (e.g. (train_program,
+        eval_program, startup, fetches))."""
+        raise NotImplementedError

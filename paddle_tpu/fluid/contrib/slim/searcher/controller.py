@@ -1,0 +1,103 @@
+"""Architecture-search controllers — reference
+``contrib/slim/searcher/controller.py`` (EvolutionaryController /
+SAController): token vectors are sampled, scored by the caller, and the
+controller walks the space by simulated annealing. Host-side pure Python
+— the expensive part (training the candidate net) runs on the TPU like
+any other Program."""
+
+import math
+
+import numpy as np
+
+__all__ = ["EvolutionaryController", "SAController"]
+
+
+class EvolutionaryController:
+    """Interface: reset / update / next_tokens."""
+
+    def reset(self, range_table, init_tokens, constrain_func=None):
+        raise NotImplementedError
+
+    def update(self, tokens, reward):
+        raise NotImplementedError
+
+    def next_tokens(self):
+        raise NotImplementedError
+
+
+class SAController(EvolutionaryController):
+    """Simulated annealing: accept a worse candidate with probability
+    exp((reward - current) / T), T decaying by ``reduce_rate`` each
+    iteration."""
+
+    def __init__(self, range_table=None, reduce_rate=0.85,
+                 init_temperature=1024, max_iter_number=300, seed=None):
+        self._range_table = range_table
+        self._reduce_rate = reduce_rate
+        self._init_temperature = init_temperature
+        self._max_iter_number = max_iter_number
+        self._rng = np.random.RandomState(seed)
+        self._constrain_func = None
+        self._tokens = None
+        self._reward = -float("inf")
+        self._best_tokens = None
+        self._max_reward = -float("inf")
+        self._iter = 0
+
+    @property
+    def best_tokens(self):
+        return list(self._best_tokens) if self._best_tokens else None
+
+    @property
+    def max_reward(self):
+        return self._max_reward
+
+    @property
+    def current_tokens(self):
+        return list(self._tokens) if self._tokens else None
+
+    def reset(self, range_table, init_tokens, constrain_func=None):
+        self._range_table = list(range_table)
+        self._tokens = list(init_tokens)
+        self._constrain_func = constrain_func
+        self._iter = 0
+        self._reward = -float("inf")
+        self._best_tokens = None
+        self._max_reward = -float("inf")
+
+    def update(self, tokens, reward):
+        """SA acceptance on the caller-evaluated reward; past
+        ``max_iter_number`` the temperature floors to 0 so only
+        improvements are accepted (pure hill climb)."""
+        self._iter += 1
+        if self._iter >= self._max_iter_number:
+            temperature = 0.0
+        else:
+            temperature = self._init_temperature * \
+                self._reduce_rate ** self._iter
+        delta = reward - self._reward
+        if delta > 0 or self._rng.random_sample() <= math.exp(
+                min(delta / max(temperature, 1e-9), 0.0)):
+            self._reward = reward
+            self._tokens = list(tokens)
+        if reward > self._max_reward:
+            self._max_reward = reward
+            self._best_tokens = list(tokens)
+
+    def next_tokens(self, control_token=None):
+        """Mutate one position of the current (or given) tokens; respects
+        ``constrain_func`` by resampling up to a bounded retry count."""
+        if control_token is None and self._tokens is None:
+            raise RuntimeError(
+                "SAController.next_tokens: call reset(range_table, "
+                "init_tokens) first")
+        base = list(control_token if control_token is not None
+                    else self._tokens)
+        for _ in range(1000):
+            cand = list(base)
+            pos = int(self._rng.randint(len(cand)))
+            cand[pos] = int(self._rng.randint(self._range_table[pos]))
+            if self._constrain_func is None or self._constrain_func(cand):
+                return cand
+        raise RuntimeError(
+            "could not sample tokens satisfying the constraint")

@@ -217,3 +217,56 @@ def test_parallel_env_reads_launcher_vars(monkeypatch):
     monkeypatch.setenv("PADDLE_TRAINER_ID", "2")
     env = dygraph.ParallelEnv()
     assert env.nranks == 4 and env.local_rank == 2
+
+
+# ------------------------------------------------------------------ nas
+def test_sa_controller_and_light_nas():
+    """SA search over a toy space converges toward the known optimum."""
+    from paddle_tpu.fluid.contrib.slim.nas import LightNAS, SearchSpace
+
+    target = [3, 1, 4, 1]
+
+    class ToySpace(SearchSpace):
+        def init_tokens(self):
+            return [0, 0, 0, 0]
+
+        def range_table(self):
+            return [5, 5, 5, 5]
+
+        def create_net(self, tokens):
+            return tokens
+
+    nas = LightNAS(ToySpace(), max_steps=120)
+    best, reward = nas.search(
+        lambda net: -sum(abs(a - b) for a, b in zip(net, target)))
+    assert reward > -3  # walked most of the way to the optimum
+
+
+def test_controller_server_round_trip():
+    from paddle_tpu.fluid.contrib.slim.nas import (ControllerServer,
+                                                   SearchAgent)
+    from paddle_tpu.fluid.contrib.slim.searcher import SAController
+
+    ctrl = SAController(seed=1)
+    ctrl.reset([4, 4], [0, 0])
+    server = ControllerServer(ctrl).start()
+    try:
+        agent = SearchAgent(server.ip(), server.port())
+        tokens = agent.next_tokens()
+        assert len(tokens) == 2 and all(0 <= t < 4 for t in tokens)
+        agent.update(tokens, 7.5)
+        assert agent.best_tokens() == tokens
+        assert ctrl.max_reward == 7.5
+    finally:
+        server.close()
+
+
+def test_sa_controller_respects_constraint():
+    from paddle_tpu.fluid.contrib.slim.searcher import SAController
+
+    ctrl = SAController(seed=2)
+    ctrl.reset([10, 10], [2, 2], constrain_func=lambda t: sum(t) <= 6)
+    for _ in range(50):
+        t = ctrl.next_tokens()
+        assert sum(t) <= 6
+        ctrl.update(t, float(sum(t)))
