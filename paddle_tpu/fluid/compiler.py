@@ -13,6 +13,8 @@ import itertools
 
 import numpy as np
 
+from . import rng as _rng
+
 __all__ = ["CompiledProgram", "BuildStrategy", "ExecutionStrategy"]
 
 
@@ -269,6 +271,7 @@ class CompiledProgram:
         def kernel(params, rest_state, mb_feeds, full_feeds, rng):
             # advance the persistent RNG state every step (dropout masks
             # must differ across steps); stages draw from step_rng
+            rng = _rng.wrap_key_data(rng)
             step_rng, next_rng = jax.random.split(rng)
             rng = step_rng
             rank = jax.lax.axis_index(axis)
@@ -343,7 +346,7 @@ class CompiledProgram:
                     raise KeyError(
                         "pipeline mode can fetch the loss or persistable "
                         "vars, not intermediate %r" % fn_)
-            return fetches, new_params, new_rest, next_rng
+            return fetches, new_params, new_rest, _rng.key_data(next_rng)
 
         repl = NamedSharding(mesh, P())
         smapped = jax.shard_map(

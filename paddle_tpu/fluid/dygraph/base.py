@@ -20,6 +20,7 @@ import contextlib
 import numpy as np
 
 from .. import framework
+from .. import rng as _rng
 from ..registry import registry
 
 __all__ = ["guard", "to_variable", "enabled", "VarBase", "Tracer",
@@ -207,18 +208,14 @@ class Tracer:
     """Eager dispatcher + tape (reference imperative::Tracer + BasicEngine)."""
 
     def __init__(self):
-        import jax
-
         self._tape = []
-        self._rng = jax.random.PRNGKey(0)
+        self._rng = _rng.root_key(0)
         self._no_grad = False
         self._fn_cache = {}
         self._program_recorder = None  # set by jit tracing
 
     def seed(self, s):
-        import jax
-
-        self._rng = jax.random.PRNGKey(s)
+        self._rng = _rng.root_key(s)
 
     # ------------------------------------------------------------------
     def trace_op(self, op_type, input_slots, out_slot_names, attrs=None):

@@ -25,6 +25,7 @@ replaced by XLA partitioning + ICI collectives.
 import numpy as np
 
 from . import framework
+from . import rng as _rng
 from .framework import Program, Variable, convert_dtype
 from .registry import LowerCtx, lower_block
 
@@ -206,7 +207,7 @@ class Executor:
         rng = scope.find_var(RNG_STATE_VAR)
         if rng is None:
             seed = program.random_seed or 0
-            rng = jax.random.PRNGKey(seed)
+            rng = _rng.key_data(_rng.root_key(seed))
             scope.set_var(RNG_STATE_VAR, rng)
 
         state = {n: scope.find_var(n) for n in state_names}
@@ -261,7 +262,8 @@ class Executor:
             env = {}
             env.update(state)
             env.update(feed_vals)
-            ctx = LowerCtx(block, env, rng_key, mesh=mesh)
+            ctx = LowerCtx(block, env, _rng.wrap_key_data(rng_key),
+                           mesh=mesh)
             if strategy is not None:
                 strategy._on_trace_begin(ctx)
             lower_block(ctx, block)
@@ -275,7 +277,7 @@ class Executor:
             for name, var in block.vars.items():
                 if var.persistable and name in env and name not in state:
                     new_state[name] = env[name]
-            return fetches, new_state, ctx.rng_key
+            return fetches, new_state, _rng.key_data(ctx.rng_key)
 
         # Startup-style programs create new persistables -> output structure
         # depends on trace; jit handles that fine since structure is fixed
@@ -349,17 +351,17 @@ class Executor:
             env = {}
             env.update(state)
             env.update(feed_vals)
-            ctx = LowerCtx(block, env, rng_key)
+            ctx = LowerCtx(block, env, _rng.wrap_key_data(rng_key))
             lower_block(ctx, block)
             fetches = [ctx.get(n) for n in fetch_names]
             new_state = {n: env[n] for n in state if n in env}
             new_state.update({n: env[n] for n in ctx.written if n in env})
-            return fetches, new_state, ctx.rng_key
+            return fetches, new_state, _rng.key_data(ctx.rng_key)
 
         state = {n: scope.find_var(n) for n in state_names}
         rng = scope.find_var(RNG_STATE_VAR)
         if rng is None:
-            rng = jax.random.PRNGKey(program.random_seed or 0)
+            rng = _rng.key_data(_rng.root_key(program.random_seed or 0))
         return step, (state, dict(feed_specs), rng)
 
     def close(self):

@@ -10,6 +10,22 @@ distinctive dummy extent for abstract eval and mapped back afterwards.
 import numpy as np
 
 _DUMMY = 1097  # unlikely to appear as a real static dim
+_KEY_AVAL = None
+
+
+def _root_key_aval():
+    """Cached key aval for abstract eval. ALWAYS threefry: inferred
+    output shapes never depend on the key impl, and resolving the real
+    impl would query jax.devices() — initializing the backend during
+    graph CONSTRUCTION, before jax.distributed.initialize can run
+    (see dygraph/parallel.py + distributed/env.py ordering)."""
+    global _KEY_AVAL
+    if _KEY_AVAL is None:
+        import jax
+
+        _KEY_AVAL = jax.eval_shape(
+            lambda: jax.random.key(0, impl="threefry2x32"))
+    return _KEY_AVAL
 
 
 def infer_op_shapes(op):
@@ -45,7 +61,9 @@ def infer_op_shapes(op):
         registry.get(op.type).lower(ctx, op)
         return {n: env[n] for n in out_names if n in env}
 
-    outs = jax.eval_shape(fn, vals, jax.ShapeDtypeStruct((2,), np.uint32))
+    # a key of the ACTIVE impl (threefry [2]x uint32, rbg [4]x —
+    # hardcoding one shape breaks the other); built once and cached
+    outs = jax.eval_shape(fn, vals, _root_key_aval())
     for n, aval in outs.items():
         v = block._find_var_recursive(n)
         if v is None:
