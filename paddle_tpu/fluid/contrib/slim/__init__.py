@@ -1,4 +1,4 @@
 """Slim: model compression (reference ``contrib/slim/``) — quantization,
 pruning, distillation."""
 
-from . import distillation, nas, prune, quantization, searcher  # noqa: F401
+from . import core, distillation, nas, prune, quantization, searcher  # noqa: F401

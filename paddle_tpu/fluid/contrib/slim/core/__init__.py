@@ -1,0 +1,3 @@
+from .compressor import Compressor, Context, Strategy
+
+__all__ = ["Compressor", "Context", "Strategy"]

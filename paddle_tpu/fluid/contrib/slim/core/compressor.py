@@ -1,0 +1,237 @@
+"""Compression orchestration — reference
+``contrib/slim/core/compressor.py`` (Context / Strategy / Compressor):
+strategies hook epoch boundaries of one training loop, so pruning,
+distillation, and quantization compose over the same run; checkpointing
+resumes mid-compression.
+
+The reference drives C++ graph executors per epoch; here each epoch is
+ordinary ``Executor.run`` over the (strategy-rewritten) Program, so every
+strategy's work compiles into the same XLA step.
+"""
+
+import json
+import os
+
+import numpy as np
+
+from ....executor import Executor, global_scope, scope_guard
+from .... import io as fluid_io
+
+__all__ = ["Context", "Strategy", "Compressor"]
+
+
+def _feed_names(feed_list):
+    """reference feed_list: [(name, var), ...] or [var/name, ...]."""
+    if not feed_list:
+        return None
+    out = []
+    for item in feed_list:
+        if isinstance(item, (tuple, list)):
+            out.append(item[0])
+        else:
+            out.append(getattr(item, "name", str(item)))
+    return out
+
+
+def _to_feed(batch, feed_names):
+    """Reader batches may be dicts (used directly) or positional
+    tuples/lists matched against the declared feed_list names."""
+    if isinstance(batch, dict):
+        return batch
+    if feed_names is None:
+        raise ValueError(
+            "reader yielded a positional batch but no feed_list was "
+            "given to map names")
+    return dict(zip(feed_names, batch))
+
+
+class Strategy:
+    """Epoch-scoped hook interface (reference Strategy): override any of
+    the callbacks; ``start_epoch``/``end_epoch`` bound when it's active."""
+
+    def __init__(self, start_epoch=0, end_epoch=0):
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+
+    def on_compression_begin(self, context):
+        pass
+
+    def on_epoch_begin(self, context):
+        pass
+
+    def on_batch_begin(self, context):
+        pass
+
+    def on_batch_end(self, context):
+        pass
+
+    def on_epoch_end(self, context):
+        pass
+
+    def on_compression_end(self, context):
+        pass
+
+
+class Context:
+    """Mutable state shared with strategies (reference Context)."""
+
+    def __init__(self, place=None, scope=None, train_program=None,
+                 train_reader=None, train_fetch_list=None,
+                 eval_program=None, eval_reader=None, eval_fetch_list=None,
+                 optimizer=None):
+        self.place = place
+        self.scope = scope if scope is not None else global_scope()
+        self.train_program = train_program
+        self.train_reader = train_reader
+        self.train_fetch_list = list(train_fetch_list or [])
+        self.eval_program = eval_program
+        self.eval_reader = eval_reader
+        self.eval_feed_names = None  # set by Compressor when given
+        self.eval_fetch_list = list(eval_fetch_list or [])
+        self.optimizer = optimizer
+        self.epoch_id = 0
+        self.batch_id = 0
+        self.eval_results = {}
+        self.exe = Executor(place)
+        self._kv = {}
+
+    def put(self, key, value):
+        self._kv[key] = value
+
+    def get(self, key):
+        return self._kv.get(key)
+
+    def run_eval_graph(self):
+        """One pass over eval_reader; returns the mean of each fetch."""
+        totals = None
+        n = 0
+        for batch in self.eval_reader():
+            batch = _to_feed(batch, self.eval_feed_names)
+            vals = self.exe.run(self.eval_program, feed=batch,
+                                fetch_list=self.eval_fetch_list,
+                                scope=self.scope)
+            vals = [float(np.asarray(v).ravel().mean()) for v in vals]
+            totals = vals if totals is None else \
+                [a + b for a, b in zip(totals, vals)]
+            n += 1
+        means = [t / max(n, 1) for t in (totals or [])]
+        for f, m in zip(self.eval_fetch_list, means):
+            self.eval_results.setdefault(
+                getattr(f, "name", str(f)), []).append(m)
+        return means
+
+    def eval_converged(self, metric_name, delta=0.001):
+        history = self.eval_results.get(metric_name, [])
+        return len(history) >= 2 and abs(history[-1] -
+                                         history[-2]) < delta
+
+
+class Compressor:
+    """Drives ``epoch`` epochs of training with strategy callbacks
+    (reference Compressor.run): feed batches come from
+    ``train_reader()`` as executor feed dicts."""
+
+    def __init__(self, place=None, scope=None, train_program=None,
+                 train_reader=None, train_feed_list=None,
+                 train_fetch_list=None, eval_program=None,
+                 eval_reader=None, eval_feed_list=None,
+                 eval_fetch_list=None, epoch=1, checkpoint_path=None,
+                 save_eval_model=True, eval_model_path=None):
+        self._train_feed_names = _feed_names(train_feed_list)
+        self._eval_feed_names = _feed_names(eval_feed_list)
+        self._context = Context(
+            place=place, scope=scope, train_program=train_program,
+            train_reader=train_reader, train_fetch_list=train_fetch_list,
+            eval_program=eval_program, eval_reader=eval_reader,
+            eval_fetch_list=eval_fetch_list)
+        self._context.eval_feed_names = self._eval_feed_names
+        self._epochs = int(epoch)
+        self._strategies = []
+        self._checkpoint_path = checkpoint_path
+        self._save_eval_model = save_eval_model
+        self._eval_model_path = eval_model_path
+
+    def add_strategy(self, strategy):
+        self._strategies.append(strategy)
+        return self
+
+    # -- checkpoint/resume -------------------------------------------------
+    def _save_checkpoint(self, ctx):
+        if not self._checkpoint_path:
+            return
+        d = os.path.join(self._checkpoint_path, "epoch_%d" % ctx.epoch_id)
+        os.makedirs(d, exist_ok=True)
+        with scope_guard(ctx.scope):
+            fluid_io.save_persistables(
+                ctx.exe, d, main_program=ctx.train_program)
+        with open(os.path.join(d, "context.json"), "w") as f:
+            json.dump({"epoch_id": ctx.epoch_id,
+                       "eval_results": ctx.eval_results}, f)
+
+    def _load_checkpoint(self, ctx):
+        if not self._checkpoint_path or \
+                not os.path.isdir(self._checkpoint_path):
+            return
+        epochs = sorted(
+            (int(n.split("_")[1]) for n in os.listdir(
+                self._checkpoint_path) if n.startswith("epoch_")),
+            reverse=True)
+        for e in epochs:
+            d = os.path.join(self._checkpoint_path, "epoch_%d" % e)
+            meta_path = os.path.join(d, "context.json")
+            if not os.path.exists(meta_path):
+                continue  # partial checkpoint (crashed mid-save): skip
+            with open(meta_path) as f:
+                meta = json.load(f)
+            with scope_guard(ctx.scope):
+                fluid_io.load_persistables(
+                    ctx.exe, d, main_program=ctx.train_program)
+            ctx.epoch_id = meta["epoch_id"] + 1
+            ctx.eval_results = meta["eval_results"]
+            return
+
+    # -- the loop ----------------------------------------------------------
+    def _active(self, strategy, epoch):
+        return strategy.start_epoch <= epoch and (
+            strategy.end_epoch == 0 or epoch < strategy.end_epoch)
+
+    def run(self):
+        ctx = self._context
+        self._load_checkpoint(ctx)
+        for s in self._strategies:
+            s.on_compression_begin(ctx)
+        while ctx.epoch_id < self._epochs:
+            active = [s for s in self._strategies
+                      if self._active(s, ctx.epoch_id)]
+            for s in active:
+                s.on_epoch_begin(ctx)
+            ctx.batch_id = 0
+            for batch in ctx.train_reader():
+                batch = _to_feed(batch, self._train_feed_names)
+                for s in active:
+                    s.on_batch_begin(ctx)
+                ctx.put("last_train_fetch", ctx.exe.run(
+                    ctx.train_program, feed=batch,
+                    fetch_list=ctx.train_fetch_list, scope=ctx.scope))
+                for s in active:
+                    s.on_batch_end(ctx)
+                ctx.batch_id += 1
+            for s in active:
+                s.on_epoch_end(ctx)
+            if ctx.eval_program is not None and ctx.eval_reader:
+                ctx.run_eval_graph()
+            self._save_checkpoint(ctx)
+            ctx.epoch_id += 1
+        for s in self._strategies:
+            s.on_compression_end(ctx)
+        if self._save_eval_model and self._eval_model_path and \
+                ctx.eval_program is not None:
+            with scope_guard(ctx.scope):
+                fluid_io.save_inference_model(
+                    self._eval_model_path,
+                    self._eval_feed_names or [],
+                    ctx.eval_fetch_list, ctx.exe,
+                    main_program=ctx.eval_program)
+        return ctx
+
+
