@@ -42,6 +42,10 @@ def _to_feed(batch, feed_names):
         raise ValueError(
             "reader yielded a positional batch but no feed_list was "
             "given to map names")
+    if len(batch) != len(feed_names):
+        raise ValueError(
+            "positional batch has %d elements but feed_list names %d: %r"
+            % (len(batch), len(feed_names), feed_names))
     return dict(zip(feed_names, batch))
 
 
@@ -102,18 +106,21 @@ class Context:
         return self._kv.get(key)
 
     def run_eval_graph(self):
-        """One pass over eval_reader; returns the mean of each fetch."""
+        """One pass over eval_reader; returns the SAMPLE-weighted mean of
+        each fetch (a trailing partial batch must not be over-weighted)."""
         totals = None
         n = 0
         for batch in self.eval_reader():
             batch = _to_feed(batch, self.eval_feed_names)
+            bs = max((int(np.shape(v)[0]) if np.ndim(v) else 1)
+                     for v in batch.values()) if batch else 1
             vals = self.exe.run(self.eval_program, feed=batch,
                                 fetch_list=self.eval_fetch_list,
                                 scope=self.scope)
-            vals = [float(np.asarray(v).ravel().mean()) for v in vals]
+            vals = [float(np.asarray(v).ravel().mean()) * bs for v in vals]
             totals = vals if totals is None else \
                 [a + b for a, b in zip(totals, vals)]
-            n += 1
+            n += bs
         means = [t / max(n, 1) for t in (totals or [])]
         for f, m in zip(self.eval_fetch_list, means):
             self.eval_results.setdefault(
@@ -167,14 +174,23 @@ class Compressor:
         with open(os.path.join(d, "context.json"), "w") as f:
             json.dump({"epoch_id": ctx.epoch_id,
                        "eval_results": ctx.eval_results}, f)
+        # retention: only the newest checkpoint is ever resumed from;
+        # context.json-last write order makes deleting the older one safe
+        prev = os.path.join(self._checkpoint_path,
+                            "epoch_%d" % (ctx.epoch_id - 1))
+        if os.path.isdir(prev):
+            import shutil
+
+            shutil.rmtree(prev, ignore_errors=True)
 
     def _load_checkpoint(self, ctx):
         if not self._checkpoint_path or \
                 not os.path.isdir(self._checkpoint_path):
             return
         epochs = sorted(
-            (int(n.split("_")[1]) for n in os.listdir(
-                self._checkpoint_path) if n.startswith("epoch_")),
+            (int(n[len("epoch_"):]) for n in os.listdir(
+                self._checkpoint_path)
+             if n.startswith("epoch_") and n[len("epoch_"):].isdigit()),
             reverse=True)
         for e in epochs:
             d = os.path.join(self._checkpoint_path, "epoch_%d" % e)
@@ -226,10 +242,18 @@ class Compressor:
             s.on_compression_end(ctx)
         if self._save_eval_model and self._eval_model_path and \
                 ctx.eval_program is not None:
+            feed_names = self._eval_feed_names
+            if not feed_names:  # derive from the program's data vars
+                feed_names = sorted(
+                    v.name for v in ctx.eval_program.list_vars()
+                    if getattr(v, "is_data", False))
+            if not feed_names:
+                raise ValueError(
+                    "cannot export eval model: no eval_feed_list given "
+                    "and the eval program declares no data vars")
             with scope_guard(ctx.scope):
                 fluid_io.save_inference_model(
-                    self._eval_model_path,
-                    self._eval_feed_names or [],
+                    self._eval_model_path, feed_names,
                     ctx.eval_fetch_list, ctx.exe,
                     main_program=ctx.eval_program)
         return ctx

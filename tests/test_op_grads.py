@@ -1,0 +1,189 @@
+"""Numeric gradient checks (finite differences vs the autodiff replay)
+for the newer differentiable lowerings — the reference's per-op
+``check_grad`` discipline (``unittests/op_test.py:135``) extended to the
+round-3 op families. Tensors stay tiny: every perturbation re-runs the
+program."""
+
+import numpy as np
+
+from op_test import OpTest
+
+RNG = np.random.RandomState(7)
+
+
+class TestRoiAlignGrad(OpTest):
+    op_type = "roi_align"
+
+    def setup_method(self, _):
+        self.inputs = {"X": RNG.rand(1, 1, 4, 4).astype(np.float32),
+                       "ROIs": np.array([[0.5, 0.5, 3.0, 3.0]],
+                                        np.float32)}
+        self.attrs = {"pooled_height": 2, "pooled_width": 2,
+                      "spatial_scale": 1.0, "sampling_ratio": 2}
+        self.outputs = {"Out": np.zeros((1, 1, 2, 2), np.float32)}
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestGridSamplerGrad(OpTest):
+    op_type = "grid_sampler"
+
+    def setup_method(self, _):
+        ys, xs = np.meshgrid(np.linspace(-0.7, 0.7, 3),
+                             np.linspace(-0.7, 0.7, 3), indexing="ij")
+        grid = np.stack([xs, ys], -1)[None].astype(np.float32)
+        self.inputs = {"X": RNG.rand(1, 1, 4, 4).astype(np.float32),
+                       "Grid": grid}
+        self.outputs = {"Output": np.zeros((1, 1, 3, 3), np.float32)}
+
+    def test_grad(self):
+        self.check_grad(["X", "Grid"], "Output")
+
+
+class TestConv2dTransposeGrad(OpTest):
+    op_type = "conv2d_transpose"
+
+    def setup_method(self, _):
+        self.inputs = {"Input": RNG.rand(1, 2, 3, 3).astype(np.float32),
+                       "Filter": RNG.rand(2, 2, 2, 2).astype(np.float32)}
+        self.attrs = {"strides": [2, 2], "paddings": [0, 0],
+                      "dilations": [1, 1], "groups": 1}
+        self.outputs = {"Output": np.zeros((1, 2, 6, 6), np.float32)}
+
+    def test_grad(self):
+        self.check_grad(["Input", "Filter"], "Output")
+
+
+class TestMaxoutGrad(OpTest):
+    op_type = "maxout"
+
+    def setup_method(self, _):
+        self.inputs = {"X": RNG.rand(1, 4, 2, 2).astype(np.float32)}
+        self.attrs = {"groups": 2}
+        self.outputs = {"Out": np.zeros((1, 2, 2, 2), np.float32)}
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestPixelShuffleGrad(OpTest):
+    op_type = "pixel_shuffle"
+
+    def setup_method(self, _):
+        self.inputs = {"X": RNG.rand(1, 4, 2, 2).astype(np.float32)}
+        self.attrs = {"upscale_factor": 2}
+        self.outputs = {"Out": np.zeros((1, 1, 4, 4), np.float32)}
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestTemporalShiftGrad(OpTest):
+    op_type = "temporal_shift"
+
+    def setup_method(self, _):
+        self.inputs = {"X": RNG.rand(4, 4, 2, 2).astype(np.float32)}
+        self.attrs = {"seg_num": 2, "shift_ratio": 0.25}
+        self.outputs = {"Out": np.zeros((4, 4, 2, 2), np.float32)}
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestHuberLossGrad(OpTest):
+    op_type = "huber_loss"
+
+    def setup_method(self, _):
+        self.inputs = {"X": RNG.rand(4, 3).astype(np.float32),
+                       "Y": RNG.rand(4, 3).astype(np.float32)}
+        self.attrs = {"delta": 0.4}
+        self.outputs = {"Out": np.zeros((4, 3), np.float32)}
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestKLDivLossGrad(OpTest):
+    op_type = "kldiv_loss"
+
+    def setup_method(self, _):
+        self.inputs = {"X": RNG.rand(3, 4).astype(np.float32),
+                       "Target": (RNG.rand(3, 4) + 0.2).astype(
+                           np.float32)}
+        self.attrs = {"reduction": "none"}
+        self.outputs = {"Loss": np.zeros((3, 4), np.float32)}
+
+    def test_grad(self):
+        self.check_grad(["X"], "Loss")
+
+
+class TestLogSoftmaxGrad(OpTest):
+    op_type = "log_softmax"
+
+    def setup_method(self, _):
+        self.inputs = {"X": RNG.randn(3, 5).astype(np.float32)}
+        self.attrs = {"axis": -1}
+        self.outputs = {"Out": np.zeros((3, 5), np.float32)}
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestBmmGrad(OpTest):
+    op_type = "bmm"
+
+    def setup_method(self, _):
+        self.inputs = {"X": RNG.rand(2, 2, 3).astype(np.float32),
+                       "Y": RNG.rand(2, 3, 2).astype(np.float32)}
+        self.outputs = {"Out": np.zeros((2, 2, 2), np.float32)}
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestSigmoidFocalLossGrad(OpTest):
+    op_type = "sigmoid_focal_loss"
+
+    def setup_method(self, _):
+        self.inputs = {"X": RNG.randn(4, 3).astype(np.float32),
+                       "Label": np.array([[1], [0], [3], [2]], np.int64),
+                       "FgNum": np.array([2], np.int32)}
+        self.attrs = {"gamma": 2.0, "alpha": 0.25}
+        self.outputs = {"Out": np.zeros((4, 3), np.float32)}
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestFusedAttentionGrad(OpTest):
+    """Finite differences through the full custom-VJP path of the fused
+    attention op (jnp fallback on CPU — same formula as the kernel)."""
+
+    op_type = "fused_multihead_attention"
+
+    def setup_method(self, _):
+        B, H, S, d = 1, 2, 4, 3
+        self.inputs = {
+            "Q": (RNG.randn(B, H, S, d) * 0.4).astype(np.float32),
+            "K": (RNG.randn(B, H, S, d) * 0.4).astype(np.float32),
+            "V": (RNG.randn(B, H, S, d) * 0.4).astype(np.float32),
+            "Bias": np.zeros((B, 1, 1, S), np.float32),
+        }
+        self.attrs = {"dropout_prob": 0.0, "is_test": False}
+        self.outputs = {"Out": np.zeros((B, H, S, d), np.float32)}
+
+    def test_grad(self):
+        self.check_grad(["Q", "K", "V"], "Out", atol=8e-3, rtol=8e-3)
+
+
+class TestLabelSmoothGrad(OpTest):
+    op_type = "label_smooth"
+
+    def setup_method(self, _):
+        self.inputs = {"X": RNG.rand(3, 4).astype(np.float32)}
+        self.attrs = {"epsilon": 0.1}
+        self.outputs = {"Out": np.zeros((3, 4), np.float32)}
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
