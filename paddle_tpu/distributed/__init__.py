@@ -4,5 +4,6 @@ Reference: ``python/paddle/distributed/`` (launch.py) and the PS stack
 (SURVEY §2.5/§2.6).
 """
 
-from . import env, launch, ps  # noqa: F401
+from . import env, heartbeat, launch, ps  # noqa: F401
+from .heartbeat import Heartbeat, Watchdog  # noqa: F401
 from .env import init_parallel_env, parallel_env  # noqa: F401

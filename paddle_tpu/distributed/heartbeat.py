@@ -1,0 +1,127 @@
+"""Worker heartbeat + failure detection — SURVEY §5.3 (the reference
+leans on its coordination service / pserver heartbeats,
+``listen_and_serv`` + fleet health; here the analogue is file-based
+liveness under the launcher's gang semantics).
+
+Workers run a ``Heartbeat`` that stamps ``<dir>/hb.<rank>`` with
+(timestamp, step) every ``interval`` seconds; the launcher's
+``Watchdog`` flags a worker dead when its stamp goes stale (hang) — a
+crashed worker is already caught by its exit code. The launcher then
+kills the gang and restarts it (training scripts resume from their own
+checkpoints, e.g. ``io.save_persistables`` / Compressor checkpoints).
+"""
+
+import json
+import os
+import threading
+import time
+
+__all__ = ["Heartbeat", "Watchdog", "current_heartbeat_dir"]
+
+ENV_DIR = "PADDLE_HEARTBEAT_DIR"
+
+
+def current_heartbeat_dir():
+    """The launcher-provided heartbeat directory, or None."""
+    return os.environ.get(ENV_DIR)
+
+
+class Heartbeat:
+    """Worker-side liveness stamper (daemon thread; also stamps on
+    ``beat(step)`` so tight training loops advance the step counter)."""
+
+    def __init__(self, rank=None, dirname=None, interval=2.0):
+        self._rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)
+                         if rank is None else rank)
+        self._dir = dirname or current_heartbeat_dir()
+        self._interval = float(interval)
+        self._step = 0
+        self._stop = threading.Event()
+        self._thread = None
+
+    @property
+    def path(self):
+        return os.path.join(self._dir, "hb.%d" % self._rank)
+
+    def _stamp(self):
+        tmp = self.path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump({"ts": time.time(), "step": self._step,
+                           "pid": os.getpid()}, f)
+            os.replace(tmp, self.path)  # atomic: never a half-write
+        except OSError:
+            # the launcher owns the dir; if it tore it down (gang kill in
+            # flight) do NOT recreate it — just stop stamping
+            pass
+
+    def start(self):
+        if self._dir is None:
+            return self  # not launched with heartbeats: no-op
+        os.makedirs(self._dir, exist_ok=True)
+        self._stamp()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.wait(self._interval):
+            self._stamp()
+
+    def beat(self, step=None):
+        if step is not None:
+            self._step = int(step)
+        if self._dir is not None:
+            self._stamp()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self._interval * 2)
+
+
+class Watchdog:
+    """Launcher-side staleness detector over the heartbeat files.
+
+    ``startup_grace`` (default 3x timeout) covers slow worker startup —
+    heavy imports / device init before the script reaches
+    ``Heartbeat().start()`` must not read as a hang."""
+
+    def __init__(self, dirname, nproc, timeout=30.0, startup_grace=None):
+        self._dir = dirname
+        self._nproc = int(nproc)
+        self._timeout = float(timeout)
+        self._grace = float(startup_grace if startup_grace is not None
+                            else 3 * timeout)
+        self._started = time.time()
+
+    def read(self, rank):
+        try:
+            with open(os.path.join(self._dir, "hb.%d" % rank)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _last_stamp(self, rank):
+        """mtime of the stamp file (no JSON parse on the poll path)."""
+        try:
+            return os.stat(os.path.join(self._dir, "hb.%d" % rank)).st_mtime
+        except OSError:
+            return None
+
+    def stale_workers(self, skip=()):
+        """Ranks whose heartbeat is older than ``timeout``; ranks in
+        ``skip`` (e.g. already exited cleanly) are ignored. A rank that
+        never stamped is only stale once ``startup_grace`` has passed."""
+        now = time.time()
+        out = []
+        for r in range(self._nproc):
+            if r in skip:
+                continue
+            last = self._last_stamp(r)
+            if last is None:
+                if now - self._started > self._grace:
+                    out.append(r)
+            elif now - last > self._timeout:
+                out.append(r)
+        return out

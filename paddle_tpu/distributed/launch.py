@@ -12,10 +12,13 @@ coordination-service address consumed by
 
 import argparse
 import os
+import shutil
 import signal
 import socket
 import subprocess
 import sys
+import tempfile
+import time
 
 __all__ = ["launch", "main"]
 
@@ -28,14 +31,10 @@ def _free_port():
     return port
 
 
-def launch(nproc, cmd, node_ip="127.0.0.1", started_port=None, env=None,
-           backend=None, log_dir=None):
-    """Spawn ``nproc`` copies of ``cmd`` (argv list) with the trainer env.
-    Returns the list of exit codes."""
-    base = _free_port() if started_port is None else int(started_port)
+def _spawn_gang(nproc, cmd, node_ip, base, env, backend, log_dir,
+                heartbeat_dir, attempt):
     endpoints = ",".join("%s:%d" % (node_ip, base + i) for i in range(nproc))
-    procs = []
-    logs = []
+    procs, logs = [], []
     for rank in range(nproc):
         child_env = dict(os.environ if env is None else env)
         child_env.update({
@@ -44,28 +43,98 @@ def launch(nproc, cmd, node_ip="127.0.0.1", started_port=None, env=None,
             "PADDLE_TRAINER_ENDPOINTS": endpoints,
             "PADDLE_CURRENT_ENDPOINT": "%s:%d" % (node_ip, base + rank),
             "TRAINING_ROLE": "TRAINER",
+            "PADDLE_RESTART_ATTEMPT": str(attempt),
         })
+        if heartbeat_dir:
+            child_env["PADDLE_HEARTBEAT_DIR"] = heartbeat_dir
         if backend:
             child_env["PADDLE_DIST_BACKEND"] = backend
         if log_dir:
             os.makedirs(log_dir, exist_ok=True)
-            f = open(os.path.join(log_dir, "worker.%d.log" % rank), "wb")
+            mode = "wb" if attempt == 0 else "ab"
+            f = open(os.path.join(log_dir, "worker.%d.log" % rank), mode)
             logs.append(f)
             procs.append(subprocess.Popen(cmd, env=child_env, stdout=f,
                                           stderr=subprocess.STDOUT))
         else:
             procs.append(subprocess.Popen(cmd, env=child_env))
-    codes = []
-    try:
-        for p in procs:
-            codes.append(p.wait())
-    except KeyboardInterrupt:
-        for p in procs:
+    return procs, logs
+
+
+def _kill_gang(procs):
+    for p in procs:
+        if p.poll() is None:
             p.send_signal(signal.SIGTERM)
-        raise
-    finally:
-        for f in logs:
-            f.close()
+    deadline = time.time() + 10
+    for p in procs:
+        try:
+            p.wait(timeout=max(0.1, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait()  # reap: the caller needs real exit codes
+
+
+def launch(nproc, cmd, node_ip="127.0.0.1", started_port=None, env=None,
+           backend=None, log_dir=None, max_restarts=0,
+           heartbeat_timeout=None):
+    """Spawn ``nproc`` copies of ``cmd`` (argv list) with the trainer env;
+    returns the list of exit codes of the final attempt.
+
+    Failure detection (SURVEY §5.3): a worker crashing (nonzero exit) or
+    hanging (stale heartbeat, when ``heartbeat_timeout`` is set and the
+    training script runs a ``distributed.Heartbeat``) kills the whole
+    gang; with ``max_restarts`` > 0 the gang is relaunched — training
+    scripts resume from their own checkpoints."""
+    from .heartbeat import Watchdog
+
+    for attempt in range(max_restarts + 1):
+        base = _free_port() if started_port is None else int(started_port)
+        hb_dir = tempfile.mkdtemp(prefix="paddle_tpu_hb_")             if heartbeat_timeout else None
+        procs, logs = _spawn_gang(nproc, cmd, node_ip, base, env, backend,
+                                  log_dir, hb_dir, attempt)
+        watchdog = Watchdog(hb_dir, nproc, heartbeat_timeout)             if hb_dir else None
+        failed = False
+        last_check = 0.0
+        try:
+            while True:
+                codes = [p.poll() for p in procs]
+                if all(c is not None for c in codes):
+                    break
+                if any(c not in (None, 0) for c in codes):
+                    failed = True  # crash: take down the survivors
+                    _kill_gang(procs)
+                    codes = [p.poll() for p in procs]
+                    break
+                if watchdog is not None and \
+                        time.time() - last_check > 1.0:
+                    last_check = time.time()
+                    # exited-clean ranks stop stamping; that's not a hang
+                    done = {i for i, c in enumerate(codes) if c == 0}
+                    stale = watchdog.stale_workers(skip=done)
+                    if stale:
+                        sys.stderr.write(
+                            "launch: workers %r missed heartbeats for "
+                            ">%ss; killing gang\n"
+                            % (stale, heartbeat_timeout))
+                        failed = True
+                        _kill_gang(procs)
+                        codes = [p.poll() for p in procs]
+                        break
+                time.sleep(0.2)
+        except KeyboardInterrupt:
+            _kill_gang(procs)
+            raise
+        finally:
+            for f in logs:
+                f.close()
+            if hb_dir:
+                shutil.rmtree(hb_dir, ignore_errors=True)
+        if not failed and all(c == 0 for c in codes):
+            return codes
+        if attempt < max_restarts:
+            sys.stderr.write(
+                "launch: gang failed (codes %r), restart %d/%d\n"
+                % (codes, attempt + 1, max_restarts))
     return codes
 
 
@@ -79,6 +148,11 @@ def main(argv=None):
     parser.add_argument("--backend", default=None,
                         help="'cpu' = virtual-CPU fake-cluster mode")
     parser.add_argument("--log_dir", default=None)
+    parser.add_argument("--max_restarts", type=int, default=0,
+                        help="relaunch the gang after a worker failure")
+    parser.add_argument("--heartbeat_timeout", type=float, default=None,
+                        help="kill+restart when a worker's heartbeat "
+                             "goes stale (script must run a Heartbeat)")
     parser.add_argument("training_script")
     parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
@@ -86,7 +160,8 @@ def main(argv=None):
         args.training_script_args
     codes = launch(args.nproc_per_node, cmd, node_ip=args.node_ip,
                    started_port=args.started_port, backend=args.backend,
-                   log_dir=args.log_dir)
+                   log_dir=args.log_dir, max_restarts=args.max_restarts,
+                   heartbeat_timeout=args.heartbeat_timeout)
     bad = [(i, c) for i, c in enumerate(codes) if c != 0]
     if bad:
         sys.exit("workers failed: %r" % bad)

@@ -75,3 +75,74 @@ def test_launch_module_help():
                        capture_output=True, cwd=REPO, timeout=120)
     assert r.returncode == 0
     assert b"nproc_per_node" in r.stdout
+
+
+def test_launch_restarts_failed_gang(tmp_path):
+    """A worker that crashes on its first attempt succeeds after the
+    launcher's gang restart (SURVEY §5.3 failure detection)."""
+    from paddle_tpu.distributed.launch import launch
+
+    script = tmp_path / "flaky.py"
+    marker = tmp_path / "attempt1"
+    script.write_text(
+        "import os, sys\n"
+        "rank = os.environ['PADDLE_TRAINER_ID']\n"
+        "attempt = int(os.environ['PADDLE_RESTART_ATTEMPT'])\n"
+        "print('rank', rank, 'attempt', attempt)\n"
+        "if attempt == 0 and rank == '1':\n"
+        "    sys.exit(3)  # crash once\n"
+        "print('DONE', rank)\n")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    log_dir = str(tmp_path / "logs")
+    codes = launch(2, [sys.executable, "-u", str(script)], env=env,
+                   log_dir=log_dir, max_restarts=1)
+    assert codes == [0, 0]
+    logs = ""
+    for i in range(2):
+        logs += open(os.path.join(log_dir, "worker.%d.log" % i)).read()
+    assert "attempt 1" in logs and "DONE 1" in logs
+
+
+def test_launch_watchdog_kills_hung_worker(tmp_path):
+    """A worker that stops heartbeating is detected and the gang killed
+    (no restart budget -> nonzero exit)."""
+    from paddle_tpu.distributed.launch import launch
+
+    script = tmp_path / "hang.py"
+    script.write_text(
+        "import os, sys, time\n"
+        "sys.path.insert(0, %r)\n"
+        "from paddle_tpu.distributed import Heartbeat\n"
+        "rank = os.environ['PADDLE_TRAINER_ID']\n"
+        "if rank == '0':\n"
+        "    hb = Heartbeat(interval=0.2).start()\n"
+        "    time.sleep(30)\n"  # healthy worker, parked
+        "else:\n"
+        "    time.sleep(30)\n"  # never heartbeats -> stale
+        % REPO)
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    import time
+    t0 = time.time()
+    codes = launch(2, [sys.executable, "-u", str(script)], env=env,
+                   heartbeat_timeout=3.0)
+    assert time.time() - t0 < 25  # killed well before the 30s sleep
+    assert any(c != 0 for c in codes)
+
+
+def test_heartbeat_watchdog_unit(tmp_path):
+    from paddle_tpu.distributed import Heartbeat, Watchdog
+
+    hb = Heartbeat(rank=0, dirname=str(tmp_path), interval=10.0).start()
+    hb.beat(step=7)
+    wd = Watchdog(str(tmp_path), nproc=2, timeout=0.5,
+                  startup_grace=0.5)
+    assert wd.read(0)["step"] == 7
+    import time
+    time.sleep(0.7)
+    hb.beat()  # rank 0 stays fresh; rank 1 never stamped
+    assert wd.stale_workers() == [1]
+    hb.stop()
