@@ -1,11 +1,12 @@
 """Layers namespace (reference ``python/paddle/fluid/layers/``)."""
 
 from .. import ops as _ops  # registers all lowering rules  # noqa: F401
-from . import (control_flow, detection, distributions, io,
+from . import (control_flow, detection, distributions, extras, io,
                learning_rate_scheduler, loss, metric_op,
                nn, ops, rnn, sequence_lod, tensor)
 from .control_flow import *  # noqa: F401,F403
 from .detection import *  # noqa: F401,F403
+from .extras import *  # noqa: F401,F403
 from .io import data
 from .learning_rate_scheduler import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403

@@ -1,0 +1,237 @@
+"""Remaining Appendix-A layer fns (SURVEY): LoD rebinding, selected-rows
+utilities, CVM, PSRoI pooling, chunk_eval, adaptive 3-D pooling, static
+resize helpers — plus explicit, documented errors for the handful of
+reference APIs whose dynamic-shape semantics have no sound XLA form."""
+
+from ..framework import Variable
+from ..layer_helper import LayerHelper
+from . import nn, tensor
+
+__all__ = [
+    "lod_reset", "lod_append", "unique_with_counts",
+    "merge_selected_rows", "get_tensor_from_selected_rows", "cvm",
+    "psroi_pool", "chunk_eval", "adaptive_pool3d", "image_resize_short",
+    "scatter_nd", "crop_tensor", "fsp_matrix", "similarity_focus",
+    "prroi_pool", "deformable_conv", "deformable_roi_pooling",
+    "filter_by_instag", "reorder_lod_tensor_by_rank", "IfElse",
+    "DynamicRNN",
+]
+
+
+def lod_reset(x, y=None, target_lod=None):
+    helper = LayerHelper("lod_reset", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.lod_level = 1
+    inputs = {"X": [x]}
+    attrs = {}
+    if y is not None:
+        inputs["Y"] = [y]
+    elif target_lod is not None:
+        attrs["target_lod"] = [int(v) for v in target_lod]
+    else:
+        raise ValueError("lod_reset needs y or target_lod")
+    helper.append_op(type="lod_reset", inputs=inputs,
+                     outputs={"Out": [out]}, attrs=attrs)
+    return out
+
+
+def lod_append(x, level):
+    helper = LayerHelper("lod_append", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.lod_level = getattr(x, "lod_level", 0) + 1
+    helper.append_op(type="lod_append", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"level": [int(v) for v in level]})
+    return out
+
+
+def unique_with_counts(x, dtype="int32"):
+    helper = LayerHelper("unique_with_counts", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    index = helper.create_variable_for_type_inference(dtype)
+    count = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type="unique_with_counts", inputs={"X": [x]},
+                     outputs={"Out": [out], "Index": [index],
+                              "Count": [count]})
+    return out, index, count
+
+
+def merge_selected_rows(x, name=None):
+    helper = LayerHelper("merge_selected_rows", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.type = "selected_rows"
+    out.shape = tuple(x.shape)  # keeps the dense height downstream
+    helper.append_op(type="merge_selected_rows", inputs={"X": [x]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def get_tensor_from_selected_rows(x, height=None, name=None):
+    """Densify a SelectedRows var. ``height`` defaults to the var's
+    declared dense height (static shapes need it at build time)."""
+    helper = LayerHelper("get_tensor_from_selected_rows", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    if height is None:
+        if x.shape and int(x.shape[0]) > 0:
+            height = int(x.shape[0])
+        else:
+            raise ValueError(
+                "pass height=: %r declares no static dense height"
+                % (x.name,))
+    helper.append_op(type="get_tensor_from_selected_rows",
+                     inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"height": int(height)})
+    return out
+
+
+def cvm(input, cvm=None, use_cvm=True):
+    helper = LayerHelper("cvm", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="cvm", inputs={"X": [input]},
+                     outputs={"Y": [out]}, attrs={"use_cvm": use_cvm})
+    return out
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, rois_num=None, name=None):
+    helper = LayerHelper("psroi_pool", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"X": [input], "ROIs": [rois]}
+    if rois_num is not None:
+        inputs["RoisNum"] = [rois_num]
+    helper.append_op(
+        type="psroi_pool", inputs=inputs, outputs={"Out": [out]},
+        attrs={"output_channels": int(output_channels),
+               "spatial_scale": float(spatial_scale),
+               "pooled_height": int(pooled_height),
+               "pooled_width": int(pooled_width)})
+    return out
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_length=None):
+    helper = LayerHelper("chunk_eval", **locals())
+    mk = helper.create_variable_for_type_inference
+    precision, recall, f1 = mk("float32"), mk("float32"), mk("float32")
+    ni, nl, nc = mk("int32"), mk("int32"), mk("int32")
+    inputs = {"Inference": [input], "Label": [label]}
+    if seq_length is not None:
+        inputs["SeqLength"] = [seq_length]
+    helper.append_op(
+        type="chunk_eval", inputs=inputs,
+        outputs={"Precision": [precision], "Recall": [recall],
+                 "F1-Score": [f1], "NumInferChunks": [ni],
+                 "NumLabelChunks": [nl], "NumCorrectChunks": [nc]},
+        attrs={"num_chunk_types": int(num_chunk_types),
+               "chunk_scheme": chunk_scheme,
+               "excluded_chunk_types": [int(t) for t in
+                                        (excluded_chunk_types or [])]})
+    return precision, recall, f1, ni, nl, nc
+
+
+def adaptive_pool3d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    """Output bins of the requested size; like adaptive_pool2d the input
+    spatial dims must divide evenly (XLA static windows)."""
+    if isinstance(pool_size, int):
+        pool_size = [pool_size] * 3
+    d, h, w = (int(s) for s in input.shape[2:])
+    od, oh, ow = (int(p) for p in pool_size)
+    for i_dim, o_dim in ((d, od), (h, oh), (w, ow)):
+        if i_dim % o_dim != 0:
+            raise ValueError(
+                "adaptive_pool3d needs divisible dims, got %d -> %d"
+                % (i_dim, o_dim))
+    k = [d // od, h // oh, w // ow]
+    return nn.pool3d(input, pool_size=k, pool_type=pool_type,
+                     pool_stride=k)
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    """Resize so the SHORT spatial side equals ``out_short_len`` (aspect
+    preserved; static shapes from the declared input dims)."""
+    h, w = int(input.shape[2]), int(input.shape[3])
+    # round-half-up on the long side (reference int(long*s/short + 0.5))
+    if h <= w:
+        shape = [out_short_len, max(1, int(w * out_short_len / h + 0.5))]
+    else:
+        shape = [max(1, int(h * out_short_len / w + 0.5)), out_short_len]
+    return nn.image_resize(input, out_shape=shape, resample=resample)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    """zeros(shape) scatter-added with updates at index (reference
+    scatter_nd_op)."""
+    ref = tensor.fill_constant(list(shape), updates.dtype, 0.0)
+    return nn.scatter_nd_add(ref, index, updates)
+
+
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    """crop with -1 ("rest of the dim") allowed in shape."""
+    offsets = list(offsets or [0] * len(x.shape))
+    full = [int(s) for s in x.shape]
+    resolved = [full[i] - offsets[i] if s in (-1, None) else int(s)
+                for i, s in enumerate(shape)]
+    return nn.crop(x, shape=resolved, offsets=offsets)
+
+
+def fsp_matrix(x, y):
+    """Flow-of-solution-procedure matrix (reference fsp_op): [N, C1, C2]
+    = x·yᵀ over spatial positions / (H*W)."""
+    n, c1 = x.shape[0], x.shape[1]
+    c2 = y.shape[1]
+    hw = int(x.shape[2]) * int(x.shape[3])
+    fx = nn.reshape(x, [-1, c1, hw])
+    fy = nn.transpose(nn.reshape(y, [-1, c2, hw]), [0, 2, 1])
+    return nn.scale(nn.matmul(fx, fy), scale=1.0 / hw)
+
+
+# -- documented-unsupported (dynamic-shape semantics XLA can't express) --
+def _unsupported(name, alternative):
+    def fn(*args, **kwargs):
+        raise NotImplementedError(
+            "%s is not supported on the TPU build (%s)" % (name,
+                                                           alternative))
+
+    fn.__name__ = name
+    fn.__doc__ = "Unsupported on TPU: use %s." % alternative
+    return fn
+
+
+similarity_focus = _unsupported(
+    "similarity_focus", "compose topk + one_hot masks for the same effect")
+prroi_pool = _unsupported(
+    "prroi_pool", "roi_align (bilinear-sampled RoI pooling)")
+deformable_conv = _unsupported(
+    "deformable_conv", "grid_sampler + conv2d composition")
+deformable_roi_pooling = _unsupported(
+    "deformable_roi_pooling", "grid_sampler + roi_align composition")
+filter_by_instag = _unsupported(
+    "filter_by_instag",
+    "mask rows host-side in the Dataset/DataLoader pipeline")
+reorder_lod_tensor_by_rank = _unsupported(
+    "reorder_lod_tensor_by_rank",
+    "argsort + gather over the bounded-LoD lengths")
+
+
+class IfElse:
+    """Reference block-style IfElse; under XLA use ``layers.cond`` /
+    ``case`` / ``switch_case`` (functional branches compile to
+    lax.cond)."""
+
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError(
+            "IfElse's imperative blocks don't trace under XLA; use "
+            "layers.cond(pred, true_fn, false_fn) or layers.case")
+
+
+class DynamicRNN:
+    """Reference block-style DynamicRNN; the TPU build covers variable
+    length recurrence with ``layers.rnn``/``RNNCell`` over bounded-LoD
+    (padded + masked) sequences, or dynamic_lstm/dynamic_gru."""
+
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError(
+            "DynamicRNN's imperative block doesn't trace under XLA; use "
+            "layers.rnn(cell, inputs, sequence_length=...) or "
+            "dynamic_lstm/dynamic_gru over bounded-LoD input")

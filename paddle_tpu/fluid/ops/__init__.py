@@ -16,6 +16,7 @@ from . import (  # noqa: F401
     loss,
     math,
     metrics,
+    misc_ops,
     nn,
     optimizer_ops,
     quant_ops,

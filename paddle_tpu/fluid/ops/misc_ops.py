@@ -1,0 +1,211 @@
+"""Remaining Appendix-A op lowerings: LoD rebinding (lod_reset/append),
+unique_with_counts, CVM, PSRoI pooling, chunk_eval (SelectedRows
+merge/densify live in tensor_ops.py). Reference:
+``operators/lod_reset_op.cc``, ``unique_op``, ``cvm_op.cc``,
+``psroi_pool_op.cc``, ``chunk_eval_op.cc``."""
+
+import numpy as np
+
+from ..lod import lod_name
+from ..registry import register
+
+
+@register("lod_reset")
+def _lod_reset(ctx, op):
+    """Rebind the @LOD lengths of X: from Y's lod, from Y's int values
+    (offset form), or from the target_lod attr (offsets)."""
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    out_name = op.output("Out")[0]
+    ctx.set_output(op, "Out", x)
+    y_names = op.input("Y")
+    if y_names:
+        ylod = ctx.env.get(lod_name(y_names[0]))
+        if ylod is not None:
+            ctx.set(lod_name(out_name), ylod)
+            return
+        y = ctx.get(y_names[0])  # int offsets tensor
+        offs = jnp.reshape(y, (-1,)).astype(np.dtype("int32"))
+        ctx.set(lod_name(out_name), offs[1:] - offs[:-1])
+        return
+    target = op.attr("target_lod", [])
+    offs = np.asarray(target, np.int32)
+    ctx.set(lod_name(out_name), jnp.asarray(offs[1:] - offs[:-1]))
+
+
+@register("lod_append")
+def _lod_append(ctx, op):
+    """Append a deeper LoD level. Only the innermost level rides the
+    device (bounded-LoD), so appending REPLACES the device lengths with
+    the new innermost level."""
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    ctx.set_output(op, "Out", x)
+    out_name = op.output("Out")[0]
+    level = op.attr("level", [])
+    offs = np.asarray(level, np.int32)
+    ctx.set(lod_name(out_name), jnp.asarray(offs[1:] - offs[:-1]))
+
+
+@register("unique_with_counts")
+def _unique_with_counts(ctx, op):
+    """Size-preserving unique + per-unique counts (fixed shapes; tail
+    slots repeat the fill value with count 0)."""
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    out, idx, counts = jnp.unique(x, return_inverse=True,
+                                  return_counts=True, size=x.shape[0])
+    ctx.set_output(op, "Out", out)
+    ctx.set_output(op, "Index", idx.astype(np.dtype("int32")))
+    ctx.set_output(op, "Count", counts.astype(np.dtype("int32")))
+
+
+@register("cvm")
+def _cvm(ctx, op):
+    """Continuous-value model op (reference cvm_op.cc): the first two
+    features are show/click counters; use_cvm keeps them log-transformed
+    (log(show+1), log(clk+1)-log(show+1)), else they are stripped."""
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    use_cvm = bool(op.attr("use_cvm", True))
+    if use_cvm:
+        show = jnp.log(x[:, 0:1] + 1.0)
+        clk = jnp.log(x[:, 1:2] + 1.0) - show
+        ctx.set_output(op, "Y", jnp.concatenate([show, clk, x[:, 2:]],
+                                                axis=1))
+    else:
+        ctx.set_output(op, "Y", x[:, 2:])
+
+
+@register("psroi_pool")
+def _psroi_pool(ctx, op):
+    """Position-sensitive RoI average pooling (reference
+    psroi_pool_op.cc): output channel c at bin (i, j) pools input channel
+    (c*ph + i)*pw + j over that bin."""
+    import jax
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")        # [N, C*ph*pw, H, W]
+    rois = ctx.get_input(op, "ROIs").reshape(-1, 4)
+    ph = int(op.attr("pooled_height", 1))
+    pw = int(op.attr("pooled_width", 1))
+    out_c = int(op.attr("output_channels"))
+    scale = float(op.attr("spatial_scale", 1.0))
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    rois_num = ctx.get_input(op, "RoisNum")
+    from .detection_ops import _rois_num_to_batch_idx
+
+    batch_idx = _rois_num_to_batch_idx(rois_num, R)
+
+    def one_roi(roi, bidx):
+        x0, y0 = roi[0] * scale, roi[1] * scale
+        x1, y1 = roi[2] * scale, roi[3] * scale
+        rw = jnp.maximum(x1 - x0, 0.1)
+        rh = jnp.maximum(y1 - y0, 0.1)
+        img = x[bidx].reshape(out_c, ph, pw, H, W)
+        yy = jnp.arange(H, dtype=x.dtype)[None, :]
+        xx = jnp.arange(W, dtype=x.dtype)[None, :]
+        iy = jnp.arange(ph, dtype=x.dtype)[:, None]
+        ix = jnp.arange(pw, dtype=x.dtype)[:, None]
+        ys0 = y0 + iy * rh / ph
+        ys1 = y0 + (iy + 1) * rh / ph
+        xs0 = x0 + ix * rw / pw
+        xs1 = x0 + (ix + 1) * rw / pw
+        ymask = ((yy >= jnp.floor(ys0)) &
+                 (yy < jnp.maximum(jnp.ceil(ys1), jnp.floor(ys0) + 1)))
+        xmask = ((xx >= jnp.floor(xs0)) &
+                 (xx < jnp.maximum(jnp.ceil(xs1), jnp.floor(xs0) + 1)))
+        # mask [1, ph, pw, H, W]: bin (i, j) covers pixel (h, w)
+        m = ymask[None, :, None, :, None] & xmask[None, None, :, None, :]
+        sel = jnp.where(m, img, 0.0)       # img [C_out, ph, pw, H, W]
+        cnt = jnp.maximum(m.sum(axis=(3, 4)), 1)
+        return sel.sum(axis=(3, 4)) / cnt  # [C_out, ph, pw]
+
+    out = jax.vmap(one_roi)(rois, batch_idx)
+    ctx.set_output(op, "Out", out)
+
+
+@register("chunk_eval")
+def _chunk_eval(ctx, op):
+    """IOB/IOE/IOBES chunk F1 (reference chunk_eval_op.cc). Span matching
+    is irregular host work, not MXU work — computed via
+    ``jax.pure_callback`` (the reference also runs it on CPU)."""
+    import jax
+    import jax.numpy as jnp
+
+    inference = ctx.get_input(op, "Inference")
+    label = ctx.get_input(op, "Label")
+    num_chunk_types = int(op.attr("num_chunk_types"))
+    scheme = str(op.attr("chunk_scheme", "IOB"))
+    excluded = set(op.attr("excluded_chunk_types", []) or [])
+    lengths = ctx.env.get(lod_name(op.input("Inference")[0]))
+    seq_len_names = op.input("SeqLength")
+    if lengths is None and seq_len_names:
+        lengths = ctx.get(seq_len_names[0])
+
+    def _extract(seq, n_types, scheme):
+        # tag layout (reference): IOB -> 2 tags/type (B, I), O = last
+        chunks = []
+        start, ctype = None, None
+        for i, t in enumerate(seq):
+            t = int(t)
+            if scheme == "IOB":
+                is_o = t >= 2 * n_types
+                b = (not is_o) and t % 2 == 0
+                ty = t // 2 if not is_o else None
+            elif scheme == "plain":
+                is_o = t >= n_types
+                b = not is_o
+                ty = t if not is_o else None
+            else:
+                raise NotImplementedError(
+                    "chunk_scheme %r not supported (IOB, plain)" % scheme)
+            if start is not None and (is_o or b or ty != ctype):
+                chunks.append((start, i - 1, ctype))
+                start, ctype = None, None
+            if not is_o and (b or start is None):
+                start, ctype = i, ty
+        if start is not None:
+            chunks.append((start, len(seq) - 1, ctype))
+        return set(chunks)
+
+    def host(inf, lab, lens):
+        inf = np.asarray(inf).ravel()
+        lab = np.asarray(lab).ravel()
+        if lens is None or np.size(lens) == 0:
+            bounds = [(0, inf.size)]
+        else:
+            offs = np.concatenate([[0], np.cumsum(np.asarray(lens))])
+            bounds = list(zip(offs[:-1], offs[1:]))
+        n_inf = n_lab = n_cor = 0
+        for s, e in bounds:
+            ci = {c for c in _extract(inf[s:e], num_chunk_types, scheme)
+                  if c[2] not in excluded}
+            cl = {c for c in _extract(lab[s:e], num_chunk_types, scheme)
+                  if c[2] not in excluded}
+            n_inf += len(ci)
+            n_lab += len(cl)
+            n_cor += len(ci & cl)
+        p = n_cor / n_inf if n_inf else 0.0
+        r = n_cor / n_lab if n_lab else 0.0
+        f1 = 2 * p * r / (p + r) if p + r else 0.0
+        return (np.float32(p), np.float32(r), np.float32(f1),
+                np.int32(n_inf), np.int32(n_lab), np.int32(n_cor))
+
+    # int32 counters: x64 is disabled on the device path
+    shapes = (jax.ShapeDtypeStruct((), np.float32),) * 3 + \
+        (jax.ShapeDtypeStruct((), np.int32),) * 3
+    args = (inference, label, lengths if lengths is not None
+            else jnp.zeros((0,), np.int32))
+    p, r, f1, ni, nl, nc = jax.pure_callback(host, shapes, *args)
+    ctx.set_output(op, "Precision", p)
+    ctx.set_output(op, "Recall", r)
+    ctx.set_output(op, "F1-Score", f1)
+    ctx.set_output(op, "NumInferChunks", ni)
+    ctx.set_output(op, "NumLabelChunks", nl)
+    ctx.set_output(op, "NumCorrectChunks", nc)

@@ -1,0 +1,226 @@
+"""Remaining Appendix-A layers (layers/extras.py + ops/misc_ops.py):
+LoD rebinding, SelectedRows utilities, CVM, PSRoI pooling, chunk_eval,
+adaptive_pool3d, resize-short, scatter_nd, crop_tensor, fsp_matrix."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+RNG = np.random.RandomState(5)
+
+
+def _run(build, feed):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        fetch = build()
+        if not isinstance(fetch, (list, tuple)):
+            fetch = [fetch]
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        res = exe.run(main, feed=feed, fetch_list=list(fetch))
+    return [np.asarray(r) for r in res]
+
+
+def test_lod_reset_rebinds_lengths():
+    x = np.arange(12, dtype=np.float32).reshape(6, 2)
+
+    def build():
+        xv = layers.data("x", [2], dtype="float32", lod_level=1)
+        y = layers.lod_reset(xv, target_lod=[0, 2, 6])
+        return [layers.sequence_pool(y, "sum")]
+
+    (out,) = _run(build, {"x": fluid.create_lod_tensor(x, [[3, 3]])})
+    # pools follow the NEW lod [2, 4], not the fed [3, 3]
+    np.testing.assert_allclose(out, [x[:2].sum(0), x[2:6].sum(0)],
+                               rtol=1e-6)
+
+
+def test_unique_with_counts():
+    x = np.array([2, 3, 3, 1, 5, 3], np.int64)
+
+    def build():
+        xv = layers.data("x", x.shape, append_batch_size=False,
+                         dtype="int64")
+        return list(layers.unique_with_counts(xv))
+
+    out, index, count = _run(build, {"x": x})
+    assert out.shape == (6,)
+    np.testing.assert_array_equal(out[index], x)  # inverse reconstructs
+    assert count[list(out).index(3)] == 3
+
+
+def test_merge_and_densify_selected_rows():
+    """An is_sparse embedding grad merges duplicates and densifies to
+    the dense-path gradient."""
+    ids = np.array([[1], [3], [1]], np.int64)
+
+    def build(sparse):
+        xv = layers.data("ids", ids.shape, append_batch_size=False,
+                         dtype="int64")
+        emb = layers.embedding(xv, size=[6, 2], is_sparse=sparse,
+                               param_attr=fluid.ParamAttr(
+                                   name="emb_w_%d" % sparse))
+        loss = layers.reduce_sum(layers.square(emb))
+        grads = fluid.backward.append_backward(loss)
+        gvar = dict((p.name, g) for p, g in grads)["emb_w_%d" % sparse]
+        if sparse:
+            merged = layers.merge_selected_rows(gvar)
+            return [layers.get_tensor_from_selected_rows(merged, height=6)]
+        return [gvar]
+
+    (dense_grad,) = _run(lambda: build(False), {"ids": ids})
+    (sparse_dense,) = _run(lambda: build(True), {"ids": ids})
+    np.testing.assert_allclose(sparse_dense, dense_grad, rtol=1e-5)
+
+
+def test_cvm():
+    x = np.array([[3.0, 1.0, 0.5, 0.6]], np.float32)
+
+    def build(use):
+        xv = layers.data("x", x.shape, append_batch_size=False)
+        return [layers.cvm(xv, use_cvm=use)]
+
+    (kept,) = _run(lambda: build(True), {"x": x})
+    np.testing.assert_allclose(
+        kept[0, :2], [np.log(4.0), np.log(2.0) - np.log(4.0)], rtol=1e-5)
+    np.testing.assert_allclose(kept[0, 2:], x[0, 2:])
+    (stripped,) = _run(lambda: build(False), {"x": x})
+    np.testing.assert_allclose(stripped, x[:, 2:])
+
+
+def test_psroi_pool_position_sensitivity():
+    """Each output channel/bin reads its OWN input channel: constant
+    per-channel planes come back exactly."""
+    out_c, ph, pw = 2, 2, 2
+    C = out_c * ph * pw
+    x = np.zeros((1, C, 4, 4), np.float32)
+    for c in range(C):
+        x[0, c] = c + 1.0
+    rois = np.array([[0, 0, 4, 4]], np.float32)
+
+    def build():
+        xv = layers.data("x", x.shape, append_batch_size=False)
+        r = layers.data("r", rois.shape, append_batch_size=False)
+        return [layers.psroi_pool(xv, r, out_c, 1.0, ph, pw)]
+
+    (out,) = _run(build, {"x": x, "r": rois})
+    assert out.shape == (1, out_c, ph, pw)
+    for c in range(out_c):
+        for i in range(ph):
+            for j in range(pw):
+                assert out[0, c, i, j] == (c * ph + i) * pw + j + 1.0
+
+
+def test_chunk_eval_iob():
+    """2 types, IOB: tags B0=0 I0=1 B1=2 I1=3 O=4."""
+    label = np.array([0, 1, 4, 2, 3, 4], np.int64)
+    inf = np.array([0, 1, 4, 2, 4, 4], np.int64)  # 2nd chunk cut short
+
+    def build():
+        iv = layers.data("i", label.shape, append_batch_size=False,
+                         dtype="int64")
+        lv = layers.data("l", label.shape, append_batch_size=False,
+                         dtype="int64")
+        return list(layers.chunk_eval(iv, lv, "IOB", 2))
+
+    p, r, f1, ni, nl, nc = _run(build, {"i": inf, "l": label})
+    assert ni == 2 and nl == 2 and nc == 1
+    np.testing.assert_allclose(p, 0.5)
+    np.testing.assert_allclose(r, 0.5)
+    np.testing.assert_allclose(f1, 0.5)
+
+
+def test_adaptive_pool3d():
+    x = RNG.rand(1, 2, 4, 4, 4).astype(np.float32)
+
+    def build():
+        xv = layers.data("x", x.shape, append_batch_size=False)
+        return [layers.adaptive_pool3d(xv, 2, pool_type="avg")]
+
+    (out,) = _run(build, {"x": x})
+    assert out.shape == (1, 2, 2, 2, 2)
+    np.testing.assert_allclose(out[0, 0, 0, 0, 0],
+                               x[0, 0, :2, :2, :2].mean(), rtol=1e-5)
+
+
+def test_image_resize_short_and_crop_tensor_and_scatter_nd():
+    x = RNG.rand(1, 3, 4, 8).astype(np.float32)
+    idx = np.array([[1], [3]], np.int64)
+    upd = np.ones((2, 2), np.float32)
+
+    def build():
+        xv = layers.data("x", x.shape, append_batch_size=False)
+        short = layers.image_resize_short(xv, 8)
+        cropped = layers.crop_tensor(xv, shape=[-1, 2, 2, -1],
+                                     offsets=[0, 1, 1, 2])
+        iv = layers.data("i", idx.shape, append_batch_size=False,
+                         dtype="int64")
+        uv = layers.data("u", upd.shape, append_batch_size=False)
+        sc = layers.scatter_nd(iv, uv, [5, 2])
+        return [short, cropped, sc]
+
+    short, cropped, sc = _run(build, {"x": x, "i": idx, "u": upd})
+    assert short.shape == (1, 3, 8, 16)  # short side 4 -> 8, aspect kept
+    np.testing.assert_allclose(cropped, x[:, 1:3, 1:3, 2:], rtol=1e-6)
+    ref = np.zeros((5, 2), np.float32)
+    ref[[1, 3]] = 1.0
+    np.testing.assert_allclose(sc, ref)
+
+
+def test_fsp_matrix():
+    a = RNG.rand(2, 3, 4, 4).astype(np.float32)
+    b = RNG.rand(2, 5, 4, 4).astype(np.float32)
+
+    def build():
+        av = layers.data("a", a.shape, append_batch_size=False)
+        bv = layers.data("b", b.shape, append_batch_size=False)
+        return [layers.fsp_matrix(av, bv)]
+
+    (out,) = _run(build, {"a": a, "b": b})
+    ref = np.einsum("nchw,ndhw->ncd", a, b) / 16.0
+    np.testing.assert_allclose(out, ref, rtol=1e-4)
+
+
+def test_unsupported_apis_raise_with_alternatives():
+    for fn, kw in ((layers.similarity_focus, {}),
+                   (layers.prroi_pool, {}),
+                   (layers.deformable_conv, {}),
+                   (layers.filter_by_instag, {})):
+        with pytest.raises(NotImplementedError):
+            fn()
+    with pytest.raises(NotImplementedError, match="cond"):
+        layers.IfElse(None)
+    with pytest.raises(NotImplementedError, match="rnn"):
+        layers.DynamicRNN()
+
+
+def test_lod_append_sets_innermost_level():
+    x = np.arange(8, dtype=np.float32).reshape(4, 2)
+
+    def build():
+        xv = layers.data("x", [2], dtype="float32", lod_level=1)
+        y = layers.lod_append(xv, level=[0, 1, 4])
+        return [layers.sequence_pool(y, "sum")]
+
+    (out,) = _run(build, {"x": fluid.create_lod_tensor(x, [[4]])})
+    np.testing.assert_allclose(out, [x[:1].sum(0), x[1:4].sum(0)],
+                               rtol=1e-6)
+
+
+def test_chunk_eval_excluded_types():
+    label = np.array([0, 1, 2, 3], np.int64)  # one type-0 + one type-1
+    inf = np.array([0, 1, 2, 3], np.int64)
+
+    def build():
+        iv = layers.data("i", label.shape, append_batch_size=False,
+                         dtype="int64")
+        lv = layers.data("l", label.shape, append_batch_size=False,
+                         dtype="int64")
+        return list(layers.chunk_eval(iv, lv, "IOB", 2,
+                                      excluded_chunk_types=[0]))
+
+    p, r, f1, ni, nl, nc = _run(build, {"i": inf, "l": label})
+    assert ni == 1 and nl == 1 and nc == 1  # type-0 chunk not counted
