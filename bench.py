@@ -142,6 +142,54 @@ def bench_bert(batch_size=128, seq_len=128, warmup=3, iters=20):
     }
 
 
+def resnet50_train_flops_per_step(batch, image_size=224):
+    """Analytic: ResNet-50 fwd ≈ 4.1 GFLOP per 224² image; train ≈ 3x."""
+    per_image = 4.1e9 * (image_size / 224.0) ** 2
+    return 3 * batch * per_image
+
+
+def bench_resnet(batch_size=128, image_size=224, warmup=3, iters=10):
+    """BASELINE config 2 (ResNet-50 images/sec/chip); opt-in via
+    BENCH_RESNET=1 so the driver's default bench stays one workload."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import resnet
+
+    import jax
+
+    main, startup, loss, acc = resnet.build_train_program(
+        image_size=image_size, use_amp=True)
+    exe = fluid.Executor()
+    rng = np.random.RandomState(0)
+    feed = {
+        "img": jax.device_put(rng.rand(
+            batch_size, 3, image_size, image_size).astype("float32")),
+        "label": jax.device_put(rng.randint(
+            0, 1000, (batch_size, 1)).astype("int64")),
+    }
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for _ in range(warmup):
+            (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
+            assert np.isfinite(np.asarray(lv)).all()
+        elapsed = _timed_run(exe, main, feed, loss, iters, jax)
+        elapsed2 = _timed_run(exe, main, feed, loss, 2 * iters, jax)
+    ips = batch_size * 2 * iters / elapsed2
+    ratio = (batch_size * iters / elapsed) / ips
+    assert 0.7 < ratio < 1.43, "resnet bench unstable across iters"
+    step_ms = elapsed2 / (2 * iters) * 1e3
+    flops = resnet50_train_flops_per_step(batch_size, image_size)
+    peak, peak_source = _peak_flops(jax.devices()[0])
+    mfu = flops / (step_ms / 1e3) / peak
+    assert mfu <= 1.0, (
+        "resnet MFU %.3f > 1: peak table wrong or timing missed work"
+        % mfu)
+    return {"resnet50_images_per_sec": round(ips, 1),
+            "resnet50_step_time_ms": round(step_ms, 3),
+            "resnet50_mfu": round(mfu, 4),
+            "resnet50_peak_source": peak_source,
+            "resnet50_batch_size": batch_size}
+
+
 if __name__ == "__main__":
     r = bench_bert()
     assert r["mfu"] <= 1.0, (
@@ -154,4 +202,6 @@ if __name__ == "__main__":
         "vs_baseline": None,
     }
     out.update(r)
+    if os.environ.get("BENCH_RESNET") == "1":
+        out.update(bench_resnet())
     print(json.dumps(out))

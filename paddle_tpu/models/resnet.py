@@ -70,7 +70,7 @@ def resnet_forward(img, label=None, depth=50, num_classes=1000):
 
 
 def build_train_program(depth=50, num_classes=1000, image_size=224,
-                        lr=0.1, momentum=0.9, seed=7):
+                        lr=0.1, momentum=0.9, seed=7, use_amp=False):
     main, startup = fluid.Program(), fluid.Program()
     main.random_seed = seed
     with fluid.program_guard(main, startup):
@@ -78,9 +78,14 @@ def build_train_program(depth=50, num_classes=1000, image_size=224,
                           dtype="float32")
         label = layers.data(name="label", shape=[1], dtype="int64")
         _, loss, acc = resnet_forward(img, label, depth, num_classes)
-        optimizer.Momentum(learning_rate=lr, momentum=momentum,
-                           regularization=fluid.regularizer.L2Decay(1e-4)
-                           ).minimize(loss)
+        opt = optimizer.Momentum(
+            learning_rate=lr, momentum=momentum,
+            regularization=fluid.regularizer.L2Decay(1e-4))
+        if use_amp:
+            from ..fluid.contrib import mixed_precision
+
+            opt = mixed_precision.decorate(opt)
+        opt.minimize(loss)
     return main, startup, loss, acc
 
 
