@@ -6,4 +6,5 @@ Reference: ``python/paddle/distributed/`` (launch.py) and the PS stack
 
 from . import env, heartbeat, launch, ps  # noqa: F401
 from .heartbeat import Heartbeat, Watchdog  # noqa: F401
-from .env import init_parallel_env, parallel_env  # noqa: F401
+from .env import (init_parallel_env, parallel_env,  # noqa: F401
+                  wait_server_ready)

@@ -71,3 +71,29 @@ def is_multiprocess():
         return jax.process_count() > 1
     except Exception:
         return False
+
+
+def wait_server_ready(endpoints, timeout=120.0, interval=0.5):
+    """Block until every ``host:port`` endpoint accepts a TCP connection
+    (reference ``transpiler/distribute_transpiler.py:322`` — trainers poll
+    pservers; here: pollers for the PS tier / NAS controller / any
+    socket-served component)."""
+    import socket
+    import time
+
+    pending = list(endpoints)
+    deadline = time.monotonic() + timeout
+    while pending:
+        still = []
+        for ep in pending:
+            host, port = ep.rsplit(":", 1)
+            try:
+                with socket.create_connection((host, int(port)), timeout=2.0):
+                    pass
+            except OSError:
+                still.append(ep)
+        pending = still
+        if pending:
+            if time.monotonic() > deadline:
+                raise TimeoutError("servers not ready: %s" % ",".join(pending))
+            time.sleep(interval)

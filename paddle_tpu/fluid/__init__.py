@@ -5,16 +5,21 @@ executed by lowering Programs to XLA (see ``executor.py``).
 """
 
 from . import (  # noqa: F401
+    average,
     backward,
     clip,
+    compat,
     contrib,
     compiler,
     data_feeder,
     dataset,
+    debugger,
+    evaluator,
     executor,
     flags,
     framework,
     initializer,
+    install_check,
     io,
     layers,
     metrics,

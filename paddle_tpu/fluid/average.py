@@ -1,0 +1,44 @@
+"""Host-side weighted running average (reference
+``python/paddle/fluid/average.py`` ``WeightedAverage``)."""
+
+import numpy as np
+
+__all__ = ["WeightedAverage"]
+
+
+def _is_number_or_matrix(x):
+    return isinstance(x, (int, float, np.ndarray)) or np.isscalar(x)
+
+
+class WeightedAverage:
+    """Accumulate ``value`` with ``weight`` and report the weighted mean.
+
+    Typical use: average per-batch mean losses weighted by batch size
+    between ``reset()`` calls (one per epoch).
+    """
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.numerator = None
+        self.denominator = None
+
+    def add(self, value, weight):
+        if not _is_number_or_matrix(value):
+            raise ValueError("add(): value must be a number or ndarray")
+        if not (np.isscalar(weight) or np.asarray(weight).size == 1):
+            raise ValueError("add(): weight must be a number")
+        value = np.mean(np.asarray(value, dtype=np.float64))
+        weight = float(np.asarray(weight).reshape(()))
+        if self.numerator is None:
+            self.numerator = value * weight
+            self.denominator = weight
+        else:
+            self.numerator += value * weight
+            self.denominator += weight
+
+    def eval(self):
+        if self.numerator is None or self.denominator == 0.0:
+            raise ValueError("eval() before any add() — nothing accumulated")
+        return self.numerator / self.denominator

@@ -1,0 +1,81 @@
+"""Program/op compatibility checks (reference ``framework/version.h`` +
+``framework/op_compatible_info.{h,cc}``: a loaded ProgramDesc is checked
+against the running framework before execution).
+
+TPU-native form: "compatible" means (a) the serialized program version is
+one this build understands, and (b) every op type in the program has a
+registered XLA lowering rule — the analogue of the reference's
+kernel-availability check."""
+
+from .registry import registry as _op_registry
+
+__all__ = ["PROGRAM_VERSION", "is_program_version_supported",
+           "check_program_compatible", "CompatibleInfo"]
+
+# Serialized-program versions this build can execute. Version 1 is the only
+# format so far (core/framework.proto `version`).
+PROGRAM_VERSION = 1
+_SUPPORTED_VERSIONS = (1,)
+
+
+def is_program_version_supported(version):
+    return version in _SUPPORTED_VERSIONS
+
+
+class CompatibleInfo:
+    """Result of a compatibility scan (reference OpCompatibleType)."""
+
+    COMPATIBLE = "compatible"
+    UNSUPPORTED_VERSION = "unsupported_version"
+    UNDEFINED_OP = "undefined_op"
+
+    def __init__(self, status, detail=""):
+        self.status = status
+        self.detail = detail
+
+    def __bool__(self):
+        return self.status == self.COMPATIBLE
+
+    def __repr__(self):
+        return "CompatibleInfo(%s%s)" % (
+            self.status, ": " + self.detail if self.detail else "")
+
+
+# Op types consumed structurally by the executor/autodiff rather than via a
+# lowering rule.
+_STRUCTURAL_OPS = frozenset({"feed", "fetch", "autodiff", "save", "load",
+                             "py_func"})
+
+
+def check_program_compatible(program, version=None):
+    """Scan ``program`` (a Program or a desc dict from proto_io) and return
+    a CompatibleInfo. Raise nothing — callers decide."""
+    if version is None and isinstance(program, dict):
+        version = program.get("version", PROGRAM_VERSION)
+    if version is not None and not is_program_version_supported(version):
+        return CompatibleInfo(CompatibleInfo.UNSUPPORTED_VERSION,
+                              "program version %s (supported: %s)"
+                              % (version, list(_SUPPORTED_VERSIONS)))
+    known = set(_op_registry.types())
+
+    def _unknown(t):
+        # *_grad op types are consumed by the autodiff replay, not by a
+        # per-op lowering rule — exempt in both scan paths.
+        return (t not in known and t not in _STRUCTURAL_OPS
+                and not t.endswith("_grad"))
+
+    missing = set()
+    if isinstance(program, dict):
+        for blk in program.get("blocks", []):
+            for op in blk.get("ops", []):
+                if _unknown(op.get("type")):
+                    missing.add(op.get("type"))
+    else:
+        for blk in program.blocks:
+            for op in blk.ops:
+                if _unknown(op.type):
+                    missing.add(op.type)
+    if missing:
+        return CompatibleInfo(CompatibleInfo.UNDEFINED_OP,
+                              "no lowering for: %s" % ", ".join(sorted(missing)))
+    return CompatibleInfo(CompatibleInfo.COMPATIBLE)

@@ -1,0 +1,79 @@
+"""Program inspection utilities (reference ``python/paddle/fluid/debugger.py``
+``pprint_program_codes``/``draw_block_graphviz`` and ``net_drawer.py``).
+
+Pure-host tooling over the Program IR: a readable text dump and a Graphviz
+dot export (ops as boxes, vars as ellipses). No graphviz binary is needed —
+we emit dot source; render externally if desired."""
+
+__all__ = ["pprint_program_codes", "pprint_block_codes",
+           "draw_block_graphviz"]
+
+
+def _fmt_attr(v):
+    s = repr(v)
+    return s if len(s) <= 60 else s[:57] + "..."
+
+
+def pprint_block_codes(block, show_backward=False):
+    """Return a readable text listing of one block's vars + ops."""
+    lines = ["block[%d]:" % block.idx]
+    for name in sorted(block.vars):
+        var = block.vars[name]
+        extra = []
+        if getattr(var, "persistable", False):
+            extra.append("persistable")
+        if getattr(var, "stop_gradient", False):
+            extra.append("stop_gradient")
+        lines.append("  var %s : shape=%s dtype=%s %s"
+                     % (name, getattr(var, "shape", None),
+                        getattr(var, "dtype", None), " ".join(extra)))
+    for i, op in enumerate(block.ops):
+        if not show_backward and op.type.endswith("_grad"):
+            continue
+        ins = {k: v for k, v in op.inputs.items()}
+        outs = {k: v for k, v in op.outputs.items()}
+        attrs = ", ".join("%s=%s" % (k, _fmt_attr(v))
+                          for k, v in sorted(op.attrs.items()))
+        lines.append("  op[%d] %s(%s) -> %s {%s}" % (i, op.type, ins, outs,
+                                                     attrs))
+    return "\n".join(lines)
+
+
+def pprint_program_codes(program, show_backward=False):
+    """Text dump of every block in the program."""
+    return "\n".join(pprint_block_codes(b, show_backward)
+                     for b in program.blocks)
+
+
+def draw_block_graphviz(block, highlights=None, path=None):
+    """Emit Graphviz dot for one block: op nodes (boxes) wired through var
+    nodes (ellipses). ``highlights`` is an optional set of var names drawn
+    in red. Writes to ``path`` if given; returns the dot source."""
+    highlights = set(highlights or [])
+    lines = ["digraph G {", "  rankdir=TB;"]
+    var_ids = {}
+
+    def var_node(name):
+        if name not in var_ids:
+            var_ids[name] = "var_%d" % len(var_ids)
+            color = ', color=red, fontcolor=red' if name in highlights else ""
+            lines.append('  %s [label="%s", shape=ellipse%s];'
+                         % (var_ids[name], name, color))
+        return var_ids[name]
+
+    for i, op in enumerate(block.ops):
+        op_id = "op_%d" % i
+        lines.append('  %s [label="%s", shape=box, style=filled, '
+                     'fillcolor=lightgrey];' % (op_id, op.type))
+        for names in op.inputs.values():
+            for n in names:
+                lines.append("  %s -> %s;" % (var_node(n), op_id))
+        for names in op.outputs.values():
+            for n in names:
+                lines.append("  %s -> %s;" % (op_id, var_node(n)))
+    lines.append("}")
+    dot = "\n".join(lines)
+    if path:
+        with open(path, "w") as f:
+            f.write(dot)
+    return dot

@@ -11,6 +11,7 @@ from .layers import Layer
 __all__ = [
     "Conv2D", "Conv3D", "Pool2D", "FC", "Linear", "BatchNorm", "Embedding",
     "LayerNorm", "PRelu", "BilinearTensorProduct", "Conv2DTranspose",
+    "Conv3DTranspose",
     "GroupNorm", "SpectralNorm", "GRUUnit", "NCE", "TreeConv", "Dropout",
 ]
 
@@ -95,11 +96,15 @@ class Conv2DTranspose(Layer):
         super().__init__(name_scope, dtype)
         self._stride = [stride] * 2 if isinstance(stride, int) else list(stride)
         self._padding = [padding] * 2 if isinstance(padding, int) else list(padding)
+        self._dilation = ([dilation] * 2 if isinstance(dilation, int)
+                          else list(dilation))
+        self._groups = groups or 1
         self._act = act
         if isinstance(filter_size, int):
             filter_size = [filter_size] * 2
         self.weight = self.create_parameter(
-            [num_channels, num_filters] + list(filter_size), param_attr, dtype)
+            [num_channels, num_filters // self._groups] + list(filter_size),
+            param_attr, dtype)
         self.bias = self.create_parameter([num_filters], bias_attr, dtype,
                                           is_bias=True)
 
@@ -107,7 +112,44 @@ class Conv2DTranspose(Layer):
         t = _tracer()
         (out,) = t.trace_op(
             "conv2d_transpose", {"Input": [input], "Filter": [self.weight]},
-            ["Output"], {"strides": self._stride, "paddings": self._padding})
+            ["Output"], {"strides": self._stride, "paddings": self._padding,
+                         "dilations": self._dilation, "groups": self._groups})
+        if self.bias is not None:
+            (out,) = t.trace_op("elementwise_add",
+                                {"X": [out], "Y": [self.bias]}, ["Out"],
+                                {"axis": 1})
+        if self._act:
+            (out,) = t.trace_op(self._act, {"X": [out]}, ["Out"], {})
+        return out
+
+
+class Conv3DTranspose(Layer):
+    """Eager 3D transposed conv (reference ``dygraph/nn.py`` Conv3DTranspose)."""
+
+    def __init__(self, name_scope=None, num_channels=None, num_filters=None,
+                 filter_size=None, padding=0, stride=1, dilation=1, groups=None,
+                 param_attr=None, bias_attr=None, act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._stride = [stride] * 3 if isinstance(stride, int) else list(stride)
+        self._padding = [padding] * 3 if isinstance(padding, int) else list(padding)
+        self._dilation = ([dilation] * 3 if isinstance(dilation, int)
+                          else list(dilation))
+        self._groups = groups or 1
+        self._act = act
+        if isinstance(filter_size, int):
+            filter_size = [filter_size] * 3
+        self.weight = self.create_parameter(
+            [num_channels, num_filters // self._groups] + list(filter_size),
+            param_attr, dtype)
+        self.bias = self.create_parameter([num_filters], bias_attr, dtype,
+                                          is_bias=True)
+
+    def forward(self, input):
+        t = _tracer()
+        (out,) = t.trace_op(
+            "conv3d_transpose", {"Input": [input], "Filter": [self.weight]},
+            ["Output"], {"strides": self._stride, "paddings": self._padding,
+                         "dilations": self._dilation, "groups": self._groups})
         if self.bias is not None:
             (out,) = t.trace_op("elementwise_add",
                                 {"X": [out], "Y": [self.bias]}, ["Out"],

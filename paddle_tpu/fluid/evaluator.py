@@ -1,0 +1,231 @@
+"""In-graph evaluators with persistable accumulator state (reference
+``python/paddle/fluid/evaluator.py``: ``Evaluator:52``, ``ChunkEvaluator:122``,
+``EditDistance:195``, ``DetectionMAP:273``).
+
+TPU-first shape: each evaluator appends its per-batch metric ops plus
+accumulate ops (``state = state + batch_stat``) to the *main* program, so a
+normal ``exe.run(main_program)`` advances the accumulators on device — no
+host round-trip per batch. ``reset(exe)`` runs a tiny generated program that
+``fill_constant``-zeros the persistable state vars through the same
+scope-writeback path the optimizers use. ``DetectionMAP`` aggregates on the
+host (the reference's ``detection_map`` op is a sequential CPU kernel; a
+host metric is the idiomatic equivalent)."""
+
+import numpy as np
+
+from . import layers
+from .framework import Program, program_guard
+
+__all__ = ["Evaluator", "ChunkEvaluator", "EditDistance", "DetectionMAP"]
+
+
+def _fetch_state(var, scope=None):
+    from .executor import global_scope
+
+    value = (scope or global_scope()).find_var(var.name)
+    if value is None:
+        raise RuntimeError("evaluator state %r not found in scope — run the "
+                           "startup program first" % var.name)
+    return float(np.asarray(value).reshape(-1)[0])
+
+
+class Evaluator:
+    """Base: owns persistable state vars; subclasses append update ops."""
+
+    def __init__(self, name=None, **kwargs):
+        self.states = []
+        self.metrics = []
+        self.helper = None
+        self._name = name or self.__class__.__name__
+
+    def _create_state(self, suffix, dtype, shape):
+        var = layers.create_global_var(
+            shape=list(shape), value=0.0, dtype=dtype, persistable=True,
+            name="%s_%s" % (self._name, suffix))
+        self.states.append(var)
+        return var
+
+    def reset(self, executor, reset_program=None):
+        """Zero every state var (reference ``evaluator.py:84``)."""
+        if reset_program is None:
+            reset_program = Program()
+        with program_guard(reset_program):
+            blk = reset_program.global_block()
+            for state in self.states:
+                v = blk.create_var(name=state.name, shape=state.shape,
+                                   dtype=state.dtype, persistable=True)
+                layers.fill_constant(shape=list(state.shape),
+                                     dtype=state.dtype, value=0.0, out=v)
+        executor.run(reset_program)
+
+    def eval(self, executor, eval_program=None):
+        raise NotImplementedError
+
+
+class ChunkEvaluator(Evaluator):
+    """Accumulate chunk counts across batches; report precision/recall/F1
+    (reference ``evaluator.py:122``; counts from the ``chunk_eval`` op)."""
+
+    def __init__(self, input, label, chunk_scheme, num_chunk_types,
+                 excluded_chunk_types=None, name=None):
+        super().__init__(name=name)
+        (precision, recall, f1, num_infer, num_label,
+         num_correct) = layers.chunk_eval(
+            input=input, label=label, chunk_scheme=chunk_scheme,
+            num_chunk_types=num_chunk_types,
+            excluded_chunk_types=excluded_chunk_types)
+        self.num_infer_chunks = self._create_state("num_infer", "int64", [1])
+        self.num_label_chunks = self._create_state("num_label", "int64", [1])
+        self.num_correct_chunks = self._create_state("num_correct", "int64",
+                                                     [1])
+        for state, batch in ((self.num_infer_chunks, num_infer),
+                             (self.num_label_chunks, num_label),
+                             (self.num_correct_chunks, num_correct)):
+            acc = layers.elementwise_add(state, layers.cast(batch, "int64"))
+            layers.assign(acc, output=state)
+        self.metrics.extend([precision, recall, f1])
+
+    def eval(self, executor, eval_program=None, scope=None):
+        infer, label, correct = (_fetch_state(s, scope)
+                                 for s in self.states)
+        precision = correct / infer if infer else 0.0
+        recall = correct / label if label else 0.0
+        f1 = (2 * precision * recall / (precision + recall)
+              if precision + recall else 0.0)
+        return precision, recall, f1
+
+
+class EditDistance(Evaluator):
+    """Average edit distance + instance error rate across batches
+    (reference ``evaluator.py:195``)."""
+
+    def __init__(self, input, label, ignored_tokens=None, name=None):
+        super().__init__(name=name)
+        distances, seq_num = layers.edit_distance(
+            input=input, label=label, normalized=False,
+            ignored_tokens=ignored_tokens)
+        self.total_distance = self._create_state("total_distance",
+                                                 "float32", [1])
+        self.seq_num = self._create_state("seq_num", "int64", [1])
+        self.instance_error = self._create_state("instance_error",
+                                                 "int64", [1])
+        batch_dist = layers.reduce_sum(distances)
+        batch_err = layers.reduce_sum(
+            layers.cast(layers.greater_than(
+                distances, layers.fill_constant([1], "float32", 0.0)),
+                "int64"))
+        for state, batch in ((self.total_distance, batch_dist),
+                             (self.seq_num, seq_num),
+                             (self.instance_error, batch_err)):
+            acc = layers.elementwise_add(
+                state, batch if batch.dtype == state.dtype
+                else layers.cast(batch, state.dtype))
+            layers.assign(acc, output=state)
+        self.metrics.extend([distances, seq_num])
+
+    def eval(self, executor, eval_program=None, scope=None):
+        total = _fetch_state(self.total_distance, scope)
+        n = _fetch_state(self.seq_num, scope)
+        err = _fetch_state(self.instance_error, scope)
+        avg_distance = total / n if n else 0.0
+        avg_instance_error = err / n if n else 0.0
+        return avg_distance, avg_instance_error
+
+
+class DetectionMAP:
+    """Mean average precision over accumulated detections (capability of
+    reference ``evaluator.py:273`` / ``detection_map_op.cc``, evaluated on
+    the host: VOC 11-point or integral AP).
+
+    ``update(detections, gt_boxes, gt_labels, difficult=None)`` per image:
+    ``detections`` is ``[M, 6]`` rows ``(label, score, x1, y1, x2, y2)``;
+    ``gt_boxes`` ``[G, 4]``; ``gt_labels`` ``[G]``.
+    """
+
+    def __init__(self, class_num, overlap_threshold=0.5,
+                 evaluate_difficult=True, ap_version="integral"):
+        if ap_version not in ("integral", "11point"):
+            raise ValueError("ap_version must be 'integral' or '11point'")
+        self.class_num = int(class_num)
+        self.overlap_threshold = float(overlap_threshold)
+        self.evaluate_difficult = bool(evaluate_difficult)
+        self.ap_version = ap_version
+        self.reset()
+
+    def reset(self, *_args):
+        self._dets = [[] for _ in range(self.class_num)]  # (score, tp)
+        self._npos = np.zeros(self.class_num, np.int64)
+
+    @staticmethod
+    def _iou(box, boxes):
+        x1 = np.maximum(box[0], boxes[:, 0])
+        y1 = np.maximum(box[1], boxes[:, 1])
+        x2 = np.minimum(box[2], boxes[:, 2])
+        y2 = np.minimum(box[3], boxes[:, 3])
+        inter = np.clip(x2 - x1, 0, None) * np.clip(y2 - y1, 0, None)
+        a = (box[2] - box[0]) * (box[3] - box[1])
+        b = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+        union = a + b - inter
+        return np.where(union > 0, inter / np.maximum(union, 1e-12), 0.0)
+
+    def update(self, detections, gt_boxes, gt_labels, difficult=None):
+        detections = np.asarray(detections, np.float64).reshape(-1, 6)
+        gt_boxes = np.asarray(gt_boxes, np.float64).reshape(-1, 4)
+        gt_labels = np.asarray(gt_labels, np.int64).reshape(-1)
+        difficult = (np.zeros_like(gt_labels, bool) if difficult is None
+                     else np.asarray(difficult, bool).reshape(-1))
+        for c in range(self.class_num):
+            mask = gt_labels == c
+            if self.evaluate_difficult:
+                self._npos[c] += int(mask.sum())
+            else:
+                self._npos[c] += int((mask & ~difficult).sum())
+        matched = np.zeros(len(gt_boxes), bool)
+        order = np.argsort(-detections[:, 1])
+        for i in order:
+            label, score = int(detections[i, 0]), detections[i, 1]
+            if not 0 <= label < self.class_num:
+                continue
+            cand = np.where(gt_labels == label)[0]
+            tp = 0
+            if len(cand):
+                ious = self._iou(detections[i, 2:6], gt_boxes[cand])
+                j = int(np.argmax(ious))
+                if ious[j] >= self.overlap_threshold:
+                    g = cand[j]
+                    if not self.evaluate_difficult and difficult[g]:
+                        continue  # neither TP nor FP
+                    if not matched[g]:
+                        matched[g] = True
+                        tp = 1
+            self._dets[label].append((score, tp))
+
+    def _ap(self, recalls, precisions):
+        if self.ap_version == "11point":
+            return float(np.mean([
+                precisions[recalls >= t].max() if (recalls >= t).any() else 0.0
+                for t in np.linspace(0, 1, 11)]))
+        # integral: sum precision deltas over recall steps
+        order = np.argsort(recalls)
+        r, p = recalls[order], precisions[order]
+        prev_r, ap = 0.0, 0.0
+        for ri, pi in zip(r, p):
+            ap += (ri - prev_r) * pi
+            prev_r = ri
+        return float(ap)
+
+    def eval(self, *_args):
+        aps = []
+        for c in range(self.class_num):
+            if self._npos[c] == 0:
+                continue  # VOC: classes with no ground truth don't count
+            if not self._dets[c]:
+                aps.append(0.0)
+                continue
+            arr = np.asarray(sorted(self._dets[c], key=lambda t: -t[0]))
+            tps = np.cumsum(arr[:, 1])
+            fps = np.cumsum(1 - arr[:, 1])
+            recalls = tps / max(int(self._npos[c]), 1)
+            precisions = tps / np.maximum(tps + fps, 1e-12)
+            aps.append(self._ap(recalls, precisions))
+        return float(np.mean(aps)) if aps else 0.0

@@ -1,0 +1,110 @@
+"""User-side dataset-file generators (reference
+``python/paddle/fluid/incubate/data_generator/__init__.py``).
+
+Subclass, implement ``generate_sample(line)`` returning a generator that
+yields ``[(slot_name, [values...]), ...]`` per instance, then pipe raw
+records through ``run_from_stdin()`` (the reference's contract: dataset
+preprocessing jobs run these scripts under the ingestion engine) or call
+``run_from_memory()``. The emitted text is the multislot line format the
+dataset engine parses (``fluid/dataset.py`` / ``native/data_feed.cc``):
+per slot ``<num> <v1> ... <vnum>``."""
+
+import sys
+
+__all__ = ["DataGenerator", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator"]
+
+
+class DataGenerator:
+    def __init__(self):
+        self._line_limit = None
+        self.batch_size_ = 32
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = int(batch_size)
+
+    # -- user hooks ---------------------------------------------------------
+    def generate_sample(self, line):
+        """Return a generator yielding one or more instances for ``line``;
+        each instance is ``[(slot_name, [values...]), ...]``."""
+        raise NotImplementedError(
+            "subclasses must implement generate_sample(line)")
+
+    def generate_batch(self, samples):
+        """Optional batch-level hook: yields instances given a list of them
+        (default passthrough, reference ``data_generator:batch``)."""
+        def local_iter():
+            for s in samples:
+                yield s
+        return local_iter
+
+    # -- serialization ------------------------------------------------------
+    def _gen_str(self, instance):
+        raise NotImplementedError
+
+    # -- drivers ------------------------------------------------------------
+    def run_from_stdin(self):
+        """stdin raw lines -> stdout multislot lines."""
+        batch = []
+        for line in sys.stdin:
+            gen = self.generate_sample(line)
+            if gen is None:
+                continue
+            for instance in gen():
+                batch.append(instance)
+                if len(batch) >= self.batch_size_:
+                    for ins in self.generate_batch(batch)():
+                        sys.stdout.write(self._gen_str(ins))
+                    batch = []
+        for ins in self.generate_batch(batch)():
+            sys.stdout.write(self._gen_str(ins))
+
+    def run_from_memory(self, lines=None):
+        """Like run_from_stdin but takes/returns python objects; returns the
+        list of emitted text lines. With no ``lines``, ``generate_sample``
+        is called once with ``None`` (the reference's memory-generation
+        contract) — implement that case if you use this mode."""
+        out = []
+        batch = []
+
+        def flush():
+            for ins in self.generate_batch(batch)():
+                out.append(self._gen_str(ins))
+
+        for line in (lines if lines is not None else [None]):
+            gen = self.generate_sample(line)
+            if gen is None:
+                continue
+            for instance in gen():
+                batch.append(instance)
+                if len(batch) >= self.batch_size_:
+                    flush()
+                    batch = []
+        flush()
+        return out
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """Numeric slots: values are ints (feasigns) or floats."""
+
+    def _gen_str(self, instance):
+        parts = []
+        for name, values in instance:
+            if not values:
+                raise ValueError("slot %r has no values" % (name,))
+            parts.append(str(len(values)))
+            parts.extend(str(v) for v in values)
+        return " ".join(parts) + "\n"
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    """String-token slots: values are pre-stringified tokens."""
+
+    def _gen_str(self, instance):
+        parts = []
+        for name, values in instance:
+            if not values:
+                raise ValueError("slot %r has no values" % (name,))
+            parts.append(str(len(values)))
+            parts.extend(values)
+        return " ".join(parts) + "\n"

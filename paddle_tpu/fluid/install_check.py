@@ -1,0 +1,53 @@
+"""Installation self-test (reference ``python/paddle/fluid/install_check.py``
+``run_check`` — trains a 2-var linear model single-device and, when more
+than one device is visible, again data-parallel)."""
+
+import numpy as np
+
+__all__ = ["run_check"]
+
+
+def _build_and_train(parallel):
+    import jax
+
+    from . import layers, optimizer
+    from .compiler import CompiledProgram
+    from .executor import Executor
+    from .framework import Program, program_guard
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = layers.data("install_check_x", [2])
+        y = layers.data("install_check_y", [1])
+        pred = layers.fc(x, 1)
+        loss = layers.reduce_mean(layers.square(pred - y))
+        optimizer.SGD(learning_rate=0.01).minimize(loss)
+
+    exe = Executor()
+    exe.run(startup)
+    ndev = len(jax.devices())
+    if parallel:
+        prog = CompiledProgram(main).with_data_parallel(loss_name=loss.name)
+        batch = 2 * ndev
+    else:
+        prog = main
+        batch = 2
+    rng = np.random.RandomState(0)
+    feed = {"install_check_x": rng.rand(batch, 2).astype(np.float32),
+            "install_check_y": rng.rand(batch, 1).astype(np.float32)}
+    (out,) = exe.run(prog, feed=feed, fetch_list=[loss])
+    return float(np.asarray(out).reshape(-1)[0])
+
+
+def run_check():
+    """Train one step single-device (and data-parallel when >1 device);
+    print diagnostics and raise on failure."""
+    import jax
+
+    _build_and_train(parallel=False)
+    print("Your paddle_tpu works well on SINGLE device.")
+    if len(jax.devices()) > 1:
+        _build_and_train(parallel=True)
+        print("Your paddle_tpu works well on MULTIPLE devices (%d)."
+              % len(jax.devices()))
+    print("install_check passed.")

@@ -10,6 +10,7 @@ from . import nn, tensor
 __all__ = [
     "lod_reset", "lod_append", "unique_with_counts",
     "merge_selected_rows", "get_tensor_from_selected_rows", "cvm",
+    "continuous_value_model",
     "psroi_pool", "chunk_eval", "adaptive_pool3d", "image_resize_short",
     "scatter_nd", "crop_tensor", "fsp_matrix", "similarity_focus",
     "prroi_pool", "deformable_conv", "deformable_roi_pooling",
@@ -90,6 +91,10 @@ def cvm(input, cvm=None, use_cvm=True):
     helper.append_op(type="cvm", inputs={"X": [input]},
                      outputs={"Y": [out]}, attrs={"use_cvm": use_cvm})
     return out
+
+
+# Reference name (``layers/nn.py`` continuous_value_model): alias of cvm.
+continuous_value_model = cvm
 
 
 def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,

@@ -147,3 +147,17 @@ def test_no_grad():
         with dygraph.no_grad():
             y = x * x
         assert y.stop_gradient or not fluid.framework._dygraph_tracer()._tape
+
+
+def test_conv3d_transpose_module():
+    with dygraph.guard():
+        m = nn.Conv3DTranspose(num_channels=2, num_filters=3, filter_size=2,
+                               stride=2)
+        x = to_variable(np.random.rand(1, 2, 3, 3, 3).astype(np.float32))
+        out = m(x)
+        assert tuple(out.numpy().shape) == (1, 3, 6, 6, 6)
+
+
+def test_continuous_value_model_alias():
+    from paddle_tpu.fluid import layers
+    assert layers.continuous_value_model is layers.cvm

@@ -1,0 +1,158 @@
+"""Misc SURVEY §2.10 modules: evaluator, average, debugger, install_check,
+data_generator, compat, wait_server_ready."""
+
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import average, compat, debugger, evaluator, layers
+
+
+def test_weighted_average():
+    w = average.WeightedAverage()
+    with pytest.raises(ValueError):
+        w.eval()
+    w.add(2.0, 1.0)
+    w.add(4.0, 3.0)
+    assert abs(w.eval() - (2.0 + 12.0) / 4.0) < 1e-9
+    w.reset()
+    w.add(np.array([1.0, 3.0]), 2.0)  # mean 2.0
+    assert abs(w.eval() - 2.0) < 1e-9
+
+
+def test_edit_distance_evaluator():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        hyp = layers.data("ed_hyp", [4], dtype="int64",
+                          append_batch_size=False)
+        ref = layers.data("ed_ref", [4], dtype="int64",
+                          append_batch_size=False)
+        hlen = layers.data("ed_hlen", [1], dtype="int64",
+                           append_batch_size=False)
+        rlen = layers.data("ed_rlen", [1], dtype="int64",
+                           append_batch_size=False)
+        dist, _ = layers.edit_distance(hyp, ref, normalized=False,
+                                       input_length=hlen, label_length=rlen)
+    exe = fluid.Executor()
+    exe.run(startup)
+    (d,) = exe.run(main, feed={
+        "ed_hyp": np.array([[1, 2, 3, 0]], np.int64),
+        "ed_ref": np.array([[1, 3, 3, 0]], np.int64),
+        "ed_hlen": np.array([3], np.int64),
+        "ed_rlen": np.array([3], np.int64)}, fetch_list=[dist])
+    assert float(np.asarray(d).reshape(-1)[0]) == 1.0
+
+
+def test_chunk_evaluator_accumulates_and_resets():
+    # IOB scheme, 1 chunk type: ids 0=B,1=I,2=O.
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        inf = layers.data("ce_inf", [6], dtype="int64",
+                          append_batch_size=False)
+        lab = layers.data("ce_lab", [6], dtype="int64",
+                          append_batch_size=False)
+        ev = evaluator.ChunkEvaluator(inf, lab, chunk_scheme="IOB",
+                                      num_chunk_types=1)
+    exe = fluid.Executor()
+    exe.run(startup)
+    seq = np.array([[0, 1, 2, 0, 1, 2]], np.int64)
+    exe.run(main, feed={"ce_inf": seq, "ce_lab": seq}, fetch_list=[])
+    p, r, f1 = ev.eval(exe)
+    assert p == 1.0 and r == 1.0 and f1 == 1.0
+    ev.reset(exe)
+    p, r, f1 = ev.eval(exe)
+    assert p == 0.0 and r == 0.0 and f1 == 0.0
+
+
+def test_detection_map_evaluator():
+    m = evaluator.DetectionMAP(class_num=2, overlap_threshold=0.5)
+    gt_boxes = np.array([[0, 0, 10, 10], [20, 20, 30, 30]], np.float64)
+    gt_labels = np.array([0, 1], np.int64)
+    dets = np.array([
+        [0, 0.9, 0, 0, 10, 10],      # TP class 0
+        [1, 0.8, 20, 20, 30, 30],    # TP class 1
+        [1, 0.7, 50, 50, 60, 60],    # FP class 1
+    ], np.float64)
+    m.update(dets, gt_boxes, gt_labels)
+    val = m.eval()
+    assert 0.5 < val <= 1.0
+    m.reset()
+    assert m.eval() == 0.0
+
+
+def test_debugger_dump_and_dot(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("dbg_x", [3])
+        y = layers.fc(x, 2)
+    text = debugger.pprint_program_codes(main)
+    assert "matmul" in text or "mul" in text
+    dot = debugger.draw_block_graphviz(main.global_block(),
+                                       highlights=["dbg_x"],
+                                       path=str(tmp_path / "g.dot"))
+    assert dot.startswith("digraph G {") and "dbg_x" in dot
+    assert (tmp_path / "g.dot").exists()
+
+
+def test_install_check_runs():
+    fluid.install_check.run_check()
+
+
+def test_data_generator_multislot():
+    from paddle_tpu.fluid.incubate.data_generator import (
+        MultiSlotDataGenerator, MultiSlotStringDataGenerator)
+
+    class G(MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def gen():
+                a, b = line.split("|")
+                yield [("ids", [int(t) for t in a.split()]),
+                       ("label", [float(b)])]
+            return gen
+
+    g = G()
+    lines = g.run_from_memory(["1 2 3|0", "4|1"])
+    assert lines == ["3 1 2 3 1 0.0\n", "1 4 1 1.0\n"]
+
+    class S(MultiSlotStringDataGenerator):
+        def generate_sample(self, line):
+            def gen():
+                yield [("tok", line.split())]
+            return gen
+
+    assert S().run_from_memory(["a b"]) == ["2 a b\n"]
+
+
+def test_compat_check():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("cm_x", [3])
+        layers.fc(x, 2)
+    assert compat.check_program_compatible(main)
+    # desc with an unknown op fails
+    desc = {"version": 1,
+            "blocks": [{"ops": [{"type": "totally_unknown_op_xyz"}]}]}
+    info = compat.check_program_compatible(desc)
+    assert not info and info.status == compat.CompatibleInfo.UNDEFINED_OP
+    info = compat.check_program_compatible({"version": 999, "blocks": []})
+    assert info.status == compat.CompatibleInfo.UNSUPPORTED_VERSION
+
+
+def test_wait_server_ready():
+    import socket
+
+    from paddle_tpu.distributed import wait_server_ready
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    t = threading.Thread(target=srv.accept, daemon=True)
+    t.start()
+    wait_server_ready(["127.0.0.1:%d" % port], timeout=10)
+    t.join(timeout=5)  # accept completes once the poller connected
+    srv.close()
+    with pytest.raises(TimeoutError):
+        wait_server_ready(["127.0.0.1:1"], timeout=0.5, interval=0.1)
