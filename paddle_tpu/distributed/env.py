@@ -86,9 +86,14 @@ def wait_server_ready(endpoints, timeout=120.0, interval=0.5):
     while pending:
         still = []
         for ep in pending:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError("servers not ready: %s"
+                                   % ",".join(still + pending[len(still):]))
             host, port = ep.rsplit(":", 1)
             try:
-                with socket.create_connection((host, int(port)), timeout=2.0):
+                with socket.create_connection(
+                        (host, int(port)), timeout=min(2.0, remaining)):
                     pass
             except OSError:
                 still.append(ep)
@@ -96,4 +101,4 @@ def wait_server_ready(endpoints, timeout=120.0, interval=0.5):
         if pending:
             if time.monotonic() > deadline:
                 raise TimeoutError("servers not ready: %s" % ",".join(pending))
-            time.sleep(interval)
+            time.sleep(min(interval, max(deadline - time.monotonic(), 0)))

@@ -60,21 +60,21 @@ def check_program_compatible(program, version=None):
 
     def _unknown(t):
         # *_grad op types are consumed by the autodiff replay, not by a
-        # per-op lowering rule — exempt in both scan paths.
+        # per-op lowering rule — exempt in both scan paths. A missing or
+        # malformed type is "unknown" (never raise: see contract above).
+        t = t if isinstance(t, str) else "<missing type>"
         return (t not in known and t not in _STRUCTURAL_OPS
                 and not t.endswith("_grad"))
 
     missing = set()
     if isinstance(program, dict):
-        for blk in program.get("blocks", []):
-            for op in blk.get("ops", []):
-                if _unknown(op.get("type")):
-                    missing.add(op.get("type"))
+        types = (op.get("type") for blk in program.get("blocks", [])
+                 for op in blk.get("ops", []))
     else:
-        for blk in program.blocks:
-            for op in blk.ops:
-                if _unknown(op.type):
-                    missing.add(op.type)
+        types = (op.type for blk in program.blocks for op in blk.ops)
+    for t in types:
+        if _unknown(t):
+            missing.add(t if isinstance(t, str) else "<missing type>")
     if missing:
         return CompatibleInfo(CompatibleInfo.UNDEFINED_OP,
                               "no lowering for: %s" % ", ".join(sorted(missing)))

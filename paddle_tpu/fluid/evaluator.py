@@ -33,10 +33,14 @@ class Evaluator:
     """Base: owns persistable state vars; subclasses append update ops."""
 
     def __init__(self, name=None, **kwargs):
+        from . import unique_name
+
         self.states = []
         self.metrics = []
         self.helper = None
-        self._name = name or self.__class__.__name__
+        # unique per instance — two evaluators in one program must not
+        # share accumulator vars
+        self._name = name or unique_name.generate(self.__class__.__name__)
 
     def _create_state(self, suffix, dtype, shape):
         var = layers.create_global_var(

@@ -26,7 +26,7 @@ __all__ = [
     "AdadeltaOptimizer", "RMSPropOptimizer", "FtrlOptimizer", "LambOptimizer",
     "LarsMomentumOptimizer", "DGCMomentumOptimizer",
     "ModelAverage", "ExponentialMovingAverage", "LookaheadOptimizer",
-    "RecomputeOptimizer", "PipelineOptimizer",
+    "RecomputeOptimizer", "PipelineOptimizer", "GradientMergeOptimizer",
 ]
 
 
@@ -851,6 +851,121 @@ class PipelineOptimizer:
             for cut in self._cut_list
         ]
         return result
+
+
+class GradientMergeOptimizer:
+    """Gradient accumulation / batch merge (capability of the reference's
+    ``ir/multi_batch_merge_pass.cc``: replicate forward/backward, merge
+    grads, apply once per k micro-batches).
+
+    TPU-first redesign: instead of cloning the graph k times, grads
+    accumulate into persistable buffers every step and the inner
+    optimizer's *entire* update subgraph is gated arithmetically —
+    its writes to persistable state (params, moments, LR counters) are
+    SSA-renamed to shadows and committed via
+    ``state' = state + sync * (shadow - state)`` where
+    ``sync = (step % k == 0)``. One static XLA graph, no divergent
+    control flow, momentum/Adam state advances exactly once per merge —
+    bit-matching a plain optimizer fed the k-step mean gradient.
+    """
+
+    def __init__(self, inner_optimizer, k_steps=1, avg=True):
+        self.inner_optimizer = inner_optimizer
+        self.k_steps = int(k_steps)
+        self.avg = avg
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return self.inner_optimizer.backward(
+            loss, startup_program, parameter_list, no_grad_set, callbacks)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        if self.k_steps <= 1:
+            return self.inner_optimizer.minimize(
+                loss, startup_program, parameter_list, no_grad_set)
+        from .framework import program_guard
+        from .layers import nn, tensor
+
+        main = loss.block.program
+        startup = startup_program or default_startup_program()
+        params_grads = self.backward(loss, startup_program, parameter_list,
+                                     no_grad_set)
+        block = main.global_block()
+        with program_guard(main, startup):
+            # per-instance counter: two merge-wrapped optimizers in one
+            # program (e.g. GAN G/D) must not share or double-increment it
+            step = nn.autoincreased_step_counter(
+                counter_name=unique_name.generate("@GRADMERGE_STEP@"),
+                begin=1)
+            k = tensor.fill_constant([1], "int64", self.k_steps)
+            mod = nn.elementwise_sub(
+                step, nn.elementwise_mul(nn.elementwise_floordiv(step, k), k))
+            # sync == 1.0 on steps k, 2k, ... (int64 [1] -> float32 [1])
+            sync = tensor.cast(
+                nn.elementwise_sub(tensor.ones([1], "int64"),
+                                   tensor.cast(mod > 0, "int64")), "float32")
+
+            # accumulate: acc_new = acc + g; merged grad = acc_new / k
+            acc_pairs = []  # (acc var, acc_new var)
+            merged = []
+            for p, g in params_grads:
+                acc = tensor.create_global_var(
+                    shape=list(p.shape), value=0.0, dtype=p.dtype,
+                    persistable=True, name=p.name + "@GRAD@MERGE")
+                acc_new = nn.elementwise_add(acc, g)
+                gm = (nn.scale(acc_new, scale=1.0 / self.k_steps)
+                      if self.avg else acc_new)
+                acc_pairs.append((acc, acc_new))
+                merged.append((p, gm))
+
+            # inner optimizer appends its update ops; record the range
+            start_idx = len(block.ops)
+            optimize_ops = self.inner_optimizer.apply_gradients(merged)
+            end_idx = len(block.ops)
+            shadows = self._shadow_persistable_writes(block, start_idx,
+                                                      end_idx)
+            # commit gated state: state' = state + sync * (shadow - state)
+            for orig_name, shadow_name in shadows.items():
+                orig = block.var(orig_name)
+                shadow = block.var(shadow_name)
+                gate = tensor.cast(sync, orig.dtype)
+                delta = nn.elementwise_mul(
+                    nn.elementwise_sub(shadow, orig), gate, axis=-1)
+                tensor.assign(nn.elementwise_add(orig, delta), output=orig)
+            # reset accumulators on sync: acc = acc_new * (1 - sync)
+            keep = nn.elementwise_sub(tensor.ones([1], "float32"), sync)
+            for acc, acc_new in acc_pairs:
+                gate = tensor.cast(keep, acc.dtype)
+                tensor.assign(nn.elementwise_mul(acc_new, gate, axis=-1),
+                              output=acc)
+        return optimize_ops, params_grads
+
+    @staticmethod
+    def _shadow_persistable_writes(block, start_idx, end_idx):
+        """SSA-rename persistable outputs of ops[start:end] to fresh
+        non-persistable shadow vars; later reads inside the range follow
+        the rename. Returns {original_name: final_shadow_name}."""
+        latest = {}
+        n_shadow = 0
+        for op in block.ops[start_idx:end_idx]:
+            for slot, names in op.inputs.items():
+                op.inputs[slot] = [latest.get(n, n) for n in names]
+            for slot, names in op.outputs.items():
+                new_names = []
+                for n in names:
+                    v = block._find_var_recursive(n)
+                    if v is not None and getattr(v, "persistable", False):
+                        shadow = "%s@GM_SHADOW_%d" % (n, n_shadow)
+                        n_shadow += 1
+                        block.create_var(name=shadow, shape=v.shape,
+                                         dtype=v.dtype, stop_gradient=True)
+                        latest[n] = shadow
+                        new_names.append(shadow)
+                    else:
+                        new_names.append(n)
+                op.outputs[slot] = new_names
+        return latest
 
 
 SGD = SGDOptimizer

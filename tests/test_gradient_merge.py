@@ -1,0 +1,99 @@
+"""GradientMergeOptimizer: k-step gradient accumulation matches a plain
+optimizer fed the combined batch (capability of the reference's
+``ir/multi_batch_merge_pass.cc``)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import initializer, layers, optimizer
+
+
+def _build(opt_factory, merge_k=None):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("gm_x", [4])
+        y = layers.data("gm_y", [1])
+        pred = layers.fc(x, 1, param_attr=fluid.ParamAttr(
+            name="gm_w", initializer=initializer.Constant(0.25)),
+            bias_attr=fluid.ParamAttr(
+                name="gm_b", initializer=initializer.Constant(0.0)))
+        loss = layers.reduce_mean(layers.square(pred - y))
+        opt = opt_factory()
+        if merge_k:
+            opt = optimizer.GradientMergeOptimizer(opt, k_steps=merge_k,
+                                                   avg=True)
+        opt.minimize(loss)
+    return main, startup, loss
+
+
+def _param(scope, name):
+    return np.asarray(scope.find_var(name))
+
+
+def _run_merge_vs_dense(opt_factory, n_merge_rounds, k=2, seed=0):
+    rng = np.random.RandomState(seed)
+    micro = [
+        (rng.rand(8, 4).astype(np.float32), rng.rand(8, 1).astype(np.float32))
+        for _ in range(n_merge_rounds * k)]
+
+    # merged: k micro-batches per applied update
+    scope_m = fluid.Scope()
+    with fluid.scope_guard(scope_m):
+        main, startup, loss = _build(opt_factory, merge_k=k)
+        exe = fluid.Executor()
+        exe.run(startup)
+        for xb, yb in micro:
+            exe.run(main, feed={"gm_x": xb, "gm_y": yb}, fetch_list=[])
+        w_m, b_m = _param(scope_m, "gm_w"), _param(scope_m, "gm_b")
+
+    # dense: one step per combined batch
+    scope_d = fluid.Scope()
+    with fluid.scope_guard(scope_d):
+        main, startup, loss = _build(opt_factory)
+        exe = fluid.Executor()
+        exe.run(startup)
+        for i in range(n_merge_rounds):
+            xs = np.concatenate([micro[i * k + j][0] for j in range(k)])
+            ys = np.concatenate([micro[i * k + j][1] for j in range(k)])
+            exe.run(main, feed={"gm_x": xs, "gm_y": ys}, fetch_list=[])
+        w_d, b_d = _param(scope_d, "gm_w"), _param(scope_d, "gm_b")
+
+    np.testing.assert_allclose(w_m, w_d, rtol=2e-5, atol=1e-6)
+    np.testing.assert_allclose(b_m, b_d, rtol=2e-5, atol=1e-6)
+
+
+def test_sgd_merge_matches_big_batch():
+    _run_merge_vs_dense(lambda: optimizer.SGD(learning_rate=0.1),
+                        n_merge_rounds=3)
+
+
+def test_adam_merge_matches_big_batch():
+    # state (moments, beta powers) must advance once per merge, not per
+    # micro step — this fails if gating leaks into optimizer state.
+    _run_merge_vs_dense(lambda: optimizer.Adam(learning_rate=0.05),
+                        n_merge_rounds=3)
+
+
+def test_momentum_merge_matches_big_batch():
+    _run_merge_vs_dense(
+        lambda: optimizer.Momentum(learning_rate=0.1, momentum=0.9),
+        n_merge_rounds=3)
+
+
+def test_params_frozen_between_syncs():
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        main, startup, loss = _build(
+            lambda: optimizer.SGD(learning_rate=0.1), merge_k=3)
+        exe = fluid.Executor()
+        exe.run(startup)
+        w0 = _param(scope, "gm_w").copy()
+        rng = np.random.RandomState(1)
+        feed = {"gm_x": rng.rand(8, 4).astype(np.float32),
+                "gm_y": rng.rand(8, 1).astype(np.float32)}
+        exe.run(main, feed=feed, fetch_list=[])
+        np.testing.assert_allclose(_param(scope, "gm_w"), w0)  # step 1: hold
+        exe.run(main, feed=feed, fetch_list=[])
+        np.testing.assert_allclose(_param(scope, "gm_w"), w0)  # step 2: hold
+        exe.run(main, feed=feed, fetch_list=[])
+        assert not np.allclose(_param(scope, "gm_w"), w0)      # step 3: apply
