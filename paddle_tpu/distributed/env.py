@@ -85,11 +85,11 @@ def wait_server_ready(endpoints, timeout=120.0, interval=0.5):
     deadline = time.monotonic() + timeout
     while pending:
         still = []
-        for ep in pending:
+        for i, ep in enumerate(pending):
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 raise TimeoutError("servers not ready: %s"
-                                   % ",".join(still + pending[len(still):]))
+                                   % ",".join(still + pending[i:]))
             host, port = ep.rsplit(":", 1)
             try:
                 with socket.create_connection(

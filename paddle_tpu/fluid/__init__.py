@@ -26,6 +26,7 @@ from . import (  # noqa: F401
     nets,
     optimizer,
     param_attr,
+    passes,
     profiler,
     regularizer,
     unique_name,

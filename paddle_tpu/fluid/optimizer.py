@@ -898,21 +898,24 @@ class GradientMergeOptimizer:
             step = nn.autoincreased_step_counter(
                 counter_name=unique_name.generate("@GRADMERGE_STEP@"),
                 begin=1)
+            from .layers.control_flow import equal
+
             k = tensor.fill_constant([1], "int64", self.k_steps)
-            mod = nn.elementwise_sub(
-                step, nn.elementwise_mul(nn.elementwise_floordiv(step, k), k))
-            # sync == 1.0 on steps k, 2k, ... (int64 [1] -> float32 [1])
+            # sync == 1.0 on steps k, 2k, ...
             sync = tensor.cast(
-                nn.elementwise_sub(tensor.ones([1], "int64"),
-                                   tensor.cast(mod > 0, "int64")), "float32")
+                equal(nn.elementwise_mod(step, k),
+                      tensor.zeros([1], "int64")), "float32")
 
             # accumulate: acc_new = acc + g; merged grad = acc_new / k
             acc_pairs = []  # (acc var, acc_new var)
             merged = []
             for p, g in params_grads:
+                # unique per instance: a param shared by two merge-wrapped
+                # optimizers must not alias one accumulator
                 acc = tensor.create_global_var(
                     shape=list(p.shape), value=0.0, dtype=p.dtype,
-                    persistable=True, name=p.name + "@GRAD@MERGE")
+                    persistable=True,
+                    name=unique_name.generate(p.name + "@GRAD@MERGE"))
                 acc_new = nn.elementwise_add(acc, g)
                 gm = (nn.scale(acc_new, scale=1.0 / self.k_steps)
                       if self.avg else acc_new)

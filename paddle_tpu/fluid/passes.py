@@ -1,0 +1,229 @@
+"""Program-rewrite pass infrastructure (reference ``framework/ir/pass.{h,cc}``
++ ``pass_builder`` + 45 ``REGISTER_PASS`` sites, and the Python ``IrGraph``
+at ``framework.py:3125``).
+
+TPU-first stance: XLA owns fusion/layout/memory passes, so the pass layer
+here only hosts *Paddle-semantic* rewrites — AMP casts, quantization,
+collective insertion, pruning, visualization. Each pass is a named callable
+``pass_fn(program, **kwargs) -> program`` (in-place rewrites return the same
+object) registered in a global ``PassRegistry``; ``PassBuilder`` composes an
+ordered pipeline the way the reference's ``BuildStrategy`` assembles its
+pass list (``details/build_strategy.cc:59``)."""
+
+__all__ = ["Pass", "PassRegistry", "PassBuilder", "register_pass",
+           "apply_pass", "get_pass", "IrGraph"]
+
+
+class Pass:
+    """A named Program rewrite. ``fn(program, **kwargs) -> program``."""
+
+    def __init__(self, name, fn, doc=""):
+        self.name = name
+        self.fn = fn
+        self.__doc__ = doc or fn.__doc__
+
+    def apply(self, program, **kwargs):
+        out = self.fn(program, **kwargs)
+        return program if out is None else out
+
+    def __repr__(self):
+        return "Pass(%r)" % self.name
+
+
+class PassRegistry:
+    def __init__(self):
+        self._passes = {}
+
+    def register(self, name, fn=None, doc=""):
+        if fn is None:  # decorator form
+            def deco(f):
+                self._passes[name] = Pass(name, f, doc)
+                return f
+            return deco
+        self._passes[name] = Pass(name, fn, doc)
+        return fn
+
+    def get(self, name):
+        if name not in self._passes:
+            raise KeyError("no pass named %r (registered: %s)"
+                           % (name, ", ".join(sorted(self._passes))))
+        return self._passes[name]
+
+    def has(self, name):
+        return name in self._passes
+
+    def names(self):
+        return sorted(self._passes)
+
+
+_registry = PassRegistry()
+register_pass = _registry.register
+get_pass = _registry.get
+
+
+def apply_pass(program, name, **kwargs):
+    """Look up and run one registered pass."""
+    return _registry.get(name).apply(program, **kwargs)
+
+
+class PassBuilder:
+    """Ordered pass pipeline (reference ``pass_builder.{h,cc}``)."""
+
+    def __init__(self, names=None):
+        self._pipeline = [_registry.get(n) for n in (names or [])]
+
+    def append_pass(self, name):
+        p = _registry.get(name)
+        self._pipeline.append(p)
+        return p
+
+    def insert_pass(self, idx, name):
+        p = _registry.get(name)
+        self._pipeline.insert(idx, p)
+        return p
+
+    def remove_pass(self, idx):
+        self._pipeline.pop(idx)
+
+    def all_passes(self):
+        return list(self._pipeline)
+
+    def apply(self, program, pass_kwargs=None):
+        pass_kwargs = pass_kwargs or {}
+        for p in self._pipeline:
+            program = p.apply(program, **pass_kwargs.get(p.name, {}))
+        return program
+
+
+# ---------------------------------------------------------------------------
+# Built-in passes over the existing rewrites
+
+
+@register_pass("amp_rewrite")
+def _amp_rewrite_pass(program, amp_lists=None, dest_dtype="bfloat16"):
+    """fp16/bf16 cast insertion (contrib.mixed_precision.fp16_utils)."""
+    from .contrib.mixed_precision.fp16_lists import AutoMixedPrecisionLists
+    from .contrib.mixed_precision.fp16_utils import rewrite_program
+
+    rewrite_program(program, amp_lists or AutoMixedPrecisionLists(),
+                    dest_dtype=dest_dtype)
+    return program
+
+
+@register_pass("prune")
+def _prune_pass(program, targets=None):
+    """Dead-op elimination toward fetch targets (Program._prune; reference
+    ``framework/prune.h``)."""
+    if targets is None:
+        raise ValueError("prune pass needs targets=[vars or names]")
+    return program._prune(targets)
+
+
+@register_pass("quant_transform")
+def _quant_transform_pass(program, **kwargs):
+    """QAT fake-quant insertion (slim QuantizationTransformPass)."""
+    from .contrib.slim.quantization.quantization_pass import (
+        QuantizationTransformPass)
+
+    QuantizationTransformPass(**kwargs).apply(program)
+    return program
+
+
+@register_pass("quant_freeze")
+def _quant_freeze_pass(program, **kwargs):
+    """Fold trained quant scales for inference (QuantizationFreezePass)."""
+    from .contrib.slim.quantization.quantization_pass import (
+        QuantizationFreezePass)
+
+    QuantizationFreezePass(**kwargs).apply(program)
+    return program
+
+
+@register_pass("quant_int8_convert")
+def _quant_int8_pass(program, weight_names=None, **kwargs):
+    """Cast frozen weights to int8 storage (ConvertToInt8Pass)."""
+    from .contrib.slim.quantization.quantization_pass import ConvertToInt8Pass
+
+    ConvertToInt8Pass(**kwargs).apply(program, weight_names=weight_names)
+    return program
+
+
+@register_pass("collective_grad_allreduce")
+def _collective_pass(program, startup_program=None, nranks=None):
+    """Insert c_allreduce on every grad (transpiler.collective.GradAllReduce:
+    the Fleet-collective DP rewrite)."""
+    from .framework import default_startup_program
+    from .transpiler.collective import GradAllReduce
+
+    t = GradAllReduce(nranks)
+    t.transpile(startup_program=startup_program or default_startup_program(),
+                main_program=program)
+    return program
+
+
+@register_pass("local_sgd")
+def _local_sgd_pass(program, startup_program=None, nranks=None, k_steps=1):
+    """Periodic parameter averaging (transpiler.collective.LocalSGD)."""
+    from .framework import default_startup_program
+    from .transpiler.collective import LocalSGD
+
+    t = LocalSGD(nranks, k_steps=k_steps)
+    t.transpile(startup_program=startup_program or default_startup_program(),
+                main_program=program)
+    return program
+
+
+@register_pass("graph_viz")
+def _graph_viz_pass(program, path=None, block_idx=0, highlights=None):
+    """Dot export (reference ``ir/graph_viz_pass.cc``)."""
+    from .debugger import draw_block_graphviz
+
+    draw_block_graphviz(program.blocks[block_idx], highlights=highlights,
+                        path=path)
+    return program
+
+
+class IrGraph:
+    """Thin graph view over a Program block (reference ``IrGraph``
+    ``framework.py:3125`` wraps the C++ ``ir::Graph``). Nodes are ops and
+    var names; used by slim tooling and tests to inspect structure."""
+
+    def __init__(self, program, block_idx=0, for_test=False):
+        self._program = program
+        self._block = program.blocks[block_idx]
+        self._for_test = for_test
+
+    @property
+    def program(self):
+        return self._program
+
+    def all_op_nodes(self):
+        return list(self._block.ops)
+
+    def all_var_names(self):
+        return sorted(self._block.vars)
+
+    def op_types(self):
+        return [op.type for op in self._block.ops]
+
+    def inputs_of(self, op):
+        return [n for vs in op.inputs.values() for n in vs]
+
+    def outputs_of(self, op):
+        return [n for vs in op.outputs.values() for n in vs]
+
+    def consumers_of(self, var_name):
+        return [op for op in self._block.ops
+                if var_name in self.inputs_of(op)]
+
+    def producer_of(self, var_name):
+        for op in self._block.ops:
+            if var_name in self.outputs_of(op):
+                return op
+        return None
+
+    def draw(self, path=None, highlights=None):
+        from .debugger import draw_block_graphviz
+
+        return draw_block_graphviz(self._block, highlights=highlights,
+                                   path=path)

@@ -1,0 +1,105 @@
+"""Pass registry / PassBuilder / IrGraph (reference ir/pass infrastructure,
+SURVEY §2.3)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers, optimizer, passes
+
+
+def _mlp_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("pp_x", [4])
+        y = layers.data("pp_y", [1])
+        h = layers.fc(x, 8, act="relu")
+        pred = layers.fc(h, 1)
+        loss = layers.reduce_mean(layers.square(pred - y))
+    return main, startup, loss
+
+
+def test_registry_lookup_and_errors():
+    assert passes.get_pass("prune").name == "prune"
+    assert "amp_rewrite" in passes._registry.names()
+    with pytest.raises(KeyError):
+        passes.get_pass("not_a_pass")
+
+
+def test_register_custom_pass_decorator():
+    @passes.register_pass("test_count_ops")
+    def count_ops(program):
+        program._test_op_count = len(program.global_block().ops)
+        return program
+
+    main, _, _ = _mlp_program()
+    out = passes.apply_pass(main, "test_count_ops")
+    assert out._test_op_count == len(main.global_block().ops)
+
+
+def test_prune_pass_drops_loss_ops():
+    main, startup, loss = _mlp_program()
+    with fluid.program_guard(main, startup):
+        optimizer.SGD(learning_rate=0.1).minimize(loss)
+    n_full = len(main.global_block().ops)
+    fc_out = None
+    for op in main.global_block().ops:
+        if op.type == "relu":
+            fc_out = op.outputs["Out"][0]
+    pruned = passes.apply_pass(main, "prune",
+                               targets=[main.global_block().var(fc_out)])
+    assert len(pruned.global_block().ops) < n_full
+    types = [op.type for op in pruned.global_block().ops]
+    assert "relu" in types and "autodiff" not in types
+
+
+def test_amp_rewrite_pass_inserts_casts():
+    main, startup, loss = _mlp_program()
+    before = [op.type for op in main.global_block().ops]
+    passes.apply_pass(main, "amp_rewrite")
+    after = [op.type for op in main.global_block().ops]
+    assert after.count("cast") > before.count("cast")
+
+
+def test_collective_pass_inserts_allreduce():
+    main, startup, loss = _mlp_program()
+    with fluid.program_guard(main, startup):
+        optimizer.SGD(learning_rate=0.1).minimize(loss)
+    passes.apply_pass(main, "collective_grad_allreduce",
+                      startup_program=startup, nranks=2)
+    types = [op.type for op in main.global_block().ops]
+    assert "c_allreduce_sum" in types
+
+
+def test_pass_builder_pipeline_order():
+    calls = []
+
+    @passes.register_pass("test_first")
+    def first(program):
+        calls.append("first")
+
+    @passes.register_pass("test_second")
+    def second(program):
+        calls.append("second")
+
+    b = passes.PassBuilder(["test_first"])
+    b.append_pass("test_second")
+    assert [p.name for p in b.all_passes()] == ["test_first", "test_second"]
+    main, _, _ = _mlp_program()
+    b.apply(main)
+    assert calls == ["first", "second"]
+    b.remove_pass(0)
+    assert [p.name for p in b.all_passes()] == ["test_second"]
+
+
+def test_ir_graph_structure():
+    main, _, loss = _mlp_program()
+    g = passes.IrGraph(main)
+    assert "relu" in g.op_types()
+    relu = next(op for op in g.all_op_nodes() if op.type == "relu")
+    (relu_out,) = g.outputs_of(relu)
+    consumers = g.consumers_of(relu_out)
+    assert consumers and all(relu_out in g.inputs_of(c) for c in consumers)
+    assert g.producer_of(relu_out) is relu
+    dot = g.draw()
+    assert dot.startswith("digraph")
