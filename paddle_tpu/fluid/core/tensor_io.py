@@ -1,0 +1,156 @@
+"""Combined tensor-file serde ("PTC1" format) — Python surface of
+``native/tensor_io.cc`` (the reference's save_combine/load_combine ops,
+``operators/save_combine_op.cc``). The native library does the file IO
+when a toolchain exists; the struct-based fallback writes byte-identical
+files, so the two interchange."""
+
+import struct
+
+import numpy as np
+
+__all__ = ["save_combine", "load_combine"]
+
+_CODE_OF = {"float32": 0, "float64": 1, "int32": 2, "int64": 3, "uint8": 4,
+            "bfloat16": 5, "float16": 6, "bool": 7, "int8": 8, "int16": 9,
+            "uint16": 10, "uint32": 11, "uint64": 12}
+_NP_OF = {}
+
+
+def _np_dtype(code):
+    global _NP_OF
+    if not _NP_OF:
+        _NP_OF = {0: np.float32, 1: np.float64, 2: np.int32, 3: np.int64,
+                  4: np.uint8, 6: np.float16, 7: np.bool_, 8: np.int8,
+                  9: np.int16, 10: np.uint16, 11: np.uint32, 12: np.uint64}
+        try:
+            import ml_dtypes
+
+            _NP_OF[5] = ml_dtypes.bfloat16
+        except ImportError:
+            pass
+    if code not in _NP_OF:
+        raise ValueError("unsupported dtype code %d" % code)
+    return np.dtype(_NP_OF[code])
+
+
+def _code(arr):
+    name = arr.dtype.name
+    if name not in _CODE_OF:
+        raise ValueError("unsupported dtype %s" % name)
+    return _CODE_OF[name]
+
+
+_lib = None
+_lib_tried = False
+
+
+def _native():
+    global _lib, _lib_tried
+    if not _lib_tried:
+        from ... import native
+
+        _lib = native.load_tensor_io()
+        _lib_tried = True
+    return _lib
+
+
+def save_combine(path, arrays):
+    """Write named arrays (dict or (name, array) iterable) to one file."""
+    items = list(arrays.items()) if isinstance(arrays, dict) else list(arrays)
+    items = [(n, np.ascontiguousarray(a)) for n, a in items]
+    lib = _native()
+    if lib is not None:
+        _save_native(lib, path, items)
+    else:
+        _save_py(path, items)
+
+
+def _save_native(lib, path, items):
+    import ctypes
+
+    h = lib.tio_open_write(path.encode())
+    if not h:
+        raise IOError("cannot open %s for writing" % path)
+    try:
+        for name, a in items:
+            dims = (ctypes.c_int64 * max(a.ndim, 1))(*(a.shape or (0,)))
+            rc = lib.tio_write_tensor(
+                h, name.encode(), _code(a), a.ndim, dims,
+                a.ctypes.data_as(ctypes.c_void_p), a.nbytes)
+            if rc != 0:
+                raise IOError("tio_write_tensor(%s) rc=%d" % (name, rc))
+    finally:
+        if lib.tio_close_write(h) != 0:
+            raise IOError("tio_close_write failed for %s" % path)
+
+
+def _save_py(path, items):
+    with open(path, "wb") as f:
+        f.write(b"PTC1")
+        f.write(struct.pack("<I", len(items)))
+        for name, a in items:
+            nb = name.encode()
+            f.write(struct.pack("<I", len(nb)))
+            f.write(nb)
+            f.write(struct.pack("<II", _code(a), a.ndim))
+            for d in a.shape:
+                f.write(struct.pack("<Q", d))
+            f.write(struct.pack("<Q", a.nbytes))
+            f.write(a.tobytes())
+
+
+def load_combine(path):
+    """Read a PTC1 file -> dict name -> np.ndarray (insertion-ordered)."""
+    lib = _native()
+    return (_load_native(lib, path) if lib is not None else _load_py(path))
+
+
+def _load_native(lib, path):
+    import ctypes
+
+    h = lib.tio_open_read(path.encode())
+    if not h:
+        raise IOError("cannot read %s (missing or corrupt)" % path)
+    try:
+        out = {}
+        name_buf = ctypes.create_string_buffer(4096)
+        dims = (ctypes.c_int64 * 16)()
+        dtype_c = ctypes.c_int()
+        nbytes_c = ctypes.c_int64()
+        for i in range(lib.tio_count(h)):
+            ndim = lib.tio_entry_meta(h, i, name_buf, 4096,
+                                      ctypes.byref(dtype_c), dims,
+                                      ctypes.byref(nbytes_c))
+            if ndim < 0:
+                raise IOError("corrupt entry %d in %s" % (i, path))
+            shape = tuple(dims[d] for d in range(ndim))
+            a = np.empty(shape, dtype=_np_dtype(dtype_c.value))
+            if a.nbytes != nbytes_c.value:
+                raise IOError("size mismatch for entry %d in %s" % (i, path))
+            rc = lib.tio_read_data(h, i, a.ctypes.data_as(ctypes.c_void_p),
+                                   a.nbytes)
+            if rc != 0:
+                raise IOError("tio_read_data rc=%d for %s" % (rc, path))
+            out[name_buf.value.decode()] = a
+        return out
+    finally:
+        lib.tio_close_read(h)
+
+
+def _load_py(path):
+    with open(path, "rb") as f:
+        if f.read(4) != b"PTC1":
+            raise IOError("%s is not a PTC1 file" % path)
+        (count,) = struct.unpack("<I", f.read(4))
+        out = {}
+        for _ in range(count):
+            (name_len,) = struct.unpack("<I", f.read(4))
+            name = f.read(name_len).decode()
+            code, ndim = struct.unpack("<II", f.read(8))
+            shape = tuple(struct.unpack("<Q", f.read(8))[0]
+                          for _ in range(ndim))
+            (nbytes,) = struct.unpack("<Q", f.read(8))
+            dt = _np_dtype(code)
+            a = np.frombuffer(f.read(nbytes), dtype=dt).reshape(shape).copy()
+            out[name] = a
+        return out

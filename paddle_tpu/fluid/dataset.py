@@ -350,6 +350,80 @@ class QueueDataset(DatasetBase):
             "QueueDataset streams; use InMemoryDataset for global_shuffle")
 
     def batch_reader(self, drop_last=False):
+        """Producer thread parses files into batches; batches stream
+        through the native bounded channel (``native/channel.cc``, the
+        reference's ``framework/channel.h`` conduit) when the toolchain
+        is present, else a Python queue."""
+        from .. import native
+
+        if native.load_channel() is not None:
+            return self._reader_over_channel(drop_last)
+        return self._reader_over_queue(drop_last)
+
+    def _produce_batches(self, drop_last):
+        buf = []
+        for f in self._filelist:
+            for s in self._parse_file(f):
+                buf.append(s)
+                if len(buf) == self._batch_size:
+                    yield self._batch_to_feed(buf)
+                    buf = []
+        if buf and not drop_last:
+            yield self._batch_to_feed(buf)
+
+    def _reader_over_channel(self, drop_last):
+        import pickle
+
+        def reader():
+            # fresh channel per pass: the reader is re-invoked every epoch
+            from .. import native
+
+            chan = native.Channel(capacity=max(2, self._thread_num * 2))
+
+            def produce():
+                try:
+                    for feed in self._produce_batches(drop_last):
+                        chan.put(pickle.dumps(feed, protocol=4))
+                except Exception as e:
+                    try:
+                        blob = pickle.dumps(("__dataset_error__", e),
+                                            protocol=4)
+                    except Exception:
+                        # exception not picklable — surface its repr instead
+                        blob = pickle.dumps(
+                            ("__dataset_error__",
+                             RuntimeError(repr(e))), protocol=4)
+                    try:
+                        chan.put(blob)
+                    except Exception:
+                        pass  # consumer closed early; nobody to report to
+                finally:
+                    chan.close()
+
+            t = threading.Thread(target=produce, daemon=True)
+            t.start()
+            try:
+                while True:
+                    blob = chan.get()
+                    if blob is None:
+                        break
+                    item = pickle.loads(blob)
+                    if isinstance(item, tuple) and len(item) == 2 and \
+                            item[0] == "__dataset_error__":
+                        raise RuntimeError(
+                            "QueueDataset stream failed") from item[1]
+                    yield item
+            finally:
+                # wake a blocked producer, wait for it to leave the channel,
+                # then free — destroying under a blocked put would be UAF
+                chan.close()
+                t.join(timeout=10)
+                if not t.is_alive():
+                    chan.destroy()
+
+        return reader
+
+    def _reader_over_queue(self, drop_last):
         import queue as _q
 
         def reader():
@@ -358,15 +432,8 @@ class QueueDataset(DatasetBase):
 
             def produce():
                 try:
-                    buf = []
-                    for f in self._filelist:
-                        for s in self._parse_file(f):
-                            buf.append(s)
-                            if len(buf) == self._batch_size:
-                                q.put(self._batch_to_feed(buf))
-                                buf = []
-                    if buf and not drop_last:
-                        q.put(self._batch_to_feed(buf))
+                    for feed in self._produce_batches(drop_last):
+                        q.put(feed)
                     q.put(end)
                 except Exception as e:  # surfaced in the consumer
                     q.put(("__dataset_error__", e))

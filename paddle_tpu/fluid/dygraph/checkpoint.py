@@ -11,17 +11,20 @@ __all__ = ["save_dygraph", "load_dygraph"]
 
 
 def save_dygraph(state_dict, model_path):
+    from ..core import tensor_io
+
     arrays = {}
     for k, v in state_dict.items():
         arrays[k] = v.numpy() if isinstance(v, VarBase) else np.asarray(v)
     os.makedirs(os.path.dirname(model_path) or ".", exist_ok=True)
-    with open(model_path + ".pdparams", "wb") as f:
-        np.savez(f, **arrays)
+    tensor_io.save_combine(model_path + ".pdparams", arrays)
 
 
 def load_dygraph(model_path):
     path = model_path + ".pdparams"
     if not os.path.exists(path):
         raise FileNotFoundError(path)
-    data = np.load(path)
-    return {k: data[k] for k in data.files}, None
+    # PTC1 (native serde) or legacy npz — same dispatch as fluid.io
+    from ..io import _load_combined
+
+    return _load_combined(path), None

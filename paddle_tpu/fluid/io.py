@@ -40,17 +40,31 @@ def save_vars(executor, dirname, main_program=None, vars=None, predicate=None,
     scope = global_scope()
     os.makedirs(dirname, exist_ok=True)
     if filename is not None:
+        from .core import tensor_io
+
         arrays = {}
         for v in vars:
             val = scope.find_var(v.name)
             if val is not None:
                 arrays[v.name] = np.asarray(val)
-        np.savez(os.path.join(dirname, filename), **arrays)
+        tensor_io.save_combine(os.path.join(dirname, filename), arrays)
     else:
         for v in vars:
             val = scope.find_var(v.name)
             if val is not None:
                 np.save(os.path.join(dirname, v.name + ".npy"), np.asarray(val))
+
+
+def _load_combined(path):
+    """Read a combined tensor file: PTC1 (native serde) or legacy npz."""
+    with open(path, "rb") as f:
+        magic = f.read(4)
+    if magic == b"PTC1":
+        from .core import tensor_io
+
+        return tensor_io.load_combine(path)
+    data = np.load(path, allow_pickle=False)
+    return {name: data[name] for name in data.files}
 
 
 def save_params(executor, dirname, main_program=None, filename=None):
@@ -70,7 +84,7 @@ def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
         vars = [v for v in main_program.list_vars() if predicate(v)]
     scope = global_scope()
     if filename is not None:
-        data = np.load(os.path.join(dirname, filename))
+        data = _load_combined(os.path.join(dirname, filename))
         for v in vars:
             if v.name in data:
                 scope.set_var(v.name, data[v.name])
@@ -158,10 +172,10 @@ def save(program, model_path):
         if val is None:
             continue
         (params if _is_param(v) else opt)[v.name] = np.asarray(val)
-    with open(base + ".pdparams", "wb") as f:
-        np.savez(f, **params)
-    with open(base + ".pdopt", "wb") as f:
-        np.savez(f, **opt)
+    from .core import tensor_io
+
+    tensor_io.save_combine(base + ".pdparams", params)
+    tensor_io.save_combine(base + ".pdopt", opt)
     with open(base + ".pdmodel", "wb") as f:
         f.write(program.serialize_to_string())
 
@@ -172,6 +186,5 @@ def load(program, model_path, executor=None, var_list=None):
         path = model_path + suffix
         if not os.path.exists(path):
             continue
-        data = np.load(path)
-        for name in data.files:
-            scope.set_var(name, data[name])
+        for name, arr in _load_combined(path).items():
+            scope.set_var(name, arr)

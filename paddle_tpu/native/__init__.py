@@ -8,6 +8,7 @@ g++ and cached next to the sources; importers must handle `None` (no
 toolchain) by falling back to pure-numpy implementations.
 """
 
+import functools
 import os
 import subprocess
 
@@ -28,6 +29,7 @@ def _build(name, srcs):
     return so
 
 
+@functools.lru_cache(maxsize=None)
 def load_data_feed():
     """ctypes handle to the multislot text parser, or None."""
     import ctypes
@@ -50,6 +52,7 @@ def load_data_feed():
     return lib
 
 
+@functools.lru_cache(maxsize=None)
 def load_ps_store():
     """ctypes handle to the embedding-store library, or None."""
     import ctypes
@@ -80,3 +83,110 @@ def load_ps_store():
     lib.pts_vocab.restype = i64
     lib.pts_vocab.argtypes = [i64]
     return lib
+
+
+@functools.lru_cache(maxsize=None)
+def load_tensor_io():
+    """ctypes handle to the combined-tensor-file serde, or None."""
+    import ctypes
+
+    so = _build("libtensor_io", ["tensor_io.cc"])
+    if so is None:
+        return None
+    lib = ctypes.CDLL(so)
+    i64 = ctypes.c_int64
+    lib.tio_open_write.restype = i64
+    lib.tio_open_write.argtypes = [ctypes.c_char_p]
+    lib.tio_write_tensor.restype = ctypes.c_int
+    lib.tio_write_tensor.argtypes = [i64, ctypes.c_char_p, ctypes.c_int,
+                                     ctypes.c_int, ctypes.POINTER(i64),
+                                     ctypes.c_void_p, i64]
+    lib.tio_close_write.restype = ctypes.c_int
+    lib.tio_close_write.argtypes = [i64]
+    lib.tio_open_read.restype = i64
+    lib.tio_open_read.argtypes = [ctypes.c_char_p]
+    lib.tio_count.restype = i64
+    lib.tio_count.argtypes = [i64]
+    lib.tio_entry_meta.restype = ctypes.c_int
+    lib.tio_entry_meta.argtypes = [i64, i64, ctypes.c_char_p, ctypes.c_int,
+                                   ctypes.POINTER(ctypes.c_int),
+                                   ctypes.POINTER(i64), ctypes.POINTER(i64)]
+    lib.tio_read_data.restype = ctypes.c_int
+    lib.tio_read_data.argtypes = [i64, i64, ctypes.c_void_p, i64]
+    lib.tio_close_read.restype = ctypes.c_int
+    lib.tio_close_read.argtypes = [i64]
+    return lib
+
+
+@functools.lru_cache(maxsize=None)
+def load_channel():
+    """ctypes handle to the bounded MPMC channel, or None."""
+    import ctypes
+
+    so = _build("libchannel", ["channel.cc"])
+    if so is None:
+        return None
+    lib = ctypes.CDLL(so)
+    i64 = ctypes.c_int64
+    lib.chn_create.restype = i64
+    lib.chn_create.argtypes = [i64]
+    lib.chn_put.restype = ctypes.c_int
+    lib.chn_put.argtypes = [i64, ctypes.c_char_p, i64]
+    lib.chn_get.restype = ctypes.c_int
+    lib.chn_get.argtypes = [i64, ctypes.POINTER(ctypes.POINTER(ctypes.c_char)),
+                            ctypes.POINTER(i64)]
+    lib.chn_free.restype = None
+    lib.chn_free.argtypes = [ctypes.POINTER(ctypes.c_char)]
+    lib.chn_size.restype = i64
+    lib.chn_size.argtypes = [i64]
+    lib.chn_close.restype = ctypes.c_int
+    lib.chn_close.argtypes = [i64]
+    lib.chn_destroy.restype = ctypes.c_int
+    lib.chn_destroy.argtypes = [i64]
+    return lib
+
+
+class Channel:
+    """Bounded MPMC byte channel over channel.cc (reference
+    ``framework/channel.h``). ``put(bytes)``; ``get() -> bytes | None``
+    (None = closed and drained). Blocking calls release the GIL."""
+
+    def __init__(self, capacity=64, _lib=None):
+        import ctypes
+
+        self._ct = ctypes
+        self._lib = _lib if _lib is not None else load_channel()
+        if self._lib is None:
+            raise RuntimeError("native channel unavailable (no toolchain)")
+        self._h = self._lib.chn_create(capacity)
+
+    def put(self, data):
+        rc = self._lib.chn_put(self._h, data, len(data))
+        if rc == 1:
+            raise RuntimeError("put on closed channel")
+        if rc != 0:
+            raise RuntimeError("channel put failed rc=%d" % rc)
+
+    def get(self):
+        out = self._ct.POINTER(self._ct.c_char)()
+        n = self._ct.c_int64()
+        rc = self._lib.chn_get(self._h, self._ct.byref(out),
+                               self._ct.byref(n))
+        if rc == 1:
+            return None
+        if rc != 0:
+            raise RuntimeError("channel get failed rc=%d" % rc)
+        data = self._ct.string_at(out, n.value)
+        self._lib.chn_free(out)
+        return data
+
+    def size(self):
+        return self._lib.chn_size(self._h)
+
+    def close(self):
+        self._lib.chn_close(self._h)
+
+    def destroy(self):
+        if self._h:
+            self._lib.chn_destroy(self._h)
+            self._h = 0

@@ -1,0 +1,107 @@
+// Bounded MPMC byte-record channel — the native tier of the reference's
+// framework/channel.h + blocking_queue.h (the conduit between dataset
+// ingestion threads and consumers). Blocking put/get with close
+// semantics; calls release the Python GIL (ctypes), so producers and
+// consumers overlap with the interpreter.
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <vector>
+
+namespace {
+
+struct Blob {
+  char* data;
+  long long len;
+};
+
+struct Channel {
+  std::mutex mu;
+  std::condition_variable not_full, not_empty;
+  std::deque<Blob> q;
+  size_t capacity;
+  bool closed = false;
+};
+
+}  // namespace
+
+extern "C" {
+
+long long chn_create(long long capacity) {
+  auto* c = new Channel();
+  c->capacity = capacity > 0 ? static_cast<size_t>(capacity) : 1;
+  return reinterpret_cast<long long>(c);
+}
+
+// Blocks while full. rc: 0 ok, 1 channel closed (record dropped).
+int chn_put(long long handle, const char* data, long long len) {
+  auto* c = reinterpret_cast<Channel*>(handle);
+  if (!c || len < 0) return -1;
+  std::unique_lock<std::mutex> lk(c->mu);
+  c->not_full.wait(lk, [c] { return c->q.size() < c->capacity || c->closed; });
+  if (c->closed) return 1;
+  char* copy = static_cast<char*>(malloc(len > 0 ? len : 1));
+  if (!copy) return -2;
+  if (len) memcpy(copy, data, static_cast<size_t>(len));
+  c->q.push_back({copy, len});
+  lk.unlock();
+  c->not_empty.notify_one();
+  return 0;
+}
+
+// Blocks while empty. rc: 0 ok (*out/*len set; caller frees with
+// chn_free), 1 closed-and-drained, <0 error.
+int chn_get(long long handle, char** out, long long* len) {
+  auto* c = reinterpret_cast<Channel*>(handle);
+  if (!c) return -1;
+  std::unique_lock<std::mutex> lk(c->mu);
+  c->not_empty.wait(lk, [c] { return !c->q.empty() || c->closed; });
+  if (c->q.empty()) return 1;  // closed and drained
+  Blob b = c->q.front();
+  c->q.pop_front();
+  lk.unlock();
+  c->not_full.notify_one();
+  *out = b.data;
+  *len = b.len;
+  return 0;
+}
+
+void chn_free(char* p) { free(p); }
+
+long long chn_size(long long handle) {
+  auto* c = reinterpret_cast<Channel*>(handle);
+  if (!c) return -1;
+  std::lock_guard<std::mutex> lk(c->mu);
+  return static_cast<long long>(c->q.size());
+}
+
+// Close: pending gets drain the queue then see rc=1; blocked puts abort.
+int chn_close(long long handle) {
+  auto* c = reinterpret_cast<Channel*>(handle);
+  if (!c) return -1;
+  {
+    std::lock_guard<std::mutex> lk(c->mu);
+    c->closed = true;
+  }
+  c->not_empty.notify_all();
+  c->not_full.notify_all();
+  return 0;
+}
+
+int chn_destroy(long long handle) {
+  auto* c = reinterpret_cast<Channel*>(handle);
+  if (!c) return -1;
+  {
+    std::lock_guard<std::mutex> lk(c->mu);
+    for (auto& b : c->q) free(b.data);
+    c->q.clear();
+  }
+  delete c;
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,181 @@
+// Combined tensor-file serde — the native tier of the reference's
+// save_combine/load_combine ops (operators/save_combine_op.cc,
+// framework/lod_tensor.cc SerializeToStream) rebuilt for the TPU host
+// runtime: one flat binary file holding N named dense tensors.
+//
+// Format "PTC1" (little-endian):
+//   magic[4]="PTC1" | u32 n_entries
+//   entry: u32 name_len | name | u32 dtype | u32 ndim | u64 dims[ndim]
+//          | u64 nbytes | raw data
+// dtype codes: 0=f32 1=f64 2=i32 3=i64 4=u8 5=bf16(raw u16) 6=f16 7=bool
+//              8=i8 9=i16 10=u16 11=u32 12=u64
+// (the serde itself is dtype-agnostic — codes are carried, data is raw
+// bytes; the Python layer maps codes to numpy dtypes)
+//
+// The Python side (fluid/core/tensor_io.py) writes the identical format
+// with struct when this library is unavailable, so files interchange.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Entry {
+  std::string name;
+  uint32_t dtype = 0;
+  std::vector<uint64_t> dims;
+  uint64_t nbytes = 0;
+  uint64_t offset = 0;  // file offset of raw data
+};
+
+struct Writer {
+  FILE* f = nullptr;
+  uint32_t count = 0;
+};
+
+struct Reader {
+  FILE* f = nullptr;
+  std::vector<Entry> entries;
+};
+
+bool write_u32(FILE* f, uint32_t v) { return fwrite(&v, 4, 1, f) == 1; }
+bool write_u64(FILE* f, uint64_t v) { return fwrite(&v, 8, 1, f) == 1; }
+bool read_u32(FILE* f, uint32_t* v) { return fread(v, 4, 1, f) == 1; }
+bool read_u64(FILE* f, uint64_t* v) { return fread(v, 8, 1, f) == 1; }
+
+}  // namespace
+
+extern "C" {
+
+// ---- writing --------------------------------------------------------------
+
+long long tio_open_write(const char* path) {
+  FILE* f = fopen(path, "wb");
+  if (!f) return 0;
+  if (fwrite("PTC1", 4, 1, f) != 1 || !write_u32(f, 0)) {
+    fclose(f);
+    return 0;
+  }
+  auto* w = new Writer{f, 0};
+  return reinterpret_cast<long long>(w);
+}
+
+int tio_write_tensor(long long handle, const char* name, int dtype, int ndim,
+                     const long long* dims, const void* data,
+                     long long nbytes) {
+  auto* w = reinterpret_cast<Writer*>(handle);
+  if (!w || !w->f || ndim < 0 || nbytes < 0) return -1;
+  uint32_t name_len = static_cast<uint32_t>(strlen(name));
+  if (!write_u32(w->f, name_len)) return -2;
+  if (name_len && fwrite(name, 1, name_len, w->f) != name_len) return -2;
+  if (!write_u32(w->f, static_cast<uint32_t>(dtype))) return -2;
+  if (!write_u32(w->f, static_cast<uint32_t>(ndim))) return -2;
+  for (int i = 0; i < ndim; ++i)
+    if (!write_u64(w->f, static_cast<uint64_t>(dims[i]))) return -2;
+  if (!write_u64(w->f, static_cast<uint64_t>(nbytes))) return -2;
+  if (nbytes &&
+      fwrite(data, 1, static_cast<size_t>(nbytes), w->f) !=
+          static_cast<size_t>(nbytes))
+    return -2;
+  w->count++;
+  return 0;
+}
+
+int tio_close_write(long long handle) {
+  auto* w = reinterpret_cast<Writer*>(handle);
+  if (!w) return -1;
+  int rc = 0;
+  if (w->f) {
+    // patch entry count at offset 4
+    if (fseek(w->f, 4, SEEK_SET) != 0 || !write_u32(w->f, w->count)) rc = -2;
+    if (fclose(w->f) != 0) rc = -2;
+  }
+  delete w;
+  return rc;
+}
+
+// ---- reading --------------------------------------------------------------
+
+long long tio_open_read(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return 0;
+  char magic[4];
+  uint32_t count = 0;
+  if (fread(magic, 4, 1, f) != 1 || memcmp(magic, "PTC1", 4) != 0 ||
+      !read_u32(f, &count)) {
+    fclose(f);
+    return 0;
+  }
+  auto* r = new Reader{f, {}};
+  r->entries.reserve(count);
+  for (uint32_t i = 0; i < count; ++i) {
+    Entry e;
+    uint32_t name_len = 0, ndim = 0;
+    if (!read_u32(f, &name_len)) goto fail;
+    e.name.resize(name_len);
+    if (name_len && fread(&e.name[0], 1, name_len, f) != name_len) goto fail;
+    if (!read_u32(f, &e.dtype) || !read_u32(f, &ndim)) goto fail;
+    e.dims.resize(ndim);
+    for (uint32_t d = 0; d < ndim; ++d)
+      if (!read_u64(f, &e.dims[d])) goto fail;
+    if (!read_u64(f, &e.nbytes)) goto fail;
+    e.offset = static_cast<uint64_t>(ftell(f));
+    if (fseek(f, static_cast<long>(e.nbytes), SEEK_CUR) != 0) goto fail;
+    r->entries.push_back(std::move(e));
+  }
+  return reinterpret_cast<long long>(r);
+fail:
+  fclose(f);
+  delete r;
+  return 0;
+}
+
+long long tio_count(long long handle) {
+  auto* r = reinterpret_cast<Reader*>(handle);
+  return r ? static_cast<long long>(r->entries.size()) : -1;
+}
+
+// name_buf receives up to name_cap bytes (NUL-terminated); dims_out must
+// hold >= 16 entries. Returns ndim, or -1 on error.
+int tio_entry_meta(long long handle, long long idx, char* name_buf,
+                   int name_cap, int* dtype_out, long long* dims_out,
+                   long long* nbytes_out) {
+  auto* r = reinterpret_cast<Reader*>(handle);
+  if (!r || idx < 0 || idx >= static_cast<long long>(r->entries.size()))
+    return -1;
+  const Entry& e = r->entries[static_cast<size_t>(idx)];
+  if (e.dims.size() > 16) return -1;
+  snprintf(name_buf, static_cast<size_t>(name_cap), "%s", e.name.c_str());
+  *dtype_out = static_cast<int>(e.dtype);
+  *nbytes_out = static_cast<long long>(e.nbytes);
+  for (size_t d = 0; d < e.dims.size(); ++d)
+    dims_out[d] = static_cast<long long>(e.dims[d]);
+  return static_cast<int>(e.dims.size());
+}
+
+int tio_read_data(long long handle, long long idx, void* dst,
+                  long long nbytes) {
+  auto* r = reinterpret_cast<Reader*>(handle);
+  if (!r || idx < 0 || idx >= static_cast<long long>(r->entries.size()))
+    return -1;
+  const Entry& e = r->entries[static_cast<size_t>(idx)];
+  if (static_cast<uint64_t>(nbytes) != e.nbytes) return -2;
+  if (fseek(r->f, static_cast<long>(e.offset), SEEK_SET) != 0) return -3;
+  if (e.nbytes && fread(dst, 1, static_cast<size_t>(e.nbytes), r->f) !=
+                      static_cast<size_t>(e.nbytes))
+    return -3;
+  return 0;
+}
+
+int tio_close_read(long long handle) {
+  auto* r = reinterpret_cast<Reader*>(handle);
+  if (!r) return -1;
+  if (r->f) fclose(r->f);
+  delete r;
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,176 @@
+"""Native tier: combined tensor serde (tensor_io.cc) + bounded channel
+(channel.cc), and their Python fallbacks/product wiring."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import native
+from paddle_tpu.fluid.core import tensor_io
+
+
+def _sample_arrays():
+    rng = np.random.RandomState(0)
+    out = {
+        "w": rng.rand(4, 3).astype(np.float32),
+        "ids": np.arange(7, dtype=np.int64),
+        "flag": np.array([True, False]),
+        "scalar": np.float32(3.5).reshape(()),
+        "bytes8": np.arange(5, dtype=np.uint8),
+    }
+    try:
+        import ml_dtypes
+
+        out["bf"] = rng.rand(3, 2).astype(ml_dtypes.bfloat16)
+    except ImportError:
+        pass
+    return out
+
+
+def test_tensor_io_roundtrip(tmp_path):
+    path = str(tmp_path / "combined.ptc")
+    arrays = _sample_arrays()
+    tensor_io.save_combine(path, arrays)
+    out = tensor_io.load_combine(path)
+    assert list(out) == list(arrays)
+    for k in arrays:
+        assert out[k].dtype == np.asarray(arrays[k]).dtype
+        np.testing.assert_array_equal(np.asarray(out[k]),
+                                      np.asarray(arrays[k]))
+
+
+def test_tensor_io_python_and_native_formats_interchange(tmp_path):
+    if native.load_tensor_io() is None:
+        pytest.skip("no toolchain")
+    arrays = _sample_arrays()
+    p_native = str(tmp_path / "n.ptc")
+    p_py = str(tmp_path / "p.ptc")
+    tensor_io._save_native(native.load_tensor_io(), p_native,
+                           [(k, np.ascontiguousarray(v))
+                            for k, v in arrays.items()])
+    tensor_io._save_py(p_py, [(k, np.ascontiguousarray(v))
+                              for k, v in arrays.items()])
+    assert open(p_native, "rb").read() == open(p_py, "rb").read()
+    # each loader reads the other's file
+    a = tensor_io._load_py(p_native)
+    b = tensor_io._load_native(native.load_tensor_io(), p_py)
+    for k in arrays:
+        np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]))
+
+
+def test_save_load_persistables_combined_file(tmp_path):
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers, optimizer
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("nio_x", [4])
+            y = layers.data("nio_y", [1])
+            loss = layers.reduce_mean(
+                layers.square(layers.fc(x, 1, param_attr=fluid.ParamAttr(
+                    name="nio_w")) - y))
+            optimizer.Adam(learning_rate=0.1).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        exe.run(main, feed={"nio_x": np.ones((4, 4), np.float32),
+                            "nio_y": np.ones((4, 1), np.float32)},
+                fetch_list=[])
+        w = np.asarray(scope.find_var("nio_w")).copy()
+        fluid.io.save_persistables(exe, str(tmp_path), main,
+                                   filename="all_params")
+        assert (tmp_path / "all_params").exists()
+        # magic says PTC1
+        assert open(tmp_path / "all_params", "rb").read(4) == b"PTC1"
+        scope.set_var("nio_w", np.zeros_like(w))
+        fluid.io.load_persistables(exe, str(tmp_path), main,
+                                   filename="all_params")
+        np.testing.assert_array_equal(np.asarray(scope.find_var("nio_w")), w)
+
+
+def test_channel_fifo_and_close():
+    if native.load_channel() is None:
+        pytest.skip("no toolchain")
+    ch = native.Channel(capacity=4)
+    ch.put(b"a")
+    ch.put(b"b")
+    assert ch.size() == 2
+    assert ch.get() == b"a"
+    assert ch.get() == b"b"
+    ch.close()
+    assert ch.get() is None  # closed and drained
+    with pytest.raises(RuntimeError):
+        ch.put(b"c")
+    ch.destroy()
+
+
+def test_channel_blocking_producer_consumer():
+    if native.load_channel() is None:
+        pytest.skip("no toolchain")
+    ch = native.Channel(capacity=2)
+    n = 50
+    got = []
+
+    def produce():
+        for i in range(n):
+            ch.put(b"item%04d" % i)
+        ch.close()
+
+    t = threading.Thread(target=produce)
+    t.start()
+    while True:
+        b = ch.get()
+        if b is None:
+            break
+        got.append(b)
+    t.join()
+    ch.destroy()
+    assert got == [b"item%04d" % i for i in range(n)]
+
+
+def test_channel_bounded_blocks_when_full():
+    if native.load_channel() is None:
+        pytest.skip("no toolchain")
+    ch = native.Channel(capacity=1)
+    ch.put(b"x")
+    state = {"done": False}
+
+    def produce():
+        ch.put(b"y")  # must block until consumer pops
+        state["done"] = True
+
+    t = threading.Thread(target=produce, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    assert not state["done"]
+    assert ch.get() == b"x"
+    t.join(timeout=5)
+    assert state["done"]
+    assert ch.get() == b"y"
+    ch.close()
+    ch.destroy()
+
+
+def test_queue_dataset_streams_over_channel(tmp_path):
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+
+    fn = str(tmp_path / "part-0")
+    with open(fn, "w") as f:
+        for i in range(10):
+            f.write("3 %d %d %d 1 %d\n" % (i, i + 1, i + 2, i % 2))
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = layers.data("qc_ids", [1], dtype="int64", lod_level=1)
+        lab = layers.data("qc_lab", [1], dtype="int64")
+    ds = fluid.DatasetFactory().create_dataset("QueueDataset")
+    ds.set_use_var([ids, lab])
+    ds.set_batch_size(4)
+    ds.set_filelist([fn])
+    batches = list(ds.batch_reader()())
+    assert len(batches) == 3  # 4+4+2
+    assert set(batches[0]) == {"qc_ids", "qc_lab"}
