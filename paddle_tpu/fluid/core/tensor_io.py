@@ -40,24 +40,21 @@ def _code(arr):
     return _CODE_OF[name]
 
 
-_lib = None
-_lib_tried = False
-
-
 def _native():
-    global _lib, _lib_tried
-    if not _lib_tried:
-        from ... import native
+    from ... import native
 
-        _lib = native.load_tensor_io()
-        _lib_tried = True
-    return _lib
+    return native.load_tensor_io()  # memoized by the native package
 
 
 def save_combine(path, arrays):
-    """Write named arrays (dict or (name, array) iterable) to one file."""
+    """Write named arrays (dict or (name, array) iterable) to one file.
+    Format limit: ndim <= 16 (enforced symmetrically at save time)."""
     items = list(arrays.items()) if isinstance(arrays, dict) else list(arrays)
     items = [(n, np.ascontiguousarray(a)) for n, a in items]
+    for n, a in items:
+        if a.ndim > 16:
+            raise ValueError("PTC1 stores at most 16 dims; %r has %d"
+                             % (n, a.ndim))
     lib = _native()
     if lib is not None:
         _save_native(lib, path, items)

@@ -354,10 +354,15 @@ class QueueDataset(DatasetBase):
         through the native bounded channel (``native/channel.cc``, the
         reference's ``framework/channel.h`` conduit) when the toolchain
         is present, else a Python queue."""
-        from .. import native
+        # In-process handoff via queue.Queue passes object references; the
+        # native channel pays pickle+copy per batch, which only wins when
+        # consumers live outside the interpreter (or to exercise the native
+        # conduit) — so it is opt-in.
+        if os.environ.get("PADDLE_TPU_NATIVE_CHANNEL") == "1":
+            from .. import native
 
-        if native.load_channel() is not None:
-            return self._reader_over_channel(drop_last)
+            if native.load_channel() is not None:
+                return self._reader_over_channel(drop_last)
         return self._reader_over_queue(drop_last)
 
     def _produce_batches(self, drop_last):

@@ -155,9 +155,11 @@ def test_channel_bounded_blocks_when_full():
     ch.destroy()
 
 
-def test_queue_dataset_streams_over_channel(tmp_path):
+def test_queue_dataset_streams_over_channel(tmp_path, monkeypatch):
     import paddle_tpu.fluid as fluid
     from paddle_tpu.fluid import layers
+
+    monkeypatch.setenv("PADDLE_TPU_NATIVE_CHANNEL", "1")
 
     fn = str(tmp_path / "part-0")
     with open(fn, "w") as f:
