@@ -156,6 +156,7 @@ class AsyncPusher:
         self._exc = None
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
+        _pushers.add(self)
 
     def _run(self):
         while True:
@@ -198,6 +199,7 @@ class AsyncPusher:
         finally:
             self._stop.set()
             self._thread.join()
+            _pushers.discard(self)
 
 
 class GeoCommunicator:
@@ -212,11 +214,17 @@ class GeoCommunicator:
         self._base = table.dump()
         self.local = self._base.copy()
         self._step = 0
+        _communicators.add(self)
 
-    def maybe_sync(self):
-        self._step += 1
-        if self._step % self.k_steps:
-            return False
+    def maybe_sync(self, force=False):
+        if not force:
+            self._step += 1
+            if self._step % self.k_steps:
+                return False
+        else:
+            # end-of-pass sync: bypass the counter and restart the cadence
+            # cleanly for the next pass
+            self._step = 0
         delta = self.local - self._base
         rows = np.nonzero(np.abs(delta).sum(axis=1))[0]
         if rows.size:
@@ -229,6 +237,23 @@ class GeoCommunicator:
 
 # global table registry used by the distributed_lookup_table op lowerings
 _tables = {}
+
+# Live pusher/communicator registries. BoxPSDataset.begin_pass/end_pass
+# drain these around an epoch. Pushers deregister in stop() — their daemon
+# thread pins them, so weak references alone never collect a running
+# pusher; communicators are plain objects and do drop out when unowned.
+import weakref
+
+_pushers = weakref.WeakSet()
+_communicators = weakref.WeakSet()
+
+
+def registered_pushers():
+    return list(_pushers)
+
+
+def registered_communicators():
+    return list(_communicators)
 
 
 def register_table(name, table):

@@ -23,7 +23,7 @@ from . import lod as _lod
 from .framework import Variable, convert_dtype
 
 __all__ = ["DatasetFactory", "DatasetBase", "InMemoryDataset",
-           "QueueDataset", "FileInstantDataset"]
+           "QueueDataset", "FileInstantDataset", "BoxPSDataset"]
 
 
 class DatasetFactory:
@@ -32,7 +32,8 @@ class DatasetFactory:
     def create_dataset(self, datafeed_class="QueueDataset"):
         kinds = {"InMemoryDataset": InMemoryDataset,
                  "QueueDataset": QueueDataset,
-                 "FileInstantDataset": FileInstantDataset}
+                 "FileInstantDataset": FileInstantDataset,
+                 "BoxPSDataset": BoxPSDataset}
         if datafeed_class not in kinds:
             raise ValueError("unknown dataset class %r (one of %s)"
                              % (datafeed_class, sorted(kinds)))
@@ -461,3 +462,29 @@ class FileInstantDataset(QueueDataset):
     """Reference ``dataset.py:729``: QueueDataset flavor whose feed reads
     instances straight from the file worker — same streaming semantics
     here."""
+
+
+class BoxPSDataset(InMemoryDataset):
+    """Reference ``dataset.py:767``: dataset bound to an embedded parameter
+    server (BoxPS) — ``begin_pass``/``end_pass`` bracket an epoch so the PS
+    tier can sync its sparse tables around it.
+
+    TPU-native analogue: the PS tier is the host-sharded embedding store
+    (``paddle_tpu/distributed/ps.py``, native ``ps_store.cc``).
+    ``begin_pass`` drains any async pushers registered on the global table
+    registry so the epoch reads settled rows; ``end_pass`` flushes pushes
+    accumulated during the pass and runs geo-communicator syncs."""
+
+    def begin_pass(self):
+        from ..distributed import ps as _ps
+
+        for pusher in _ps.registered_pushers():
+            pusher.flush()
+
+    def end_pass(self):
+        from ..distributed import ps as _ps
+
+        for pusher in _ps.registered_pushers():
+            pusher.flush()
+        for comm in _ps.registered_communicators():
+            comm.maybe_sync(force=True)

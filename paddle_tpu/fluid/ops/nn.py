@@ -225,27 +225,27 @@ def _dropout(ctx, op):
     p = op.attr("dropout_prob", 0.5)
     is_test = op.attr("is_test", False)
     impl = op.attr("dropout_implementation", "downgrade_in_infer")
-    if is_test:
-        out = x * (1.0 - p) if impl == "downgrade_in_infer" else x
-        ctx.set_output(op, "Out", out)
-        return
-    keep = 1.0 - p
-    if keep <= 0.0:
-        ctx.set_output(op, "Out", jnp.zeros_like(x))
-        ctx.set_output(op, "Mask", jnp.zeros_like(x))
-        return
-    # Mask from 8-bit random words, applied multiplicatively. Against
+    # Masks come from 8-bit random words, applied multiplicatively. Against
     # bernoulli (32-bit uniform) + where this is 4x less generator traffic
     # and fuses into one VPU pass — measured on v5e BERT-base AMP:
-    # 94.8 -> 87.5 ms/step. Keep-probability resolution is 1/256; upscale
-    # divides by the REALIZED keep (thresh/256) so E[out] == x exactly.
-    thresh = int(round(keep * 256.0))
-    if thresh >= 256:  # keep rounds to 1 (p < ~1/512): dropout is a no-op
-        ctx.next_rng()  # consume the key: replay stream must stay aligned
-        ctx.set_output(op, "Out", x)
-        ctx.set_output(op, "Mask", jnp.ones_like(x))
+    # 94.8 -> 87.5 ms/step. Keep-probability resolution is 1/256; BOTH the
+    # upscale factor and the downgrade inference scale use the REALIZED
+    # keep (thresh/256), so E[train out] == E[test out] exactly.
+    keep = 1.0 - p
+    thresh = min(max(int(round(keep * 256.0)), 0 if keep <= 0.0 else 1), 256)
+    if is_test:
+        out = x * (thresh / 256.0) if impl == "downgrade_in_infer" else x
+        ctx.set_output(op, "Out", out)
         return
-    thresh = max(thresh, 1)  # 0 < keep < 1/512: closest nonzero keep, 1/256
+    if thresh <= 0 or thresh >= 256:
+        # degenerate keep (rounds to 0 or 1): constant output, but the op
+        # still consumes its key so the autodiff replay stream and any
+        # key-count-sensitive config comparison stay aligned
+        ctx.next_rng()
+        one_or_zero = (jnp.ones_like if thresh >= 256 else jnp.zeros_like)
+        ctx.set_output(op, "Out", x if thresh >= 256 else jnp.zeros_like(x))
+        ctx.set_output(op, "Mask", one_or_zero(x))
+        return
     bits = jax.random.bits(ctx.next_rng(), x.shape, jnp.uint8)
     mask = bits < jnp.uint8(thresh)
     scale = (256.0 / thresh) if impl == "upscale_in_train" else 1.0

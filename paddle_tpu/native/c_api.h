@@ -1,0 +1,95 @@
+/* paddle_tpu native host runtime — public C API.
+ *
+ * The analogue of the reference's minimal C surface
+ * (paddle/fluid/framework/c/c_api.h): a stable C boundary over the native
+ * host components, for embedding in non-Python launchers and for the
+ * ctypes bindings in paddle_tpu/native/__init__.py.
+ *
+ * Each component builds into its own shared object (g++ -shared -fPIC):
+ *   libps_store.so   — sharded host embedding store (ps_store.cc)
+ *   libdata_feed.so  — multislot dataset-text parser (data_feed.cc)
+ *   libtensor_io.so  — combined tensor-file serde, format PTC1 (tensor_io.cc)
+ *   libchannel.so    — bounded MPMC byte channel (channel.cc)
+ *
+ * Conventions: handles are opaque int64 — pts_ handles are table indices
+ * (>= 0, never fail); tio_ and chn_ handles are pointers (0 = failure).
+ * Functions return 0 on success and negative codes on error unless
+ * documented otherwise; all buffers are caller-owned except where a
+ * free function is provided (chn_free).
+ */
+
+#ifndef PADDLE_TPU_NATIVE_C_API_H_
+#define PADDLE_TPU_NATIVE_C_API_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* ---- libps_store: host-sharded embedding table (SURVEY §2.6 sparse PS;
+ * the FleetWrapper/pslib capability). Rows hash to nshards independent
+ * lock-striped shards; push applies the optimizer rule on the host. */
+
+int64_t pts_create(int64_t vocab, int64_t dim, int64_t nshards,
+                   double init_scale, int64_t seed);
+int pts_pull(int64_t h, const int64_t* ids, int64_t n, float* out);
+int pts_push_sgd(int64_t h, const int64_t* ids, int64_t n,
+                 const float* grads, double lr);
+int pts_push_adagrad(int64_t h, const int64_t* ids, int64_t n,
+                     const float* grads, double lr, double eps);
+int pts_dump(int64_t h, int64_t start, int64_t n, float* out);
+int pts_load(int64_t h, int64_t start, int64_t n, const float* in);
+int pts_reset(int64_t h, double init_scale, int64_t seed);
+int64_t pts_dim(int64_t h);
+int64_t pts_vocab(int64_t h);
+
+/* ---- libdata_feed: multislot line parser (reference MultiSlotDataFeed).
+ * Line format, per slot: "<num> <v1> ... <vnum>". types[s] is 'u' for
+ * int64 feasign slots, 'f' for float slots.
+ * dfd_count returns the line count and fills per-slot value counts
+ * (negative return = 1-based index of the malformed line, negated).
+ * dfd_parse fills caller-allocated per-slot flat arrays + offsets
+ * (offsets[s] has n_lines+1 entries). */
+
+long long dfd_count(const char* buf, long long len, int n_slots,
+                    int64_t* counts);
+int dfd_parse(const char* buf, long long len, int n_slots, const char* types,
+              float** fvals, int64_t** uvals, int64_t** offsets);
+
+/* ---- libtensor_io: PTC1 combined tensor files (reference
+ * save_combine/load_combine). dtype codes: 0=f32 1=f64 2=i32 3=i64 4=u8
+ * 5=bf16 6=f16 7=bool 8=i8 9=i16 10=u16 11=u32 12=u64; ndim <= 16. */
+
+int64_t tio_open_write(const char* path);
+int tio_write_tensor(int64_t h, const char* name, int dtype, int ndim,
+                     const long long* dims, const void* data,
+                     long long nbytes);
+int tio_close_write(int64_t h);
+int64_t tio_open_read(const char* path);
+long long tio_count(int64_t h);
+/* Returns ndim (>=0) or -1; name_buf gets a NUL-terminated copy; dims_out
+ * must hold 16 entries. */
+int tio_entry_meta(int64_t h, long long idx, char* name_buf, int name_cap,
+                   int* dtype_out, long long* dims_out, long long* nbytes_out);
+int tio_read_data(int64_t h, long long idx, void* dst, long long nbytes);
+int tio_close_read(int64_t h);
+
+/* ---- libchannel: bounded blocking MPMC byte channel (reference
+ * framework/channel.h). put/get block at capacity/empty; after chn_close,
+ * puts return 1 and gets drain then return 1. Blobs from chn_get are
+ * freed with chn_free. */
+
+int64_t chn_create(int64_t capacity);
+int chn_put(int64_t h, const char* data, long long len);
+int chn_get(int64_t h, char** out, long long* len);
+void chn_free(char* p);
+long long chn_size(int64_t h);
+int chn_close(int64_t h);
+int chn_destroy(int64_t h);
+
+#ifdef __cplusplus
+}  /* extern "C" */
+#endif
+
+#endif  /* PADDLE_TPU_NATIVE_C_API_H_ */

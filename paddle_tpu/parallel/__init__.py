@@ -14,6 +14,7 @@ first-class here.
 
 from .mesh import (  # noqa: F401
     make_mesh,
+    make_hybrid_mesh,
     mesh_axis_size,
     local_slice,
     DP, TP, PP, SP, EP,

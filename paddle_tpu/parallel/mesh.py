@@ -85,3 +85,49 @@ def local_slice(array, mesh, axis_name, dim, index=None):
     idx = [slice(None)] * array.ndim
     idx[dim] = slice(start, start + chunk)
     return array[tuple(idx)]
+
+
+def make_hybrid_mesh(ici_axes, dcn_axes, devices=None):
+    """ICI x DCN hybrid mesh for multi-slice jobs (SURVEY §5.8: the
+    reference's hierarchical allreduce — inter/exter NCCL rings,
+    ``platform/nccl_helper.h`` — maps to XLA's ICI+DCN phase split).
+
+    ``dcn_axes`` sizes multiply across slices (typically ``{"dp": n_slices}``
+    — only batch-parallel traffic should cross the data-center network);
+    ``ici_axes`` lay out within a slice exactly like ``make_mesh``. Uses
+    ``mesh_utils.create_hybrid_device_mesh`` when the runtime reports
+    multiple slices; single-slice (or CPU-virtual) environments collapse to
+    a plain ``make_mesh`` of the combined sizes, so code written against
+    the hybrid layout runs unchanged on one slice.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    for d in (ici_axes, dcn_axes):
+        if any(int(v) == -1 for v in d.values()):
+            raise ValueError("make_hybrid_mesh does not support the -1 "
+                             "wildcard; give explicit per-axis sizes")
+
+    dcn_names = [a for a in _CANONICAL_ORDER if a in dcn_axes]
+    dcn_names += [a for a in dcn_axes if a not in dcn_names]
+    ici_names = [a for a in _CANONICAL_ORDER if a in ici_axes]
+    ici_names += [a for a in ici_axes if a not in ici_names]
+    # combined axis order: DCN-crossing axes outermost (slowest), so every
+    # other axis's collectives stay on ICI
+    names = dcn_names + [a for a in ici_names if a not in dcn_names]
+
+    n_slices = len({getattr(d, "slice_index", 0) for d in devices})
+    if n_slices > 1:
+        from jax.experimental import mesh_utils
+
+        ici_shape = [int(ici_axes.get(a, 1)) for a in names]
+        dcn_shape = [int(dcn_axes.get(a, 1)) for a in names]
+        grid = mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape, devices=devices)
+        return Mesh(grid, tuple(names))
+    combined = {}
+    for a in names:
+        combined[a] = int(ici_axes.get(a, 1)) * int(dcn_axes.get(a, 1))
+    return make_mesh(combined, devices=devices)

@@ -1,0 +1,88 @@
+"""C API surface: a C program compiled against native/c_api.h must link and
+run against the shipped shared objects (the reference's framework/c/c_api
+capability + ABI regression guard for the ctypes bindings)."""
+
+import os
+import subprocess
+
+import pytest
+
+from paddle_tpu import native
+
+_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "paddle_tpu", "native")
+
+_C_PROGRAM = r"""
+#include <stdio.h>
+#include <string.h>
+#include "c_api.h"
+
+int main(void) {
+  /* ps_store */
+  int64_t t = pts_create(100, 4, 2, 0.0, 7);
+  if (t < 0) return 1;
+  int64_t ids[2] = {3, 42};
+  float rows[8];
+  if (pts_pull(t, ids, 2, rows) != 0) return 2;
+  float grads[8] = {1, 1, 1, 1, 2, 2, 2, 2};
+  if (pts_push_sgd(t, ids, 2, grads, 0.5) != 0) return 3;
+  if (pts_pull(t, ids, 2, rows) != 0) return 4;
+  if (rows[0] != -0.5f || rows[4] != -1.0f) return 5;
+
+  /* channel */
+  int64_t ch = chn_create(2);
+  if (chn_put(ch, "hello", 5) != 0) return 6;
+  char* out; long long n;
+  if (chn_get(ch, &out, &n) != 0 || n != 5 || memcmp(out, "hello", 5))
+    return 7;
+  chn_free(out);
+  chn_close(ch);
+  if (chn_get(ch, &out, &n) != 1) return 8; /* closed + drained */
+  chn_destroy(ch);
+
+  /* tensor_io */
+  int64_t w = tio_open_write("/tmp/capi_test.ptc");
+  if (!w) return 9;
+  long long dims[2] = {2, 2};
+  float data[4] = {1, 2, 3, 4};
+  if (tio_write_tensor(w, "m", 0, 2, dims, data, 16) != 0) return 10;
+  if (tio_close_write(w) != 0) return 11;
+  int64_t r = tio_open_read("/tmp/capi_test.ptc");
+  if (!r || tio_count(r) != 1) return 12;
+  char name[64]; int dt; long long d2[16], nb;
+  if (tio_entry_meta(r, 0, name, 64, &dt, d2, &nb) != 2) return 13;
+  if (strcmp(name, "m") || dt != 0 || d2[0] != 2 || nb != 16) return 14;
+  float back[4];
+  if (tio_read_data(r, 0, back, 16) != 0 || back[3] != 4.0f) return 15;
+  tio_close_read(r);
+
+  /* data_feed */
+  const char* text = "2 1 2 1 3\n";
+  int64_t counts[2];
+  long long lines = dfd_count(text, (long long)strlen(text), 2, counts);
+  if (lines != 1 || counts[0] != 2 || counts[1] != 1) return 16;
+
+  printf("C_API_OK\n");
+  return 0;
+}
+"""
+
+
+def test_c_program_against_header(tmp_path):
+    # ensure the .so files exist (builds them if a toolchain is present)
+    libs = [native.load_ps_store(), native.load_channel(),
+            native.load_tensor_io(), native.load_data_feed()]
+    if any(l is None for l in libs):
+        pytest.skip("no toolchain")
+    src = tmp_path / "capi_test.c"
+    src.write_text(_C_PROGRAM)
+    exe = tmp_path / "capi_test"
+    sos = [os.path.join(_DIR, "lib%s.so" % n)
+           for n in ("ps_store", "channel", "tensor_io", "data_feed")]
+    subprocess.run(
+        ["g++", "-x", "c", str(src), "-x", "none", "-o", str(exe),
+         "-I", _DIR] + sos + ["-Wl,-rpath," + _DIR],
+        check=True, capture_output=True)
+    out = subprocess.run([str(exe)], capture_output=True, text=True)
+    assert out.returncode == 0, (out.returncode, out.stdout, out.stderr)
+    assert "C_API_OK" in out.stdout

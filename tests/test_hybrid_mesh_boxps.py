@@ -1,0 +1,74 @@
+"""make_hybrid_mesh (ICI x DCN layout, single-slice collapse) and
+BoxPSDataset pass hooks."""
+
+import numpy as np
+
+from paddle_tpu import parallel
+
+
+def test_hybrid_mesh_single_slice_collapse():
+    # CPU-virtual devices report one slice -> collapse to a plain mesh of
+    # the combined sizes, with DCN axes outermost
+    mesh = parallel.make_hybrid_mesh(ici_axes={"tp": 2, "dp": 2},
+                                     dcn_axes={"dp": 2})
+    assert dict(mesh.shape) == {"dp": 4, "tp": 2}
+    assert mesh.axis_names == ("dp", "tp")
+
+
+def test_hybrid_mesh_runs_collective():
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = parallel.make_hybrid_mesh(ici_axes={"dp": 4}, dcn_axes={"dp": 2})
+    x = np.arange(8, dtype=np.float32)
+    xs = jax.device_put(x, NamedSharding(mesh, P("dp")))
+
+    @jax.jit
+    def total(v):
+        return v.sum()
+
+    assert float(total(xs)) == x.sum()
+
+
+def test_boxps_dataset_pass_hooks(tmp_path):
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.distributed import ps
+    from paddle_tpu.fluid import layers
+
+    table = ps.EmbeddingTable(vocab=16, dim=2, nshards=2, init_scale=0.0)
+    pusher = ps.AsyncPusher(table)
+    assert pusher in ps.registered_pushers()
+    comm = ps.GeoCommunicator(table, k_steps=100)
+    assert comm in ps.registered_communicators()
+
+    fn = str(tmp_path / "p0")
+    with open(fn, "w") as f:
+        for i in range(6):
+            f.write("1 %d 1 0.5\n" % (i % 4))
+    ds = fluid.DatasetFactory().create_dataset("BoxPSDataset")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = layers.data("bp_ids", [1], dtype="int64")
+        val = layers.data("bp_val", [1], dtype="float32")
+    ds.set_use_var([ids, val])
+    ds.set_batch_size(3)
+    ds.set_filelist([fn])
+
+    # a pending async push must be applied by begin_pass's flush
+    pusher.push(np.array([1], np.int64), np.full((1, 2), 1.0, np.float32),
+                lr=1.0)
+    ds.begin_pass()
+    np.testing.assert_allclose(table.pull(np.array([1], np.int64)),
+                               [[-1.0, -1.0]])
+
+    ds.load_into_memory()
+    ds.local_shuffle()
+    n = sum(1 for _ in ds.batch_reader()())
+    assert n == 2
+
+    # end_pass forces the geo communicator to sync its mirror
+    comm.local[2] += 5.0
+    ds.end_pass()
+    np.testing.assert_allclose(table.pull(np.array([2], np.int64)),
+                               [[5.0, 5.0]])
+    pusher.stop()
