@@ -156,7 +156,7 @@ class AsyncPusher:
         self._exc = None
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
-        _pushers.add(self)
+        _registry_add(_pushers, self)
 
     def _run(self):
         while True:
@@ -199,7 +199,7 @@ class AsyncPusher:
         finally:
             self._stop.set()
             self._thread.join()
-            _pushers.discard(self)
+            _registry_discard(_pushers, self)
 
 
 class GeoCommunicator:
@@ -214,7 +214,7 @@ class GeoCommunicator:
         self._base = table.dump()
         self.local = self._base.copy()
         self._step = 0
-        _communicators.add(self)
+        _registry_add(_communicators, self)
 
     def maybe_sync(self, force=False):
         if not force:
@@ -244,16 +244,29 @@ _tables = {}
 # pusher; communicators are plain objects and do drop out when unowned.
 import weakref
 
+_registry_mu = threading.Lock()
 _pushers = weakref.WeakSet()
 _communicators = weakref.WeakSet()
 
 
+def _registry_add(reg, item):
+    with _registry_mu:
+        reg.add(item)
+
+
+def _registry_discard(reg, item):
+    with _registry_mu:
+        reg.discard(item)
+
+
 def registered_pushers():
-    return list(_pushers)
+    with _registry_mu:  # adds/discards race from other threads
+        return list(_pushers)
 
 
 def registered_communicators():
-    return list(_communicators)
+    with _registry_mu:
+        return list(_communicators)
 
 
 def register_table(name, table):

@@ -1,7 +1,17 @@
 """Model zoo built on the paddle_tpu static-graph API.
 
 Parity targets (BASELINE.md configs): LeNet/MNIST, ResNet-50, BERT/ERNIE,
-DeepFM CTR, Transformer NMT.
+DeepFM CTR, Transformer NMT; plus the book-suite families (word2vec,
+sentiment conv/stacked-LSTM, VGG16 — reference ``tests/book/``).
 """
 
-from . import bert, deepfm, lenet, resnet, transformer  # noqa: F401
+from . import (  # noqa: F401
+    bert,
+    deepfm,
+    lenet,
+    resnet,
+    sentiment,
+    transformer,
+    vgg,
+    word2vec,
+)

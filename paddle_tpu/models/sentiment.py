@@ -1,0 +1,74 @@
+"""Sentiment classification over variable-length text (reference book
+chapter: ``python/paddle/fluid/tests/book/test_understand_sentiment.py`` —
+the conv and stacked-LSTM variants). Ragged input rides the bounded-LoD
+encoding, so every batch compiles to one static XLA shape."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers, optimizer
+
+__all__ = ["conv_net", "stacked_lstm_net", "build_train_program",
+           "synthetic_reviews"]
+
+
+def conv_net(data, label, input_dim, class_dim=2, emb_dim=32, hid_dim=32):
+    """Reference ``convolution_net``: two sequence-conv+pool towers."""
+    from paddle_tpu.fluid import nets
+
+    emb = layers.embedding(data, size=[input_dim, emb_dim], is_sparse=False)
+    conv3 = nets.sequence_conv_pool(emb, num_filters=hid_dim, filter_size=3,
+                                    act="tanh", pool_type="sqrt")
+    conv4 = nets.sequence_conv_pool(emb, num_filters=hid_dim, filter_size=4,
+                                    act="tanh", pool_type="sqrt")
+    predict = layers.fc([conv3, conv4], size=class_dim, act="softmax")
+    loss = layers.mean(layers.cross_entropy(predict, label))
+    acc = layers.accuracy(predict, label)
+    return loss, acc, predict
+
+
+def stacked_lstm_net(data, label, input_dim, class_dim=2, emb_dim=32,
+                     hid_dim=32, stacked_num=3):
+    """Reference ``stacked_lstm_net``: fc+lstm ladder, max-pooled."""
+    emb = layers.embedding(data, size=[input_dim, emb_dim], is_sparse=False)
+    fc1 = layers.fc(emb, size=hid_dim)
+    lstm1, _ = layers.dynamic_lstm(fc1, size=hid_dim)
+    inputs = [fc1, lstm1]
+    for _ in range(2, stacked_num + 1):
+        fc = layers.fc(inputs, size=hid_dim)
+        lstm, _ = layers.dynamic_lstm(fc, size=hid_dim,
+                                      is_reverse=(len(inputs) % 2 == 0))
+        inputs = [fc, lstm]
+    fc_last = layers.sequence_pool(inputs[0], pool_type="max")
+    lstm_last = layers.sequence_pool(inputs[1], pool_type="max")
+    predict = layers.fc([fc_last, lstm_last], size=class_dim, act="softmax")
+    loss = layers.mean(layers.cross_entropy(predict, label))
+    acc = layers.accuracy(predict, label)
+    return loss, acc, predict
+
+
+def build_train_program(net="conv", input_dim=256, lr=1e-3, seed=3):
+    builder = conv_net if net == "conv" else stacked_lstm_net
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    with fluid.program_guard(main, startup):
+        data = layers.data("snt_words", [1], dtype="int64", lod_level=1)
+        label = layers.data("snt_label", [1], dtype="int64")
+        loss, acc, predict = builder(data, label, input_dim)
+        optimizer.Adam(learning_rate=lr).minimize(loss)
+    return main, startup, loss, acc
+
+
+def synthetic_reviews(rng, n, input_dim=256, max_len=12):
+    """Separable synthetic text: positive reviews draw tokens from the top
+    half of the vocabulary, negative from the bottom half."""
+    labels = rng.randint(0, 2, n).astype(np.int64)
+    lens, flat = [], []
+    for y in labels:
+        ln = int(rng.randint(4, max_len))
+        lo, hi = (input_dim // 2, input_dim) if y else (0, input_dim // 2)
+        flat.extend(rng.randint(lo, hi, ln).tolist())
+        lens.append(ln)
+    words = np.asarray(flat, np.int64)[:, None]
+    return {"snt_words": fluid.create_lod_tensor(words, [lens]),
+            "snt_label": labels[:, None]}

@@ -3,7 +3,8 @@
  * The analogue of the reference's minimal C surface
  * (paddle/fluid/framework/c/c_api.h): a stable C boundary over the native
  * host components, for embedding in non-Python launchers and for the
- * ctypes bindings in paddle_tpu/native/__init__.py.
+ * ctypes bindings in paddle_tpu/native/__init__.py. Each .cc includes this
+ * header so declaration/definition drift is a compile error.
  *
  * Each component builds into its own shared object (g++ -shared -fPIC):
  *   libps_store.so   — sharded host embedding store (ps_store.cc)
@@ -11,11 +12,11 @@
  *   libtensor_io.so  — combined tensor-file serde, format PTC1 (tensor_io.cc)
  *   libchannel.so    — bounded MPMC byte channel (channel.cc)
  *
- * Conventions: handles are opaque int64 — pts_ handles are table indices
- * (>= 0, never fail); tio_ and chn_ handles are pointers (0 = failure).
- * Functions return 0 on success and negative codes on error unless
- * documented otherwise; all buffers are caller-owned except where a
- * free function is provided (chn_free).
+ * Conventions: handles are opaque 64-bit ints — pts_ handles are table
+ * indices (>= 0, never fail); tio_ and chn_ handles are pointers
+ * (0 = failure). Functions return 0 on success and negative codes on
+ * error unless documented otherwise; all buffers are caller-owned except
+ * where a free function is provided (chn_free).
  */
 
 #ifndef PADDLE_TPU_NATIVE_C_API_H_
@@ -53,40 +54,42 @@ int64_t pts_vocab(int64_t h);
  * (offsets[s] has n_lines+1 entries). */
 
 long long dfd_count(const char* buf, long long len, int n_slots,
-                    int64_t* counts);
+                    long long* value_counts);
 int dfd_parse(const char* buf, long long len, int n_slots, const char* types,
-              float** fvals, int64_t** uvals, int64_t** offsets);
+              float** fvals, long long** uvals, long long** offsets);
 
 /* ---- libtensor_io: PTC1 combined tensor files (reference
  * save_combine/load_combine). dtype codes: 0=f32 1=f64 2=i32 3=i64 4=u8
  * 5=bf16 6=f16 7=bool 8=i8 9=i16 10=u16 11=u32 12=u64; ndim <= 16. */
 
-int64_t tio_open_write(const char* path);
-int tio_write_tensor(int64_t h, const char* name, int dtype, int ndim,
+long long tio_open_write(const char* path);
+int tio_write_tensor(long long handle, const char* name, int dtype, int ndim,
                      const long long* dims, const void* data,
                      long long nbytes);
-int tio_close_write(int64_t h);
-int64_t tio_open_read(const char* path);
-long long tio_count(int64_t h);
+int tio_close_write(long long handle);
+long long tio_open_read(const char* path);
+long long tio_count(long long handle);
 /* Returns ndim (>=0) or -1; name_buf gets a NUL-terminated copy; dims_out
  * must hold 16 entries. */
-int tio_entry_meta(int64_t h, long long idx, char* name_buf, int name_cap,
-                   int* dtype_out, long long* dims_out, long long* nbytes_out);
-int tio_read_data(int64_t h, long long idx, void* dst, long long nbytes);
-int tio_close_read(int64_t h);
+int tio_entry_meta(long long handle, long long idx, char* name_buf,
+                   int name_cap, int* dtype_out, long long* dims_out,
+                   long long* nbytes_out);
+int tio_read_data(long long handle, long long idx, void* dst,
+                  long long nbytes);
+int tio_close_read(long long handle);
 
 /* ---- libchannel: bounded blocking MPMC byte channel (reference
  * framework/channel.h). put/get block at capacity/empty; after chn_close,
  * puts return 1 and gets drain then return 1. Blobs from chn_get are
  * freed with chn_free. */
 
-int64_t chn_create(int64_t capacity);
-int chn_put(int64_t h, const char* data, long long len);
-int chn_get(int64_t h, char** out, long long* len);
+long long chn_create(long long capacity);
+int chn_put(long long handle, const char* data, long long len);
+int chn_get(long long handle, char** out, long long* len);
 void chn_free(char* p);
-long long chn_size(int64_t h);
-int chn_close(int64_t h);
-int chn_destroy(int64_t h);
+long long chn_size(long long handle);
+int chn_close(long long handle);
+int chn_destroy(long long handle);
 
 #ifdef __cplusplus
 }  /* extern "C" */

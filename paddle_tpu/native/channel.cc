@@ -12,6 +12,8 @@
 #include <mutex>
 #include <vector>
 
+#include "c_api.h"  /* decl/def drift = compile error */
+
 namespace {
 
 struct Blob {

@@ -13,6 +13,8 @@
 #include <cstdlib>
 #include <cstring>
 
+#include "c_api.h"  /* decl/def drift = compile error */
+
 namespace {
 // Skip spaces/tabs only — a token chase must NEVER cross a newline, or a
 // truncated line would silently merge with the next sample (strtod's own

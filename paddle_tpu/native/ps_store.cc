@@ -20,6 +20,8 @@
 #include <random>
 #include <vector>
 
+#include "c_api.h"  /* decl/def drift = compile error */
+
 namespace {
 
 struct Shard {

@@ -21,6 +21,8 @@
 #include <string>
 #include <vector>
 
+#include "c_api.h"  /* decl/def drift = compile error */
+
 namespace {
 
 struct Entry {

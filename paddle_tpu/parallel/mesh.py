@@ -127,7 +127,14 @@ def make_hybrid_mesh(ici_axes, dcn_axes, devices=None):
         grid = mesh_utils.create_hybrid_device_mesh(
             ici_shape, dcn_shape, devices=devices)
         return Mesh(grid, tuple(names))
-    combined = {}
-    for a in names:
-        combined[a] = int(ici_axes.get(a, 1)) * int(dcn_axes.get(a, 1))
-    return make_mesh(combined, devices=devices)
+    # build the Mesh directly in the hybrid `names` order (make_mesh would
+    # re-sort canonically, and axis order must not depend on slice count)
+    sizes = [int(ici_axes.get(a, 1)) * int(dcn_axes.get(a, 1))
+             for a in names]
+    total = int(np.prod(sizes)) if sizes else 1
+    devices = np.asarray(devices).reshape(-1)
+    if total > devices.size:
+        raise ValueError("hybrid mesh %s needs %d devices, only %d available"
+                         % (dict(zip(names, sizes)), total, devices.size))
+    return Mesh(devices[:total].reshape(sizes if sizes else (1,)),
+                tuple(names))

@@ -1,0 +1,59 @@
+"""Book-suite e2e models beyond MNIST (reference
+``python/paddle/fluid/tests/book/``): word2vec, sentiment (conv +
+stacked-LSTM), VGG16. Each trains on synthetic separable data and must
+reduce its loss."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.models import sentiment, vgg, word2vec
+
+
+def _train(main, startup, loss, feeder, steps, fetch=None):
+    exe = fluid.Executor()
+    losses = []
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for i in range(steps):
+            out = exe.run(main, feed=feeder(i),
+                          fetch_list=[loss] + list(fetch or []))
+            losses.append(float(np.asarray(out[0]).ravel()[0]))
+    return losses
+
+
+def test_word2vec_learns_ngram_language():
+    rng = np.random.RandomState(0)
+    main, startup, loss, _ = word2vec.build_train_program(vocab_size=32,
+                                                          lr=5e-3)
+    batches = [word2vec.synthetic_ngrams(rng, 64, 32) for _ in range(8)]
+    losses = _train(main, startup, loss, lambda i: batches[i % 8], 60)
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+
+def test_sentiment_conv_net_separates():
+    rng = np.random.RandomState(1)
+    main, startup, loss, acc = sentiment.build_train_program(net="conv",
+                                                             input_dim=64)
+    batches = [sentiment.synthetic_reviews(rng, 32, 64) for _ in range(6)]
+    losses = _train(main, startup, loss, lambda i: batches[i % 6], 36)
+    assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
+
+
+def test_sentiment_stacked_lstm_runs_and_learns():
+    rng = np.random.RandomState(2)
+    main, startup, loss, acc = sentiment.build_train_program(net="lstm",
+                                                             input_dim=64)
+    batches = [sentiment.synthetic_reviews(rng, 16, 64) for _ in range(4)]
+    losses = _train(main, startup, loss, lambda i: batches[i % 4], 24)
+    assert losses[-1] < losses[0] * 0.85, (losses[0], losses[-1])
+
+
+def test_vgg16_smoke_trains():
+    rng = np.random.RandomState(3)
+    main, startup, loss, acc = vgg.build_train_program(width_mult=0.125,
+                                                       lr=2e-3)
+    batches = [vgg.synthetic_cifar(rng, 16) for _ in range(3)]
+    losses = _train(main, startup, loss, lambda i: batches[i % 3], 9)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 1.05, (losses[0], losses[-1])
