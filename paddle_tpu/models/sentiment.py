@@ -34,10 +34,11 @@ def stacked_lstm_net(data, label, input_dim, class_dim=2, emb_dim=32,
     fc1 = layers.fc(emb, size=hid_dim)
     lstm1, _ = layers.dynamic_lstm(fc1, size=hid_dim)
     inputs = [fc1, lstm1]
-    for _ in range(2, stacked_num + 1):
+    for i in range(2, stacked_num + 1):
         fc = layers.fc(inputs, size=hid_dim)
+        # direction alternates per depth (reference stacked_lstm_net)
         lstm, _ = layers.dynamic_lstm(fc, size=hid_dim,
-                                      is_reverse=(len(inputs) % 2 == 0))
+                                      is_reverse=(i % 2) == 0)
         inputs = [fc, lstm]
     fc_last = layers.sequence_pool(inputs[0], pool_type="max")
     lstm_last = layers.sequence_pool(inputs[1], pool_type="max")
