@@ -201,6 +201,36 @@ def bench_resnet(batch_size=128, image_size=224, warmup=3, iters=10):
             "resnet50_batch_size": batch_size}
 
 
+def bench_lenet(batch_size=1024, warmup=10, iters=100):
+    """BASELINE config 1 (MNIST LeNet images/sec/chip, the first e2e
+    milestone); opt-in via BENCH_LENET=1. Steps are host-overhead bound
+    (~10 ms), so the windows are long to ride out tunnel jitter; note the
+    first-step XLA conv compile can take minutes on a tunneled chip."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import lenet
+
+    import jax
+
+    main, startup, loss, acc = lenet.build_train_program()
+    exe = fluid.Executor()
+    rng = np.random.RandomState(0)
+    feed = {"img": jax.device_put(
+                rng.rand(batch_size, 1, 28, 28).astype("float32")),
+            "label": jax.device_put(
+                rng.randint(0, 10, (batch_size, 1)).astype("int64"))}
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for _ in range(warmup):
+            (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
+            assert np.isfinite(np.asarray(lv)).all()
+        ips, _, step_s = _stable_throughput(
+            exe, main, feed, loss, iters, jax, batch_size,
+            "lenet images/sec")
+    return {"lenet_images_per_sec": round(ips, 1),
+            "lenet_step_time_ms": round(step_s * 1e3, 3),
+            "lenet_batch_size": batch_size}
+
+
 def bench_deepfm(batch_size=4096, warmup=8, iters=40):
     """BASELINE config 4 (DeepFM CTR examples/sec/chip); opt-in via
     BENCH_DEEPFM=1. Embedding-gather dominated — the number that matters
@@ -318,6 +348,8 @@ if __name__ == "__main__":
         "vs_baseline": None,
     }
     out.update(r)
+    if os.environ.get("BENCH_LENET") == "1":
+        out.update(bench_lenet())
     if os.environ.get("BENCH_RESNET") == "1":
         out.update(bench_resnet())
     if os.environ.get("BENCH_DEEPFM") == "1":

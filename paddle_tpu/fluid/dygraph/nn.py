@@ -485,12 +485,69 @@ class GRUUnit(Layer):
 
 
 class NCE(Layer):
-    def __init__(self, *args, **kwargs):
-        raise NotImplementedError(
-            "NCE requires dynamic negative sampling; planned with the sparse "
-            "subsystem (parallel/sparse.py)")
+    """Eager noise-contrastive estimation head (reference dygraph NCE)
+    over the static ``nce`` op — uniform negative sampling from the
+    tracer's threaded PRNG (``ops/structured_loss_ops.py``)."""
+
+    def __init__(self, name_scope=None, num_total_classes=None, dim=None,
+                 sample_weight=None, param_attr=None, bias_attr=None,
+                 num_neg_samples=10, sampler="uniform", custom_dist=None,
+                 seed=0, is_sparse=False, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        if sampler != "uniform" or custom_dist is not None:
+            raise NotImplementedError(
+                "dygraph NCE supports sampler='uniform' only")
+        if is_sparse:
+            raise NotImplementedError(
+                "dygraph NCE is_sparse is not supported; use the static "
+                "path with a distributed embedding for sparse updates")
+        self._num_total_classes = int(num_total_classes)
+        self._num_neg = int(num_neg_samples)
+        self.weight = self.create_parameter([num_total_classes, dim],
+                                            param_attr, dtype)
+        self.bias = self.create_parameter([num_total_classes], bias_attr,
+                                          dtype, is_bias=True)
+
+    def forward(self, input, label, sample_weight=None):
+        if sample_weight is not None:
+            raise NotImplementedError("NCE sample_weight is not supported")
+        t = _tracer()
+        ins = {"Input": [input], "Label": [label], "Weight": [self.weight]}
+        if self.bias is not None:
+            ins["Bias"] = [self.bias]
+        cost, _logits, _labels = t.trace_op(
+            "nce", ins, ["Cost", "SampleLogits", "SampleLabels"],
+            {"num_total_classes": self._num_total_classes,
+             "num_neg_samples": self._num_neg})
+        return cost
 
 
 class TreeConv(Layer):
-    def __init__(self, *args, **kwargs):
-        raise NotImplementedError("TreeConv planned with detection/graph ops")
+    """Eager tree-based convolution (reference dygraph TreeConv) over the
+    ``tree_conv`` op (``ops/misc_ops.py`` — TBCNN as masked matmuls)."""
+
+    def __init__(self, name_scope=None, feature_size=None, output_size=None,
+                 num_filters=1, max_depth=2, act="tanh", param_attr=None,
+                 bias_attr=None, name=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._max_depth = int(max_depth)
+        self._act = act
+        self.weight = self.create_parameter(
+            [feature_size, 3, output_size, num_filters], param_attr, dtype)
+        self.bias = self.create_parameter([num_filters], bias_attr, dtype,
+                                          is_bias=True)
+
+    def forward(self, nodes_vector, edge_set):
+        t = _tracer()
+        (out,) = t.trace_op(
+            "tree_conv",
+            {"NodesVector": [nodes_vector], "EdgeSet": [edge_set],
+             "Filter": [self.weight]},
+            ["Out"], {"max_depth": self._max_depth})
+        if self.bias is not None:
+            (out,) = t.trace_op("elementwise_add",
+                                {"X": [out], "Y": [self.bias]}, ["Out"],
+                                {"axis": -1})
+        if self._act:
+            (out,) = t.trace_op(self._act, {"X": [out]}, ["Out"], {})
+        return out

@@ -15,7 +15,7 @@ __all__ = [
     "scatter_nd", "crop_tensor", "fsp_matrix", "similarity_focus",
     "prroi_pool", "deformable_conv", "deformable_roi_pooling",
     "filter_by_instag", "reorder_lod_tensor_by_rank", "IfElse",
-    "DynamicRNN",
+    "DynamicRNN", "tree_conv",
 ]
 
 
@@ -240,3 +240,29 @@ class DynamicRNN:
             "DynamicRNN's imperative block doesn't trace under XLA; use "
             "layers.rnn(cell, inputs, sequence_length=...) or "
             "dynamic_lstm/dynamic_gru over bounded-LoD input")
+
+
+def tree_conv(nodes_vector, edge_set, output_size, num_filters=1,
+              max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+              name=None):
+    """Tree-based convolution over (features, edges) trees (reference
+    ``contrib/layers/nn.py`` tree_conv + ``tree_conv_op.cc``). Returns
+    ``[batch, nodes, output_size, num_filters]`` after bias and act."""
+    helper = LayerHelper("tree_conv", **locals())
+    feature_size = int(nodes_vector.shape[-1])
+    w = helper.create_parameter(
+        attr=param_attr, shape=[feature_size, 3, output_size, num_filters],
+        dtype=nodes_vector.dtype)
+    out = helper.create_variable_for_type_inference(nodes_vector.dtype)
+    helper.append_op(
+        type="tree_conv",
+        inputs={"NodesVector": [nodes_vector], "EdgeSet": [edge_set],
+                "Filter": [w]},
+        outputs={"Out": [out]},
+        attrs={"max_depth": int(max_depth)})
+    if bias_attr is not False:  # repo convention: only False disables bias
+        bias = helper.create_parameter(attr=bias_attr, shape=[num_filters],
+                                       dtype=nodes_vector.dtype,
+                                       is_bias=True)
+        out = nn.elementwise_add(out, bias, axis=-1)
+    return helper.append_activation(out, act)

@@ -1,0 +1,131 @@
+"""Per-module smoke tests for every dygraph nn module (reference
+``dygraph/nn.py`` 16-module surface) — shape + finiteness, plus grads
+through one representative."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import dygraph
+from paddle_tpu.fluid.dygraph import nn, to_variable
+
+
+def _rand(*shape):
+    return to_variable(np.random.RandomState(0).rand(*shape)
+                       .astype(np.float32))
+
+
+def test_conv3d_module():
+    with dygraph.guard():
+        m = nn.Conv3D(num_channels=2, num_filters=3, filter_size=2)
+        out = m(_rand(1, 2, 5, 5, 5))
+        assert tuple(out.numpy().shape) == (1, 3, 4, 4, 4)
+
+
+def test_pool2d_module_avg():
+    with dygraph.guard():
+        m = nn.Pool2D(pool_size=2, pool_stride=2, pool_type="avg")
+        out = m(_rand(2, 3, 8, 8))
+        assert tuple(out.numpy().shape) == (2, 3, 4, 4)
+
+
+def test_batch_norm_module_updates_stats():
+    with dygraph.guard():
+        m = nn.BatchNorm(num_channels=4)
+        x = _rand(8, 4, 3, 3)
+        out = m(x)
+        assert tuple(out.numpy().shape) == (8, 4, 3, 3)
+        assert np.isfinite(out.numpy()).all()
+
+
+def test_layer_norm_module():
+    with dygraph.guard():
+        m = nn.LayerNorm(normalized_shape=6)
+        out = m(_rand(4, 6))
+        np.testing.assert_allclose(out.numpy().mean(axis=-1), 0.0,
+                                   atol=1e-5)
+
+
+def test_group_norm_module():
+    with dygraph.guard():
+        m = nn.GroupNorm(channels=4, groups=2)
+        out = m(_rand(2, 4, 3, 3))
+        assert np.isfinite(out.numpy()).all()
+
+
+def test_prelu_module_modes():
+    with dygraph.guard():
+        neg = to_variable(-np.ones((2, 3), np.float32))
+        out = nn.PRelu(mode="all")(neg)
+        np.testing.assert_allclose(out.numpy(), -0.25)
+        out = nn.PRelu(mode="channel", channel=3)(neg)
+        np.testing.assert_allclose(out.numpy(), -0.25)
+
+
+def test_bilinear_tensor_product_module():
+    with dygraph.guard():
+        m = nn.BilinearTensorProduct(input1_dim=3, input2_dim=4,
+                                     output_dim=5)
+        out = m(_rand(2, 3), _rand(2, 4))
+        assert tuple(out.numpy().shape) == (2, 5)
+
+
+def test_embedding_module():
+    with dygraph.guard():
+        m = nn.Embedding(size=[10, 4])
+        ids = to_variable(np.array([[1], [3]], np.int64))
+        out = m(ids)
+        assert out.numpy().reshape(2, 4).shape == (2, 4)
+
+
+def test_gru_unit_module_steps():
+    with dygraph.guard():
+        H = 4
+        m = nn.GRUUnit(size=3 * H)
+        x = _rand(2, 3 * H)
+        h = _rand(2, H)
+        out = m(x, h)
+        hidden = out[0] if isinstance(out, (list, tuple)) else out
+        assert tuple(hidden.numpy().shape) == (2, H)
+
+
+def test_spectral_norm_module_normalizes():
+    with dygraph.guard():
+        w = _rand(6, 4)
+        m = nn.SpectralNorm(weight_shape=[6, 4], power_iters=20)
+        wn = m(w).numpy()
+        # largest singular value ~ 1 after normalization
+        s = np.linalg.svd(wn, compute_uv=False)[0]
+        assert abs(s - 1.0) < 0.1, s
+
+
+def test_dropout_module_train_eval():
+    with dygraph.guard():
+        x = to_variable(np.ones((64, 64), np.float32))
+        m = nn.Dropout(p=0.5, dropout_implementation="upscale_in_train")
+        train_out = m(x).numpy()
+        assert (train_out == 0).any()
+        m.eval()
+        np.testing.assert_allclose(m(x).numpy(), 1.0)  # upscale: eval = x
+        m2 = nn.Dropout(p=0.5)  # downgrade_in_infer: eval = x * keep
+        m2.eval()
+        np.testing.assert_allclose(m2(x).numpy(), 0.5)
+
+
+def test_conv2d_transpose_grads_flow():
+    with dygraph.guard():
+        from paddle_tpu.fluid import optimizer
+
+        m = nn.Conv2DTranspose(num_channels=2, num_filters=2, filter_size=2,
+                               stride=2)
+        opt = optimizer.SGD(learning_rate=0.1)
+        x = _rand(1, 2, 4, 4)
+        losses = []
+        for _ in range(5):
+            out = m(x)
+            sq = out * out
+            tracer = fluid.framework._dygraph_tracer()
+            (loss,) = tracer.trace_op("mean", {"X": [sq]}, ["Out"], {})
+            m.clear_gradients()
+            opt.minimize(loss, parameter_list=m.parameters())
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]

@@ -1,0 +1,118 @@
+"""tree_conv op/layer/dygraph module (reference tree_conv_op.cc +
+math/tree2col.cc) and the dygraph NCE module."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import dygraph, layers, optimizer
+from paddle_tpu.fluid.dygraph import nn, to_variable
+
+# tree: 1 -> (2, 3), 2 -> (4, 5); padding row
+EDGES = np.array([[[1, 2], [1, 3], [2, 4], [2, 5], [0, 0]]], np.int32)
+CHILDREN = {1: [2, 3], 2: [4, 5], 3: [], 4: [], 5: []}
+
+
+def _np_tree_conv(feat, filt, max_depth):
+    """DFS reference implementing the tree2col patch semantics."""
+    B, N, F = feat.shape
+    _, _, K, NF = filt.shape
+    out = np.zeros((B, N, K, NF), np.float64)
+    for b in range(B):
+        for u in range(1, N + 1):
+            items = [(u, 1, 1, 0)]
+            frontier = [(u, 0)]
+            seen = {u}
+            while frontier:
+                node, depth = frontier.pop(0)
+                for i, ch in enumerate(CHILDREN.get(node, [])):
+                    if ch not in seen and depth + 1 < max_depth:
+                        seen.add(ch)
+                        items.append((ch, i + 1, len(CHILDREN[node]),
+                                      depth + 1))
+                        frontier.append((ch, depth + 1))
+            pt = np.zeros(F)
+            pl = np.zeros(F)
+            pr = np.zeros(F)
+            for (v, idx, pclen, depth) in items:
+                et = (max_depth - depth) / max_depth
+                fr = 0.5 if pclen == 1 else (idx - 1) / (pclen - 1)
+                f = feat[b, v - 1]
+                pt += et * f
+                pl += (1 - et) * fr * f
+                pr += (1 - et) * (1 - fr) * f
+            out[b, u - 1] = (np.einsum("f,fko->ko", pt, filt[:, 0]) +
+                             np.einsum("f,fko->ko", pl, filt[:, 1]) +
+                             np.einsum("f,fko->ko", pr, filt[:, 2]))
+    return out.astype(np.float32)
+
+
+def test_tree_conv_matches_dfs_reference():
+    rng = np.random.RandomState(0)
+    N, F, K, NF, D = 5, 3, 2, 2, 2
+    feat = rng.randn(1, N, F).astype(np.float32)
+    filt = rng.randn(F, 3, K, NF).astype(np.float32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        nv = layers.data("tc_nv", [N, F], dtype="float32")
+        es = layers.data("tc_es", [5, 2], dtype="int32")
+        out = layers.tree_conv(nv, es, output_size=K, num_filters=NF,
+                               max_depth=D, act=None,
+                               param_attr=fluid.ParamAttr(name="tc_w"))
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.global_scope().set_var("tc_w", filt)
+        o, = exe.run(main, feed={"tc_nv": feat, "tc_es": EDGES},
+                     fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(o), _np_tree_conv(feat, filt, D),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_tree_conv_deeper_depth():
+    rng = np.random.RandomState(1)
+    N, F, D = 5, 2, 3
+    feat = rng.randn(1, N, F).astype(np.float32)
+    filt = rng.randn(F, 3, 1, 1).astype(np.float32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        nv = layers.data("tc2_nv", [N, F], dtype="float32")
+        es = layers.data("tc2_es", [5, 2], dtype="int32")
+        out = layers.tree_conv(nv, es, output_size=1, num_filters=1,
+                               max_depth=D, act=None,
+                               param_attr=fluid.ParamAttr(name="tc2_w"))
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.global_scope().set_var("tc2_w", filt)
+        o, = exe.run(main, feed={"tc2_nv": feat, "tc2_es": EDGES},
+                     fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(o), _np_tree_conv(feat, filt, D),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_dygraph_tree_conv_module():
+    with dygraph.guard():
+        m = nn.TreeConv(feature_size=3, output_size=2, num_filters=2,
+                        max_depth=2)
+        feat = to_variable(np.random.rand(1, 5, 3).astype(np.float32))
+        out = m(feat, to_variable(EDGES))
+        assert tuple(out.numpy().shape) == (1, 5, 2, 2)
+        assert np.isfinite(out.numpy()).all()
+
+
+def test_dygraph_nce_module_trains():
+    rng = np.random.RandomState(0)
+    with dygraph.guard():
+        m = nn.NCE(num_total_classes=32, dim=8, num_neg_samples=4)
+        opt = optimizer.SGD(learning_rate=0.1)
+        costs = []
+        x = rng.rand(16, 8).astype(np.float32)
+        y = rng.randint(0, 32, (16, 1)).astype(np.int64)
+        for _ in range(20):
+            cost = m(to_variable(x), to_variable(y))
+            tracer = fluid.framework._dygraph_tracer()
+            (loss,) = tracer.trace_op("mean", {"X": [cost]}, ["Out"], {})
+            m.clear_gradients()
+            opt.minimize(loss, parameter_list=m.parameters())
+            costs.append(float(loss.numpy()))
+        assert costs[-1] < costs[0], costs
