@@ -501,6 +501,12 @@ class NCE(Layer):
             raise NotImplementedError(
                 "dygraph NCE is_sparse is not supported; use the static "
                 "path with a distributed embedding for sparse updates")
+        if seed:
+            raise NotImplementedError(
+                "dygraph NCE seed is not supported; negatives draw from "
+                "the tracer's threaded PRNG (set the scope seed instead)")
+        if sample_weight is not None:
+            raise NotImplementedError("NCE sample_weight is not supported")
         self._num_total_classes = int(num_total_classes)
         self._num_neg = int(num_neg_samples)
         self.weight = self.create_parameter([num_total_classes, dim],

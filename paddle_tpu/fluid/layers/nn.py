@@ -1467,7 +1467,15 @@ def crf_decoding(input, param_attr, label=None, length=None):
     created by linear_chain_crf."""
     helper = LayerHelper("crf_decoding", **locals())
     name = param_attr.name if hasattr(param_attr, "name") else str(param_attr)
-    trans = helper.main_program.global_block().var(name)
+    block = helper.main_program.global_block()
+    if block._find_var_recursive(name) is not None:
+        trans = block.var(name)
+    else:
+        # standalone decode program: declare the named transition param so
+        # it resolves from scope (trained by linear_chain_crf elsewhere)
+        num_tags = int(input.shape[-1])
+        trans = helper.create_parameter(param_attr,
+                                        [num_tags + 2, num_tags], "float32")
     path = helper.create_variable_for_type_inference("int64")
     path.shape = (-1, 1)
     path.lod_level = 1

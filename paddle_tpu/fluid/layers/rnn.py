@@ -237,25 +237,49 @@ class GRUCell(RNNCell):
 
     def __init__(self, hidden_size, param_attr=None, bias_attr=None,
                  gate_activation="sigmoid", activation="tanh",
-                 origin_mode=False, name="GRUCell"):
+                 origin_mode=False, name=None):
         self.hidden_size = hidden_size
         self._param_attr = param_attr
         self._bias_attr = bias_attr
         self._gate_act = gate_activation
         self._act = activation
         self._origin = origin_mode
+        self._name = name
         self._wx = self._wh = self._b = None
+
+    def _named(self, attr, suffix):
+        """An explicit cell name pins the param names, so a separately
+        built program (e.g. a beam-decode graph) resolves the SAME
+        persistables from scope as the training graph. A caller attr
+        without a name gets the pinned name filled in (an attr WITH a
+        name wins)."""
+        if self._name is None:
+            return attr
+        from ..param_attr import ParamAttr
+
+        pinned = "%s.%s" % (self._name, suffix)
+        if attr is None:
+            return ParamAttr(name=pinned)
+        attr = ParamAttr._to_attr(attr)
+        if getattr(attr, "name", None) is None:
+            import copy
+
+            attr = copy.copy(attr)  # don't mutate a caller-shared attr
+            attr.name = pinned
+        return attr
 
     def _ensure_params(self, in_dim):
         if self._wx is not None:
             return
         helper = LayerHelper("gru_cell")
         H = self.hidden_size
-        self._wx = helper.create_parameter(self._param_attr,
-                                           [in_dim, 3 * H], "float32")
-        self._wh = helper.create_parameter(None, [H, 3 * H], "float32")
-        self._b = helper.create_parameter(self._bias_attr, [1, 3 * H],
-                                          "float32", is_bias=True)
+        self._wx = helper.create_parameter(
+            self._named(self._param_attr, "wx"), [in_dim, 3 * H], "float32")
+        self._wh = helper.create_parameter(self._named(None, "wh"),
+                                           [H, 3 * H], "float32")
+        self._b = helper.create_parameter(
+            self._named(self._bias_attr, "b"), [1, 3 * H], "float32",
+            is_bias=True)
 
     def call(self, inputs, states):
         self._ensure_params(int(inputs.shape[-1]))
@@ -291,22 +315,27 @@ class LSTMCell(RNNCell):
 
     def __init__(self, hidden_size, param_attr=None, bias_attr=None,
                  gate_activation="sigmoid", activation="tanh",
-                 forget_bias=1.0, name="LSTMCell"):
+                 forget_bias=1.0, name=None):
         self.hidden_size = hidden_size
         self._param_attr = param_attr
         self._bias_attr = bias_attr
         self._forget_bias = forget_bias
+        self._name = name
         self._w = self._b = None
+
+    _named = GRUCell._named
 
     def _ensure_params(self, in_dim):
         if self._w is not None:
             return
         helper = LayerHelper("lstm_cell")
         H = self.hidden_size
-        self._w = helper.create_parameter(self._param_attr,
-                                          [in_dim + H, 4 * H], "float32")
-        self._b = helper.create_parameter(self._bias_attr, [1, 4 * H],
-                                          "float32", is_bias=True)
+        self._w = helper.create_parameter(
+            self._named(self._param_attr, "w"), [in_dim + H, 4 * H],
+            "float32")
+        self._b = helper.create_parameter(
+            self._named(self._bias_attr, "b"), [1, 4 * H], "float32",
+            is_bias=True)
 
     def call(self, inputs, states):
         h, c = states

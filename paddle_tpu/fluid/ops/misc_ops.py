@@ -228,8 +228,8 @@ def _tree_conv(ctx, op):
     nodes = ctx.get_input(op, "NodesVector")   # [B, N, F]
     edges = ctx.get_input(op, "EdgeSet")       # [B, E, 2]
     filt = ctx.get_input(op, "Filter")         # [F, 3, K, NumF]
-    D = float(op.attr("max_depth", 2))
     max_depth = int(op.attr("max_depth", 2))
+    D = float(max_depth)
     N = nodes.shape[1]
 
     def one(feat, edge):

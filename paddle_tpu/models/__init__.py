@@ -11,6 +11,8 @@ from . import (  # noqa: F401
     lenet,
     resnet,
     sentiment,
+    seq2seq,
+    tagger,
     transformer,
     vgg,
     word2vec,

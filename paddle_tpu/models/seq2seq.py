@@ -1,0 +1,102 @@
+"""Encoder-decoder NMT with beam-search inference (reference book chapter:
+``python/paddle/fluid/tests/book/test_machine_translation.py`` — GRU
+seq2seq trained with teacher forcing, decoded with beam search).
+
+TPU framing: fixed-length padded sequences (static shapes), the unrolled
+``layers.rnn`` over a shared-parameter GRUCell, and the BeamSearchDecoder /
+``dynamic_decode`` machinery for inference."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers, optimizer
+
+__all__ = ["build_train_program", "build_infer_program", "synthetic_pairs"]
+
+
+def _encoder(src, vocab_size, emb_dim, hidden):
+    emb = layers.embedding(
+        src, size=[vocab_size, emb_dim],
+        param_attr=fluid.ParamAttr(name="s2s_src_emb"))
+    cell = layers.GRUCell(hidden_size=hidden, name="s2s_enc")
+    outs, final = layers.rnn(cell, emb)
+    return final
+
+
+def _decoder_cell(hidden):
+    return layers.GRUCell(hidden_size=hidden, name="s2s_dec")
+
+
+def _tgt_embedding(vocab_size, emb_dim):
+    def embed(ids):
+        return layers.embedding(
+            ids, size=[vocab_size, emb_dim],
+            param_attr=fluid.ParamAttr(name="s2s_tgt_emb"))
+    return embed
+
+
+def _output_fn(vocab_size):
+    def out(h):
+        return layers.fc(h, size=vocab_size,
+                         param_attr=fluid.ParamAttr(name="s2s_proj_w"),
+                         bias_attr=fluid.ParamAttr(name="s2s_proj_b"))
+    return out
+
+
+def build_train_program(src_vocab=32, tgt_vocab=32, emb_dim=16, hidden=32,
+                        src_len=6, tgt_len=6, lr=5e-3, seed=9):
+    """Teacher forcing: decoder consumes <go>+target[:-1], predicts
+    target."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    with fluid.program_guard(main, startup):
+        src = layers.data("s2s_src", [src_len], dtype="int64")
+        tgt_in = layers.data("s2s_tgt_in", [tgt_len], dtype="int64")
+        tgt_out = layers.data("s2s_tgt_out", [tgt_len, 1], dtype="int64")
+        enc_final = _encoder(src, src_vocab, emb_dim, hidden)
+        dec_cell = _decoder_cell(hidden)
+        dec_emb = _tgt_embedding(tgt_vocab, emb_dim)(tgt_in)
+        dec_outs, _ = layers.rnn(dec_cell, dec_emb,
+                                 initial_states=enc_final)
+        # flatten timesteps so the shared 2-D output projection applies
+        flat = layers.reshape(dec_outs, [-1, hidden])
+        logits = _output_fn(tgt_vocab)(flat)
+        loss = layers.mean(layers.softmax_with_cross_entropy(
+            logits, layers.reshape(tgt_out, [-1, 1])))
+        optimizer.Adam(learning_rate=lr).minimize(loss)
+    return main, startup, loss
+
+
+def build_infer_program(src_vocab=32, tgt_vocab=32, emb_dim=16, hidden=32,
+                        src_len=6, max_tgt_len=6, beam_size=4, go_id=0,
+                        end_id=1, seed=9):
+    """Beam-search decode sharing the training parameter names."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    with fluid.program_guard(main, startup):
+        src = layers.data("s2s_src", [src_len], dtype="int64")
+        enc_final = _encoder(src, src_vocab, emb_dim, hidden)
+        dec_cell = _decoder_cell(hidden)
+        decoder = layers.BeamSearchDecoder(
+            dec_cell, start_token=go_id, end_token=end_id,
+            beam_size=beam_size,
+            embedding_fn=_tgt_embedding(tgt_vocab, emb_dim),
+            output_fn=_output_fn(tgt_vocab))
+        # decode FROM the encoder's final state (get_initial_states would
+        # start from zeros — the classic silent seq2seq bug)
+        final, _ = layers.dynamic_decode(decoder, inits=enc_final,
+                                         max_step_num=max_tgt_len)
+    return main, startup, final["sequences"]
+
+
+def synthetic_pairs(rng, n, vocab=32, src_len=6, go_id=0, end_id=1):
+    """Echo task over tokens >= 2 (0 = <go>, 1 = <end>): the target repeats
+    the LAST source token then closes with <end> — a deterministic
+    language the encoder's final state can carry exactly."""
+    src = rng.randint(2, vocab, (n, src_len)).astype(np.int64)
+    tgt = np.tile(src[:, -1:], (1, src_len))
+    tgt[:, -1] = end_id
+    tgt_in = np.concatenate([np.full((n, 1), go_id, np.int64),
+                             tgt[:, :-1]], axis=1)
+    return {"s2s_src": src, "s2s_tgt_in": tgt_in,
+            "s2s_tgt_out": tgt[:, :, None]}

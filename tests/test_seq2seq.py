@@ -1,0 +1,64 @@
+"""Seq2seq NMT book model: teacher-forced training then beam-search decode
+in the SAME scope (shared parameter names) — the reference
+test_machine_translation flow end to end."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.models import seq2seq
+
+
+def test_seq2seq_trains_and_beam_decodes_echo():
+    rng = np.random.RandomState(0)
+    V, L = 16, 5
+    main, startup, loss = seq2seq.build_train_program(
+        src_vocab=V, tgt_vocab=V, src_len=L, tgt_len=L, lr=1e-2)
+    infer, infer_startup, seqs = seq2seq.build_infer_program(
+        src_vocab=V, tgt_vocab=V, src_len=L, max_tgt_len=L, beam_size=3)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(150):
+            feed = seq2seq.synthetic_pairs(rng, 32, V, L)
+            (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(lv).ravel()[0]))
+        assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])
+
+        # infer program resolves the SAME persistable params from scope
+        feed = seq2seq.synthetic_pairs(rng, 4, V, L)
+        (sv,) = exe.run(infer, feed={"s2s_src": feed["s2s_src"]},
+                        fetch_list=[seqs])
+        sv = np.asarray(sv)  # [T, B*beam]
+        assert sv.shape[1] == 4 * 3
+        # top beam of each example echoes the last source token
+        want = feed["s2s_src"][:, -1]
+        got_first_step = sv[0].reshape(4, 3)[:, 0]
+        assert (got_first_step == want).mean() >= 0.75, (got_first_step,
+                                                         want)
+
+
+def test_crf_tagger_trains_and_decodes():
+    from paddle_tpu.models import tagger
+
+    rng = np.random.RandomState(2)
+    main, startup, loss = tagger.build_train_program(vocab=32, num_tags=4)
+    dec, _, path = tagger.build_decode_program(vocab=32, num_tags=4)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(120):
+            feed, _l = tagger.synthetic_tagging(rng, 16, 32, 4)
+            (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(lv).ravel()[0]))
+        assert losses[-1] < losses[0] * 0.3, (losses[0], losses[-1])
+
+        feed, lens = tagger.synthetic_tagging(rng, 8, 32, 4)
+        (pv,) = exe.run(dec, feed={"tg_words": feed["tg_words"]},
+                        fetch_list=[path])
+        pv = np.asarray(pv).ravel()
+        want = np.asarray(feed["tg_tags"]._data).ravel()
+        n = sum(lens)
+        acc = (pv[:n] == want[:n]).mean()
+        assert acc > 0.8, acc
