@@ -19,8 +19,23 @@ __all__ = ["CompiledProgram", "BuildStrategy", "ExecutionStrategy"]
 
 
 class BuildStrategy:
-    """Reference ``details/build_strategy.h:58``. Most knobs are XLA's job
-    now; kept ones change sharding/fusion behavior."""
+    """Reference ``details/build_strategy.h:58``. Knob fates on TPU:
+
+    - ``enable_inplace`` — HONORED: toggles XLA buffer donation of the
+      state pytree in every compiled step (off = keep old buffers live).
+    - ``sync_batch_norm`` — inherent under GSPMD: a batch sharded over
+      'dp' computes batch-norm statistics over the GLOBAL batch (XLA
+      reduces across the sharded axis), which is exactly sync-BN; the
+      flag is accepted for parity and not consulted.
+    - ``fuse_all_reduce_ops`` / ``fuse_elewise_add_act_ops`` /
+      ``fuse_all_optimizer_ops`` / ``memory_optimize`` — delegated to
+      XLA's fusion/scheduling; accepted, not consulted.
+    - ``reduce_strategy``/``gradient_scale_strategy`` — the GSPMD mean
+      semantics make per-device grad scaling moot (loss is a global
+      mean); accepted, not consulted.
+    - ``num_trainers``/``trainer_id`` — multi-process identity comes from
+      ``paddle_tpu.distributed`` env bootstrap instead.
+    """
 
     class ReduceStrategy:
         AllReduce = 0
@@ -354,7 +369,8 @@ class CompiledProgram:
             in_specs=(P(), P(), P(), P(), P()),
             out_specs=(P(), P(), P(), P()),
             check_vma=False)
-        jfn = jax.jit(smapped, donate_argnums=(0, 1))
+        donate = (0, 1) if self._build_strategy.enable_inplace else ()
+        jfn = jax.jit(smapped, donate_argnums=donate)
 
         def fn(state, feed_vals, rng):
             params = {n: state[n] for n in state if n in wrt}
@@ -417,7 +433,8 @@ class CompiledProgram:
             out_specs=([P() for _ in fetch_names], {n: P() for n in state_names}, P()),
             check_vma=False,
         )
-        jfn = jax.jit(smapped, donate_argnums=(0,))
+        donate = (0,) if self._build_strategy.enable_inplace else ()
+        jfn = jax.jit(smapped, donate_argnums=donate)
         feed_shardings = {n: NamedSharding(mesh, feed_specs[n]) for n in feed}
 
         def fn(state, feed_vals, rng):
@@ -473,11 +490,12 @@ class CompiledProgram:
             repl,
         )
         out_shardings = ([repl for _ in fetch_names], None, repl)
+        donate = (0,) if self._build_strategy.enable_inplace else ()
         jfn = jax.jit(
             step,
             in_shardings=in_shardings,
             out_shardings=out_shardings,
-            donate_argnums=(0,),
+            donate_argnums=donate,
         )
 
         def fn(state, feed_vals, rng):

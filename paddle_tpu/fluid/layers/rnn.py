@@ -261,6 +261,8 @@ class GRUCell(RNNCell):
         if attr is None:
             return ParamAttr(name=pinned)
         attr = ParamAttr._to_attr(attr)
+        if attr is False:  # bias_attr=False = no param; pass through
+            return attr
         if getattr(attr, "name", None) is None:
             import copy
 
