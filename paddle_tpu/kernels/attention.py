@@ -71,6 +71,73 @@ def _ref_attention(q, k, v, bias, scale, p_drop, seed):
         q.dtype)
 
 
+def _blockwise_attention(q, k, v, bias, scale, p_drop, seed):
+    """Online-softmax attention over K/V blocks (the single-device form of
+    the ring-attention fold, ``parallel/attention.py``): the [S, S] score
+    matrix never exists — per block, scores are folded into (max, denom,
+    weighted-sum) carries. Used past the Pallas kernel's VMEM bound.
+    The scan step is rematerialized, so backward memory is the per-step
+    carries: O(nb · S · d) = O(S²·d/block) — block/d× below the score
+    matrix (a flash-style custom vjp would tighten this to O(S·d)).
+
+    Dropout semantics match the one-pass form: the softmax DENOMINATOR
+    uses undropped weights; only the value accumulation is masked —
+    identical to dropping normalized probabilities."""
+    B, H, S, d = q.shape
+    block = min(512, S)
+    pad = (-S) % block  # prime/odd S: pad keys, mask pads, full-size blocks
+    Sk = S + pad
+    nb = Sk // block
+    qf = q.astype(jnp.float32)
+    bias_f = jnp.broadcast_to(bias.astype(jnp.float32),
+                              (B, bias.shape[1], bias.shape[2], S))
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        bias_f = jnp.pad(bias_f, ((0, 0), (0, 0), (0, 0), (0, pad)),
+                         constant_values=-1e30)
+    kb = jnp.moveaxis(k.reshape(B, H, nb, block, d), 2, 0)
+    vb = jnp.moveaxis(v.reshape(B, H, nb, block, d), 2, 0)
+    bb = jnp.moveaxis(
+        bias_f.reshape(B, bias_f.shape[1], bias_f.shape[2], nb, block),
+        3, 0)
+
+    m0 = jnp.full((B, H, S), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, H, S), jnp.float32)
+    o0 = jnp.zeros((B, H, S, d), jnp.float32)
+
+    def step(carry, xs):
+        m, l, o = carry
+        kblk, vblk, bblk, i = xs
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf,
+                       kblk.astype(jnp.float32)) * scale + bblk
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1)
+        if p_drop > 0.0:
+            key = jax.random.fold_in(
+                jax.random.fold_in(jax.random.PRNGKey(0), seed[0]), i)
+            keep = jax.random.bernoulli(key, 1.0 - p_drop, p.shape)
+            p = jnp.where(keep, p / (1.0 - p_drop), 0.0)
+        o = o * corr[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vblk.astype(jnp.float32))
+        return (m_new, l, o), None
+
+    (m, l, o), _ = jax.lax.scan(
+        jax.checkpoint(step), (m0, l0, o0),
+        (kb, vb, bb, jnp.arange(nb)))
+    return (o / l[..., None]).astype(q.dtype)
+
+
+def _fallback_attention(q, k, v, bias, scale, p_drop, seed):
+    """Off-kernel path: one-pass reference below the VMEM bound, blockwise
+    online softmax above it."""
+    if q.shape[2] > _MAX_FUSED_SEQ:
+        return _blockwise_attention(q, k, v, bias, scale, p_drop, seed)
+    return _ref_attention(q, k, v, bias, scale, p_drop, seed)
+
+
 def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, *,
                 scale, p_drop, n_heads):
     """One grid step = a BLOCK of batches for one head: batched matmuls
@@ -256,7 +323,7 @@ def _use_kernel(q, p_drop):
 def _fused(q, k, v, bias, scale, p_drop, seed):
     if _use_kernel(q, p_drop):
         return _pallas_attention(q, k, v, bias, scale, p_drop, seed)
-    return _ref_attention(q, k, v, bias, scale, p_drop, seed)
+    return _fallback_attention(q, k, v, bias, scale, p_drop, seed)
 
 
 def _fused_fwd(q, k, v, bias, scale, p_drop, seed):
@@ -269,9 +336,11 @@ def _fused_bwd(scale, p_drop, res, do):
         dq, dk, dv, dbias = _pallas_attention_bwd(q, k, v, bias, seed, do,
                                                scale, p_drop)
     else:
-        # recompute-based vjp through the reference path
+        # recompute-based vjp through the fallback path (blockwise past the
+        # VMEM bound keeps the vjp O(S*d) memory via the remat'd scan)
         def f(q_, k_, v_, bias_):
-            return _ref_attention(q_, k_, v_, bias_, scale, p_drop, seed)
+            return _fallback_attention(q_, k_, v_, bias_, scale, p_drop,
+                                       seed)
 
         _, vjp = jax.vjp(f, q, k, v, bias)
         dq, dk, dv, dbias = vjp(do)
