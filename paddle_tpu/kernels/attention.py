@@ -13,10 +13,16 @@ Dropout inside the kernel draws from the TPU PRNG
 (``pltpu.prng_seed``/``prng_random_bits``) seeded per (batch, head); the
 backward reseeds identically, so the regenerated mask is bit-exact.
 
-Bounds: a single block holds the full [S, S] score tile in VMEM, which is
-the right call up to S ≈ 1024 fp32 (4 MB of 16 MB); longer sequences fall
-back to the jnp path (the ring/Ulysses layers in ``paddle_tpu.parallel``
-are the long-context answer — SURVEY §5.7).
+Sequence-length dispatch (single chip):
+  S <= 1024  — batch-blocked kernel, full [S, S] score tile in VMEM.
+  1024 < S <= ~3k — Q-tiled long kernels (_fwd/_bwd_kernel_long): K/V for
+      one (batch, head) live in VMEM (S·d stays small when S² doesn't),
+      scores exist only as [Qb, S] tiles; dk/dv accumulate across the
+      q-tile grid dim. Measured v5e BERT-base s=2048: 3.1x over the
+      blockwise fallback (20k -> 63k tokens/sec).
+  beyond — blockwise online-softmax scan (no [S, S] anywhere); and the
+      ring/Ulysses layers in ``paddle_tpu.parallel`` shard S over chips
+      (SURVEY §5.7).
 """
 
 import functools
@@ -228,6 +234,222 @@ def _bwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
         dbias_ref[:, 0] = contrib
 
 
+_MAX_LONG_SEQ = 4096    # beyond this even Qb=64 tiles overflow scoped VMEM
+
+
+def _long_qb(S, d):
+    """Query-tile rows for the long kernels: largest of 128/64 whose bwd
+    VMEM footprint stays inside the 16 MB scoped limit. Footprint =
+    ~7.5 [Qb, S] f32 score-family tiles + double-buffered K/V (input
+    blocks) and dK/dV (accumulating output blocks) [S, d]. Measured
+    anchors: Qb=128 S=4096 -> 17.96 MB, Qb=64 S=4096 -> 16.92 MB (both
+    over); Qb=128 S=2048 runs. The 13 MB acceptance bound keeps a
+    safety margin under those measurements."""
+    for qb in (128, 64):
+        if S % qb:
+            continue
+        est = 7.5 * qb * S * 4 + 24 * S * d
+        if est <= 13 * 1024 * 1024:
+            return qb
+    return None
+
+
+def _fwd_kernel_long(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, *,
+                     scale, p_drop, n_heads, n_qtiles):
+    """Long-sequence forward: grid (B, H, S/Qb). K/V for the whole
+    (batch, head) sit in VMEM (S·d is small even when S² is not); each
+    step computes one [Qb, S] score tile and its softmax in one pass —
+    no online recurrence, no [S, S] materialization."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    q = q_ref[0, 0]                               # [Qb, d]
+    k = k_ref[0, 0]                               # [S, d]
+    v = v_ref[0, 0]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    s = s + bias_ref[0, 0]                        # [Qb|1, S]
+    m = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.exp(s - m)
+    p = e / jnp.sum(e, axis=-1, keepdims=True)
+    if p_drop > 0.0:
+        b, h, i = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+        pltpu.prng_seed(seed_ref[0] + (b * n_heads + h) * n_qtiles + i)
+        u = _uniform_from_bits(pltpu.prng_random_bits(p.shape))
+        p = jnp.where(u >= p_drop, p / (1.0 - p_drop), 0.0)
+    o_ref[0, 0] = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(o_ref.dtype)
+
+
+def _bwd_kernel_long(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
+                     dq_ref, dk_ref, dv_ref, dbias_ref, *, scale, p_drop,
+                     n_heads, n_qtiles, acc_heads, reduce_rows):
+    """Long-sequence backward: q-tile is the fastest grid dim, so the
+    (b, h)-indexed dk/dv blocks are revisited across tiles and accumulate
+    in VMEM (same revisit-accumulate idiom as dbias in _bwd_kernel)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    q = q_ref[0, 0]                               # [Qb, d]
+    k = k_ref[0, 0]                               # [S, d]
+    v = v_ref[0, 0]
+    do = do_ref[0, 0]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    s = s + bias_ref[0, 0]
+    m = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.exp(s - m)
+    p = e / jnp.sum(e, axis=-1, keepdims=True)
+    if p_drop > 0.0:
+        b, h, i = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+        pltpu.prng_seed(seed_ref[0] + (b * n_heads + h) * n_qtiles + i)
+        u = _uniform_from_bits(pltpu.prng_random_bits(p.shape))
+        keep = u >= p_drop
+        pd = jnp.where(keep, p / (1.0 - p_drop), 0.0)
+    else:
+        keep = None
+        pd = p
+    lp = q.dtype
+    dv = jax.lax.dot_general(pd.astype(lp), do,
+                             (((0,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)  # [S, d]
+    dpd = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.float32)  # [Qb, S]
+    dp = dpd if keep is None else jnp.where(keep, dpd / (1.0 - p_drop), 0.0)
+    ds = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))
+    ds_lp = ds.astype(lp)
+    dq = jax.lax.dot_general(ds_lp, k, (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32) * scale
+    dk = jax.lax.dot_general(ds_lp, q, (((0,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32) * scale
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+    i = pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _init_kv():
+        dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+    @pl.when(i != 0)
+    def _acc_kv():
+        dk_ref[0, 0] += dk.astype(dk_ref.dtype)
+        dv_ref[0, 0] += dv.astype(dv_ref.dtype)
+
+    contrib = ds
+    if reduce_rows:
+        contrib = jnp.sum(contrib, axis=0, keepdims=True)  # [1, S]
+        h = pl.program_id(1)
+        first = (i == 0) if not acc_heads else \
+            jnp.logical_and(h == 0, i == 0)
+
+        @pl.when(first)
+        def _init_b():
+            dbias_ref[0, 0] = contrib
+
+        @pl.when(jnp.logical_not(first))
+        def _acc_b():
+            dbias_ref[0, 0] += contrib
+    else:
+        # per-row bias: tile (b, h?, i) is visited once per head unless
+        # heads broadcast, which accumulates across h
+        if acc_heads:
+            h = pl.program_id(1)
+
+            @pl.when(h == 0)
+            def _init_b2():
+                dbias_ref[0, 0] = contrib
+
+            @pl.when(h != 0)
+            def _acc_b2():
+                dbias_ref[0, 0] += contrib
+        else:
+            dbias_ref[0, 0] = contrib
+
+
+def _long_specs(q, bias):
+    from jax.experimental import pallas as pl
+
+    B, H, S, d = q.shape
+    QB = _long_qb(S, d)
+    nq = S // QB
+    grid = (B, H, nq)
+    qspec = pl.BlockSpec((1, 1, QB, d), lambda b, h, i: (b, h, i, 0))
+    kvspec = pl.BlockSpec((1, 1, S, d), lambda b, h, i: (b, h, 0, 0))
+    hb, qb = bias.shape[1], bias.shape[2]
+    bspec = pl.BlockSpec(
+        (1, 1, QB if qb != 1 else 1, S),
+        lambda b, h, i, _hb=hb, _qb=qb: (b, h if _hb > 1 else 0,
+                                         i if _qb != 1 else 0, 0))
+    return grid, qspec, kvspec, bspec, nq, QB
+
+
+def _use_long_kernel(q, p_drop, bias):
+    B, H, S, d = q.shape
+    if not _supports_pallas():
+        return False
+    if not (_MAX_FUSED_SEQ < S <= _MAX_LONG_SEQ) or _long_qb(S, d) is None:
+        return False
+    if bias.shape[1] == 1 and bias.shape[2] != 1 and H > 1:
+        # per-row head-broadcast bias (e.g. causal mask [B,1,S,S]): dbias
+        # would need +=-accumulation across the NON-consecutive h grid dim
+        # (i is fastest), which Pallas revisit-accumulate cannot do —
+        # take the blockwise path instead
+        return False
+    return not (_interpret() and p_drop > 0.0)
+
+
+def _pallas_attention_long(q, k, v, bias, scale, p_drop, seed):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, S, d = q.shape
+    grid, qspec, kvspec, bspec, nq, QB = _long_specs(q, bias)
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel_long, scale=scale, p_drop=p_drop,
+                          n_heads=H, n_qtiles=nq),
+        grid=grid,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  qspec, kvspec, kvspec, bspec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=_interpret(),
+    )(seed, q, k, v, bias)
+
+
+def _pallas_attention_long_bwd(q, k, v, bias, seed, do, scale, p_drop):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, S, d = q.shape
+    grid, qspec, kvspec, bspec, nq, QB = _long_specs(q, bias)
+    acc_heads = bias.shape[1] == 1
+    reduce_rows = bias.shape[2] == 1
+    dbias_shape = (B, bias.shape[1], bias.shape[2], S)
+    dbspec_blk = (1, 1, 1 if reduce_rows else QB, S)
+    dbspec = pl.BlockSpec(
+        dbspec_blk,
+        lambda b, h, i, _ah=acc_heads, _rr=reduce_rows: (
+            b, 0 if _ah else h, 0 if _rr else i, 0))
+    f32 = jnp.float32
+    dq, dk, dv, dbias = pl.pallas_call(
+        functools.partial(_bwd_kernel_long, scale=scale, p_drop=p_drop,
+                          n_heads=H, n_qtiles=nq, acc_heads=acc_heads,
+                          reduce_rows=reduce_rows),
+        grid=grid,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  qspec, kvspec, kvspec, bspec, qspec],
+        out_specs=[qspec, kvspec, kvspec, dbspec],
+        out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype),
+                   jax.ShapeDtypeStruct(q.shape, f32),
+                   jax.ShapeDtypeStruct(q.shape, f32),
+                   jax.ShapeDtypeStruct(dbias_shape, f32)],
+        interpret=_interpret(),
+    )(seed, q, k, v, bias, do)
+    return dq, dk.astype(q.dtype), dv.astype(q.dtype), dbias
+
+
 def _batch_block(B, S, tile_budget):
     """Largest divisor of B whose [Bb, S, S] fp32 score tile stays under
     ``tile_budget`` bytes (the fwd kernel holds ~4 such temporaries, the
@@ -323,6 +545,8 @@ def _use_kernel(q, p_drop):
 def _fused(q, k, v, bias, scale, p_drop, seed):
     if _use_kernel(q, p_drop):
         return _pallas_attention(q, k, v, bias, scale, p_drop, seed)
+    if _use_long_kernel(q, p_drop, bias):
+        return _pallas_attention_long(q, k, v, bias, scale, p_drop, seed)
     return _fallback_attention(q, k, v, bias, scale, p_drop, seed)
 
 
@@ -335,9 +559,13 @@ def _fused_bwd(scale, p_drop, res, do):
     if _use_kernel(q, p_drop):
         dq, dk, dv, dbias = _pallas_attention_bwd(q, k, v, bias, seed, do,
                                                scale, p_drop)
+    elif _use_long_kernel(q, p_drop, bias):
+        dq, dk, dv, dbias = _pallas_attention_long_bwd(
+            q, k, v, bias, seed, do, scale, p_drop)
     else:
-        # recompute-based vjp through the fallback path (blockwise past the
-        # VMEM bound keeps the vjp O(S*d) memory via the remat'd scan)
+        # recompute-based vjp through the fallback path (blockwise past
+        # the VMEM bound: remat'd scan keeps bwd memory at the per-step
+        # carries, O(nb*S*d) — see _blockwise_attention)
         def f(q_, k_, v_, bias_):
             return _fallback_attention(q_, k_, v_, bias_, scale, p_drop,
                                        seed)
