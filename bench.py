@@ -231,6 +231,46 @@ def bench_lenet(batch_size=1024, warmup=10, iters=100):
             "lenet_batch_size": batch_size}
 
 
+def bench_longseq(batch_size=8, seq_len=2048, warmup=3, iters=10):
+    """Long-context single-chip BERT (opt-in BENCH_LONGSEQ=1): exercises
+    the Q-tiled long-sequence attention kernels
+    (kernels/attention.py dispatch tier 2)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import bert
+
+    import jax
+
+    cfg = bert.BertConfig.base()  # fresh instance per call
+    cfg.max_seq = seq_len
+    main, startup, loss = bert.build_pretrain_program(cfg, seq_len=seq_len,
+                                                      use_amp=True)
+    exe = fluid.Executor()
+    batch = {k: jax.device_put(v)
+             for k, v in bert.synthetic_batch(cfg, batch_size,
+                                              seq_len).items()}
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for _ in range(warmup):
+            (lv,) = exe.run(main, feed=batch, fetch_list=[loss])
+            assert np.isfinite(np.asarray(lv)).all()
+        tps, _, step_s = _stable_throughput(
+            exe, main, batch, loss, iters, jax, batch_size * seq_len,
+            "longseq tokens/sec")
+    flops = bert_train_flops_per_step(cfg, batch_size, seq_len,
+                                      bert.max_predictions(seq_len))
+    peak, peak_source = _peak_flops(jax.devices()[0])
+    mfu = flops / step_s / peak
+    assert mfu <= 1.0, (
+        "longseq MFU %.3f > 1: peak table wrong or timing missed work"
+        % mfu)
+    return {"longseq_tokens_per_sec": round(tps, 1),
+            "longseq_step_time_ms": round(step_s * 1e3, 3),
+            "longseq_mfu": round(mfu, 4),
+            "longseq_peak_source": peak_source,
+            "longseq_batch_size": batch_size,
+            "longseq_seq_len": seq_len}
+
+
 def bench_deepfm(batch_size=4096, warmup=8, iters=40):
     """BASELINE config 4 (DeepFM CTR examples/sec/chip); opt-in via
     BENCH_DEEPFM=1. Embedding-gather dominated — the number that matters
@@ -356,4 +396,6 @@ if __name__ == "__main__":
         out.update(bench_deepfm())
     if os.environ.get("BENCH_TRANSFORMER") == "1":
         out.update(bench_transformer())
+    if os.environ.get("BENCH_LONGSEQ") == "1":
+        out.update(bench_longseq())
     print(json.dumps(out))

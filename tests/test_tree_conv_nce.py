@@ -116,3 +116,56 @@ def test_dygraph_nce_module_trains():
             opt.minimize(loss, parameter_list=m.parameters())
             costs.append(float(loss.numpy()))
         assert costs[-1] < costs[0], costs
+
+
+def test_tree_conv_gradients_flow():
+    """tree_conv must be trainable: numeric grad of the filter via the
+    autodiff replay vs finite differences."""
+    rng = np.random.RandomState(4)
+    N, F = 5, 2
+    feat = rng.randn(1, N, F).astype(np.float32) * 0.5
+    filt0 = rng.randn(F, 3, 1, 1).astype(np.float32) * 0.5
+
+    def loss_at(filt):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            nv = layers.data("tg_nv", [N, F], dtype="float32")
+            es = layers.data("tg_es", [5, 2], dtype="int32")
+            out = layers.tree_conv(nv, es, output_size=1, num_filters=1,
+                                   max_depth=2, act=None, bias_attr=False,
+                                   param_attr=fluid.ParamAttr(name="tg_w"))
+            loss = layers.reduce_sum(layers.square(out))
+        exe = fluid.Executor()
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            fluid.global_scope().set_var("tg_w", filt)
+            (lv,) = exe.run(main, feed={"tg_nv": feat, "tg_es": EDGES},
+                            fetch_list=[loss])
+        return float(np.asarray(lv).ravel()[0])
+
+    def grad_at(filt):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            nv = layers.data("tg_nv", [N, F], dtype="float32")
+            es = layers.data("tg_es", [5, 2], dtype="int32")
+            out = layers.tree_conv(nv, es, output_size=1, num_filters=1,
+                                   max_depth=2, act=None, bias_attr=False,
+                                   param_attr=fluid.ParamAttr(name="tg_w"))
+            loss = layers.reduce_sum(layers.square(out))
+            (g,) = fluid.gradients(loss, main.global_block().var("tg_w"))
+        exe = fluid.Executor()
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            fluid.global_scope().set_var("tg_w", filt)
+            (gv,) = exe.run(main, feed={"tg_nv": feat, "tg_es": EDGES},
+                            fetch_list=[g])
+        return np.asarray(gv)
+
+    g = grad_at(filt0)
+    eps = 1e-3
+    num = np.zeros_like(filt0)
+    for idx in np.ndindex(filt0.shape):
+        up = filt0.copy(); up[idx] += eps
+        dn = filt0.copy(); dn[idx] -= eps
+        num[idx] = (loss_at(up) - loss_at(dn)) / (2 * eps)
+    np.testing.assert_allclose(g, num, rtol=2e-2, atol=2e-3)
