@@ -23,6 +23,7 @@ from . import (  # noqa: F401
     io,
     layers,
     metrics,
+    net_drawer,
     nets,
     optimizer,
     param_attr,

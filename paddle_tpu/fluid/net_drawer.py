@@ -1,0 +1,13 @@
+"""Graph drawing entry points (reference ``python/paddle/fluid/net_drawer.py``
+— graphviz export of a Program). Thin veneer over ``debugger``."""
+
+from .debugger import draw_block_graphviz
+
+__all__ = ["draw_graph", "draw_block_graphviz"]
+
+
+def draw_graph(startup_program, main_program, path=None, block_idx=0,
+               **kwargs):
+    """Dot source for the main program's block (startup accepted for
+    reference-signature parity; its initializer subgraph is omitted)."""
+    return draw_block_graphviz(main_program.blocks[block_idx], path=path)

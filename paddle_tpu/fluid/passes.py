@@ -227,3 +227,62 @@ class IrGraph:
 
         return draw_block_graphviz(self._block, highlights=highlights,
                                    path=path)
+
+
+@register_pass("program_check")
+def _program_check_pass(program, startup_program=None, feed_names=None):
+    """Well-formedness validation (reference ``multi_devices_check_pass``,
+    ``details/build_strategy.cc:80``): every op input must be produced by
+    an earlier op (in this block or an ancestor block), fed, persistable,
+    or initialized by the startup program; unknown op types are reported
+    with the op index. Raises ValueError with the full defect list."""
+    from .registry import registry as op_registry
+
+    feed_names = set(feed_names or [])
+    startup_written = set()
+    if startup_program is not None:
+        for op in startup_program.global_block().ops:
+            startup_written.update(op.output_arg_names())
+
+    def ancestor_produced(blk):
+        out = set()
+        b = blk.parent_block
+        while b is not None:
+            for op in b.ops:
+                out.update(op.output_arg_names())
+            b = b.parent_block
+        return out
+
+    problems = []
+    for blk in program.blocks:
+        # sub-blocks (While/cond bodies) legitimately read anything their
+        # ancestors produce at any point — the runtime enters them after
+        # the whole parent program is lowered
+        produced = ancestor_produced(blk)
+        for idx, op in enumerate(blk.ops):
+            if op.type == "feed":
+                produced.update(op.output_arg_names())
+                continue
+            known = (op_registry.has(op.type)
+                     or op.type in ("fetch", "autodiff", "py_func")
+                     or op.type.endswith("_grad"))
+            if not known:
+                problems.append("block %d op[%d] %r: no lowering rule"
+                                % (blk.idx, idx, op.type))
+            for name in set(op.input_arg_names()):  # dedupe repeated slots
+                var = blk._find_var_recursive(name)
+                ok = (name in produced or name in feed_names
+                      or name in startup_written
+                      or (var is not None and
+                          (getattr(var, "persistable", False)
+                           or getattr(var, "is_data", False))))
+                if not ok:
+                    problems.append(
+                        "block %d op[%d] %s: input %r is never produced, "
+                        "fed, persistable, or startup-initialized"
+                        % (blk.idx, idx, op.type, name))
+            produced.update(op.output_arg_names())
+    if problems:
+        raise ValueError("program_check found %d defect(s):\n  %s"
+                         % (len(problems), "\n  ".join(problems)))
+    return program

@@ -245,6 +245,9 @@ def _long_qb(S, d):
     anchors: Qb=128 S=4096 -> 17.96 MB, Qb=64 S=4096 -> 16.92 MB (both
     over); Qb=128 S=2048 runs. The 13 MB acceptance bound keeps a
     safety margin under those measurements."""
+    # Measured at S=4096/d=64: 17.96M (qb=128), 16.92M (64), 16.39M (32) —
+    # the qb-independent K/V/dK/dV double-buffering dominates, so smaller
+    # tiles can't rescue S=4096; a split dq/dkdv bwd pair could.
     for qb in (128, 64):
         if S % qb:
             continue

@@ -103,3 +103,29 @@ def test_ir_graph_structure():
     assert g.producer_of(relu_out) is relu
     dot = g.draw()
     assert dot.startswith("digraph")
+
+
+def test_program_check_pass():
+    main, startup, loss = _mlp_program()
+    passes.apply_pass(main, "program_check", startup_program=startup,
+                      feed_names=["pp_x", "pp_y"])
+
+    broken = fluid.Program()
+    blk = broken.global_block()
+    blk.create_var(name="pc_ghost", shape=(2,), dtype="float32")
+    blk.create_var(name="pc_out", shape=(2,), dtype="float32")
+    blk.append_op("relu", inputs={"X": ["pc_ghost"]},
+                  outputs={"Out": ["pc_out"]})
+    blk.append_op("not_an_op", inputs={"X": ["pc_out"]},
+                  outputs={"Out": ["pc_out"]})
+    with pytest.raises(ValueError) as ei:
+        passes.apply_pass(broken, "program_check")
+    msg = str(ei.value)
+    assert "never produced" in msg and "no lowering rule" in msg
+
+
+def test_net_drawer_draw_graph(tmp_path):
+    main, startup, _ = _mlp_program()
+    dot = fluid.net_drawer.draw_graph(startup, main,
+                                      path=str(tmp_path / "nd.dot"))
+    assert dot.startswith("digraph") and (tmp_path / "nd.dot").exists()
