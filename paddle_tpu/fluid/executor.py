@@ -198,8 +198,17 @@ class Executor:
             tuple(state_names),
             strategy._uid if strategy is not None else 0,
         )
+        from . import flags as _flags
+
         step = self._cache.get(key)
         if step is None:
+            if _flags.check_program_enabled():
+                # debug mode (reference multi_devices_check_pass): validate
+                # well-formedness once per compiled signature
+                from .passes import apply_pass
+
+                apply_pass(program, "program_check",
+                           feed_names=list(feed))
             step = self._build(program, block, feed, fetch_names, state_names, strategy)
             self._cache[key] = step
 
@@ -223,8 +232,6 @@ class Executor:
         scope.set_var(RNG_STATE_VAR, new_rng)
         for n, v in new_state.items():
             scope.set_var(n, v)
-
-        from . import flags as _flags
 
         if _flags.check_nan_inf_enabled():
             # debug mode (reference FLAGS_check_nan_inf / nan_inf_utils):

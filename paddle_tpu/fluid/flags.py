@@ -9,6 +9,10 @@ Live flags:
                            persistable update for non-finite numbers and
                            raises naming the program (reference
                            ``framework/details/nan_inf_utils_detail``)
+  FLAGS_check_program      executor validates each program's
+                           well-formedness (the program_check pass — the
+                           reference's ``multi_devices_check_pass``)
+                           before first compiling it
   FLAGS_cudnn_deterministic  accepted (XLA is deterministic by default)
   FLAGS_eager_delete_tensor_gb  accepted (XLA buffer lifetime)
 """
@@ -19,6 +23,8 @@ __all__ = ["set_flags", "get_flags"]
 
 _FLAGS = {
     "FLAGS_check_nan_inf": os.environ.get("FLAGS_check_nan_inf",
+                                          "0") in ("1", "true", "True"),
+    "FLAGS_check_program": os.environ.get("FLAGS_check_program",
                                           "0") in ("1", "true", "True"),
     "FLAGS_cudnn_deterministic": False,
     "FLAGS_eager_delete_tensor_gb": 0.0,
@@ -42,3 +48,7 @@ def get_flags(names):
 
 def check_nan_inf_enabled():
     return bool(_FLAGS.get("FLAGS_check_nan_inf"))
+
+
+def check_program_enabled():
+    return bool(_FLAGS.get("FLAGS_check_program"))

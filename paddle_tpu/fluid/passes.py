@@ -233,16 +233,20 @@ class IrGraph:
 def _program_check_pass(program, startup_program=None, feed_names=None):
     """Well-formedness validation (reference ``multi_devices_check_pass``,
     ``details/build_strategy.cc:80``): every op input must be produced by
-    an earlier op (in this block or an ancestor block), fed, persistable,
-    or initialized by the startup program; unknown op types are reported
-    with the op index. Raises ValueError with the full defect list."""
+    an earlier op (in this block or an ancestor block), fed, or
+    persistable; unknown op types are reported with the op index. Raises
+    ValueError with the full defect list.
+
+    The check mirrors THIS runtime exactly: the executor materializes
+    only fed values, in-block products, and persistable scope state —
+    a startup program can only initialize persistable vars usefully, so
+    (unlike the reference) "startup-initialized" is not a separate
+    acceptance category. ``startup_program`` is accepted for signature
+    parity and unused."""
     from .registry import registry as op_registry
 
+    del startup_program  # see docstring: no extra acceptance category
     feed_names = set(feed_names or [])
-    startup_written = set()
-    if startup_program is not None:
-        for op in startup_program.global_block().ops:
-            startup_written.update(op.output_arg_names())
 
     def ancestor_produced(blk):
         out = set()
@@ -263,8 +267,10 @@ def _program_check_pass(program, startup_program=None, feed_names=None):
             if op.type == "feed":
                 produced.update(op.output_arg_names())
                 continue
+            from .compat import _STRUCTURAL_OPS
+
             known = (op_registry.has(op.type)
-                     or op.type in ("fetch", "autodiff", "py_func")
+                     or op.type in _STRUCTURAL_OPS
                      or op.type.endswith("_grad"))
             if not known:
                 problems.append("block %d op[%d] %r: no lowering rule"
@@ -272,14 +278,13 @@ def _program_check_pass(program, startup_program=None, feed_names=None):
             for name in set(op.input_arg_names()):  # dedupe repeated slots
                 var = blk._find_var_recursive(name)
                 ok = (name in produced or name in feed_names
-                      or name in startup_written
                       or (var is not None and
                           (getattr(var, "persistable", False)
                            or getattr(var, "is_data", False))))
                 if not ok:
                     problems.append(
                         "block %d op[%d] %s: input %r is never produced, "
-                        "fed, persistable, or startup-initialized"
+                        "fed, or persistable"
                         % (blk.idx, idx, op.type, name))
             produced.update(op.output_arg_names())
     if problems:

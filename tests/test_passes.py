@@ -129,3 +129,29 @@ def test_net_drawer_draw_graph(tmp_path):
     dot = fluid.net_drawer.draw_graph(startup, main,
                                       path=str(tmp_path / "nd.dot"))
     assert dot.startswith("digraph") and (tmp_path / "nd.dot").exists()
+
+
+def test_flags_check_program_in_executor():
+    import numpy as np
+
+    fluid.set_flags({"FLAGS_check_program": True})
+    try:
+        main, startup, loss = _mlp_program()
+        exe = fluid.Executor()
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            exe.run(main, feed={
+                "pp_x": np.ones((2, 4), np.float32),
+                "pp_y": np.ones((2, 1), np.float32)}, fetch_list=[loss])
+
+        broken = fluid.Program()
+        blk = broken.global_block()
+        blk.create_var(name="fc_ghost", shape=(2,), dtype="float32")
+        blk.create_var(name="fc_out", shape=(2,), dtype="float32")
+        blk.append_op("relu", inputs={"X": ["fc_ghost"]},
+                      outputs={"Out": ["fc_out"]})
+        with fluid.scope_guard(fluid.Scope()):
+            with pytest.raises(ValueError, match="program_check"):
+                exe.run(broken, feed={}, fetch_list=["fc_out"])
+    finally:
+        fluid.set_flags({"FLAGS_check_program": False})
