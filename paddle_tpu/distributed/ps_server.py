@@ -1,0 +1,374 @@
+"""Parameter-server serving tier over TCP (reference
+``operators/distributed_ops/listen_and_serv_op.cc`` +
+``operators/distributed/grpc``: pserver processes serve pull/push RPCs;
+trainers talk to them through a dispatcher).
+
+TPU-native framing: the row store is the host EmbeddingTable
+(``ps.py`` / native ``ps_store.cc``); this module adds the cross-process
+transport — a compact length-prefixed binary protocol (no pickle: only
+dtyped arrays and scalars cross the wire) with:
+
+  * ``TableServer`` — threaded socket server hosting the table shards of
+    one endpoint (the ``listen_and_serv`` runtime).
+  * ``RemoteTable`` — client proxy with the EmbeddingTable interface.
+  * ``ShardedRemoteTable`` — row-sharded client over N endpoints
+    (id -> endpoint ``id % n``, local row ``id // n`` — the HashName
+    dispatch of ``transpiler/ps_dispatcher.py``).
+
+Registering a ShardedRemoteTable in the ps registry makes the existing
+``distributed_lookup_table``/``distributed_push`` op lowerings train
+against remote pservers with no graph changes.
+"""
+
+import socket
+import struct
+import threading
+
+import numpy as np
+
+__all__ = ["TableServer", "RemoteTable", "ShardedRemoteTable",
+           "shard_vocab"]
+
+# opcodes
+_PULL, _PUSH, _META, _DUMP, _LOAD, _PING, _STOP, _RESET = range(1, 9)
+_OPT_CODE = {"sgd": 0, "adagrad": 1}
+_OPT_NAME = {v: k for k, v in _OPT_CODE.items()}
+
+_DT_CODE = {"float32": 0, "float64": 1, "int32": 2, "int64": 3}
+_DT_NP = {0: np.float32, 1: np.float64, 2: np.int32, 3: np.int64}
+
+
+def _send_all(sock, data):
+    sock.sendall(data)
+
+
+def _recv_exact(sock, n):
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _pack_arr(a):
+    a = np.ascontiguousarray(a)
+    code = _DT_CODE[a.dtype.name]
+    head = struct.pack("<BB", code, a.ndim)
+    head += b"".join(struct.pack("<Q", d) for d in a.shape)
+    return head + a.tobytes()
+
+
+def _unpack_arr(buf, off):
+    code, ndim = struct.unpack_from("<BB", buf, off)
+    off += 2
+    shape = []
+    for _ in range(ndim):
+        (d,) = struct.unpack_from("<Q", buf, off)
+        off += 8
+        shape.append(d)
+    dt = np.dtype(_DT_NP[code])
+    n = int(np.prod(shape)) if shape else 1
+    a = np.frombuffer(buf, dt, count=n, offset=off).reshape(shape)
+    off += n * dt.itemsize
+    return a.copy(), off
+
+
+def _frame(payload):
+    return struct.pack("<I", len(payload)) + payload
+
+
+def _read_frame(sock):
+    (n,) = struct.unpack("<I", _recv_exact(sock, 4))
+    return _recv_exact(sock, n)
+
+
+def shard_vocab(vocab, n_shards, shard_idx):
+    """Rows owned by shard k of n under id -> (id % n, id // n) mapping."""
+    return (int(vocab) - shard_idx + n_shards - 1) // n_shards
+
+
+class TableServer:
+    """Serves pull/push/dump/load for the local shard of each table.
+
+    ``tables`` maps name -> EmbeddingTable (already shard-sized). Serving
+    runs on daemon threads (one per connection); ``stop()`` or a _STOP
+    request shuts down.
+    """
+
+    def __init__(self, host="127.0.0.1", port=0, tables=None):
+        self.tables = dict(tables or {})
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(16)
+        self.host, self.port = self._srv.getsockname()
+        self._stop = threading.Event()
+        self._accept_thread = None
+
+    @property
+    def endpoint(self):
+        return "%s:%d" % (self.host, self.port)
+
+    def add_table(self, name, table):
+        self.tables[name] = table
+
+    def start(self):
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+        return self
+
+    def serve_forever(self):
+        """Blocking serve — what ``exe.run(pserver_program)`` does, like
+        the reference's ``listen_and_serv`` RunSyncLoop."""
+        self._accept_loop()
+
+    def _accept_loop(self):
+        self._srv.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    def stop(self):
+        self._stop.set()
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5)
+        # a never-started server still holds its bound socket — release it
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    # -- request handling ---------------------------------------------------
+    def _serve_conn(self, conn):
+        try:
+            while not self._stop.is_set():
+                try:
+                    req = _read_frame(conn)
+                except (ConnectionError, OSError):
+                    return
+                resp = self._handle(req)
+                _send_all(conn, _frame(resp))
+                if req and req[0] == _STOP:
+                    self._stop.set()
+                    return
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle(self, req):
+        try:
+            op = req[0]
+            (name_len,) = struct.unpack_from("<B", req, 1)
+            name = req[2:2 + name_len].decode()
+            off = 2 + name_len
+            if op == _PING:
+                return b"\x00"
+            if op == _STOP:
+                return b"\x00"
+            table = self.tables.get(name)
+            if table is None and op not in (_PING, _STOP):
+                return b"\x01" + b"unknown table %s" % name.encode()
+            if op == _PULL:
+                ids, off = _unpack_arr(req, off)
+                return b"\x00" + _pack_arr(table.pull(ids))
+            if op == _PUSH:
+                ids, off = _unpack_arr(req, off)
+                grads, off = _unpack_arr(req, off)
+                lr, opt_code, eps = struct.unpack_from("<dBd", req, off)
+                table.push(ids, grads, lr=lr,
+                           optimizer=_OPT_NAME.get(opt_code, "sgd"),
+                           eps=eps)
+                return b"\x00"
+            if op == _META:
+                return b"\x00" + struct.pack("<QQ", table.vocab, table.dim)
+            if op == _DUMP:
+                start, n = struct.unpack_from("<QQ", req, off)
+                full = table.dump()
+                return b"\x00" + _pack_arr(full[start:start + n])
+            if op == _LOAD:
+                (start,) = struct.unpack_from("<Q", req, off)
+                rows, _ = _unpack_arr(req, off + 8)
+                full = table.dump()
+                full[start:start + rows.shape[0]] = rows
+                table.load(full)
+                return b"\x00"
+            if op == _RESET:
+                table.reinit()
+                return b"\x00"
+            return b"\x01unknown opcode"
+        except Exception as e:  # surface to the client, keep serving
+            return b"\x01" + repr(e).encode()[:512]
+
+
+class _Conn:
+    """One persistent client connection with a request lock."""
+
+    def __init__(self, endpoint):
+        host, port = endpoint.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)), timeout=30)
+        self._mu = threading.Lock()
+
+    def request(self, payload):
+        with self._mu:
+            _send_all(self._sock, _frame(payload))
+            resp = _read_frame(self._sock)
+        if not resp or resp[0] != 0:
+            raise RuntimeError("pserver error: %s"
+                               % resp[1:].decode("utf-8", "replace"))
+        return resp[1:]
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def _req(op, name, body=b""):
+    nb = name.encode()
+    return struct.pack("<BB", op, len(nb)) + nb + body
+
+
+class RemoteTable:
+    """EmbeddingTable-interface proxy for ONE endpoint/shard."""
+
+    def __init__(self, endpoint, name):
+        self._conn = _Conn(endpoint)
+        self._name = name
+        meta = self._conn.request(_req(_META, name))
+        self.vocab, self.dim = struct.unpack("<QQ", meta)
+
+    def pull(self, ids):
+        ids = np.ascontiguousarray(np.asarray(ids).reshape(-1), np.int64)
+        body = self._conn.request(_req(_PULL, self._name, _pack_arr(ids)))
+        rows, _ = _unpack_arr(body, 0)
+        return rows
+
+    def push(self, ids, grads, lr=0.01, optimizer="sgd", eps=1e-6):
+        ids = np.ascontiguousarray(np.asarray(ids).reshape(-1), np.int64)
+        grads = np.ascontiguousarray(np.asarray(grads, np.float32)
+                                     .reshape(ids.shape[0], self.dim))
+        body = (_pack_arr(ids) + _pack_arr(grads) +
+                struct.pack("<dBd", float(lr),
+                            _OPT_CODE.get(optimizer, 0), float(eps)))
+        self._conn.request(_req(_PUSH, self._name, body))
+
+    # frames carry a u32 length, so dump/load chunk rows to stay far
+    # below the 4 GiB frame ceiling on big shards
+    _CHUNK_BYTES = 64 * 1024 * 1024
+
+    def _rows_per_chunk(self):
+        return max(1, self._CHUNK_BYTES // (self.dim * 4))
+
+    def dump(self):
+        step = self._rows_per_chunk()
+        parts = []
+        for start in range(0, self.vocab, step):
+            n = min(step, self.vocab - start)
+            body = self._conn.request(
+                _req(_DUMP, self._name, struct.pack("<QQ", start, n)))
+            rows, _ = _unpack_arr(body, 0)
+            parts.append(rows)
+        return np.concatenate(parts) if len(parts) > 1 else parts[0]
+
+    def load(self, arr):
+        arr = np.ascontiguousarray(np.asarray(arr, np.float32))
+        step = self._rows_per_chunk()
+        for start in range(0, arr.shape[0], step):
+            part = arr[start:start + step]
+            self._conn.request(
+                _req(_LOAD, self._name,
+                     struct.pack("<Q", start) + _pack_arr(part)))
+
+    def reinit(self):
+        self._conn.request(_req(_RESET, self._name))
+
+    def ping(self):
+        self._conn.request(_req(_PING, self._name))
+
+    def close(self):
+        self._conn.close()
+
+
+class ShardedRemoteTable:
+    """Row-sharded EmbeddingTable proxy over N endpoints.
+
+    Global id -> endpoint ``id % n``, local row ``id // n`` (HashName
+    dispatch). Presents the full [vocab, dim] table to callers — the
+    existing op lowerings and Geo/Async communicators work unchanged.
+    """
+
+    def __init__(self, endpoints, name, vocab, dim):
+        self.vocab, self.dim = int(vocab), int(dim)
+        self._n = len(endpoints)
+        self._shards = [RemoteTable(ep, name) for ep in endpoints]
+        for k, sh in enumerate(self._shards):
+            expect = shard_vocab(self.vocab, self._n, k)
+            if sh.vocab < expect or sh.dim != self.dim:
+                raise ValueError(
+                    "endpoint %d serves [%d, %d], want >= [%d, %d]"
+                    % (k, sh.vocab, sh.dim, expect, self.dim))
+
+    def _split(self, ids):
+        ids = np.asarray(ids).reshape(-1)
+        ep = ids % self._n
+        local = ids // self._n
+        return ep, local
+
+    def pull(self, ids):
+        ids = np.asarray(ids).reshape(-1).astype(np.int64)
+        ep, local = self._split(ids)
+        out = np.empty((ids.shape[0], self.dim), np.float32)
+        for k, sh in enumerate(self._shards):
+            mask = ep == k
+            if mask.any():
+                out[mask] = sh.pull(local[mask])
+        return out
+
+    def push(self, ids, grads, lr=0.01, optimizer="sgd", eps=1e-6):
+        ids = np.asarray(ids).reshape(-1).astype(np.int64)
+        grads = np.asarray(grads, np.float32).reshape(ids.shape[0], self.dim)
+        ep, local = self._split(ids)
+        for k, sh in enumerate(self._shards):
+            mask = ep == k
+            if mask.any():
+                sh.push(local[mask], grads[mask], lr=lr,
+                        optimizer=optimizer, eps=eps)
+
+    def dump(self):
+        out = np.zeros((self.vocab, self.dim), np.float32)
+        for k, sh in enumerate(self._shards):
+            rows = sh.dump()
+            n = shard_vocab(self.vocab, self._n, k)
+            out[k::self._n] = rows[:n]
+        return out
+
+    def load(self, arr):
+        arr = np.asarray(arr, np.float32)
+        for k, sh in enumerate(self._shards):
+            # the server merges loaded rows in place from row 0 — sending
+            # just this shard's slice suffices (no dump round-trip)
+            sh.load(arr[k::self._n])
+
+    def reinit(self):
+        for sh in self._shards:
+            sh.reinit()
+
+    def close(self):
+        for sh in self._shards:
+            sh.close()

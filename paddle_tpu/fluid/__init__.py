@@ -30,6 +30,7 @@ from . import (  # noqa: F401
     passes,
     profiler,
     regularizer,
+    transpiler,
     unique_name,
 )
 from .backward import append_backward, calc_gradient, gradients  # noqa: F401
@@ -49,6 +50,10 @@ from .framework import (  # noqa: F401
     record_op_callstacks,
 )
 from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
+from .transpiler import (  # noqa: F401
+    DistributeTranspiler,
+    DistributeTranspilerConfig,
+)
 
 
 class CPUPlace:

@@ -162,6 +162,17 @@ class Executor:
 
         block = program.global_block()
 
+        # pserver programs don't compile — their listen_and_serv op is a
+        # host serving loop; running one blocks, like the reference's
+        # pserver Executor (listen_and_serv_op.cc RunSyncLoop)
+        for op in block.ops:
+            if op.type == "listen_and_serv":
+                from .transpiler.distribute_transpiler import (
+                    build_server_from_attrs)
+
+                build_server_from_attrs(op.attrs).serve_forever()
+                return []
+
         # normalize feeds to declared dtype; device-resident jax Arrays pass
         # through untouched (the DataLoader/buffered-reader path pre-stages
         # H2D transfers — critical when the chip sits behind a slow link)

@@ -243,6 +243,7 @@ def _program_check_pass(program, startup_program=None, feed_names=None):
     (unlike the reference) "startup-initialized" is not a separate
     acceptance category. ``startup_program`` is accepted for signature
     parity and unused."""
+    from .compat import _STRUCTURAL_OPS
     from .registry import registry as op_registry
 
     del startup_program  # see docstring: no extra acceptance category
@@ -267,8 +268,6 @@ def _program_check_pass(program, startup_program=None, feed_names=None):
             if op.type == "feed":
                 produced.update(op.output_arg_names())
                 continue
-            from .compat import _STRUCTURAL_OPS
-
             known = (op_registry.has(op.type)
                      or op.type in _STRUCTURAL_OPS
                      or op.type.endswith("_grad"))

@@ -1,4 +1,9 @@
 """Program transpilers (reference ``python/paddle/fluid/transpiler/``)."""
 
-from . import collective  # noqa: F401
+from . import collective, ps_dispatcher  # noqa: F401
 from .collective import Collective, GradAllReduce, LocalSGD  # noqa: F401
+from .distribute_transpiler import (  # noqa: F401
+    DistributeTranspiler,
+    DistributeTranspilerConfig,
+)
+from .ps_dispatcher import HashName, RoundRobin  # noqa: F401

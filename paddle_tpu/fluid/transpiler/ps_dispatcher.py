@@ -1,0 +1,50 @@
+"""Parameter-to-pserver dispatchers (reference
+``transpiler/ps_dispatcher.py``: RoundRobin / HashName decide which
+endpoint owns each parameter block)."""
+
+import zlib
+
+__all__ = ["PSDispatcher", "RoundRobin", "HashName"]
+
+
+class PSDispatcher:
+    def __init__(self, pserver_endpoints):
+        self._eps = list(pserver_endpoints)
+        self._step = 0
+
+    @property
+    def eps(self):
+        return self._eps
+
+    def reset(self):
+        self._step = 0
+
+    def dispatch(self, varlist):
+        raise NotImplementedError
+
+
+class RoundRobin(PSDispatcher):
+    """Endpoints assigned in rotation (reference ``ps_dispatcher.py``
+    RoundRobin)."""
+
+    def dispatch(self, varlist):
+        out = []
+        for _v in varlist:
+            out.append(self._eps[self._step % len(self._eps)])
+            self._step += 1
+        return out
+
+
+class HashName(PSDispatcher):
+    """Endpoint chosen by name hash — deterministic across trainers
+    without coordination (reference ``ps_dispatcher.py`` HashName)."""
+
+    def dispatch(self, varlist):
+        out = []
+        for v in varlist:
+            name = v if isinstance(v, str) else v.name
+            # crc32, not builtin hash(): per-process hash salting would
+            # send different trainers to different endpoints
+            out.append(self._eps[zlib.crc32(name.encode())
+                                 % len(self._eps)])
+        return out

@@ -1,0 +1,157 @@
+"""DistributeTranspiler + TCP parameter-server tier: pserver programs
+serve over loopback sockets (the reference's fake-cluster discipline,
+``test_dist_base.py:500``), trainers pull/push through ShardedRemoteTable,
+and the result matches single-process local-table training exactly."""
+
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.distributed import ps, wait_server_ready
+from paddle_tpu.distributed.ps_server import (RemoteTable,
+                                              ShardedRemoteTable,
+                                              TableServer, shard_vocab)
+from paddle_tpu.fluid import layers, optimizer
+
+
+@pytest.fixture(autouse=True)
+def _clean_tables():
+    ps.reset_tables()
+    yield
+    ps.reset_tables()
+
+
+def _start_server(tables):
+    srv = TableServer(tables=tables).start()
+    return srv
+
+
+def test_remote_table_roundtrip():
+    local = ps.EmbeddingTable(vocab=12, dim=3, init_scale=0.0)
+    srv = _start_server({"t": local})
+    try:
+        wait_server_ready([srv.endpoint])
+        rt = RemoteTable(srv.endpoint, "t")
+        assert (rt.vocab, rt.dim) == (12, 3)
+        ids = np.array([1, 5, 1], np.int64)
+        np.testing.assert_allclose(rt.pull(ids), np.zeros((3, 3)))
+        rt.push(np.array([2], np.int64), np.ones((1, 3), np.float32),
+                lr=0.5)
+        np.testing.assert_allclose(rt.pull(np.array([2], np.int64)),
+                                   [[-0.5, -0.5, -0.5]])
+        # dump/load round trip
+        arr = rt.dump()
+        arr[7] = 9.0
+        rt.load(arr)
+        np.testing.assert_allclose(rt.pull(np.array([7], np.int64)),
+                                   [[9.0, 9.0, 9.0]])
+        rt.close()
+    finally:
+        srv.stop()
+
+
+def test_sharded_remote_matches_local_table():
+    vocab, dim, n = 17, 4, 3
+    servers = []
+    try:
+        for k in range(n):
+            rows = shard_vocab(vocab, n, k)
+            servers.append(_start_server(
+                {"s": ps.EmbeddingTable(rows, dim, init_scale=0.0)}))
+        wait_server_ready([s.endpoint for s in servers])
+        sharded = ShardedRemoteTable([s.endpoint for s in servers], "s",
+                                     vocab, dim)
+        local = ps.EmbeddingTable(vocab, dim, init_scale=0.0)
+        rng = np.random.RandomState(0)
+        for _ in range(5):
+            ids = rng.randint(0, vocab, 9).astype(np.int64)
+            grads = rng.randn(9, dim).astype(np.float32)
+            sharded.push(ids, grads, lr=0.1)
+            local.push(ids, grads, lr=0.1)
+        np.testing.assert_allclose(sharded.dump(), local.dump(), rtol=1e-5,
+                                   atol=1e-6)
+        probe = rng.randint(0, vocab, 6).astype(np.int64)
+        np.testing.assert_allclose(sharded.pull(probe), local.pull(probe),
+                                   rtol=1e-5, atol=1e-6)
+        sharded.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def _build_ctr_program(vocab, dim):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 3
+    with fluid.program_guard(main, startup):
+        ids = layers.data("dt_ids", [1], dtype="int64")
+        label = layers.data("dt_label", [1], dtype="float32")
+        emb = layers.embedding(ids, size=[vocab, dim],
+                               is_distributed=True,
+                               param_attr=fluid.ParamAttr(name="dt_emb"))
+        emb = layers.reshape(emb, [-1, dim])
+        pred = layers.fc(emb, 1, param_attr=fluid.ParamAttr(name="dt_w"),
+                         bias_attr=fluid.ParamAttr(name="dt_b"))
+        loss = layers.reduce_mean(layers.square(pred - label))
+        optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def test_distribute_transpiler_e2e_matches_local():
+    """2 'pserver processes' (threads serving exe.run(pserver_program)) +
+    1 trainer; final embedding table equals local-table training."""
+    vocab, dim = 10, 4
+    rng = np.random.RandomState(1)
+    batches = [(rng.randint(0, vocab, (8, 1)).astype(np.int64),
+                rng.rand(8, 1).astype(np.float32)) for _ in range(6)]
+
+    def train(main, startup, loss, preload=None):
+        """Run startup (which re-inits the table), then optionally load
+        known rows so runs compare exactly, then train."""
+        exe = fluid.Executor()
+        init = None
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            if preload is not None:
+                ps.get_table("dt_emb").load(preload)
+            else:
+                init = ps.get_table("dt_emb").dump().copy()
+            for ids, lab in batches:
+                exe.run(main, feed={"dt_ids": ids, "dt_label": lab},
+                        fetch_list=[loss])
+            return ps.get_table("dt_emb").dump(), init
+
+    # ---- local baseline ----
+    main, startup, loss = _build_ctr_program(vocab, dim)
+    local_final, baseline_init = train(main, startup, loss)
+    ps.reset_tables()
+
+    # ---- transpiled: 2 pservers on loopback ----
+    main, startup, loss = _build_ctr_program(vocab, dim)
+    # reserve two free ports
+    probes = [TableServer() for _ in range(2)]
+    eps = [s.endpoint for s in probes]
+    for s in probes:
+        s.stop()
+
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, pservers=",".join(eps),
+                trainers=1)
+
+    server_threads = []
+    for ep in eps:
+        prog = t.get_pserver_program(ep)
+        types = [op.type for op in prog.global_block().ops]
+        assert types == ["listen_and_serv"]
+        th = threading.Thread(
+            target=lambda p=prog: fluid.Executor().run(p), daemon=True)
+        th.start()
+        server_threads.append(th)
+    wait_server_ready(eps)
+
+    trainer_prog = t.get_trainer_program()
+    remote_final, _ = train(trainer_prog, startup, loss,
+                            preload=baseline_init)
+    np.testing.assert_allclose(remote_final, local_final, rtol=1e-5,
+                               atol=1e-6)
