@@ -118,29 +118,44 @@ class EmbeddingTable:
 
     # -- checkpoint -------------------------------------------------------
     def dump(self):
+        return self.dump_rows(0, self.vocab)
+
+    def dump_rows(self, start, n):
+        """Rows [start, start+n) — the serving tier checkpoints in chunks
+        so big shards never copy whole-table per chunk."""
+        start, n = int(start), int(max(0, min(n, self.vocab - start)))
         if self._lib is not None:
             import ctypes
 
-            out = np.empty((self.vocab, self.dim), np.float32)
-            self._lib.pts_dump(
-                self._h, 0, self.vocab,
-                out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+            out = np.empty((n, self.dim), np.float32)
+            if n:
+                self._lib.pts_dump(
+                    self._h, start, n,
+                    out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
             return out
         with self._mu:
-            return self._data.copy()
+            return self._data[start:start + n].copy()
 
     def load(self, arr):
         arr = np.ascontiguousarray(np.asarray(arr, np.float32))
         assert arr.shape == (self.vocab, self.dim)
+        self.load_rows(0, arr)
+
+    def load_rows(self, start, arr):
+        arr = np.ascontiguousarray(np.asarray(arr, np.float32))
+        start = int(start)
+        n = int(min(arr.shape[0], self.vocab - start))
+        if n <= 0:
+            return
         if self._lib is not None:
             import ctypes
 
             self._lib.pts_load(
-                self._h, 0, self.vocab,
-                arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+                self._h, start, n,
+                arr[:n].ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
             return
         with self._mu:
-            self._data[:] = arr
+            self._data[start:start + n] = arr[:n]
 
 
 class AsyncPusher:

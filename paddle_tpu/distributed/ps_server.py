@@ -198,14 +198,11 @@ class TableServer:
                 return b"\x00" + struct.pack("<QQ", table.vocab, table.dim)
             if op == _DUMP:
                 start, n = struct.unpack_from("<QQ", req, off)
-                full = table.dump()
-                return b"\x00" + _pack_arr(full[start:start + n])
+                return b"\x00" + _pack_arr(table.dump_rows(start, n))
             if op == _LOAD:
                 (start,) = struct.unpack_from("<Q", req, off)
                 rows, _ = _unpack_arr(req, off + 8)
-                full = table.dump()
-                full[start:start + rows.shape[0]] = rows
-                table.load(full)
+                table.load_rows(start, rows)
                 return b"\x00"
             if op == _RESET:
                 table.reinit()
@@ -225,18 +222,32 @@ class _Conn:
 
     def request(self, payload):
         with self._mu:
-            _send_all(self._sock, _frame(payload))
-            resp = _read_frame(self._sock)
+            if self._sock is None:
+                raise ConnectionError("pserver connection is closed "
+                                      "(previous request failed mid-frame)")
+            try:
+                _send_all(self._sock, _frame(payload))
+                resp = _read_frame(self._sock)
+            except (OSError, ConnectionError):
+                # a timeout/short read leaves the stream desynchronized —
+                # poison the connection rather than serve misframed bytes
+                try:
+                    self._sock.close()
+                finally:
+                    self._sock = None
+                raise
         if not resp or resp[0] != 0:
             raise RuntimeError("pserver error: %s"
                                % resp[1:].decode("utf-8", "replace"))
         return resp[1:]
 
     def close(self):
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
 
 
 def _req(op, name, body=b""):
@@ -284,6 +295,8 @@ class RemoteTable:
                 _req(_DUMP, self._name, struct.pack("<QQ", start, n)))
             rows, _ = _unpack_arr(body, 0)
             parts.append(rows)
+        if not parts:  # zero-row shard (vocab < n_endpoints)
+            return np.zeros((0, self.dim), np.float32)
         return np.concatenate(parts) if len(parts) > 1 else parts[0]
 
     def load(self, arr):
@@ -330,25 +343,43 @@ class ShardedRemoteTable:
         local = ids // self._n
         return ep, local
 
+    def _fanout(self, fns):
+        """Per-shard requests run concurrently (the reference dispatches
+        shard RPCs in parallel; serial round-trips would scale latency
+        with endpoint count in the training hot path)."""
+        if len(fns) == 1:
+            return [fns[0]()]
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=len(fns)) as pool:
+            return list(pool.map(lambda f: f(), fns))
+
     def pull(self, ids):
         ids = np.asarray(ids).reshape(-1).astype(np.int64)
         ep, local = self._split(ids)
         out = np.empty((ids.shape[0], self.dim), np.float32)
+        jobs, masks = [], []
         for k, sh in enumerate(self._shards):
             mask = ep == k
             if mask.any():
-                out[mask] = sh.pull(local[mask])
+                jobs.append(lambda s=sh, m=mask: s.pull(local[m]))
+                masks.append(mask)
+        for mask, rows in zip(masks, self._fanout(jobs)):
+            out[mask] = rows
         return out
 
     def push(self, ids, grads, lr=0.01, optimizer="sgd", eps=1e-6):
         ids = np.asarray(ids).reshape(-1).astype(np.int64)
         grads = np.asarray(grads, np.float32).reshape(ids.shape[0], self.dim)
         ep, local = self._split(ids)
+        jobs = []
         for k, sh in enumerate(self._shards):
             mask = ep == k
             if mask.any():
-                sh.push(local[mask], grads[mask], lr=lr,
-                        optimizer=optimizer, eps=eps)
+                jobs.append(lambda s=sh, m=mask: s.push(
+                    local[m], grads[m], lr=lr, optimizer=optimizer,
+                    eps=eps))
+        self._fanout(jobs)
 
     def dump(self):
         out = np.zeros((self.vocab, self.dim), np.float32)

@@ -1,0 +1,131 @@
+"""Fleet parameter-server mode (reference
+``incubate/fleet/parameter_server/distribute_transpiler/__init__.py``):
+the ``fleet.init / distributed_optimizer / init_server / run_server /
+init_worker`` recipe over the DistributeTranspiler + TCP serving tier.
+
+Worker flow:
+    fleet.init(role)                       # role: worker
+    opt = fleet.distributed_optimizer(optimizer.SGD(...))
+    opt.minimize(loss)                     # builds + transpiles
+    fleet.init_worker()                    # swap tables to remote proxies
+    exe.run(fleet.main_program, ...)
+Server flow (servers build the SAME graph so the transpiler learns the
+table shapes — the reference's pserver scripts do the same):
+    fleet.init(role)                       # role: server
+    opt = fleet.distributed_optimizer(optimizer.SGD(...))
+    opt.minimize(loss)
+    fleet.init_server()
+    fleet.run_server()                     # blocks, serving this endpoint
+"""
+
+from ..base.fleet_base import DistributedOptimizer, Fleet
+from ..base.role_maker import Role
+
+__all__ = ["fleet", "TranspilerOptimizer", "ParameterServerFleet"]
+
+
+class ParameterServerFleet(Fleet):
+    def __init__(self):
+        super().__init__()
+        self._transpiler = None
+        self._main_program = None
+        self._startup_program = None
+        self._pserver_prog = None
+
+    # -- programs -----------------------------------------------------------
+    @property
+    def main_program(self):
+        return self._main_program
+
+    @property
+    def startup_program(self):
+        return self._startup_program
+
+    # -- transpile hook (called by TranspilerOptimizer.minimize) ------------
+    def _compile_time_transpile(self, loss, startup_program=None):
+        from ....framework import default_startup_program
+        from ....transpiler import DistributeTranspiler
+
+        self._main_program = loss.block.program
+        self._startup_program = (startup_program or
+                                 default_startup_program())
+        eps = ",".join(self._role_maker.get_pserver_endpoints())
+        self._transpiler = DistributeTranspiler()
+        self._transpiler.transpile(
+            trainer_id=self._role_maker.worker_index(),
+            program=self._main_program, pservers=eps,
+            trainers=self._role_maker.worker_num())
+
+    def _require_transpiled(self, what):
+        if self._transpiler is None:
+            raise RuntimeError(
+                "%s needs fleet.distributed_optimizer(...).minimize(loss) "
+                "first (nothing transpiled yet)" % what)
+
+    # -- worker -------------------------------------------------------------
+    def init_worker(self):
+        self._require_transpiled("init_worker")
+        self._main_program = self._transpiler.get_trainer_program()
+        return self._main_program
+
+    def stop_worker(self):
+        from .....distributed import ps
+
+        for name in list(self._transpiler._tables
+                         if self._transpiler else []):
+            table = ps.get_table(name)
+            if hasattr(table, "close"):
+                table.close()
+
+    # -- server -------------------------------------------------------------
+    def init_server(self, *args, **kwargs):
+        self._require_transpiled("init_server")
+        ep = self._role_maker.get_pserver_endpoints()[
+            self._role_maker.server_index()]
+        self._pserver_prog = self._transpiler.get_pserver_program(ep)
+        return self._pserver_prog
+
+    def run_server(self):
+        """Blocks serving this endpoint (reference RunSyncLoop)."""
+        if self._pserver_prog is None:
+            self.init_server()
+        from ....executor import Executor
+
+        Executor().run(self._pserver_prog)
+
+    # -- facade plumbing ----------------------------------------------------
+    def distributed_optimizer(self, optimizer, strategy=None):
+        return TranspilerOptimizer(optimizer, strategy, fleet_obj=self)
+
+    def save_persistables(self, executor, dirname, main_program=None):
+        from .... import io
+
+        io.save_persistables(executor, dirname,
+                             main_program or self._main_program)
+
+    def save_inference_model(self, executor, dirname, feeded_var_names,
+                             target_vars, main_program=None):
+        from .... import io
+
+        io.save_inference_model(dirname, feeded_var_names, target_vars,
+                                executor,
+                                main_program or self._main_program)
+
+
+class TranspilerOptimizer(DistributedOptimizer):
+    """minimize() = inner optimizer minimize + PS transpile (reference
+    ``TranspilerOptimizer.minimize``)."""
+
+    def __init__(self, optimizer, strategy=None, fleet_obj=None):
+        super().__init__(optimizer, strategy)
+        self._fleet = fleet_obj
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        result = self._optimizer.minimize(loss, startup_program,
+                                          parameter_list, no_grad_set)
+        self._fleet._compile_time_transpile(loss, startup_program)
+        return result
+
+
+fleet = ParameterServerFleet()

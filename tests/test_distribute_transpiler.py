@@ -155,3 +155,67 @@ def test_distribute_transpiler_e2e_matches_local():
                             preload=baseline_init)
     np.testing.assert_allclose(remote_final, local_final, rtol=1e-5,
                                atol=1e-6)
+
+
+def test_fleet_parameter_server_mode():
+    """The reference recipe through the Fleet facade: server role runs
+    run_server() (blocking, in a thread), worker role transpiles via
+    distributed_optimizer + init_worker and trains."""
+    from paddle_tpu.fluid.incubate.fleet.base.role_maker import (
+        Role, UserDefinedRoleMaker)
+    from paddle_tpu.fluid.incubate.fleet.parameter_server import (
+        ParameterServerFleet)
+
+    vocab, dim = 12, 3
+    probes = [TableServer() for _ in range(2)]
+    eps = [s.endpoint for s in probes]
+    for s in probes:
+        s.stop()
+
+    rng = np.random.RandomState(5)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = layers.data("fl_ids", [1], dtype="int64")
+        label = layers.data("fl_label", [1], dtype="float32")
+        emb = layers.reshape(layers.embedding(
+            ids, size=[vocab, dim], is_distributed=True,
+            param_attr=fluid.ParamAttr(name="fl_emb")), [-1, dim])
+        loss = layers.reduce_mean(
+            layers.square(layers.fc(emb, 1) - label))
+
+        # worker-side fleet
+        worker = ParameterServerFleet()
+        worker.init(UserDefinedRoleMaker(current_id=0, role=Role.WORKER,
+                                         worker_num=1,
+                                         server_endpoints=eps))
+        opt = worker.distributed_optimizer(optimizer.SGD(learning_rate=0.1))
+        opt.minimize(loss)
+
+    # server-side fleets (threads standing in for pserver processes)
+    for k, ep in enumerate(eps):
+        server = ParameterServerFleet()
+        server.init(UserDefinedRoleMaker(current_id=k, role=Role.SERVER,
+                                         worker_num=1,
+                                         server_endpoints=eps))
+        sopt = server.distributed_optimizer(
+            optimizer.SGD(learning_rate=0.1))
+        # servers see the same graph; transpile records the table specs
+        sopt._fleet._transpiler = worker._transpiler
+        server.init_server()
+        threading.Thread(target=server.run_server, daemon=True).start()
+    wait_server_ready(eps)
+
+    trainer_prog = worker.init_worker()
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(25):
+            b = rng.randint(0, vocab, (8, 1)).astype(np.int64)
+            y = (b % 2).astype(np.float32)
+            (lv,) = exe.run(trainer_prog,
+                            feed={"fl_ids": b, "fl_label": y},
+                            fetch_list=[loss])
+            losses.append(float(np.asarray(lv).ravel()[0]))
+    assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
+    worker.stop_worker()
