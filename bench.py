@@ -231,10 +231,11 @@ def bench_lenet(batch_size=1024, warmup=10, iters=100):
             "lenet_batch_size": batch_size}
 
 
-def bench_longseq(batch_size=8, seq_len=2048, warmup=3, iters=10):
-    """Long-context single-chip BERT (opt-in BENCH_LONGSEQ=1): exercises
-    the Q-tiled long-sequence attention kernels
-    (kernels/attention.py dispatch tier 2)."""
+def bench_longseq(batch_size=8, seq_len=2048, warmup=3, iters=10,
+                  prefix="longseq"):
+    """Long-context single-chip BERT (opt-in BENCH_LONGSEQ=1): s=2048
+    exercises the Q-tiled long kernels (dispatch tier 2), s=4096 the
+    flash split-backward tier (kernels/attention.py)."""
     import paddle_tpu.fluid as fluid
     from paddle_tpu.models import bert
 
@@ -255,20 +256,20 @@ def bench_longseq(batch_size=8, seq_len=2048, warmup=3, iters=10):
             assert np.isfinite(np.asarray(lv)).all()
         tps, _, step_s = _stable_throughput(
             exe, main, batch, loss, iters, jax, batch_size * seq_len,
-            "longseq tokens/sec")
+            prefix + " tokens/sec")
     flops = bert_train_flops_per_step(cfg, batch_size, seq_len,
                                       bert.max_predictions(seq_len))
     peak, peak_source = _peak_flops(jax.devices()[0])
     mfu = flops / step_s / peak
     assert mfu <= 1.0, (
-        "longseq MFU %.3f > 1: peak table wrong or timing missed work"
-        % mfu)
-    return {"longseq_tokens_per_sec": round(tps, 1),
-            "longseq_step_time_ms": round(step_s * 1e3, 3),
-            "longseq_mfu": round(mfu, 4),
-            "longseq_peak_source": peak_source,
-            "longseq_batch_size": batch_size,
-            "longseq_seq_len": seq_len}
+        "%s MFU %.3f > 1: peak table wrong or timing missed work"
+        % (prefix, mfu))
+    return {prefix + "_tokens_per_sec": round(tps, 1),
+            prefix + "_step_time_ms": round(step_s * 1e3, 3),
+            prefix + "_mfu": round(mfu, 4),
+            prefix + "_peak_source": peak_source,
+            prefix + "_batch_size": batch_size,
+            prefix + "_seq_len": seq_len}
 
 
 def bench_deepfm(batch_size=4096, warmup=8, iters=40):
@@ -398,4 +399,6 @@ if __name__ == "__main__":
         out.update(bench_transformer())
     if os.environ.get("BENCH_LONGSEQ") == "1":
         out.update(bench_longseq())
+        out.update(bench_longseq(batch_size=4, seq_len=4096,
+                                 prefix="longseq4k"))
     print(json.dumps(out))

@@ -20,9 +20,19 @@ Sequence-length dispatch (single chip):
       scores exist only as [Qb, S] tiles; dk/dv accumulate across the
       q-tile grid dim. Measured v5e BERT-base s=2048: 3.1x over the
       blockwise fallback (20k -> 63k tokens/sec).
-  beyond — blockwise online-softmax scan (no [S, S] anywhere); and the
-      ring/Ulysses layers in ``paddle_tpu.parallel`` shard S over chips
-      (SURVEY §5.7).
+  ~3k < S — flash tier (_flash_*): BOTH q and k are tiled, so no VMEM
+      term scales with S². The forward runs online softmax over k-tiles
+      in VMEM scratch and saves per-row logsumexp; the backward is the
+      flash-attention-2 SPLIT pair — one kernel accumulates dq over
+      k-tiles, a second accumulates dk/dv over q-tiles — each
+      regenerating probabilities from the saved logsumexp, which is
+      exactly the split the fused long-kernel backward could not fit
+      (its K/V + dK/dV [S, d] blocks plus [Qb, S] tiles overflow scoped
+      VMEM at S=4096; see _long_qb). Row-broadcast bias only
+      (per-row bias falls through to blockwise).
+  fallback — blockwise online-softmax scan (no [S, S] anywhere); and
+      the ring/Ulysses layers in ``paddle_tpu.parallel`` shard S over
+      chips (SURVEY §5.7).
 """
 
 import functools
@@ -247,7 +257,8 @@ def _long_qb(S, d):
     safety margin under those measurements."""
     # Measured at S=4096/d=64: 17.96M (qb=128), 16.92M (64), 16.39M (32) —
     # the qb-independent K/V/dK/dV double-buffering dominates, so smaller
-    # tiles can't rescue S=4096; a split dq/dkdv bwd pair could.
+    # tiles can't rescue S=4096; the flash tier's split dq/dkdv pair
+    # (_flash_dq_kernel/_flash_dkdv_kernel) takes over there.
     for qb in (128, 64):
         if S % qb:
             continue
@@ -453,6 +464,286 @@ def _pallas_attention_long_bwd(q, k, v, bias, seed, do, scale, p_drop):
     return dq, dk.astype(q.dtype), dv.astype(q.dtype), dbias
 
 
+_FLASH_BLOCK_CANDIDATES = (512, 256, 128)
+
+
+def _flash_block(S):
+    """Tile edge for the flash tier: largest candidate dividing S. Both q
+    and k use the same edge, so the score tile is [Tb, Tb] and nothing in
+    VMEM scales with S (at Tb=512/d=64 the whole working set is ~6 MB)."""
+    for tb in _FLASH_BLOCK_CANDIDATES:
+        if S % tb == 0:
+            return tb
+    return None
+
+
+def _use_flash_kernel(q, p_drop, bias):
+    B, H, S, d = q.shape
+    if not _supports_pallas() or S <= _MAX_FUSED_SEQ:
+        return False
+    if _use_long_kernel(q, p_drop, bias):
+        return False        # the measured-faster long tier wins <=~3k
+    if _flash_block(S) is None:
+        return False
+    if bias.shape[2] != 1:
+        # per-row bias: dbias would need [B, H, S, S] f32 partials in
+        # HBM (6+ GB at S=4096) — take the blockwise path instead
+        return False
+    return not (_interpret() and p_drop > 0.0)
+
+
+def _flash_seed(seed0, b, h, i, j, n_heads, nq, nk):
+    """One PRNG stream per (batch, head, q-tile, k-tile): all three flash
+    kernels request [Tb, Tb]-shaped bits under this seed, so the dropout
+    mask regenerates bit-exactly in both backward kernels."""
+    return seed0 + (((b * n_heads + h) * nq + i) * nk + j)
+
+
+def _flash_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref,
+                      lse_ref, acc_scr, m_scr, l_scr, *, scale, p_drop,
+                      n_heads, nq, nk):
+    """Grid (B, H, nq, nk), k-tile fastest: classic online softmax. The
+    (m, l, acc) carries live in VMEM scratch across the k-tile sweep; o
+    and the row logsumexp L are written on the last k-tile. Dropout
+    masks only the value accumulation — the denominator uses undropped
+    weights (same semantics as _blockwise_attention)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    j = pl.program_id(3)
+    q = q_ref[0, 0]                               # [Tb, d]
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    s = s + bias_ref[0, 0]                        # [1, Tb] row-broadcast
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full(m_scr.shape, -1e30, jnp.float32)
+        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    m_prev = m_scr[...]                           # [Tb, 1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)                        # [Tb, Tb]
+    corr = jnp.exp(m_prev - m_new)
+    l_scr[...] = l_scr[...] * corr + jnp.sum(p, axis=-1, keepdims=True)
+    if p_drop > 0.0:
+        b, h, i = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+        pltpu.prng_seed(_flash_seed(seed_ref[0], b, h, i, j,
+                                    n_heads, nq, nk))
+        u = _uniform_from_bits(pltpu.prng_random_bits(p.shape))
+        p = jnp.where(u >= p_drop, p / (1.0 - p_drop), 0.0)
+    acc_scr[...] = acc_scr[...] * corr + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_scr[...] = m_new
+
+    @pl.when(j == nk - 1)
+    def _emit():
+        l = l_scr[...]
+        o_ref[0, 0] = (acc_scr[...] / l).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_scr[...] + jnp.log(l)       # [Tb, 1]
+
+
+def _flash_dq_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
+                     lse_ref, dd_ref, dq_ref, dbias_ref, *, scale, p_drop,
+                     n_heads, nq, nk):
+    """Split backward, half 1 — grid (B, H, nq, nk), k-tile fastest: the
+    dq block (keyed on the q-tile) accumulates over consecutive k-tile
+    steps. Probabilities regenerate from the saved logsumexp: p =
+    exp(s - L) is exactly softmax without a second online pass. Also
+    emits per-(q-tile) dbias partials, reduced outside the kernel."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    j = pl.program_id(3)
+    q = q_ref[0, 0]                               # [Tb, d]
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
+    do = do_ref[0, 0]
+    lse = lse_ref[0, 0]                           # [Tb, 1]
+    dd = dd_ref[0, 0]                             # rowsum(do*o) [Tb, 1]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    s = s + bias_ref[0, 0]
+    p = jnp.exp(s - lse)                          # undropped softmax rows
+    if p_drop > 0.0:
+        b, h, i = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+        pltpu.prng_seed(_flash_seed(seed_ref[0], b, h, i, j,
+                                    n_heads, nq, nk))
+        u = _uniform_from_bits(pltpu.prng_random_bits(p.shape))
+        pd = jnp.where(u >= p_drop, p / (1.0 - p_drop), 0.0)
+    else:
+        pd = p
+    dpd = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    ds = pd * dpd - p * dd                        # [Tb, Tb]
+    dbias_ref[0, 0] = jnp.sum(ds, axis=0, keepdims=True)
+    contrib = jax.lax.dot_general(ds.astype(q.dtype), k,
+                                  (((1,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32) * scale
+
+    @pl.when(j == 0)
+    def _init():
+        dq_ref[0, 0] = contrib
+
+    @pl.when(j != 0)
+    def _acc():
+        dq_ref[0, 0] += contrib
+
+
+def _flash_dkdv_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
+                       lse_ref, dd_ref, dk_ref, dv_ref, *, scale, p_drop,
+                       n_heads, nq, nk):
+    """Split backward, half 2 — grid (B, H, nk, nq), q-tile fastest: the
+    dk/dv blocks (keyed on the k-tile) accumulate over consecutive
+    q-tile steps. The PRNG seed uses the same (i, j) formula as the
+    forward, so the regenerated mask is bit-exact despite the
+    transposed grid order."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    j, i = pl.program_id(2), pl.program_id(3)
+    q = q_ref[0, 0]                               # [Tb, d]
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
+    do = do_ref[0, 0]
+    lse = lse_ref[0, 0]
+    dd = dd_ref[0, 0]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    s = s + bias_ref[0, 0]
+    p = jnp.exp(s - lse)
+    if p_drop > 0.0:
+        b, h = pl.program_id(0), pl.program_id(1)
+        pltpu.prng_seed(_flash_seed(seed_ref[0], b, h, i, j,
+                                    n_heads, nq, nk))
+        u = _uniform_from_bits(pltpu.prng_random_bits(p.shape))
+        pd = jnp.where(u >= p_drop, p / (1.0 - p_drop), 0.0)
+    else:
+        pd = p
+    lp = q.dtype
+    dv = jax.lax.dot_general(pd.astype(lp), do,
+                             (((0,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)  # [Tb, d]
+    dpd = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    ds = pd * dpd - p * dd
+    dk = jax.lax.dot_general(ds.astype(lp), q,
+                             (((0,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32) * scale
+
+    @pl.when(i == 0)
+    def _init():
+        dk_ref[0, 0] = dk
+        dv_ref[0, 0] = dv
+
+    @pl.when(i != 0)
+    def _acc():
+        dk_ref[0, 0] += dk
+        dv_ref[0, 0] += dv
+
+
+def _flash_specs(q, bias):
+    from jax.experimental import pallas as pl
+
+    B, H, S, d = q.shape
+    TB = _flash_block(S)
+    nt = S // TB
+    hb = bias.shape[1]
+    qspec = pl.BlockSpec((1, 1, TB, d), lambda b, h, i, j: (b, h, i, 0))
+    kspec = pl.BlockSpec((1, 1, TB, d), lambda b, h, i, j: (b, h, j, 0))
+    bspec = pl.BlockSpec((1, 1, 1, TB),
+                         lambda b, h, i, j, _hb=hb: (b, h if _hb > 1 else 0,
+                                                     0, j))
+    # per-row stats (lse, rowsum(do*o)) ride as [B, H, S, 1]: trailing
+    # dim 1 satisfies the TPU block-shape rule (equal to the array dim)
+    # and [Tb, 1] blocks line up with the kernels' column-vector math
+    rowspec = pl.BlockSpec((1, 1, TB, 1), lambda b, h, i, j: (b, h, i, 0))
+    return TB, nt, qspec, kspec, bspec, rowspec
+
+
+def _pallas_attention_flash(q, k, v, bias, scale, p_drop, seed):
+    """Returns (o, lse): lse [B, H, S] f32 feeds the split backward."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, S, d = q.shape
+    TB, nt, qspec, kspec, bspec, rowspec = _flash_specs(q, bias)
+    f32 = jnp.float32
+    return pl.pallas_call(
+        functools.partial(_flash_fwd_kernel, scale=scale, p_drop=p_drop,
+                          n_heads=H, nq=nt, nk=nt),
+        grid=(B, H, nt, nt),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  qspec, kspec, kspec, bspec],
+        out_specs=[qspec, rowspec],
+        out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype),
+                   jax.ShapeDtypeStruct((B, H, S, 1), f32)],
+        scratch_shapes=[pltpu.VMEM((TB, d), f32),
+                        pltpu.VMEM((TB, 1), f32),
+                        pltpu.VMEM((TB, 1), f32)],
+        interpret=_interpret(),
+    )(seed, q, k, v, bias)
+
+
+def _pallas_attention_flash_bwd(q, k, v, bias, seed, do, o, lse, scale,
+                                p_drop):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, S, d = q.shape
+    TB, nt, qspec, kspec, bspec, rowspec = _flash_specs(q, bias)
+    f32 = jnp.float32
+    dd = jnp.sum(do.astype(f32) * o.astype(f32), axis=-1,
+                 keepdims=True)                            # [B, H, S, 1]
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    # dbias partials: one [1, TB] row-sum per (q-tile, k-tile), each
+    # block written exactly once (no cross-grid-dim revisit hazards);
+    # laid out [B, H*nt, 1, S] to satisfy the TPU block-shape rule, and
+    # reduced to the bias broadcast shape with plain XLA below.
+    dbpspec = pl.BlockSpec(
+        (1, 1, 1, TB), lambda b, h, i, j, _nt=nt: (b, h * _nt + i, 0, j))
+    dq, dbp = pl.pallas_call(
+        functools.partial(_flash_dq_kernel, scale=scale, p_drop=p_drop,
+                          n_heads=H, nq=nt, nk=nt),
+        grid=(B, H, nt, nt),
+        in_specs=[smem, qspec, kspec, kspec, bspec, qspec, rowspec,
+                  rowspec],
+        out_specs=[qspec, dbpspec],
+        out_shape=[jax.ShapeDtypeStruct(q.shape, f32),
+                   jax.ShapeDtypeStruct((B, H * nt, 1, S), f32)],
+        interpret=_interpret(),
+    )(seed, q, k, v, bias, do, lse, dd)
+    # transposed grid: k-tile is the SLOW tile dim so dk/dv accumulate
+    # over consecutive q-tile steps
+    qspec_t = pl.BlockSpec((1, 1, TB, d), lambda b, h, j, i: (b, h, i, 0))
+    kspec_t = pl.BlockSpec((1, 1, TB, d), lambda b, h, j, i: (b, h, j, 0))
+    bspec_t = pl.BlockSpec(
+        (1, 1, 1, TB),
+        lambda b, h, j, i, _hb=bias.shape[1]: (b, h if _hb > 1 else 0,
+                                               0, j))
+    rowspec_t = pl.BlockSpec((1, 1, TB, 1), lambda b, h, j, i: (b, h, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_dkdv_kernel, scale=scale, p_drop=p_drop,
+                          n_heads=H, nq=nt, nk=nt),
+        grid=(B, H, nt, nt),
+        in_specs=[smem, qspec_t, kspec_t, kspec_t, bspec_t, qspec_t,
+                  rowspec_t, rowspec_t],
+        out_specs=[kspec_t, kspec_t],
+        out_shape=[jax.ShapeDtypeStruct(q.shape, f32),
+                   jax.ShapeDtypeStruct(q.shape, f32)],
+        interpret=_interpret(),
+    )(seed, q, k, v, bias, do, lse, dd)
+    dbias = jnp.sum(dbp.reshape(B, H, nt, S), axis=2,
+                    keepdims=False)[:, :, None, :]         # [B, H, 1, S]
+    if bias.shape[1] == 1:
+        dbias = jnp.sum(dbias, axis=1, keepdims=True)
+    return dq, dk, dv, dbias
+
+
 def _batch_block(B, S, tile_budget):
     """Largest divisor of B whose [Bb, S, S] fp32 score tile stays under
     ``tile_budget`` bytes (the fwd kernel holds ~4 such temporaries, the
@@ -550,15 +841,32 @@ def _fused(q, k, v, bias, scale, p_drop, seed):
         return _pallas_attention(q, k, v, bias, scale, p_drop, seed)
     if _use_long_kernel(q, p_drop, bias):
         return _pallas_attention_long(q, k, v, bias, scale, p_drop, seed)
+    if _use_flash_kernel(q, p_drop, bias):
+        return _pallas_attention_flash(q, k, v, bias, scale, p_drop,
+                                       seed)[0]
     return _fallback_attention(q, k, v, bias, scale, p_drop, seed)
 
 
 def _fused_fwd(q, k, v, bias, scale, p_drop, seed):
-    return _fused(q, k, v, bias, scale, p_drop, seed), (q, k, v, bias, seed)
+    if _use_flash_kernel(q, p_drop, bias):
+        # the split backward regenerates probabilities from the row
+        # logsumexp and needs rowsum(do*o), so o and lse join the
+        # residuals (flash-attention-2 residual set: q, k, v, o, L)
+        o, lse = _pallas_attention_flash(q, k, v, bias, scale, p_drop,
+                                         seed)
+        return o, (q, k, v, bias, seed, (o, lse))
+    out = _fused(q, k, v, bias, scale, p_drop, seed)
+    return out, (q, k, v, bias, seed, None)
 
 
 def _fused_bwd(scale, p_drop, res, do):
-    q, k, v, bias, seed = res
+    q, k, v, bias, seed, flash_res = res
+    if flash_res is not None:
+        o, lse = flash_res
+        dq, dk, dv, dbias = _pallas_attention_flash_bwd(
+            q, k, v, bias, seed, do, o, lse, scale, p_drop)
+        return (dq.astype(q.dtype), dk.astype(q.dtype), dv.astype(q.dtype),
+                dbias.astype(bias.dtype), _seed_ct(seed))
     if _use_kernel(q, p_drop):
         dq, dk, dv, dbias = _pallas_attention_bwd(q, k, v, bias, seed, do,
                                                scale, p_drop)
