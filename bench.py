@@ -101,6 +101,85 @@ def _stable_throughput(exe, main, feed, loss, iters, jax, units_per_step,
     return r2, r1, elapsed2 / (2 * iters)
 
 
+def _profile_table(exe, main, batch, loss, jax, steps=3,
+                   out_path="bench_profile.txt"):
+    """BENCH_PROFILE=1: trace `steps` steps with jax.profiler, parse the
+    XPlane proto, and write a per-op device-time table (reference
+    ``platform/profiler.h:166`` per-op tables). Parsing needs the
+    xplane proto bundled with tensorflow; degrades to a notice when
+    absent."""
+    import glob as _glob
+    import shutil
+    import tempfile
+    import collections
+    import re as _re
+
+    tracedir = tempfile.mkdtemp(prefix="bench_xplane_")
+    try:
+        jax.profiler.start_trace(tracedir)
+        for _ in range(steps):
+            (lv,) = exe.run(main, feed=batch, fetch_list=[loss])
+        np.asarray(lv)
+        jax.profiler.stop_trace()
+        try:
+            from tensorflow.tsl.profiler.protobuf import xplane_pb2
+        except Exception as e:  # pragma: no cover - env without TF
+            with open(out_path, "w") as f:
+                f.write("xplane parser unavailable (%s); raw trace kept "
+                        "in %s\n" % (e, tracedir))
+            return
+        files = _glob.glob(tracedir + "/**/*.xplane.pb", recursive=True)
+        if not files:
+            with open(out_path, "w") as f:
+                f.write("no .xplane.pb produced under %s\n" % tracedir)
+            return
+        xs = xplane_pb2.XSpace()
+        with open(files[0], "rb") as f:
+            xs.ParseFromString(f.read())
+        planes = [p for p in xs.planes if "/device:" in p.name
+                  and any(len(ln.events) for ln in p.lines)]
+        lines = []
+        for plane in planes:
+            md = plane.event_metadata
+            for ln in plane.lines:
+                if ln.name != "XLA Ops":
+                    continue
+                per_inst = collections.Counter()
+                per_family = collections.Counter()
+                n_inst = collections.Counter()
+                total = 0
+                for ev in ln.events:
+                    name = md[ev.metadata_id].name
+                    inst = name.split(" = ")[0].strip().lstrip("%")
+                    fam = _re.sub(r"\.\d+$", "", inst)
+                    shape = name.split(" = ")[1].split(" ")[0] \
+                        if " = " in name else ""
+                    per_inst[(inst, shape)] += ev.duration_ps
+                    per_family[fam] += ev.duration_ps
+                    n_inst[fam] += 1
+                    total += ev.duration_ps
+                lines.append("== %s: %.3f ms/step device op time ==" %
+                             (plane.name, total / 1e9 / steps))
+                lines.append("-- by fusion family --")
+                for fam, ps in per_family.most_common(15):
+                    lines.append("%10.3f ms/step %5.1f%% n=%-5d %s" % (
+                        ps / 1e9 / steps, 100.0 * ps / max(total, 1),
+                        n_inst[fam] // steps, fam))
+                lines.append("-- top instructions --")
+                for (inst, shape), ps in per_inst.most_common(25):
+                    lines.append("%10.3f ms/step %5.1f%%  %s  %s" % (
+                        ps / 1e9 / steps, 100.0 * ps / max(total, 1),
+                        inst, shape[:70]))
+        if not lines:
+            lines = ["no device plane with an 'XLA Ops' line in the "
+                     "trace (CPU/interpret run?)"]
+        with open(out_path, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        print("profile table -> %s" % out_path, file=sys.stderr)
+    finally:
+        shutil.rmtree(tracedir, ignore_errors=True)
+
+
 def bench_bert(batch_size=128, seq_len=128, warmup=3, iters=20):
     import paddle_tpu.fluid as fluid
     from paddle_tpu.models import bert
@@ -131,6 +210,8 @@ def bench_bert(batch_size=128, seq_len=128, warmup=3, iters=20):
         tps2, tps, step_s = _stable_throughput(
             exe, main, batch, loss, iters, jax, batch_size * seq_len,
             "bert tokens/sec")
+        if os.environ.get("BENCH_PROFILE") == "1":
+            _profile_table(exe, main, batch, loss, jax)
 
     # report the larger (more averaged) run
     step_time_ms = step_s * 1e3

@@ -33,6 +33,17 @@ Sequence-length dispatch (single chip):
   fallback — blockwise online-softmax scan (no [S, S] anywhere); and
       the ring/Ulysses layers in ``paddle_tpu.parallel`` shard S over
       chips (SURVEY §5.7).
+
+There is also a PACKED tier (``fused_attention_packed``): q/k/v in the
+fc-native [B, S, H*d] layout with heads split/merged inside the kernel,
+eliminating the head transposes from the graph. Honest status from v5e
+measurement at BERT-base b=128/s=128: it LOSES to XLA's batched-GEMM
+chain end-to-end (157 ms step vs 87 ms — the per-(batch, head-chunk)
+grid is latency-bound at tiny S), as does the per-head fused kernel
+(126 ms — layout glue around the custom call). It is kept as a
+correct, tested building block for shapes with larger S·heads per
+block; BERT's ``use_fused_attention="auto"`` picks the GEMM chain
+below S=256.
 """
 
 import functools
@@ -744,6 +755,330 @@ def _pallas_attention_flash_bwd(q, k, v, bias, seed, do, o, lse, scale,
     return dq, dk, dv, dbias
 
 
+_PACKED_MAX_SEQ = 256  # past this even hc=1 chunks overflow the temp
+                       # budget (22 live [S, S] f32 tiles, _packed_hc)
+
+
+def _packed_hc(n_heads, S):
+    """Heads per inner chunk: largest divisor of H whose live
+    [hc, S, S] f32 score-family temporaries stay under 8 MB. Measured
+    anchor: 12 unchunked heads at S=128 allocated 17.45 MB of kernel
+    stack — ~22 live [S, S] f32 tiles per head once Mosaic's scheduler
+    is done, hence the 22x coefficient."""
+    for hc in range(n_heads, 0, -1):
+        if n_heads % hc:
+            continue
+        if 22 * hc * S * S * 4 <= 8 * 1024 * 1024:
+            return hc
+    return None
+
+
+def _packed_bb(B, S, HD, n_heads):
+    """Batch block for the packed kernels: largest divisor of B whose
+    backward DMA set (7 double-buffered [Bb, S, H, d] bf16 in/out blocks
+    + their in-VMEM transposed copies) plus the chunked ~8 MB temp
+    budget fits scoped VMEM. The backward bound is used for the forward
+    too so the dropout PRNG draw shapes line up (cf. _fwd_budget)."""
+    if _packed_hc(n_heads, S) is None:
+        return None
+    best = None
+    for bb in range(1, B + 1):
+        if B % bb:
+            continue
+        est = 42 * bb * S * HD + 8 * 1024 * 1024
+        if est <= 15 * 1024 * 1024:
+            best = bb
+    return best
+
+
+def _use_packed_kernel(q3, n_heads, p_drop, bias):
+    """Packed tier: q/k/v in the fc-native [B, S, H*d] layout, heads
+    looped inside the kernel. Kills BOTH failure modes of small-S
+    attention: the XLA chain's HBM-materialized [B,H,S,S] probability
+    tensors, and the layout copies/transposes the per-head kernel's
+    [B,H,S,d] operands force around every custom call."""
+    B, S, HD = q3.shape
+    if not _supports_pallas() or S > _PACKED_MAX_SEQ:
+        return False
+    if HD % n_heads or _packed_bb(B, S, HD, n_heads) is None:
+        return False
+    if bias.shape[2] != 1 or bias.shape[1] not in (1, n_heads):
+        return False
+    return not (_interpret() and p_drop > 0.0)
+
+
+def _split_heads_vmem(t):
+    """[Bb, S, H, d] -> [Bb*H, S, d] entirely in VMEM — ONE transpose per
+    operand (per-head lane slices of a packed [.., H*d] block at d=64
+    would trigger a sub-128-lane relayout for every head; splitting the
+    lane dim in-kernel is an unsupported Mosaic shape cast, so the 4D
+    view is bitcast OUTSIDE the kernel). Heads merge into the single
+    batch dim Mosaic's tpu.matmul supports."""
+    Bb, S, H, d = t.shape
+    return jnp.swapaxes(t, 1, 2).reshape(Bb * H, S, d)
+
+
+def _merge_heads_vmem(t, n_heads):
+    """Inverse of _split_heads_vmem: [Bb*H, S, d] -> [Bb, S, H, d]."""
+    BH, S, d = t.shape
+    Bb = BH // n_heads
+    return jnp.swapaxes(t.reshape(Bb, n_heads, S, d), 1, 2)
+
+
+def _packed_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, *,
+                       scale, p_drop, n_heads):
+    """Grid (B/Bb,): one step = Bb batches, ALL heads, one multi-batch
+    dot over (Bb, H): scores -> softmax -> dropout -> PV with the
+    [Bb, H, S, S] tile never leaving VMEM; the head split/merge is an
+    in-VMEM relayout, so HBM only ever sees the packed layout."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    q = _split_heads_vmem(q_ref[...])             # [Bb*H, S, d], b-major
+    k = _split_heads_vmem(k_ref[...])
+    v = _split_heads_vmem(v_ref[...])
+    BH, S, d = q.shape
+    H = n_heads
+    hc = _packed_hc(H, S)
+    i = pl.program_id(0)
+    dn = (((2,), (2,)), ((0,), (0,)))
+    outs = []
+    for ci in range(BH // hc):
+        # contiguous (b, head-chunk) rows bound the live [hc, S, S] f32
+        # temporaries; leading-dim slices cost no relayout
+        b, c = (ci * hc) // H, (ci * hc) % H
+        rows = slice(ci * hc, (ci + 1) * hc)
+        qc, kc, vc = q[rows], k[rows], v[rows]
+        s = jax.lax.dot_general(qc, kc, dn,
+                                preferred_element_type=jnp.float32) * scale
+        bsl = (bias_ref[b, c:c + hc] if bias_ref.shape[1] > 1
+               else bias_ref[b, 0:1])               # [hc|1, 1, S]
+        s = s + bsl
+        m = jnp.max(s, axis=-1, keepdims=True)
+        e = jnp.exp(s - m)
+        p = e / jnp.sum(e, axis=-1, keepdims=True)
+        if p_drop > 0.0:
+            pltpu.prng_seed(seed_ref[0] + i * BH + ci)
+            u = _uniform_from_bits(pltpu.prng_random_bits(p.shape))
+            p = jnp.where(u >= p_drop, p / (1.0 - p_drop), 0.0)
+        outs.append(jax.lax.dot_general(
+            p.astype(vc.dtype), vc, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32))
+    o = jnp.concatenate(outs, axis=0)
+    o_ref[...] = _merge_heads_vmem(o, n_heads).astype(o_ref.dtype)
+
+
+def _packed_bwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
+                       dq_ref, dk_ref, dv_ref, dbias_ref, *, scale, p_drop,
+                       n_heads):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    q = _split_heads_vmem(q_ref[...])             # [Bb*H, S, d], b-major
+    k = _split_heads_vmem(k_ref[...])
+    v = _split_heads_vmem(v_ref[...])
+    do = _split_heads_vmem(do_ref[...])
+    BH, S, d = q.shape
+    H = n_heads
+    Bb = BH // H
+    hc = _packed_hc(H, S)
+    i = pl.program_id(0)
+    per_head_bias = dbias_ref.shape[1] == n_heads
+    dn = (((2,), (2,)), ((0,), (0,)))
+    lp = q.dtype
+    dqs, dks, dvs, dbs = [], [], [], []
+    for ci in range(BH // hc):
+        b, c = (ci * hc) // H, (ci * hc) % H
+        rows = slice(ci * hc, (ci + 1) * hc)
+        qc, kc, vc, doc = q[rows], k[rows], v[rows], do[rows]
+        s = jax.lax.dot_general(qc, kc, dn,
+                                preferred_element_type=jnp.float32) * scale
+        bsl = (bias_ref[b, c:c + hc] if bias_ref.shape[1] > 1
+               else bias_ref[b, 0:1])
+        s = s + bsl
+        m = jnp.max(s, axis=-1, keepdims=True)
+        e = jnp.exp(s - m)
+        p = e / jnp.sum(e, axis=-1, keepdims=True)
+        if p_drop > 0.0:
+            pltpu.prng_seed(seed_ref[0] + i * BH + ci)  # fwd's stream
+            u = _uniform_from_bits(pltpu.prng_random_bits(p.shape))
+            keep = u >= p_drop
+            pd = jnp.where(keep, p / (1.0 - p_drop), 0.0)
+        else:
+            keep = None
+            pd = p
+        dv_ = jax.lax.dot_general(pd.astype(lp), doc,
+                                  (((1,), (1,)), ((0,), (0,))),
+                                  preferred_element_type=jnp.float32)
+        dpd = jax.lax.dot_general(doc, vc, (((2,), (2,)), ((0,), (0,))),
+                                  preferred_element_type=jnp.float32)
+        dp = dpd if keep is None else jnp.where(keep, dpd / (1.0 - p_drop),
+                                                0.0)
+        ds = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))
+        ds_lp = ds.astype(lp)
+        dqs.append(jax.lax.dot_general(
+            ds_lp, kc, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale)
+        dks.append(jax.lax.dot_general(
+            ds_lp, qc, (((1,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale)
+        dvs.append(dv_)
+        dbs.append(jnp.sum(ds, axis=1))           # [hc, S]
+    dq = jnp.concatenate(dqs, axis=0)
+    dk = jnp.concatenate(dks, axis=0)
+    dv = jnp.concatenate(dvs, axis=0)
+    dq_ref[...] = _merge_heads_vmem(dq, n_heads).astype(dq_ref.dtype)
+    dk_ref[...] = _merge_heads_vmem(dk, n_heads).astype(dk_ref.dtype)
+    dv_ref[...] = _merge_heads_vmem(dv, n_heads).astype(dv_ref.dtype)
+    dsb = jnp.concatenate(dbs, axis=0).reshape(Bb, H, 1, S)
+    if per_head_bias:
+        dbias_ref[...] = dsb                      # [Bb, H, 1, S]
+    else:
+        dbias_ref[...] = jnp.sum(dsb, axis=1, keepdims=True)
+
+
+def _packed_specs4(B, S, H, d, bias, Bb):
+    from jax.experimental import pallas as pl
+
+    # q/k/v ride as 4D [B, S, H, d] bitcast views (free outside the
+    # kernel): block minor dims (H, d) equal the array dims, satisfying
+    # the TPU block-shape rule, and the kernel's head transpose happens
+    # once per operand in VMEM
+    qspec = pl.BlockSpec((Bb, S, H, d), lambda i: (i, 0, 0, 0))
+    bspec = pl.BlockSpec((Bb, bias.shape[1], 1, S), lambda i: (i, 0, 0, 0))
+    return qspec, bspec
+
+
+def _pallas_attention_packed(q3, k3, v3, bias, scale, p_drop, seed,
+                             n_heads):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, S, HD = q3.shape
+    d = HD // n_heads
+    Bb = _packed_bb(B, S, HD, n_heads)
+    qspec, bspec = _packed_specs4(B, S, n_heads, d, bias, Bb)
+    v4 = lambda t: t.reshape(B, S, n_heads, d)
+    o4 = pl.pallas_call(
+        functools.partial(_packed_fwd_kernel, scale=scale, p_drop=p_drop,
+                          n_heads=n_heads),
+        grid=(B // Bb,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  qspec, qspec, qspec, bspec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((B, S, n_heads, d), q3.dtype),
+        interpret=_interpret(),
+    )(seed, v4(q3), v4(k3), v4(v3), bias)
+    return o4.reshape(B, S, HD)
+
+
+def _pallas_attention_packed_bwd(q3, k3, v3, bias, seed, do, scale,
+                                 p_drop, n_heads):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, S, HD = q3.shape
+    d = HD // n_heads
+    Bb = _packed_bb(B, S, HD, n_heads)
+    qspec, bspec = _packed_specs4(B, S, n_heads, d, bias, Bb)
+    dbias_shape = (B, bias.shape[1], 1, S)
+    v4 = lambda t: t.reshape(B, S, n_heads, d)
+    shape4 = jax.ShapeDtypeStruct((B, S, n_heads, d), q3.dtype)
+    dq, dk, dv, dbias = pl.pallas_call(
+        functools.partial(_packed_bwd_kernel, scale=scale, p_drop=p_drop,
+                          n_heads=n_heads),
+        grid=(B // Bb,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  qspec, qspec, qspec, bspec, qspec],
+        out_specs=[qspec, qspec, qspec, bspec],
+        out_shape=[shape4, shape4, shape4,
+                   jax.ShapeDtypeStruct(dbias_shape, jnp.float32)],
+        interpret=_interpret(),
+    )(seed, v4(q3), v4(k3), v4(v3), bias, v4(do))
+    return (dq.reshape(B, S, HD), dk.reshape(B, S, HD),
+            dv.reshape(B, S, HD), dbias)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _packed(q3, k3, v3, bias, scale, p_drop, n_heads, seed):
+    if _use_packed_kernel(q3, n_heads, p_drop, bias):
+        return _pallas_attention_packed(q3, k3, v3, bias, scale, p_drop,
+                                        seed, n_heads)
+    return _packed_fallback(q3, k3, v3, bias, scale, p_drop, n_heads, seed)
+
+
+def _packed_fallback(q3, k3, v3, bias, scale, p_drop, n_heads, seed):
+    """Reshape/transpose into [B, H, S, d] and ride the per-head dispatch
+    (which itself falls back to jnp off-TPU)."""
+    B, S, HD = q3.shape
+    d = HD // n_heads
+
+    def split(t):
+        return jnp.transpose(t.reshape(B, S, n_heads, d), (0, 2, 1, 3))
+
+    o = _fused(split(q3), split(k3), split(v3), bias, scale, p_drop, seed)
+    return jnp.transpose(o, (0, 2, 1, 3)).reshape(B, S, HD)
+
+
+def _packed_fwd(q3, k3, v3, bias, scale, p_drop, n_heads, seed):
+    return (_packed(q3, k3, v3, bias, scale, p_drop, n_heads, seed),
+            (q3, k3, v3, bias, seed))
+
+
+def _packed_bwd(scale, p_drop, n_heads, res, do):
+    q3, k3, v3, bias, seed = res
+    if _use_packed_kernel(q3, n_heads, p_drop, bias):
+        dq, dk, dv, dbias = _pallas_attention_packed_bwd(
+            q3, k3, v3, bias, seed, do, scale, p_drop, n_heads)
+        return dq, dk, dv, dbias.astype(bias.dtype), _seed_ct(seed)
+
+    def f(q_, k_, v_, b_):
+        return _packed_fallback(q_, k_, v_, b_, scale, p_drop, n_heads,
+                                seed)
+
+    _, vjp = jax.vjp(f, q3, k3, v3, bias)
+    dq, dk, dv, dbias = vjp(do)
+    return dq, dk, dv, dbias, _seed_ct(seed)
+
+
+_packed.defvjp(_packed_fwd, _packed_bwd)
+
+
+def fused_attention_packed(q, k, v, bias=None, n_heads=1, scale=None,
+                           dropout_prob=0.0, rng_key=None):
+    """Multi-head attention on PACKED [B, S, H*d] q/k/v (the layout the
+    QKV projections produce) — no head split/merge transposes in the
+    graph; the kernel strides over head slices in VMEM. bias
+    broadcastable [B, 1|H, 1, S] additive; returns [B, S, H*d]."""
+    B, S, HD = q.shape
+    d = HD // n_heads
+    scale, bias, seed = _prep_bias_seed(B, S, d, bias, scale,
+                                        dropout_prob, rng_key)
+    return _packed(q, k, v, bias, scale, float(dropout_prob),
+                   int(n_heads), seed)
+
+
+def _prep_bias_seed(B, S, d, bias, scale, dropout_prob, rng_key):
+    """Shared entry-point epilogue for fused_attention and
+    fused_attention_packed: default scale, f32 bias broadcast to the
+    batch, and the int32 dropout seed derived from rng_key — factored so
+    the two wrappers' dropout streams cannot drift apart."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    if bias is None:
+        bias = jnp.zeros((B, 1, 1, S), jnp.float32)
+    bias = jnp.broadcast_to(bias.astype(jnp.float32),
+                            (B, bias.shape[1], bias.shape[2], S))
+    if dropout_prob > 0.0:
+        if rng_key is None:
+            raise ValueError("dropout_prob > 0 requires rng_key")
+        seed = jax.random.randint(rng_key, (1,), 0, 2 ** 31 - 1,
+                                  dtype=jnp.int32)
+    else:
+        seed = jnp.zeros((1,), jnp.int32)
+    return float(scale), bias, seed
+
+
 def _batch_block(B, S, tile_budget):
     """Largest divisor of B whose [Bb, S, S] fp32 score tile stays under
     ``tile_budget`` bytes (the fwd kernel holds ~4 such temporaries, the
@@ -904,18 +1239,6 @@ def fused_attention(q, k, v, bias=None, scale=None, dropout_prob=0.0,
     (0 keep / -1e4 mask); returns [B, H, S, d] in q's dtype.
     """
     B, H, S, d = q.shape
-    if scale is None:
-        scale = 1.0 / math.sqrt(d)
-    if bias is None:
-        bias = jnp.zeros((B, 1, 1, S), jnp.float32)
-    bias = jnp.broadcast_to(
-        bias.astype(jnp.float32),
-        (B, bias.shape[1], bias.shape[2], S))
-    if dropout_prob > 0.0:
-        if rng_key is None:
-            raise ValueError("dropout_prob > 0 requires rng_key")
-        seed = jax.random.randint(rng_key, (1,), 0, 2 ** 31 - 1,
-                                  dtype=jnp.int32)
-    else:
-        seed = jnp.zeros((1,), jnp.int32)
-    return _fused(q, k, v, bias, float(scale), float(dropout_prob), seed)
+    scale, bias, seed = _prep_bias_seed(B, S, d, bias, scale,
+                                        dropout_prob, rng_key)
+    return _fused(q, k, v, bias, scale, float(dropout_prob), seed)

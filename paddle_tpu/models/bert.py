@@ -68,14 +68,22 @@ def _mha(x, attn_bias, cfg, prefix):
         t = layers.reshape(t, [0, 0, n_heads, d])
         return layers.transpose(t, [0, 2, 1, 3])  # [B, nH, S, d]
 
-    q, k, v = split_heads(q), split_heads(k), split_heads(v)
     seq = x.shape[1]
     use_fused = getattr(cfg, "use_fused_attention", "auto")
     if use_fused == "auto":
-        # measured on v5e: at S=128 XLA's batched-GEMM path wins (the
-        # S x S tile is tiny and the grid serializes); from S>=256 the
-        # in-VMEM fusion pays for itself
+        # measured on v5e: at S=128 XLA's batched-GEMM path wins — the
+        # fused per-head kernel drowns in layout glue (126 ms step vs
+        # 87) and the packed kernel in per-chunk latency (157 ms); from
+        # S>=256 the in-VMEM fusion pays for itself
         use_fused = seq >= 256
+    if use_fused == "packed":
+        # q/k/v stay in the fc-native [B, S, H*d] layout end to end
+        ctx = layers.fused_attention_packed(
+            q, k, v, n_heads, attn_bias,
+            dropout_prob=cfg.attn_dropout or 0.0)
+        return layers.fc(ctx, h, num_flatten_dims=2, name=prefix + "_out",
+                         param_attr=_tp_attr(cfg, "row"))
+    q, k, v = split_heads(q), split_heads(k), split_heads(v)
     if use_fused:
         # one pallas kernel per (batch-block, head): scores/softmax/
         # dropout/PV stay in VMEM (jnp fallback off-TPU) —
@@ -144,6 +152,30 @@ def bert_encoder(src_ids, pos_ids, sent_ids, input_mask, cfg):
     return x
 
 
+def _mlm_logits(x2d, cfg):
+    """Vocab projection for the MLM head. By default the decoder weight is
+    TIED to the word embedding table (the reference's ``weight_sharing``,
+    dist_transformer.py:159,1466: output projection = matmul against the
+    embedding param, transpose_y) — halves the vocab-sized parameter/
+    optimizer-state footprint. ``cfg.tie_mlm_decoder=False`` restores an
+    untied fc."""
+    if getattr(cfg, "tie_mlm_decoder", True):
+        name = getattr(cfg, "embedding_param_name", "word_emb")
+        try:
+            table = fluid.default_main_program().global_block().var(name)
+        except Exception:
+            # head built without bert_encoder in this program (custom
+            # encoder / renamed table): fall back to an untied decoder
+            table = None
+        if table is not None:
+            logits = layers.matmul(x2d, table, transpose_y=True)
+            bias = layers.create_parameter(
+                [cfg.vocab_size], "float32", name="mlm_out_bias",
+                default_initializer=fluid.initializer.Constant(0.0))
+            return layers.elementwise_add(logits, bias)
+    return layers.fc(x2d, cfg.vocab_size, name="mlm_logits")
+
+
 def mlm_loss(enc, mask_label, mask_weight, cfg):
     """Masked-LM loss over all positions, weighted by mask_weight
     [B, S, 1] (1 on masked positions). Static shapes: no gather of dynamic
@@ -151,8 +183,10 @@ def mlm_loss(enc, mask_label, mask_weight, cfg):
     x = layers.fc(enc, cfg.hidden, num_flatten_dims=2, act="gelu",
                   name="mlm_transform")
     x = layers.layer_norm(x, begin_norm_axis=2)
-    logits = layers.fc(x, cfg.vocab_size, num_flatten_dims=2,
-                       name="mlm_logits")
+    b, s = enc.shape[0], enc.shape[1]
+    logits = layers.reshape(
+        _mlm_logits(layers.reshape(x, [-1, cfg.hidden]), cfg),
+        [b, s, cfg.vocab_size])
     ce = layers.softmax_with_cross_entropy(logits, mask_label)  # [B, S, 1]
     num = layers.reduce_sum(layers.elementwise_mul(ce, mask_weight))
     den = layers.reduce_sum(mask_weight)
@@ -172,7 +206,7 @@ def mlm_loss_masked(enc, mask_pos, mask_label, mask_weight, cfg):
     sel = layers.gather(flat, layers.reshape(mask_pos, [-1]))  # [B*P, H]
     x = layers.fc(sel, h, act="gelu", name="mlm_transform")
     x = layers.layer_norm(x, begin_norm_axis=1)
-    logits = layers.fc(x, cfg.vocab_size, name="mlm_logits")
+    logits = _mlm_logits(x, cfg)
     ce = layers.softmax_with_cross_entropy(
         logits, layers.reshape(mask_label, [-1, 1]))     # [B*P, 1]
     w = layers.reshape(mask_weight, [-1, 1])

@@ -450,3 +450,77 @@ class TestFlashKernels:
         for a, b_ in zip(g_f, g_b):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                        rtol=3e-4, atol=3e-5)
+
+
+@pytest.mark.skipif(not _supports_pallas(), reason="no pallas")
+class TestPackedKernels:
+    """Packed-layout tier (fused_attention_packed): [B, S, H*d] q/k/v,
+    heads split/merged inside the kernel (interpret mode runs the real
+    body; off-TPU the wrapper falls back through the per-head dispatch)."""
+
+    def _setup(self, bias_shape):
+        from paddle_tpu.kernels import attention as A
+
+        rng = np.random.RandomState(13)
+        b, s, h, d = 4, 64, 3, 8
+        hd = h * d
+        q = jnp.asarray((rng.randn(b, s, hd) * 0.4).astype(np.float32))
+        k = jnp.asarray((rng.randn(b, s, hd) * 0.4).astype(np.float32))
+        v = jnp.asarray((rng.randn(b, s, hd) * 0.4).astype(np.float32))
+        bias = np.zeros(bias_shape, np.float32)
+        bias[..., -5:] = -1e4
+        return A, q, k, v, jnp.asarray(bias), h, d
+
+    def _ref(self, A, q, k, v, bias, h, d):
+        B, S, HD = q.shape
+
+        def split(t):
+            return jnp.transpose(t.reshape(B, S, h, d), (0, 2, 1, 3))
+
+        o = A._ref_attention(split(q), split(k), split(v), bias,
+                             1.0 / np.sqrt(d), 0.0,
+                             jnp.zeros((1,), jnp.int32))
+        return jnp.transpose(o, (0, 2, 1, 3)).reshape(B, S, HD)
+
+    @pytest.mark.parametrize("bias_shape", [(4, 1, 1, 64), (4, 3, 1, 64)])
+    def test_forward_and_grads_match_reference(self, bias_shape):
+        A, q, k, v, bias, h, d = self._setup(bias_shape)
+        assert A._use_packed_kernel(q, h, 0.0, bias)
+        out = A.fused_attention_packed(q, k, v, bias, n_heads=h)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(self._ref(A, q, k, v, bias, h, d)),
+            rtol=2e-4, atol=2e-5)
+
+        gp = jax.grad(lambda *a: (A.fused_attention_packed(
+            *a, n_heads=h) ** 2).sum(), argnums=(0, 1, 2, 3))(q, k, v, bias)
+        gr = jax.grad(lambda *a: (self._ref(A, *a, h, d) ** 2).sum(),
+                      argnums=(0, 1, 2, 3))(q, k, v, bias)
+        for a, b in zip(gp, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=3e-4, atol=1e-4)
+
+    def test_layer_through_program(self):
+        """fused_multihead_attention_packed drives through a Program and
+        its grads flow (packed layout end to end, no transposes)."""
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.fluid import layers
+
+        b, s, h, d = 2, 32, 2, 8
+        rng = np.random.RandomState(5)
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            q = layers.data("q", shape=[b, s, h * d], dtype="float32")
+            w = layers.create_parameter(
+                [h * d], "float32",
+                default_initializer=fluid.initializer.Constant(1.0))
+            out = layers.fused_attention_packed(q, q, q * w, h)
+            loss = layers.reduce_mean(out * out)
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        assert any(op.type == "fused_multihead_attention_packed"
+                   for op in main.blocks[0].ops)
+        exe = fluid.Executor()
+        feed = {"q": rng.randn(b, s, h * d).astype(np.float32)}
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            (l0,) = exe.run(main, feed=feed, fetch_list=[loss])
+            assert np.isfinite(np.asarray(l0)).all()
