@@ -508,21 +508,78 @@ class StaticRNN:
         return self._out_vars
 
 
-# -- TensorArray stand-ins ---------------------------------------------------
+# -- bounded TensorArray -----------------------------------------------------
+#
+# Reference LoDTensorArray (layers at control_flow.py:1113 array_write,
+# :1177 create_array, :1466 array_read, :1578 array_length) re-designed
+# for static shapes: a fixed-capacity [bound, ...element] buffer + an
+# int32 length side-bound to ``name + "@ALEN"`` (design note in
+# fluid/ops/control_flow.py). Arrays written inside While/StaticRNN
+# blocks must be created with ``element_shape`` (and ``bound``) so the
+# loop carry holds its final shape from the first iteration.
 
-def create_array(dtype):
-    raise NotImplementedError(
-        "LoDTensorArray requires dynamic sizes; under XLA use while_loop with "
-        "pre-allocated (T, ...) tensors or StaticRNN step outputs")
+DEFAULT_TENSOR_ARRAY_BOUND = 128
+
+
+def create_array(dtype, element_shape=None, bound=None):
+    """Create a bounded tensor array. ``element_shape``/``bound`` are
+    TPU-native extensions: pass them when the array is written inside a
+    loop block (the buffer must pre-exist with its final shape); plain
+    straight-line writes may omit them (the first write sizes the
+    buffer to ``bound`` x its element shape)."""
+    helper = LayerHelper("create_array")
+    out = helper.create_variable_for_type_inference(dtype)
+    out.is_tensor_array = True
+    out._ta_bound = int(bound or DEFAULT_TENSOR_ARRAY_BOUND)
+    helper.append_op(
+        type="create_array", inputs={}, outputs={"Out": [out]},
+        attrs={"dtype": dtype,
+               "element_shape": [int(s) for s in element_shape]
+               if element_shape else [],
+               "bound": out._ta_bound})
+    return out
+
+
+def _as_index_var(i):
+    from . import tensor
+
+    if isinstance(i, int):
+        return tensor.fill_constant([1], "int32", i)
+    return i
 
 
 def array_write(x, i, array=None):
-    raise NotImplementedError("see create_array")
+    """Write ``x`` into slot ``i``; returns the array (reference
+    ``control_flow.py:1113``). ``i`` may be a python int or an int
+    Variable (e.g. a loop counter)."""
+    if array is None:
+        array = create_array(x.dtype)
+    helper = LayerHelper("array_write")
+    helper.append_op(
+        type="array_write",
+        inputs={"X": [x], "I": [_as_index_var(i)], "Array": [array]},
+        outputs={"Out": [array]},
+        attrs={"bound": getattr(array, "_ta_bound",
+                                DEFAULT_TENSOR_ARRAY_BOUND)})
+    return array
 
 
 def array_read(array, i):
-    raise NotImplementedError("see create_array")
+    """Read slot ``i`` (reference ``control_flow.py:1466``)."""
+    helper = LayerHelper("array_read")
+    out = helper.create_variable_for_type_inference(array.dtype)
+    helper.append_op(type="array_read",
+                     inputs={"X": [array], "I": [_as_index_var(i)]},
+                     outputs={"Out": [out]})
+    return out
 
 
 def array_length(array):
-    raise NotImplementedError("see create_array")
+    """Number of written slots, int32 [1] (reference
+    ``control_flow.py:1578``; int64 there — x64 stays off under JAX)."""
+    helper = LayerHelper("array_length")
+    out = helper.create_variable_for_type_inference("int32")
+    out.shape = (1,)
+    helper.append_op(type="array_length", inputs={"X": [array]},
+                     outputs={"Out": [out]})
+    return out

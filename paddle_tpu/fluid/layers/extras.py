@@ -219,27 +219,219 @@ reorder_lod_tensor_by_rank = _unsupported(
     "argsort + gather over the bounded-LoD lengths")
 
 
-class IfElse:
-    """Reference block-style IfElse; under XLA use ``layers.cond`` /
-    ``case`` / ``switch_case`` (functional branches compile to
-    lax.cond)."""
+def _select(cond, x, y):
+    """Elementwise select (jnp.where semantics, the "where" op's
+    3-input form): rows where ``cond`` is true take ``x``, others take
+    ``y`` — a true select, so NaN/Inf produced by the branch a row did
+    NOT take cannot leak into it (mask-multiply merges would)."""
+    helper = LayerHelper("where")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="where",
+                     inputs={"Condition": [cond], "X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    return out
 
-    def __init__(self, *args, **kwargs):
-        raise NotImplementedError(
-            "IfElse's imperative blocks don't trace under XLA; use "
-            "layers.cond(pred, true_fn, false_fn) or layers.case")
+
+class IfElse:
+    """Row-wise conditional (reference ``control_flow.py:2078``): rows of
+    the batch where ``cond`` is true flow through the true block, the
+    rest through the false block, and ``ie()`` merges them back in
+    order. TPU-native redesign: the reference gathers each subset and
+    runs only that block on it (dynamic row counts); under XLA both
+    blocks run on the FULL batch and the merge is a row-wise select —
+    bit-identical results for the row-independent computations IfElse
+    supports, at the cost of evaluating both branches (the standard
+    XLA/`lax.select` trade).
+
+        ie = IfElse(cond)                 # cond: [B, 1] bool
+        with ie.true_block():
+            d = ie.input(x)
+            ie.output(true_fn(d))
+        with ie.false_block():
+            d = ie.input(x)
+            ie.output(false_fn(d))
+        out, = ie()
+    """
+
+    def __init__(self, cond, name=None):
+        self._cond = cond
+        self._outs = {True: [], False: []}
+        self._in_branch = None
+
+    import contextlib
+
+    @contextlib.contextmanager
+    def true_block(self):
+        self._in_branch = True
+        try:
+            yield
+        finally:
+            self._in_branch = None
+
+    @contextlib.contextmanager
+    def false_block(self):
+        self._in_branch = False
+        try:
+            yield
+        finally:
+            self._in_branch = None
+
+    def input(self, x):
+        assert self._in_branch is not None, \
+            "IfElse.input() only inside true_block()/false_block()"
+        # both-branch trace: the block sees the full batch; masking
+        # happens at merge time
+        return x
+
+    def output(self, *outs):
+        assert self._in_branch is not None, \
+            "IfElse.output() only inside true_block()/false_block()"
+        self._outs[self._in_branch].extend(outs)
+
+    def __call__(self):
+        from . import nn, tensor
+
+        t, f = self._outs[True], self._outs[False]
+        assert len(t) == len(f) and t, (
+            "IfElse: both blocks must emit the same number of outputs")
+        return [_select(self._cond, tv, fv) for tv, fv in zip(t, f)]
 
 
 class DynamicRNN:
-    """Reference block-style DynamicRNN; the TPU build covers variable
-    length recurrence with ``layers.rnn``/``RNNCell`` over bounded-LoD
-    (padded + masked) sequences, or dynamic_lstm/dynamic_gru."""
+    """Variable-length block-style RNN (reference
+    ``control_flow.py:2250``). TPU-native redesign over the bounded-LoD
+    substrate: instead of the reference's sort-by-length batch
+    shrinking, the step body runs for every sequence at every step and
+    ``update_memory`` masks state updates past each row's length — the
+    same math, static shapes. The step block is traced once into a
+    StaticRNN (lax.scan); inputs are bounded-LoD sequences
+    (``sequence_pad`` supplies the [B, T, D] view and lengths); outputs
+    come back dense [B, T, D] with steps past each row's length zeroed.
 
-    def __init__(self, *args, **kwargs):
-        raise NotImplementedError(
-            "DynamicRNN's imperative block doesn't trace under XLA; use "
-            "layers.rnn(cell, inputs, sequence_length=...) or "
-            "dynamic_lstm/dynamic_gru over bounded-LoD input")
+        drnn = DynamicRNN(maxlen=T)
+        with drnn.block():
+            x_t = drnn.step_input(x)          # x: bounded-LoD [total, D]
+            h_prev = drnn.memory(shape=[H], value=0.0, batch_ref=x_t)
+            h = some_layers(x_t, h_prev)
+            drnn.update_memory(h_prev, h)
+            drnn.output(h)
+        out = drnn()                          # [B, T, H]
+    """
+
+    def __init__(self, name=None, maxlen=None):
+        from .control_flow import StaticRNN
+
+        self._rnn = StaticRNN(name=name or "dynamic_rnn")
+        self._maxlen = maxlen
+        self._lengths = None       # [B] int lengths (outer block)
+        self._padded_ref = None    # [B, T, D] padded view (outer block)
+        self._t = None             # [1] step counter (step block)
+        self._mask = None          # [B, 1] in-step validity mask
+        self._helper = LayerHelper(name or "dynamic_rnn")
+
+    import contextlib
+
+    @contextlib.contextmanager
+    def block(self):
+        with self._rnn.step():
+            yield
+
+    def _step_mask(self):
+        from .control_flow import less_than
+
+        if self._mask is None:
+            assert self._t is not None, "call step_input() first"
+            self._mask = less_than(self._t, self._lengths)  # [B] bool
+        return self._mask
+
+    def _rowwise_mask(self, ref):
+        """[B] bool, unsqueezed to [B, 1] for rank>=2 operands so the
+        select broadcasts row-wise."""
+        from . import nn
+
+        mask = self._step_mask()
+        if len(ref.shape) >= 2 or not ref.shape:
+            mask = nn.unsqueeze(mask, [1])
+        return mask
+
+    def step_input(self, x, level=0):
+        """x: bounded-LoD sequence ([total_bound, D] + @LOD lengths).
+        Returns the per-step [B, D] slice inside the block."""
+        from . import nn, sequence_lod, tensor
+
+        assert self._maxlen is not None, (
+            "DynamicRNN(maxlen=T) is required: XLA needs the static step "
+            "bound (the bounded-LoD analogue of the reference's dynamic "
+            "max length)")
+        program = self._helper.main_program
+        blk_idx = program.current_block_idx
+        # build the padded view + counter in the PARENT block
+        program.current_block_idx = self._rnn._block.parent_idx
+        pad0 = tensor.fill_constant([1], x.dtype, 0.0)
+        padded, length = sequence_lod.sequence_pad(
+            x, pad0, maxlen=self._maxlen)               # [B, T, D], [B]
+        if self._lengths is None:
+            self._lengths = tensor.cast(length, "int32")
+            self._padded_ref = padded
+        rank = len(x.shape) + 1                         # padded adds T
+        tm = nn.transpose(padded, [1, 0] + list(range(2, rank)))
+        if self._t is None:
+            T = int(self._maxlen)
+            counter = nn.reshape(tensor.range(0, T, 1, "int32"), [T, 1])
+            self._t = self._rnn.step_input(counter)     # [1] per step
+        program.current_block_idx = blk_idx
+        return self._rnn.step_input(tm)
+
+    def static_input(self, x):
+        """Non-sequence input visible in every step (closure capture —
+        the step block reads outer vars directly)."""
+        return x
+
+    def memory(self, init=None, shape=None, value=0.0, need_reorder=False,
+               dtype="float32", batch_ref=None):
+        from . import nn, tensor
+
+        if init is None and shape is not None:
+            assert self._padded_ref is not None, (
+                "call step_input() before memory(shape=...)")
+            program = self._helper.main_program
+            cur = program.current_block_idx
+            program.current_block_idx = self._rnn._block.parent_idx
+            # batch size comes from the padded input at lowering time
+            init = tensor.fill_constant_batch_size_like(
+                self._padded_ref, shape=[1] + list(shape), dtype=dtype,
+                value=value, input_dim_idx=0, output_dim_idx=0)
+            program.current_block_idx = cur
+            return self._rnn.memory(init=init)
+        return self._rnn.memory(init=init, shape=shape, value=value,
+                                dtype=dtype)
+
+    def update_memory(self, ex_mem, new_mem):
+        """Masked update: rows whose sequence already ended keep their
+        previous state (the reference achieves this by shrinking the
+        batch; masking is the static-shape equivalent)."""
+        merged = _select(self._rowwise_mask(new_mem), new_mem, ex_mem)
+        self._rnn.update_memory(ex_mem, merged)
+
+    def output(self, *outputs):
+        """Per-step outputs, zeroed past each row's length."""
+        from . import nn
+
+        for o in outputs:
+            zero = tensor.fill_constant([1], o.dtype, 0.0)
+            self._rnn.step_output(
+                _select(self._rowwise_mask(o), o, zero))
+
+    def __call__(self):
+        from . import nn
+
+        outs = self._rnn()
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        dense = [nn.transpose(o, [1, 0] +
+                              list(range(2, max(len(o.shape), 2))))
+                 for o in outs]                          # [B, T, ...]
+        return dense[0] if len(dense) == 1 else dense
 
 
 def tree_conv(nodes_vector, edge_set, output_size, num_filters=1,

@@ -11,7 +11,7 @@ __all__ = [
     "sums", "assign", "fill_constant_batch_size_like", "fill_constant",
     "argmin", "argmax", "argsort", "ones", "zeros", "reverse", "has_inf",
     "has_nan", "isfinite", "range", "linspace", "zeros_like", "ones_like",
-    "diag", "eye",
+    "diag", "eye", "tensor_array_to_tensor",
 ]
 
 
@@ -258,8 +258,19 @@ def eye(num_rows, num_columns=None, batch_shape=None, dtype="float32"):
     return out
 
 
-def tensor_array_to_tensor(input, axis=1):
-    raise NotImplementedError(
-        "LoDTensorArray is replaced by static stacked tensors under XLA; "
-        "use layers.stack/concat"
-    )
+def tensor_array_to_tensor(input, axis=1, name=None, use_stack=False):
+    """Concat (or stack) every slot of a bounded tensor array along
+    ``axis``; returns (out, out_index) like the reference
+    (``layers/tensor.py:279``). Bounded semantics: all ``bound`` slots
+    participate — unwritten slots are zeros — so the result matches the
+    reference exactly when the array is fully written; out_index holds
+    each slot's (static) size along ``axis``."""
+    helper = LayerHelper("tensor_array_to_tensor", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out_index = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="tensor_array_to_tensor",
+        inputs={"X": [input]},
+        outputs={"Out": [out], "OutIndex": [out_index]},
+        attrs={"axis": int(axis), "use_stack": bool(use_stack)})
+    return out, out_index

@@ -80,6 +80,13 @@ def _while(ctx, op):
     carried = [n for n in writes if n in ctx.env]
     if cond_name not in carried:
         carried = [cond_name] + carried
+    # side-bindings (@ALEN array lengths, @LOD lengths, @ROWS ids) of
+    # carried vars ride along so their updates survive the loop
+    for n in list(carried):
+        for suf in ("@ALEN", "@LOD", "@ROWS"):
+            key = n + suf
+            if key in ctx.env and key not in carried:
+                carried.append(key)
 
     init = tuple(ctx.env[n] for n in carried)
     cond_pos = carried.index(cond_name)
@@ -134,3 +141,111 @@ def _static_rnn(ctx, op):
         ctx.set(n, v)
     for outer, v in zip(op.attr("final_mem_names") or [], final_carry):
         ctx.set(outer, v)
+
+
+# -- bounded TensorArray ------------------------------------------------------
+#
+# Reference LoDTensorArray (framework/lod_tensor_array.h, layers
+# array_write/array_read at control_flow.py:1113/:1466) is a dynamically
+# growing vector<LoDTensor>. XLA needs static shapes, so the TPU-native
+# form follows the bounded-LoD recipe (fluid/lod.py): a fixed-capacity
+# [bound, ...element] buffer plus an int32 length scalar side-bound to
+# ``name + "@ALEN"``. Writes are functional dynamic-index updates (the
+# autodiff replay differentiates straight through); reads are dynamic
+# index gathers. Entries past the written length are zeros.
+
+ALEN_SUFFIX = "@ALEN"
+
+
+def _array_len(ctx, name):
+    import jax.numpy as jnp
+
+    key = name + ALEN_SUFFIX
+    if key not in ctx.env:
+        ctx.env[key] = jnp.zeros((), jnp.int32)
+    return ctx.env[key]
+
+
+@register("create_array")
+def _create_array(ctx, op):
+    import jax.numpy as jnp
+
+    out = op.output("Out")[0]
+    dtype = np.dtype(op.attr("dtype", "float32"))
+    shp = op.attr("element_shape", None)
+    bound = int(op.attr("bound", 0))
+    if shp:
+        ctx.set(out, jnp.zeros((bound,) + tuple(int(s) for s in shp),
+                               dtype))
+    else:
+        # element shape unknown until the first write: 0-size sentinel
+        # (arrays used inside While must pass element_shape so the loop
+        # carry has its final shape from the start)
+        ctx.set(out, jnp.zeros((0,), dtype))
+    ctx.env[out + ALEN_SUFFIX] = jnp.zeros((), jnp.int32)
+
+
+@register("array_write")
+def _array_write(ctx, op):
+    import jax
+    import jax.numpy as jnp
+
+    x = ctx.get_input(op, "X")
+    i = ctx.get_input(op, "I")
+    name = op.output("Out")[0]
+    arr = ctx.get(name)
+    if arr.ndim == 1 and arr.shape[0] == 0:  # lazy sentinel
+        bound = int(op.attr("bound", 0)) or 128
+        arr = jnp.zeros((bound,) + x.shape, arr.dtype)
+    i = jnp.reshape(i, ()).astype(jnp.int32)
+    # out-of-bounds dynamic writes clamp to the last slot (XLA
+    # dynamic_update_slice semantics) — size via create_array(bound=...)
+    arr = jax.lax.dynamic_update_index_in_dim(
+        arr, x.astype(arr.dtype), i, 0)
+    ctx.set(name, arr)
+    ctx.env[name + ALEN_SUFFIX] = jnp.maximum(_array_len(ctx, name), i + 1)
+
+
+@register("array_read")
+def _array_read(ctx, op):
+    import jax
+    import jax.numpy as jnp
+
+    arr = ctx.get_input(op, "X")
+    i = jnp.reshape(ctx.get_input(op, "I"), ()).astype(jnp.int32)
+    ctx.set_output(op, "Out",
+                   jax.lax.dynamic_index_in_dim(arr, i, 0, keepdims=False))
+
+
+@register("array_length")
+def _array_length(ctx, op):
+    import jax.numpy as jnp
+
+    name = op.input("X")[0]
+    ctx.set_output(op, "Out", _array_len(ctx, name).reshape((1,)))
+
+
+@register("tensor_array_to_tensor")
+def _tensor_array_to_tensor(ctx, op):
+    import jax.numpy as jnp
+
+    arr = ctx.get_input(op, "X")           # [T, ...element]
+    axis = int(op.attr("axis", 1))
+    use_stack = bool(op.attr("use_stack", False))
+    T = arr.shape[0]
+    moved = jnp.moveaxis(arr, 0, axis)     # T at position `axis`
+    if use_stack:
+        out = moved                        # entries stacked along axis
+        per_entry = arr.shape[1:][axis] if axis < arr.ndim - 1 else 1
+    else:
+        # concat along axis: merge (T, entry_axis) in T-major order.
+        # Bounded semantics: ALL `bound` cells participate; unwritten
+        # cells contribute zeros (exact reference match when the array
+        # is fully written).
+        shape = list(moved.shape)
+        per_entry = shape[axis + 1]
+        out = moved.reshape(tuple(shape[:axis]) + (T * per_entry,)
+                            + tuple(shape[axis + 2:]))
+    ctx.set_output(op, "Out", out)
+    ctx.set_output(op, "OutIndex",
+                   jnp.full((T,), per_entry, jnp.int32))

@@ -150,3 +150,148 @@ def test_while_backward():
     # s -> s^2 three times => s^8; ds/dx = 8 x^7
     (g,) = _run(main, {"x": xv}, [gx])
     np.testing.assert_allclose(g, 8 * xv ** 7, rtol=1e-4)
+
+
+# -- bounded TensorArray (reference control_flow.py:1113/:1466/:1578,
+#    tensor.py:279) ----------------------------------------------------------
+
+def test_tensor_array_write_read_roundtrip():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[2, 3], dtype="float32",
+                        append_batch_size=False)
+        arr = layers.create_array("float32")
+        arr = layers.array_write(x, 0, arr)
+        arr = layers.array_write(x * 2.0, 1, arr)
+        n = layers.array_length(arr)
+        r0 = layers.array_read(arr, 0)
+        r1 = layers.array_read(arr, 1)
+    xv = np.arange(6, dtype=np.float32).reshape(2, 3)
+    nv, v0, v1 = _run(main, {"x": xv}, [n, r0, r1])
+    assert nv[0] == 2
+    np.testing.assert_allclose(v0, xv)
+    np.testing.assert_allclose(v1, 2 * xv)
+
+
+def test_tensor_array_in_while_and_to_tensor():
+    """array_write inside a While accumulates across iterations (the
+    @ALEN length rides the loop carry); tensor_array_to_tensor stacks
+    and concats the slots."""
+    main, startup = fluid.Program(), fluid.Program()
+    T = 4
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[2, 3], dtype="float32",
+                        append_batch_size=False)
+        arr = layers.create_array("float32", element_shape=[2, 3], bound=T)
+        i = layers.fill_constant([1], "int32", 0)
+        limit = layers.fill_constant([1], "int32", T)
+        cond = layers.less_than(i, limit)
+        w = layers.While(cond)
+        with w.block():
+            fi = layers.cast(i, "float32")
+            layers.array_write(x * fi, i, arr)
+            layers.increment(i, 1)
+            layers.less_than(i, limit, cond=cond)
+        n = layers.array_length(arr)
+        stacked, sidx = layers.tensor_array_to_tensor(arr, axis=0,
+                                                      use_stack=True)
+        cat, cidx = layers.tensor_array_to_tensor(arr, axis=1)
+    xv = np.arange(6, dtype=np.float32).reshape(2, 3)
+    nv, sv, siv, cv, civ = _run(main, {"x": xv}, [n, stacked, sidx, cat,
+                                                  cidx])
+    want = np.stack([xv * t for t in range(T)])
+    assert nv[0] == T
+    np.testing.assert_allclose(sv, want)
+    np.testing.assert_allclose(cv, np.concatenate(list(want), axis=1))
+    assert list(civ) == [3] * T  # per-slot size along axis=1
+
+
+def test_dynamic_rnn_matches_static_rnn_equal_lengths():
+    """On equal-length input DynamicRNN's masking is inert: it must equal
+    StaticRNN on the same accumulation body."""
+    B, T, D = 3, 5, 4
+    rng = np.random.RandomState(0)
+    flat = rng.randn(B * T, D).astype(np.float32)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[-1, D], dtype="float32",
+                        append_batch_size=False, lod_level=1)
+        drnn = layers.DynamicRNN(maxlen=T)
+        with drnn.block():
+            xt = drnn.step_input(x)
+            h = drnn.memory(shape=[D], value=0.0, batch_ref=xt)
+            nh = layers.elementwise_add(h, xt)
+            drnn.update_memory(h, nh)
+            drnn.output(nh)
+        out = drnn()
+
+        xs = layers.data("xs", shape=[T, B, D], dtype="float32",
+                         append_batch_size=False)
+        srnn = layers.StaticRNN()
+        with srnn.step():
+            xt2 = srnn.step_input(xs)
+            h2 = srnn.memory(shape=[B, D], value=0.0)
+            nh2 = layers.elementwise_add(h2, xt2)
+            srnn.update_memory(h2, nh2)
+            srnn.step_output(nh2)
+        sout = srnn()
+    feed = {"x": fluid.create_lod_tensor(flat, [[T] * B]),
+            "xs": flat.reshape(B, T, D).transpose(1, 0, 2)}
+    dv, sv = _run(main, feed, [out, sout])
+    np.testing.assert_allclose(dv, sv.transpose(1, 0, 2), atol=1e-5)
+
+
+def test_dynamic_rnn_variable_lengths_masks():
+    """Shorter sequences freeze their memory and zero their outputs past
+    their length."""
+    D = 4
+    lens = [5, 3, 5]
+    rng = np.random.RandomState(1)
+    flat = rng.randn(sum(lens), D).astype(np.float32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[-1, D], dtype="float32",
+                        append_batch_size=False, lod_level=1)
+        drnn = layers.DynamicRNN(maxlen=5)
+        with drnn.block():
+            xt = drnn.step_input(x)
+            h = drnn.memory(shape=[D], value=0.0, batch_ref=xt)
+            nh = layers.elementwise_add(h, xt)
+            drnn.update_memory(h, nh)
+            drnn.output(nh)
+        out = drnn()
+    (ov,) = _run(main, {"x": fluid.create_lod_tensor(flat, [lens])}, [out])
+    ptr = 0
+    for b, L in enumerate(lens):
+        ref = np.cumsum(flat[ptr:ptr + L], axis=0)
+        ptr += L
+        np.testing.assert_allclose(ov[b, :L], ref, atol=1e-5)
+        np.testing.assert_allclose(ov[b, L:], 0.0)
+
+
+def test_ifelse_matches_rowwise_select():
+    """IfElse (reference control_flow.py:2078) == where(cond, true_fn,
+    false_fn) row-wise."""
+    B, D = 4, 3
+    rng = np.random.RandomState(2)
+    xv = rng.randn(B, D).astype(np.float32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[B, D], dtype="float32",
+                        append_batch_size=False)
+        thr = layers.fill_constant([B, 1], "float32", 0.0)
+        row = layers.reduce_sum(x, dim=1, keep_dim=True)
+        cond = layers.greater_than(row, thr)
+        ie = layers.IfElse(cond)
+        with ie.true_block():
+            d = ie.input(x)
+            ie.output(d * 2.0)
+        with ie.false_block():
+            d = ie.input(x)
+            ie.output(d - 1.0)
+        merged, = ie()
+    (mv,) = _run(main, {"x": xv}, [merged])
+    mask = xv.sum(1, keepdims=True) > 0
+    np.testing.assert_allclose(mv, np.where(mask, xv * 2.0, xv - 1.0),
+                               atol=1e-6)
