@@ -20,9 +20,12 @@ Registering a ShardedRemoteTable in the ps registry makes the existing
 against remote pservers with no graph changes.
 """
 
+import os
 import socket
 import struct
 import threading
+import time
+import uuid
 
 import numpy as np
 
@@ -36,6 +39,19 @@ _OPT_NAME = {v: k for k, v in _OPT_CODE.items()}
 
 _DT_CODE = {"float32": 0, "float64": 1, "int32": 2, "int64": 3}
 _DT_NP = {0: np.float32, 1: np.float64, 2: np.int32, 3: np.int64}
+
+# hello magic: rejects random/legacy peers before any table op runs
+_MAGIC = b"PTPS2"
+
+# frames carry a u32 length; cap what a peer may make us allocate
+# (reference-style sanity bound — ADVICE r3: an attacker-supplied u32
+# could demand 4 GiB). Dump/load chunking keeps legit frames far below.
+_MAX_FRAME = int(os.environ.get("PADDLE_PS_MAX_FRAME_BYTES",
+                                256 * 1024 * 1024))
+
+
+def _default_token():
+    return os.environ.get("PADDLE_PS_TOKEN", "")
 
 
 def _send_all(sock, data):
@@ -79,8 +95,13 @@ def _frame(payload):
     return struct.pack("<I", len(payload)) + payload
 
 
-def _read_frame(sock):
+def _read_frame(sock, max_bytes=None):
     (n,) = struct.unpack("<I", _recv_exact(sock, 4))
+    if n > (max_bytes or _MAX_FRAME):
+        # the stream cannot be resynchronized after a refused frame
+        raise ConnectionError(
+            "frame of %d bytes exceeds the %d-byte cap "
+            "(PADDLE_PS_MAX_FRAME_BYTES)" % (n, max_bytes or _MAX_FRAME))
     return _recv_exact(sock, n)
 
 
@@ -97,8 +118,13 @@ class TableServer:
     request shuts down.
     """
 
-    def __init__(self, host="127.0.0.1", port=0, tables=None):
+    def __init__(self, host="127.0.0.1", port=0, tables=None, token=None):
         self.tables = dict(tables or {})
+        # shared-secret handshake (ADVICE r3): every connection must open
+        # with the magic + this token before any opcode is served. Empty
+        # token (the default) still requires the magic, which filters
+        # stray/legacy peers; real deployments set PADDLE_PS_TOKEN.
+        self.token = _default_token() if token is None else str(token)
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind((host, port))
@@ -106,6 +132,12 @@ class TableServer:
         self.host, self.port = self._srv.getsockname()
         self._stop = threading.Event()
         self._accept_thread = None
+        # last applied push sequence per client id: lets a reconnecting
+        # client RE-SEND a push whose response was lost without the
+        # gradient being applied twice (at-most-once apply; reference
+        # heart_beat_monitor.h treats trainer membership as tracked state)
+        self._push_seq = {}
+        self._push_mu = threading.Lock()
 
     @property
     def endpoint(self):
@@ -154,6 +186,23 @@ class TableServer:
     # -- request handling ---------------------------------------------------
     def _serve_conn(self, conn):
         try:
+            # hello: magic + u16 token length + token; anything else is
+            # dropped before a single table opcode can run
+            try:
+                conn.settimeout(10)
+                hello = _recv_exact(conn, len(_MAGIC) + 2)
+                if hello[:len(_MAGIC)] != _MAGIC:
+                    return
+                (tlen,) = struct.unpack_from("<H", hello, len(_MAGIC))
+                tok = _recv_exact(conn, tlen).decode("utf-8", "replace") \
+                    if tlen else ""
+                if tok != self.token:
+                    _send_all(conn, _frame(b"\x01bad token"))
+                    return
+                _send_all(conn, _frame(b"\x00"))
+                conn.settimeout(None)
+            except (ConnectionError, OSError, struct.error):
+                return
             while not self._stop.is_set():
                 try:
                     req = _read_frame(conn)
@@ -185,11 +234,26 @@ class TableServer:
                 return b"\x01" + b"unknown table %s" % name.encode()
             if op == _PULL:
                 ids, off = _unpack_arr(req, off)
+                bad = self._check_ids(ids, table)
+                if bad is not None:
+                    return bad
                 return b"\x00" + _pack_arr(table.pull(ids))
             if op == _PUSH:
+                client, seq = struct.unpack_from("<16sQ", req, off)
+                off += 24
                 ids, off = _unpack_arr(req, off)
                 grads, off = _unpack_arr(req, off)
                 lr, opt_code, eps = struct.unpack_from("<dBd", req, off)
+                bad = self._check_ids(ids, table)
+                if bad is not None:
+                    return bad
+                # at-most-once apply: a retried push (same client, seq <=
+                # last applied) acks without re-applying the gradient
+                with self._push_mu:
+                    last = self._push_seq.get(client, -1)
+                    if seq <= last:
+                        return b"\x00"
+                    self._push_seq[client] = seq
                 table.push(ids, grads, lr=lr,
                            optimizer=_OPT_NAME.get(opt_code, "sgd"),
                            eps=eps)
@@ -211,31 +275,80 @@ class TableServer:
         except Exception as e:  # surface to the client, keep serving
             return b"\x01" + repr(e).encode()[:512]
 
+    @staticmethod
+    def _check_ids(ids, table):
+        """Server-side bounds guard (ADVICE r3: negative ids floor-index
+        silently; out-of-range ids read/write the wrong rows)."""
+        ids = np.asarray(ids)
+        if ids.size and (int(ids.min()) < 0 or
+                         int(ids.max()) >= int(table.vocab)):
+            return (b"\x01ids out of range [0, %d)" % int(table.vocab))
+        return None
+
 
 class _Conn:
-    """One persistent client connection with a request lock."""
+    """One persistent client connection with a request lock, the shared
+    token handshake, and reconnect-with-backoff. Requests are retried
+    across reconnects — safe for every opcode because pushes carry a
+    (client, seq) pair the server dedupes (at-most-once apply), and the
+    rest are idempotent reads/overwrites."""
 
-    def __init__(self, endpoint):
+    RETRIES = 4
+    BACKOFF = 0.2  # seconds, doubled per attempt
+
+    def __init__(self, endpoint, token=None):
         host, port = endpoint.rsplit(":", 1)
-        self._sock = socket.create_connection((host, int(port)), timeout=30)
+        self._addr = (host, int(port))
+        self._token = _default_token() if token is None else str(token)
         self._mu = threading.Lock()
+        self._sock = None
+        self._connect()
+
+    def _connect(self):
+        sock = socket.create_connection(self._addr, timeout=30)
+        tok = self._token.encode()
+        try:
+            _send_all(sock, _MAGIC + struct.pack("<H", len(tok)) + tok)
+            resp = _read_frame(sock)
+            if not resp or resp[0] != 0:
+                raise ConnectionError(
+                    "pserver rejected handshake: %s"
+                    % resp[1:].decode("utf-8", "replace"))
+        except Exception:
+            sock.close()
+            raise
+        self._sock = sock
 
     def request(self, payload):
         with self._mu:
-            if self._sock is None:
-                raise ConnectionError("pserver connection is closed "
-                                      "(previous request failed mid-frame)")
-            try:
-                _send_all(self._sock, _frame(payload))
-                resp = _read_frame(self._sock)
-            except (OSError, ConnectionError):
-                # a timeout/short read leaves the stream desynchronized —
-                # poison the connection rather than serve misframed bytes
+            last_err = None
+            for attempt in range(self.RETRIES + 1):
+                if self._sock is None:
+                    try:
+                        self._connect()
+                    except (OSError, ConnectionError) as e:
+                        last_err = e
+                        time.sleep(self.BACKOFF * (2 ** attempt))
+                        continue
                 try:
-                    self._sock.close()
-                finally:
+                    _send_all(self._sock, _frame(payload))
+                    resp = _read_frame(self._sock)
+                    break
+                except (OSError, ConnectionError) as e:
+                    # a timeout/short read leaves the stream
+                    # desynchronized — drop the socket and retry on a
+                    # fresh connection (push dedup makes this safe)
+                    last_err = e
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
                     self._sock = None
-                raise
+                    time.sleep(self.BACKOFF * (2 ** attempt))
+            else:
+                raise ConnectionError(
+                    "pserver %s:%d unreachable after %d attempts: %r"
+                    % (self._addr + (self.RETRIES + 1, last_err)))
         if not resp or resp[0] != 0:
             raise RuntimeError("pserver error: %s"
                                % resp[1:].decode("utf-8", "replace"))
@@ -258,9 +371,11 @@ def _req(op, name, body=b""):
 class RemoteTable:
     """EmbeddingTable-interface proxy for ONE endpoint/shard."""
 
-    def __init__(self, endpoint, name):
-        self._conn = _Conn(endpoint)
+    def __init__(self, endpoint, name, token=None):
+        self._conn = _Conn(endpoint, token=token)
         self._name = name
+        self._client_id = uuid.uuid4().bytes     # push-dedup identity
+        self._push_seq = 0
         meta = self._conn.request(_req(_META, name))
         self.vocab, self.dim = struct.unpack("<QQ", meta)
 
@@ -274,7 +389,9 @@ class RemoteTable:
         ids = np.ascontiguousarray(np.asarray(ids).reshape(-1), np.int64)
         grads = np.ascontiguousarray(np.asarray(grads, np.float32)
                                      .reshape(ids.shape[0], self.dim))
-        body = (_pack_arr(ids) + _pack_arr(grads) +
+        self._push_seq += 1
+        body = (struct.pack("<16sQ", self._client_id, self._push_seq) +
+                _pack_arr(ids) + _pack_arr(grads) +
                 struct.pack("<dBd", float(lr),
                             _OPT_CODE.get(optimizer, 0), float(eps)))
         self._conn.request(_req(_PUSH, self._name, body))
@@ -326,10 +443,11 @@ class ShardedRemoteTable:
     existing op lowerings and Geo/Async communicators work unchanged.
     """
 
-    def __init__(self, endpoints, name, vocab, dim):
+    def __init__(self, endpoints, name, vocab, dim, token=None):
         self.vocab, self.dim = int(vocab), int(dim)
         self._n = len(endpoints)
-        self._shards = [RemoteTable(ep, name) for ep in endpoints]
+        self._shards = [RemoteTable(ep, name, token=token)
+                        for ep in endpoints]
         for k, sh in enumerate(self._shards):
             expect = shard_vocab(self.vocab, self._n, k)
             if sh.vocab < expect or sh.dim != self.dim:
@@ -339,6 +457,13 @@ class ShardedRemoteTable:
 
     def _split(self, ids):
         ids = np.asarray(ids).reshape(-1)
+        if ids.size and (int(ids.min()) < 0 or
+                         int(ids.max()) >= self.vocab):
+            # negative ids floor-divide to negative local rows; ids past
+            # the vocab map into the wrong shard — both corrupt silently
+            raise ValueError(
+                "embedding ids out of range [0, %d): min=%d max=%d"
+                % (self.vocab, int(ids.min()), int(ids.max())))
         ep = ids % self._n
         local = ids // self._n
         return ep, local

@@ -224,6 +224,9 @@ class Tracer:
         import jax
 
         attrs = dict(attrs or {})
+        from ..registry import EXECUTED_OP_TYPES
+
+        EXECUTED_OP_TYPES.add(op_type)
         info = registry.get(op_type)
         n_keys = 2 if info.has_state else 0
         keys = []

@@ -1361,11 +1361,46 @@ def dice_loss(input, label, epsilon=1e-5):
     return reduce_mean(dice_score)
 
 
+_PYFUNC_TABLE = {}
+
+
 def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
-    raise NotImplementedError(
-        "py_func requires host callbacks; use pure ops or jax.pure_callback "
-        "via custom op registration (paddle_tpu.fluid.registry.register)"
+    """Host-Python op with optional custom backward (reference
+    ``operators/py_func_op.cc`` / ``layers/nn.py`` py_func). ``func``
+    maps numpy inputs to numpy outputs matching ``out``'s declared
+    shapes/dtypes (out vars must carry static shapes — create them with
+    ``program.current_block().create_var(...)``); ``backward_func``
+    receives (x..., out..., dout...) minus ``skip_vars_in_backward_input``
+    and returns grads for each x (None for non-differentiable inputs).
+    Lowering: ``jax.pure_callback`` forward wrapped in ``jax.custom_vjp``
+    whose backward is a second host callback — the same mechanism the
+    distributed_lookup_table lowerings use (ops/distributed_ops.py).
+    Callables live in an in-process table keyed by an op attr; a Program
+    serialized via proto_io keeps the op but needs the same Python
+    process (or re-registration) to execute it — host code cannot ride
+    the proto, exactly like the reference's pybind-registered callables."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    skip = set(id(v) for v in (skip_vars_in_backward_input or []))
+    for o in outs:
+        if not o.shape or any(int(s) < 0 for s in o.shape):
+            raise ValueError(
+                "py_func out var %r needs a fully static shape" % o.name)
+    func_id = len(_PYFUNC_TABLE)
+    _PYFUNC_TABLE[func_id] = (
+        func, backward_func,
+        [id(v) in skip for v in xs],       # skip flags for x slots
+        [id(v) in skip for v in outs],     # skip flags for out slots
     )
+    helper = LayerHelper("py_func")
+    helper.append_op(
+        type="py_func",
+        inputs={"X": list(xs)},
+        outputs={"Out": list(outs)},
+        attrs={"func_id": func_id,
+               "out_shapes": [[int(s) for s in o.shape] for o in outs],
+               "out_dtypes": [str(o.dtype) for o in outs]})
+    return out
 
 
 # -- extra ops used by models ------------------------------------------------

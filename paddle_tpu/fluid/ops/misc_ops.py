@@ -269,3 +269,70 @@ def _tree_conv(ctx, op):
 
     out = jax.vmap(one)(nodes, edges)   # [B, N, K, NumF]
     ctx.set_output(op, "Out", out)
+
+
+@register("py_func")
+def _py_func(ctx, op):
+    """Host-Python forward via jax.pure_callback; custom backward (when
+    the layer registered one) via jax.custom_vjp whose bwd is a second
+    host callback fed (x..., out..., dout...) minus the skip slots.
+    Reference: operators/py_func_op.cc."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..layers.nn import _PYFUNC_TABLE
+
+    func, bwd, x_skip, out_skip = _PYFUNC_TABLE[int(op.attr("func_id"))]
+    xs = [ctx.get(n) for n in op.input("X")]
+    out_specs = tuple(
+        jax.ShapeDtypeStruct(tuple(s), np.dtype(d))
+        for s, d in zip(op.attr("out_shapes"), op.attr("out_dtypes")))
+    x_specs = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype) for a in xs)
+
+    def fwd_host(*arrs):
+        rets = func(*[np.asarray(a) for a in arrs])
+        rets = rets if isinstance(rets, (list, tuple)) else [rets]
+        return tuple(np.asarray(r).astype(spec.dtype).reshape(spec.shape)
+                     for r, spec in zip(rets, out_specs))
+
+    if bwd is None:
+        outs = jax.pure_callback(fwd_host, out_specs, *xs)
+    else:
+        @jax.custom_vjp
+        def f(*args):
+            return jax.pure_callback(fwd_host, out_specs, *args)
+
+        def f_fwd(*args):
+            outs = f(*args)
+            return outs, (args, outs)
+
+        def f_bwd(res, douts):
+            args, outs_v = res
+
+            def bwd_host(*flat):
+                n = len(args)
+                m = len(outs_v)
+                xs_np = [np.asarray(a) for a in flat[:n]]
+                outs_np = [np.asarray(a) for a in flat[n:n + m]]
+                douts_np = [np.asarray(a) for a in flat[n + m:]]
+                call = [a for a, s in zip(xs_np, x_skip) if not s]
+                call += [o for o, s in zip(outs_np, out_skip) if not s]
+                call += douts_np
+                gs = bwd(*call)
+                gs = gs if isinstance(gs, (list, tuple)) else [gs]
+                full = []
+                for a, g in zip(args, list(gs) + [None] * len(args)):
+                    if g is None:
+                        full.append(np.zeros(a.shape, a.dtype))
+                    else:
+                        full.append(np.asarray(g).astype(a.dtype)
+                                    .reshape(a.shape))
+                return tuple(full)
+
+            return jax.pure_callback(bwd_host, x_specs, *args, *outs_v,
+                                     *douts)
+
+        f.defvjp(f_fwd, f_bwd)
+        outs = f(*xs)
+    for n, v in zip(op.output("Out"), outs):
+        ctx.set(n, v)

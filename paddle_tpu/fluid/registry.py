@@ -192,10 +192,18 @@ def attribute_op_error(op, exc):
     raise EnforceError("\n".join(lines)) from exc
 
 
+# Op types whose lowering actually ran in this process — the
+# execution-based coverage gate (tests/test_zz_coverage_gate.py) asserts
+# every registered type lands here during the full suite, so a lowering
+# that is merely *mentioned* in test text can no longer pass the gate.
+EXECUTED_OP_TYPES = set()
+
+
 def lower_op(ctx, op):
     """Lower ONE op with error attribution + LoD propagation — the single
     entry every lowering loop (block, sub-block, replay, pipeline stage)
     must use so failures name the failing op and its creation site."""
+    EXECUTED_OP_TYPES.add(op.type)
     try:
         registry.get(op.type).lower(ctx, op)
     except EnforceError:

@@ -219,3 +219,149 @@ def test_fleet_parameter_server_mode():
             losses.append(float(np.asarray(lv).ravel()[0]))
     assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
     worker.stop_worker()
+
+
+# -- transport hardening (VERDICT r3 #7 / ADVICE r3) --------------------------
+
+def test_ps_auth_token_rejects_mismatch():
+    from paddle_tpu.distributed.ps import EmbeddingTable
+    from paddle_tpu.distributed.ps_server import RemoteTable, TableServer
+
+    srv = TableServer(tables={"t": EmbeddingTable(8, 4, seed=0)},
+                      token="secret").start()
+    try:
+        with pytest.raises((ConnectionError, RuntimeError)):
+            RemoteTable(srv.endpoint, "t", token="wrong")
+        rt = RemoteTable(srv.endpoint, "t", token="secret")
+        assert rt.vocab == 8
+        rt.close()
+    finally:
+        srv.stop()
+
+
+def test_ps_frame_cap_and_magic():
+    """A raw peer without the magic gets dropped; an oversized frame
+    poisons the stream instead of allocating."""
+    import socket
+    import struct as st
+
+    from paddle_tpu.distributed.ps import EmbeddingTable
+    from paddle_tpu.distributed import ps_server as M
+
+    srv = M.TableServer(tables={"t": EmbeddingTable(8, 4, seed=0)}).start()
+    try:
+        # no magic: server closes without serving
+        s = socket.create_connection((srv.host, srv.port), timeout=5)
+        s.sendall(b"GARBAGE-" + b"x" * 20)
+        s.settimeout(2)
+        try:
+            assert s.recv(64) == b""  # clean close, no response
+        except (ConnectionResetError, OSError):
+            pass                      # RST is an equally firm rejection
+        s.close()
+        # client-side cap: a frame header demanding > cap raises before
+        # any allocation happens
+        a, b = socket.socketpair()
+        try:
+            a.sendall(st.pack("<I", M._MAX_FRAME + 1))
+            with pytest.raises(ConnectionError):
+                M._read_frame(b)
+        finally:
+            a.close()
+            b.close()
+    finally:
+        srv.stop()
+
+
+def test_ps_push_retry_applies_once():
+    """The (client, seq) dedup: re-sending the same push frame (what the
+    reconnect path does when a response is lost) must not apply the
+    gradient twice."""
+    from paddle_tpu.distributed.ps import EmbeddingTable
+    from paddle_tpu.distributed.ps_server import RemoteTable, TableServer
+
+    table = EmbeddingTable(8, 4, seed=0)
+    srv = TableServer(tables={"t": table}).start()
+    try:
+        rt = RemoteTable(srv.endpoint, "t")
+        before = table.pull(np.arange(8)).copy()
+        ids = np.array([1, 3])
+        g = np.ones((2, 4), np.float32)
+        rt.push(ids, g, lr=0.5)  # seq=1
+        after_once = table.pull(np.arange(8)).copy()
+        # replay the exact same seq through a second connection (the
+        # retry path): server must ack without applying
+        import struct as st
+        from paddle_tpu.distributed import ps_server as M
+
+        body = (st.pack("<16sQ", rt._client_id, rt._push_seq) +
+                M._pack_arr(ids.astype(np.int64)) + M._pack_arr(g) +
+                st.pack("<dBd", 0.5, 0, 1e-6))
+        conn = M._Conn(srv.endpoint)
+        conn.request(M._req(M._PUSH, "t", body))
+        conn.close()
+        np.testing.assert_array_equal(table.pull(np.arange(8)), after_once)
+        assert not np.allclose(before, after_once)
+        rt.close()
+    finally:
+        srv.stop()
+
+
+def test_ps_id_bounds_rejected():
+    from paddle_tpu.distributed.ps import EmbeddingTable
+    from paddle_tpu.distributed.ps_server import (RemoteTable,
+                                                  ShardedRemoteTable,
+                                                  TableServer, shard_vocab)
+
+    srvs = [TableServer(tables={"s": EmbeddingTable(
+        shard_vocab(10, 2, k), 4, seed=k)}).start() for k in range(2)]
+    try:
+        sh = ShardedRemoteTable([s.endpoint for s in srvs], "s", 10, 4)
+        with pytest.raises(ValueError):
+            sh.pull(np.array([-1, 2]))
+        with pytest.raises(ValueError):
+            sh.push(np.array([10]), np.ones((1, 4), np.float32))
+        # server side too (direct shard access past the shard vocab)
+        rt = RemoteTable(srvs[0].endpoint, "s")
+        with pytest.raises(RuntimeError):
+            rt.pull(np.array([99]))
+        sh.close()
+        rt.close()
+    finally:
+        for s in srvs:
+            s.stop()
+
+
+def test_ps_server_crash_restart_resume():
+    """Fault injection (VERDICT r3 #7): kill the TableServer mid-train,
+    restart it on the same port from a dump, and the SAME client object
+    resumes via reconnect-with-backoff — no corruption."""
+    from paddle_tpu.distributed.ps import EmbeddingTable
+    from paddle_tpu.distributed.ps_server import RemoteTable, TableServer
+
+    table = EmbeddingTable(8, 4, seed=0)
+    srv = TableServer(tables={"t": table}).start()
+    port = srv.port
+    rt = RemoteTable(srv.endpoint, "t")
+    ids = np.array([0, 5])
+    rt.push(ids, np.ones((2, 4), np.float32), lr=0.1)
+    snapshot = rt.dump()
+
+    srv.stop()  # crash
+    # while down: requests fail after the retry budget
+    rt2 = None
+    with pytest.raises((ConnectionError, OSError)):
+        rt.pull(ids)
+
+    # restart on the same port from the dump
+    table2 = EmbeddingTable(8, 4, seed=99)   # different init...
+    table2.load_rows(0, snapshot)            # ...restored from the dump
+    srv2 = TableServer(port=port, tables={"t": table2}).start()
+    try:
+        rows = rt.pull(ids)                  # same client, auto-reconnect
+        np.testing.assert_allclose(rows, snapshot[ids])
+        rt.push(ids, np.ones((2, 4), np.float32), lr=0.1)  # train resumes
+        assert not np.allclose(rt.pull(ids), snapshot[ids])
+        rt.close()
+    finally:
+        srv2.stop()

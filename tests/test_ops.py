@@ -442,3 +442,69 @@ class TestDropoutGradReplay(OpTest):
         # gradient must be 2.0 exactly where output non-zero, 0 where dropped
         np.testing.assert_allclose((out != 0), (g != 0))
         assert set(np.unique(g)).issubset({0.0, 2.0})
+
+
+def test_py_func_forward_and_custom_backward():
+    """py_func (reference operators/py_func_op.cc): host numpy forward +
+    user backward; grad checked against the analytic value."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+
+    def fwd(a):
+        return np.tanh(a)
+
+    def bwd(a, out, dout):
+        return dout * (1.0 - out ** 2)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[2, 3], dtype="float32",
+                        append_batch_size=False)
+        out_var = main.current_block().create_var(
+            name="pyf_out", shape=(2, 3), dtype="float32")
+        o = layers.py_func(fwd, x, out_var, backward_func=bwd)
+        loss = layers.reduce_sum(o * o)
+        (gx,) = fluid.gradients(loss, x)
+    exe = fluid.Executor()
+    xv = np.random.RandomState(0).randn(2, 3).astype(np.float32)
+    with fluid.scope_guard(fluid.Scope()):
+        ov, gv = exe.run(main, feed={"x": xv}, fetch_list=[o, gx])
+    ref = np.tanh(xv)
+    np.testing.assert_allclose(ov, ref, atol=1e-6)
+    np.testing.assert_allclose(gv, 2 * ref * (1 - ref ** 2), atol=1e-5)
+    # finite-difference cross-check of the registered backward
+    eps = 1e-3
+    num = np.zeros_like(xv)
+    for idx in np.ndindex(*xv.shape):
+        p = xv.copy(); p[idx] += eps
+        m = xv.copy(); m[idx] -= eps
+        num[idx] = ((np.tanh(p) ** 2).sum() - (np.tanh(m) ** 2).sum()) \
+            / (2 * eps)
+    np.testing.assert_allclose(gv, num, atol=1e-2, rtol=1e-2)
+
+
+def test_py_func_multiple_outputs_no_backward():
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+
+    def fwd(a, b):
+        return a + b, a * b
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4], dtype="float32",
+                        append_batch_size=False)
+        y = layers.data("y", shape=[4], dtype="float32",
+                        append_batch_size=False)
+        o1 = main.current_block().create_var(name="pyf_o1", shape=(4,),
+                                             dtype="float32")
+        o2 = main.current_block().create_var(name="pyf_o2", shape=(4,),
+                                             dtype="float32")
+        outs = layers.py_func(fwd, [x, y], [o1, o2])
+    exe = fluid.Executor()
+    xv = np.arange(4, dtype=np.float32)
+    yv = np.full(4, 2.0, np.float32)
+    with fluid.scope_guard(fluid.Scope()):
+        a, b = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=list(outs))
+    np.testing.assert_allclose(a, xv + yv)
+    np.testing.assert_allclose(b, xv * yv)
