@@ -132,12 +132,19 @@ class TableServer:
         self.host, self.port = self._srv.getsockname()
         self._stop = threading.Event()
         self._accept_thread = None
+        self._conns = set()
+        self._conns_mu = threading.Lock()
         # last applied push sequence per client id: lets a reconnecting
         # client RE-SEND a push whose response was lost without the
         # gradient being applied twice (at-most-once apply; reference
-        # heart_beat_monitor.h treats trainer membership as tracked state)
-        self._push_seq = {}
+        # heart_beat_monitor.h treats trainer membership as tracked state).
+        # LRU-bounded so elastic trainer fleets (fresh uuid per process)
+        # cannot grow server memory without bound.
+        import collections
+
+        self._push_seq = collections.OrderedDict()
         self._push_mu = threading.Lock()
+        self._push_seq_cap = 4096
 
     @property
     def endpoint(self):
@@ -175,6 +182,15 @@ class TableServer:
 
     def stop(self):
         self._stop.set()
+        # sever live connections too — their serving threads would
+        # otherwise keep answering after "shutdown"
+        with self._conns_mu:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
         if self._accept_thread is not None:
             self._accept_thread.join(timeout=5)
         # a never-started server still holds its bound socket — release it
@@ -185,6 +201,8 @@ class TableServer:
 
     # -- request handling ---------------------------------------------------
     def _serve_conn(self, conn):
+        with self._conns_mu:
+            self._conns.add(conn)
         try:
             # hello: magic + u16 token length + token; anything else is
             # dropped before a single table opcode can run
@@ -214,6 +232,8 @@ class TableServer:
                     self._stop.set()
                     return
         finally:
+            with self._conns_mu:
+                self._conns.discard(conn)
             try:
                 conn.close()
             except OSError:
@@ -248,15 +268,26 @@ class TableServer:
                 if bad is not None:
                     return bad
                 # at-most-once apply: a retried push (same client, seq <=
-                # last applied) acks without re-applying the gradient
+                # last APPLIED) acks without re-applying. The apply runs
+                # under the client's own lock and the seq is recorded only
+                # after table.push succeeds, so a failed apply stays
+                # retryable and a concurrent duplicate cannot double-apply.
                 with self._push_mu:
-                    last = self._push_seq.get(client, -1)
-                    if seq <= last:
+                    st = self._push_seq.get(client)
+                    if st is None:
+                        st = {"last": -1, "mu": threading.Lock()}
+                        self._push_seq[client] = st
+                        while len(self._push_seq) > self._push_seq_cap:
+                            self._push_seq.popitem(last=False)
+                    else:
+                        self._push_seq.move_to_end(client)
+                with st["mu"]:
+                    if seq <= st["last"]:
                         return b"\x00"
-                    self._push_seq[client] = seq
-                table.push(ids, grads, lr=lr,
-                           optimizer=_OPT_NAME.get(opt_code, "sgd"),
-                           eps=eps)
+                    table.push(ids, grads, lr=lr,
+                               optimizer=_OPT_NAME.get(opt_code, "sgd"),
+                               eps=eps)
+                    st["last"] = seq
                 return b"\x00"
             if op == _META:
                 return b"\x00" + struct.pack("<QQ", table.vocab, table.dim)
@@ -328,7 +359,8 @@ class _Conn:
                         self._connect()
                     except (OSError, ConnectionError) as e:
                         last_err = e
-                        time.sleep(self.BACKOFF * (2 ** attempt))
+                        if attempt < self.RETRIES:
+                            time.sleep(self.BACKOFF * (2 ** attempt))
                         continue
                 try:
                     _send_all(self._sock, _frame(payload))
@@ -344,7 +376,8 @@ class _Conn:
                     except OSError:
                         pass
                     self._sock = None
-                    time.sleep(self.BACKOFF * (2 ** attempt))
+                    if attempt < self.RETRIES:
+                        time.sleep(self.BACKOFF * (2 ** attempt))
             else:
                 raise ConnectionError(
                     "pserver %s:%d unreachable after %d attempts: %r"

@@ -1383,7 +1383,7 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
     outs = out if isinstance(out, (list, tuple)) else [out]
     skip = set(id(v) for v in (skip_vars_in_backward_input or []))
     for o in outs:
-        if not o.shape or any(int(s) < 0 for s in o.shape):
+        if o.shape is None or any(int(s) < 0 for s in o.shape):
             raise ValueError(
                 "py_func out var %r needs a fully static shape" % o.name)
     func_id = len(_PYFUNC_TABLE)

@@ -292,6 +292,10 @@ def _py_func(ctx, op):
     def fwd_host(*arrs):
         rets = func(*[np.asarray(a) for a in arrs])
         rets = rets if isinstance(rets, (list, tuple)) else [rets]
+        if len(rets) != len(out_specs):
+            raise ValueError(
+                "py_func forward returned %d output(s); %d declared"
+                % (len(rets), len(out_specs)))
         return tuple(np.asarray(r).astype(spec.dtype).reshape(spec.shape)
                      for r, spec in zip(rets, out_specs))
 
@@ -308,6 +312,12 @@ def _py_func(ctx, op):
 
         def f_bwd(res, douts):
             args, outs_v = res
+            # integer inputs take float0 cotangents (jax's tangent type
+            # for non-float leaves) — only float inputs ride through the
+            # host callback
+            is_float = [jnp.issubdtype(a.dtype, jnp.floating)
+                        for a in args]
+            f_specs = tuple(s for s, fl in zip(x_specs, is_float) if fl)
 
             def bwd_host(*flat):
                 n = len(args)
@@ -320,8 +330,11 @@ def _py_func(ctx, op):
                 call += douts_np
                 gs = bwd(*call)
                 gs = gs if isinstance(gs, (list, tuple)) else [gs]
+                gs = list(gs) + [None] * len(args)
                 full = []
-                for a, g in zip(args, list(gs) + [None] * len(args)):
+                for a, g, fl in zip(args, gs, is_float):
+                    if not fl:
+                        continue
                     if g is None:
                         full.append(np.zeros(a.shape, a.dtype))
                     else:
@@ -329,8 +342,12 @@ def _py_func(ctx, op):
                                     .reshape(a.shape))
                 return tuple(full)
 
-            return jax.pure_callback(bwd_host, x_specs, *args, *outs_v,
-                                     *douts)
+            f_grads = iter(jax.pure_callback(bwd_host, f_specs, *args,
+                                             *outs_v, *douts))
+            return tuple(
+                next(f_grads) if fl
+                else np.zeros(a.shape, jax.dtypes.float0)
+                for a, fl in zip(args, is_float))
 
         f.defvjp(f_fwd, f_bwd)
         outs = f(*xs)

@@ -1,0 +1,35 @@
+"""EXECUTION-based op-coverage gate (VERDICT r3 #4): every registered
+lowering must actually RUN during the suite — ``registry.lower_op`` (and
+the dygraph tracer) record executed types into ``EXECUTED_OP_TYPES``, and
+this file (alphabetically last, so it runs after every other module)
+asserts registry ⊆ executed ∪ EXEMPT. Unlike the old textual-mention
+check (an op named in a comment passed), a lowering that silently stops
+being exercised now fails the build. Reference analogue: the op-test
+discipline of ``unittests/op_test.py:135``."""
+
+import pytest
+
+
+# Genuinely-unexecutable-in-process lowerings, each with its reason.
+EXEMPT = {
+    # spawned trainer SUBPROCESSES execute these (test_multiprocess /
+    # launch gang tests); the recorder is per-process
+    "c_comm_init", "c_comm_init_all",
+    # identity boot markers for rendezvous the transpiler emits for
+    # reference parity; real bootstrap is jax.distributed (env.py) and
+    # the lowering is shared with `barrier` (asserted registered)
+    "c_gen_nccl_id", "gen_nccl_id",
+}
+
+
+def test_every_registered_lowering_executed(request):
+    from paddle_tpu.fluid.registry import EXECUTED_OP_TYPES, registry
+
+    if len(request.session.items) < 400:
+        pytest.skip("partial run: the execution gate needs the full suite")
+    missing = sorted(t for t in registry.types()
+                     if t not in EXECUTED_OP_TYPES and t not in EXEMPT)
+    assert not missing, (
+        "registered op lowerings never executed by the suite "
+        "(add a real execution test or an EXEMPT entry with a reason): %s"
+        % missing)
