@@ -110,59 +110,34 @@ def shard_vocab(vocab, n_shards, shard_idx):
     return (int(vocab) - shard_idx + n_shards - 1) // n_shards
 
 
-class TableServer:
-    """Serves pull/push/dump/load for the local shard of each table.
+class FramedServer:
+    """Shared transport base: bound socket, daemon accept loop, live
+    connection tracking (``stop()`` severs serving threads, not just the
+    acceptor), and the magic+token handshake — subclasses implement
+    ``_serve_authenticated(conn)``. Used by TableServer here and
+    ExchangeServer (sample_exchange.py) so the hardening lives once."""
 
-    ``tables`` maps name -> EmbeddingTable (already shard-sized). Serving
-    runs on daemon threads (one per connection); ``stop()`` or a _STOP
-    request shuts down.
-    """
-
-    def __init__(self, host="127.0.0.1", port=0, tables=None, token=None):
-        self.tables = dict(tables or {})
-        # shared-secret handshake (ADVICE r3): every connection must open
-        # with the magic + this token before any opcode is served. Empty
-        # token (the default) still requires the magic, which filters
-        # stray/legacy peers; real deployments set PADDLE_PS_TOKEN.
+    def __init__(self, host="127.0.0.1", port=0, token=None, backlog=64):
         self.token = _default_token() if token is None else str(token)
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind((host, port))
-        self._srv.listen(16)
+        self._srv.listen(backlog)
         self.host, self.port = self._srv.getsockname()
         self._stop = threading.Event()
         self._accept_thread = None
         self._conns = set()
         self._conns_mu = threading.Lock()
-        # last applied push sequence per client id: lets a reconnecting
-        # client RE-SEND a push whose response was lost without the
-        # gradient being applied twice (at-most-once apply; reference
-        # heart_beat_monitor.h treats trainer membership as tracked state).
-        # LRU-bounded so elastic trainer fleets (fresh uuid per process)
-        # cannot grow server memory without bound.
-        import collections
-
-        self._push_seq = collections.OrderedDict()
-        self._push_mu = threading.Lock()
-        self._push_seq_cap = 4096
 
     @property
     def endpoint(self):
         return "%s:%d" % (self.host, self.port)
-
-    def add_table(self, name, table):
-        self.tables[name] = table
 
     def start(self):
         self._accept_thread = threading.Thread(target=self._accept_loop,
                                                daemon=True)
         self._accept_thread.start()
         return self
-
-    def serve_forever(self):
-        """Blocking serve — what ``exe.run(pserver_program)`` does, like
-        the reference's ``listen_and_serv`` RunSyncLoop."""
-        self._accept_loop()
 
     def _accept_loop(self):
         self._srv.settimeout(0.2)
@@ -183,10 +158,16 @@ class TableServer:
     def stop(self):
         self._stop.set()
         # sever live connections too — their serving threads would
-        # otherwise keep answering after "shutdown"
+        # otherwise keep answering after "shutdown". shutdown() (not just
+        # close()) reliably wakes threads blocked in recv and prevents
+        # the freed fd from being re-read by the old thread.
         with self._conns_mu:
             conns = list(self._conns)
         for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 c.close()
             except OSError:
@@ -199,13 +180,12 @@ class TableServer:
         except OSError:
             pass
 
-    # -- request handling ---------------------------------------------------
     def _serve_conn(self, conn):
         with self._conns_mu:
             self._conns.add(conn)
         try:
             # hello: magic + u16 token length + token; anything else is
-            # dropped before a single table opcode can run
+            # dropped before a single opcode can run
             try:
                 conn.settimeout(10)
                 hello = _recv_exact(conn, len(_MAGIC) + 2)
@@ -221,16 +201,7 @@ class TableServer:
                 conn.settimeout(None)
             except (ConnectionError, OSError, struct.error):
                 return
-            while not self._stop.is_set():
-                try:
-                    req = _read_frame(conn)
-                except (ConnectionError, OSError):
-                    return
-                resp = self._handle(req)
-                _send_all(conn, _frame(resp))
-                if req and req[0] == _STOP:
-                    self._stop.set()
-                    return
+            self._serve_authenticated(conn)
         finally:
             with self._conns_mu:
                 self._conns.discard(conn)
@@ -238,6 +209,65 @@ class TableServer:
                 conn.close()
             except OSError:
                 pass
+
+    def _serve_authenticated(self, conn):
+        raise NotImplementedError
+
+
+class TableServer(FramedServer):
+    """Serves pull/push/dump/load for the local shard of each table.
+
+    ``tables`` maps name -> EmbeddingTable (already shard-sized). Serving
+    runs on daemon threads (one per connection); ``stop()`` or a _STOP
+    request shuts down.
+    """
+
+    def __init__(self, host="127.0.0.1", port=0, tables=None, token=None):
+        # shared-secret handshake (ADVICE r3): every connection must open
+        # with the magic + this token before any opcode is served. Empty
+        # token (the default) still requires the magic, which filters
+        # stray/legacy peers; real deployments set PADDLE_PS_TOKEN.
+        super().__init__(host=host, port=port, token=token, backlog=16)
+        self.tables = dict(tables or {})
+        # last applied push sequence per client id: lets a reconnecting
+        # client RE-SEND a push whose response was lost without the
+        # gradient being applied twice (at-most-once apply; reference
+        # heart_beat_monitor.h treats trainer membership as tracked state).
+        # LRU-bounded so elastic trainer fleets (fresh uuid per process)
+        # cannot grow server memory without bound; size the cap above the
+        # peak CONCURRENT client count (PADDLE_PS_PUSH_DEDUP_CAP) —
+        # evicting a live client would re-open its double-apply window,
+        # so evictions are logged.
+        import collections
+
+        self._push_seq = collections.OrderedDict()
+        self._push_mu = threading.Lock()
+        self._push_seq_cap = int(os.environ.get(
+            "PADDLE_PS_PUSH_DEDUP_CAP", 4096))
+
+    def add_table(self, name, table):
+        self.tables[name] = table
+
+    def serve_forever(self):
+        """Blocking serve — what ``exe.run(pserver_program)`` does, like
+        the reference's ``listen_and_serv`` RunSyncLoop."""
+        self._accept_loop()
+
+    # -- request handling ---------------------------------------------------
+    def _serve_authenticated(self, conn):
+        while not self._stop.is_set():
+            try:
+                req = _read_frame(conn)
+            except (ConnectionError, OSError):
+                return
+            resp = self._handle(req)
+            try:
+                _send_all(conn, _frame(resp))
+            except (ConnectionError, OSError):
+                return
+            if req and req[0] == _STOP:
+                self._stop.set()
+                return
 
     def _handle(self, req):
         try:
@@ -278,7 +308,16 @@ class TableServer:
                         st = {"last": -1, "mu": threading.Lock()}
                         self._push_seq[client] = st
                         while len(self._push_seq) > self._push_seq_cap:
-                            self._push_seq.popitem(last=False)
+                            evicted, _ = self._push_seq.popitem(last=False)
+                            import logging
+
+                            logging.getLogger(__name__).warning(
+                                "push-dedup state evicted for client %s "
+                                "(cap %d exceeded — raise "
+                                "PADDLE_PS_PUSH_DEDUP_CAP above the "
+                                "concurrent trainer count or its retry "
+                                "protection lapses)",
+                                evicted.hex(), self._push_seq_cap)
                     else:
                         self._push_seq.move_to_end(client)
                 with st["mu"]:

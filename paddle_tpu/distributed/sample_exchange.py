@@ -1,0 +1,169 @@
+"""Exchange-based global shuffle (reference ``framework/data_set.h:100``
+GlobalShuffle + ``fleet`` send/receive at ``dataset.py:504``): each
+trainer loads only ITS OWN file shard, then samples hash-route between
+trainers over TCP so every trainer ends with a random, disjoint ~1/N of
+the global data — O(data/N) host memory per worker, not O(data).
+
+Rides the hardened PS framing (magic + token handshake, length-capped
+frames, no pickle — samples are tuples of dtyped 1-D arrays packed with
+the same array codec as table rows).
+"""
+
+import socket
+import struct
+import threading
+
+import numpy as np
+
+from .ps_server import (_MAGIC, FramedServer, _frame, _pack_arr,
+                        _read_frame, _send_all, _unpack_arr)
+
+__all__ = ["ExchangeServer", "exchange_shuffle"]
+
+_SEND, _DONE = 1, 2
+_BATCH_BYTES = 4 * 1024 * 1024
+
+
+def _pack_samples(samples):
+    out = [struct.pack("<I", len(samples))]
+    for s in samples:
+        out.append(struct.pack("<B", len(s)))
+        for arr in s:
+            out.append(_pack_arr(np.asarray(arr)))
+    return b"".join(out)
+
+
+def _unpack_samples(buf, off=0):
+    (n,) = struct.unpack_from("<I", buf, off)
+    off += 4
+    samples = []
+    for _ in range(n):
+        (k,) = struct.unpack_from("<B", buf, off)
+        off += 1
+        slots = []
+        for _ in range(k):
+            arr, off = _unpack_arr(buf, off)
+            slots.append(arr)
+        samples.append(tuple(slots))
+    return samples, off
+
+
+class ExchangeServer(FramedServer):
+    """Per-trainer inbox: peers stream sample batches at it during the
+    shuffle; ``wait(n_senders)`` blocks until every peer (including the
+    local loop-back sender) signalled DONE and returns the samples.
+    Transport (accept loop, handshake, conn-severing stop) is the shared
+    FramedServer."""
+
+    def __init__(self, host="127.0.0.1", port=0, token=None):
+        super().__init__(host=host, port=port, token=token, backlog=64)
+        self._samples = []
+        self._done = 0
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        self.start()
+
+    def _serve_authenticated(self, conn):
+        try:
+            while not self._stop.is_set():
+                req = _read_frame(conn)
+                if not req:
+                    return
+                if req[0] == _SEND:
+                    batch, _ = _unpack_samples(req, 1)
+                    with self._mu:
+                        self._samples.extend(batch)
+                    _send_all(conn, _frame(b"\x00"))
+                elif req[0] == _DONE:
+                    with self._cv:
+                        self._done += 1
+                        self._cv.notify_all()
+                    _send_all(conn, _frame(b"\x00"))
+                    return
+                else:
+                    return
+        except (ConnectionError, OSError, struct.error):
+            return
+
+    def wait(self, n_senders, timeout=300):
+        with self._cv:
+            ok = self._cv.wait_for(lambda: self._done >= n_senders,
+                                   timeout=timeout)
+            if not ok:
+                raise TimeoutError(
+                    "exchange: %d/%d senders finished within %ds"
+                    % (self._done, n_senders, timeout))
+            out = self._samples
+            self._samples = []
+            self._done = 0
+        return out
+
+
+class _Sender:
+    def __init__(self, endpoint, token, connect_timeout=60):
+        import time
+
+        host, port = endpoint.rsplit(":", 1)
+        # peers start at different speeds (interpreter/JAX import skew);
+        # retry until the inbox is listening
+        deadline = time.time() + connect_timeout
+        while True:
+            try:
+                self._sock = socket.create_connection(
+                    (host, int(port)), timeout=30)
+                break
+            except OSError:
+                if time.time() >= deadline:
+                    raise
+                time.sleep(0.25)
+        tok = (token or "").encode()
+        _send_all(self._sock, _MAGIC + struct.pack("<H", len(tok)) + tok)
+        resp = _read_frame(self._sock)
+        if not resp or resp[0] != 0:
+            raise ConnectionError("exchange peer rejected handshake")
+
+    def send(self, samples):
+        _send_all(self._sock,
+                  _frame(bytes([_SEND]) + _pack_samples(samples)))
+        _read_frame(self._sock)  # ack
+
+    def done(self):
+        _send_all(self._sock, _frame(bytes([_DONE])))
+        _read_frame(self._sock)
+        self._sock.close()
+
+
+def exchange_shuffle(samples, server, endpoints, seed=0, token=None):
+    """Route ``samples`` to the trainers owning them and return this
+    trainer's received set. ``server`` is this trainer's ExchangeServer;
+    ``endpoints`` lists ALL trainers' exchange endpoints (index =
+    trainer id). Each sample's destination is an independent uniform
+    draw, so the post-exchange sets partition the global data and are
+    shuffled; a final local shuffle de-correlates arrival order."""
+    n = len(endpoints)
+    rng = np.random.RandomState(seed + 917)
+    token = server.token if token is None else token
+    dests = rng.randint(0, n, size=len(samples))
+    senders = [_Sender(ep, token) for ep in endpoints]
+    try:
+        for k, snd in enumerate(senders):
+            mine = [s for s, d in zip(samples, dests) if d == k]
+            batch, size = [], 0
+            for s in mine:
+                batch.append(s)
+                size += sum(a.nbytes + 16 for a in s)
+                if size >= _BATCH_BYTES:
+                    snd.send(batch)
+                    batch, size = [], 0
+            if batch:
+                snd.send(batch)
+    finally:
+        for snd in senders:
+            try:
+                snd.done()
+            except (ConnectionError, OSError):
+                pass
+    got = server.wait(n_senders=n)
+    rng2 = np.random.RandomState(seed + 31)
+    rng2.shuffle(got)
+    return got

@@ -303,14 +303,34 @@ class InMemoryDataset(DatasetBase):
     def local_shuffle(self):
         self._rng.shuffle(self._samples)
 
+    def set_exchange(self, server, endpoints, seed=None):
+        """Enable the network sample exchange for global_shuffle:
+        ``server`` is this trainer's ``ExchangeServer``
+        (distributed/sample_exchange.py), ``endpoints`` every trainer's
+        exchange endpoint. With this set, each trainer loads only its
+        own file shard and global_shuffle exchanges samples — O(data/N)
+        host memory (reference GlobalShuffle, data_set.h:100)."""
+        self._exchange = (server, list(endpoints), seed)
+
     def global_shuffle(self, fleet=None, thread_num=12):
-        """Distributed shuffle: shuffle locally, then keep the samples
-        this trainer owns by hash — every trainer sees a disjoint 1/N of
-        the (virtually concatenated) global data, like the reference's
-        fleet send/receive exchange (dataset.py:504) without the RPC
-        round-trip (each trainer loads the full filelist; the hash does
-        the partitioning)."""
+        """Distributed shuffle. With ``set_exchange`` configured: the
+        reference's exchange semantics — samples hash-route between
+        trainers over TCP, every trainer keeps a random disjoint ~1/N of
+        the global data while having loaded only its own files.
+        Without it (``fleet`` only): DEGRADED mode — every trainer must
+        have loaded the FULL filelist; a positional hash keeps 1/N and
+        discards the rest (correct result, O(global-data) memory)."""
         self._rng.shuffle(self._samples)
+        exchange = getattr(self, "_exchange", None)
+        if exchange is not None:
+            from ..distributed.sample_exchange import exchange_shuffle
+
+            server, endpoints, seed = exchange
+            if seed is None:
+                seed = int(self._rng.randint(0, 2 ** 31 - 1))
+            self._samples = exchange_shuffle(self._samples, server,
+                                             endpoints, seed=seed)
+            return
         if fleet is None:
             return
         trainer_id = fleet.worker_index()

@@ -320,6 +320,8 @@ class Executor:
         same compile-cached XLA step ``run()`` uses — thread-level
         parallelism lives in the dataset's parsing/prefetch side, device
         parallelism in the compiled step's shardings."""
+        import jax
+
         if dataset is None:
             raise ValueError("dataset is required")
         if thread:
@@ -328,9 +330,29 @@ class Executor:
         fetch_info = list(fetch_info or
                           [getattr(v, "name", str(v)) for v in fetch_list])
         n_batches = 0
-        for feed in dataset.batch_reader()():
-            res = self.run(program, feed=feed, fetch_list=fetch_list,
-                           scope=scope)
+        # double-buffer ahead-dispatch (the fluid/reader.py staging trick;
+        # reference buffered_reader.h ReadAsync semantics): step i is
+        # dispatched asynchronously (return_numpy=False keeps it
+        # in-flight), then batch i+1 parses on host and stages H2D while
+        # the device executes — host prep and device step overlap.
+        import numpy as _np
+
+        def _stage(feed):
+            # LoDTensor (and other non-array) feeds ride through raw —
+            # run() decomposes them into data + @LOD with dtype
+            # normalization; only plain arrays pre-stage on device
+            return {k: jax.device_put(v)
+                    if isinstance(v, (_np.ndarray, jax.Array)) else v
+                    for k, v in feed.items()}
+
+        it = iter(dataset.batch_reader()())
+        nxt = next(it, None)
+        staged = _stage(nxt) if nxt is not None else None
+        while staged is not None:
+            res = self.run(program, feed=staged, fetch_list=fetch_list,
+                           scope=scope, return_numpy=False)
+            nxt = next(it, None)
+            staged = _stage(nxt) if nxt is not None else None
             n_batches += 1
             if debug and fetch_list and n_batches % print_period == 0:
                 import numpy as _np

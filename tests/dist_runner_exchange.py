@@ -1,0 +1,62 @@
+"""Subprocess runner for the exchange-based global shuffle test: trainer
+k loads ONLY its own file, runs the network exchange, and writes the
+keys of the samples it ended up with."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu.fluid as fluid  # noqa: E402
+from paddle_tpu.fluid import layers  # noqa: E402
+from paddle_tpu.distributed.sample_exchange import ExchangeServer  # noqa: E402
+
+
+def main():
+    cfg = json.loads(sys.argv[1])
+    tid = cfg["trainer_id"]
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        dense = layers.data("dense", [3])
+        ids = layers.data("ids", [1], dtype="int64")
+        label = layers.data("label", [1])
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(2)
+    ds.set_use_var([dense, ids, label])
+    ds.set_filelist([cfg["files"][tid]])   # ONLY this trainer's shard
+    ds.load_into_memory()
+    n_loaded = ds.get_memory_data_size()
+
+    # rendezvous: bind port 0 ourselves (no parent-side TOCTOU), publish
+    # it, and wait for every peer's published port
+    import time
+
+    server = ExchangeServer(port=0, token="xchg")
+    with open(cfg["rdv"][tid] + ".tmp", "w") as f:
+        f.write(str(server.port))
+    os.replace(cfg["rdv"][tid] + ".tmp", cfg["rdv"][tid])
+    ports = []
+    deadline = time.time() + 120
+    for path in cfg["rdv"]:
+        while not os.path.exists(path):
+            if time.time() > deadline:
+                raise TimeoutError("peer rendezvous file missing: " + path)
+            time.sleep(0.1)
+        ports.append(int(open(path).read()))
+    endpoints = ["127.0.0.1:%d" % p for p in ports]
+    ds.set_exchange(server, endpoints, seed=100 + tid)
+    ds.global_shuffle()
+    server.stop()
+
+    keys = ["%.6f" % float(s[0][0]) for s in ds._samples]
+    with open(cfg["out"][tid], "w") as f:
+        json.dump({"loaded": n_loaded, "keys": keys}, f)
+
+
+if __name__ == "__main__":
+    main()
