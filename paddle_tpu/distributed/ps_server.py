@@ -27,7 +27,11 @@ import threading
 import time
 import uuid
 
+import logging
+
 import numpy as np
+
+_LOG = logging.getLogger(__name__)
 
 __all__ = ["TableServer", "RemoteTable", "ShardedRemoteTable",
            "shard_vocab"]
@@ -242,6 +246,10 @@ class TableServer(FramedServer):
 
         self._push_seq = collections.OrderedDict()
         self._push_mu = threading.Lock()
+        # tables that ever received a push/load: reported in _META so a
+        # joining trainer can tell a fresh shard from a restored one
+        # (get_trainer_program's push_init guard)
+        self._touched = set()
         self._push_seq_cap = int(os.environ.get(
             "PADDLE_PS_PUSH_DEDUP_CAP", 4096))
 
@@ -309,9 +317,7 @@ class TableServer(FramedServer):
                         self._push_seq[client] = st
                         while len(self._push_seq) > self._push_seq_cap:
                             evicted, _ = self._push_seq.popitem(last=False)
-                            import logging
-
-                            logging.getLogger(__name__).warning(
+                            _LOG.warning(
                                 "push-dedup state evicted for client %s "
                                 "(cap %d exceeded — raise "
                                 "PADDLE_PS_PUSH_DEDUP_CAP above the "
@@ -327,9 +333,12 @@ class TableServer(FramedServer):
                                optimizer=_OPT_NAME.get(opt_code, "sgd"),
                                eps=eps)
                     st["last"] = seq
+                self._touched.add(id(table))
                 return b"\x00"
             if op == _META:
-                return b"\x00" + struct.pack("<QQ", table.vocab, table.dim)
+                return b"\x00" + struct.pack(
+                    "<QQB", table.vocab, table.dim,
+                    1 if id(table) in self._touched else 0)
             if op == _DUMP:
                 start, n = struct.unpack_from("<QQ", req, off)
                 return b"\x00" + _pack_arr(table.dump_rows(start, n))
@@ -337,9 +346,11 @@ class TableServer(FramedServer):
                 (start,) = struct.unpack_from("<Q", req, off)
                 rows, _ = _unpack_arr(req, off + 8)
                 table.load_rows(start, rows)
+                self._touched.add(id(table))
                 return b"\x00"
             if op == _RESET:
                 table.reinit()
+                self._touched.discard(id(table))
                 return b"\x00"
             return b"\x01unknown opcode"
         except Exception as e:  # surface to the client, keep serving
@@ -449,7 +460,10 @@ class RemoteTable:
         self._client_id = uuid.uuid4().bytes     # push-dedup identity
         self._push_seq = 0
         meta = self._conn.request(_req(_META, name))
-        self.vocab, self.dim = struct.unpack("<QQ", meta)
+        self.vocab, self.dim = struct.unpack_from("<QQ", meta)
+        # servers report whether the shard ever saw a push/load (older
+        # 16-byte replies imply unknown -> treated as touched for safety)
+        self.touched = bool(meta[16]) if len(meta) > 16 else True
 
     def pull(self, ids):
         ids = np.ascontiguousarray(np.asarray(ids).reshape(-1), np.int64)
@@ -520,6 +534,10 @@ class ShardedRemoteTable:
         self._n = len(endpoints)
         self._shards = [RemoteTable(ep, name, token=token)
                         for ep in endpoints]
+        # any shard already pushed/loaded => the remote state is live
+        # (e.g. restored from a checkpoint) and must not be overwritten
+        # by a joining trainer's fresh init
+        self.touched = any(sh.touched for sh in self._shards)
         for k, sh in enumerate(self._shards):
             expect = shard_vocab(self.vocab, self._n, k)
             if sh.vocab < expect or sh.dim != self.dim:

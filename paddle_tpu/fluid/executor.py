@@ -355,8 +355,6 @@ class Executor:
             staged = _stage(nxt) if nxt is not None else None
             n_batches += 1
             if debug and fetch_list and n_batches % print_period == 0:
-                import numpy as _np
-
                 msg = ", ".join(
                     "%s=%s" % (info, _np.asarray(val).ravel()[:4])
                     for info, val in zip(fetch_info, res))

@@ -228,13 +228,15 @@ def _dropout(ctx, op):
     # Masks come from 8-bit random words, applied multiplicatively. Against
     # bernoulli (32-bit uniform) + where this is 4x less generator traffic
     # and fuses into one VPU pass — measured on v5e BERT-base AMP:
-    # 94.8 -> 87.5 ms/step. Keep-probability resolution is 1/256; BOTH the
-    # upscale factor and the downgrade inference scale use the REALIZED
-    # keep (thresh/256), so E[train out] == E[test out] exactly.
+    # 94.8 -> 87.5 ms/step. Keep-probability resolution is 1/256;
+    # INFERENCE scales by the EXACT 1-p (reference-checkpoint parity,
+    # ADVICE r3 #3) and the realized-keep (thresh/256) correction folds
+    # into the TRAIN-time factor, so E[train out] == E[test out] still
+    # holds exactly.
     keep = 1.0 - p
     thresh = min(max(int(round(keep * 256.0)), 0 if keep <= 0.0 else 1), 256)
     if is_test:
-        out = x * (thresh / 256.0) if impl == "downgrade_in_infer" else x
+        out = x * keep if impl == "downgrade_in_infer" else x
         ctx.set_output(op, "Out", out)
         return
     if thresh <= 0 or thresh >= 256:
@@ -243,12 +245,22 @@ def _dropout(ctx, op):
         # key-count-sensitive config comparison stay aligned
         ctx.next_rng()
         one_or_zero = (jnp.ones_like if thresh >= 256 else jnp.zeros_like)
-        ctx.set_output(op, "Out", x if thresh >= 256 else jnp.zeros_like(x))
+        if thresh >= 256:
+            # keep-everything grid cell: the downgrade impl must still
+            # carry the exact keep so E[train] == x*keep == E[test]
+            full = x * keep if impl == "downgrade_in_infer" else x
+        else:
+            full = jnp.zeros_like(x)
+        ctx.set_output(op, "Out", full)
         ctx.set_output(op, "Mask", one_or_zero(x))
         return
     bits = jax.random.bits(ctx.next_rng(), x.shape, jnp.uint8)
     mask = bits < jnp.uint8(thresh)
-    scale = (256.0 / thresh) if impl == "upscale_in_train" else 1.0
+    realized = thresh / 256.0
+    if impl == "upscale_in_train":
+        scale = 1.0 / realized             # E[out] == x; infer passes x
+    else:
+        scale = keep / realized            # E[out] == x*keep == infer
     out = x * (mask.astype(x.dtype) * scale)
     ctx.set_output(op, "Out", out)
     ctx.set_output(op, "Mask", mask.astype(x.dtype))

@@ -15,11 +15,17 @@ import time
 from collections import OrderedDict
 
 __all__ = ["profiler", "start_profiler", "stop_profiler", "reset_profiler",
+           "export_chrome_tracing",
            "RecordEvent", "cuda_profiler", "npu_profiler"]
 
 _enabled = False
 _events = OrderedDict()  # name -> [calls, total, min, max]
 _trace_dir = None
+_spans = []              # (name, t_end, dur) — for the chrome timeline
+_MAX_SPANS = 200_000
+# perf_counter has an arbitrary epoch; anchor it to unix time once so
+# host spans land on the same clock as device XPlane timestamps
+_EPOCH_ANCHOR = (time.perf_counter(), time.time())
 
 
 def now():
@@ -37,6 +43,8 @@ def _record(name, seconds):
         e[1] += seconds
         e[2] = min(e[2], seconds)
         e[3] = max(e[3], seconds)
+    if len(_spans) < _MAX_SPANS:
+        _spans.append((name, time.perf_counter(), seconds))
 
 
 class RecordEvent:
@@ -74,11 +82,15 @@ def start_profiler(state="All", tracer_option="Default", trace_dir=None):
         jax.profiler.start_trace(trace_dir)
 
 
-def stop_profiler(sorted_key=None, profile_path=None):
-    """Disable collection, print the summary table, optionally write it to
-    ``profile_path``, and stop the device trace if one is running."""
+def stop_profiler(sorted_key=None, profile_path=None, timeline_path=None):
+    """Disable collection, print the summary table, optionally write it
+    to ``profile_path``, stop the device trace if one is running, and —
+    with ``timeline_path`` — export a chrome://tracing JSON (the
+    reference's ``tools/timeline.py`` output, host events + any captured
+    device ops)."""
     global _enabled, _trace_dir
     _enabled = False
+    trace_dir = _trace_dir
     if _trace_dir is not None:
         import jax
 
@@ -89,11 +101,70 @@ def stop_profiler(sorted_key=None, profile_path=None):
     if profile_path:
         with open(profile_path, "w") as f:
             f.write(report)
+    if timeline_path:
+        export_chrome_tracing(timeline_path, trace_dir=trace_dir)
     return report
+
+
+def export_chrome_tracing(path, trace_dir=None):
+    """Write a chrome://tracing JSON: host RecordEvent/Executor spans as
+    pid 0, and — when a jax.profiler trace was captured and the xplane
+    proto is importable — the device's XLA-op timeline as pid 1.
+    Reference ``tools/timeline.py`` emits the same format from its
+    profile protos."""
+    import glob
+    import json
+
+    pc0, unix0 = _EPOCH_ANCHOR
+    events = []
+    for name, t_end, dur in _spans:
+        start_unix = (t_end - dur) - pc0 + unix0
+        events.append({"name": name, "ph": "X", "pid": 0, "tid": 0,
+                       "ts": start_unix * 1e6, "dur": dur * 1e6,
+                       "cat": "host"})
+    if trace_dir:
+        try:
+            from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+            files = glob.glob(trace_dir + "/**/*.xplane.pb",
+                              recursive=True)
+            if files:
+                xs = xplane_pb2.XSpace()
+                with open(sorted(files)[-1], "rb") as f:
+                    xs.ParseFromString(f.read())
+                for plane in xs.planes:
+                    if "/device:" not in plane.name:
+                        continue
+                    md = plane.event_metadata
+                    for line in plane.lines:
+                        if line.name != "XLA Ops":
+                            continue
+                        for ev in line.events:
+                            nm = md[ev.metadata_id].name.split(" = ")[0]
+                            events.append({
+                                "name": nm.lstrip("%")[:120], "ph": "X",
+                                "pid": 1, "tid": int(line.id or 0),
+                                "ts": (line.timestamp_ns +
+                                       ev.offset_ps / 1e3) / 1e3,
+                                "dur": ev.duration_ps / 1e6,
+                                "cat": "device"})
+        except Exception as e:  # host spans still export
+            events.append({"name": "xplane-convert-failed: %r" % (e,),
+                           "ph": "i", "pid": 1, "tid": 0, "ts": 0,
+                           "s": "g"})
+    meta = [{"name": "process_name", "ph": "M", "pid": 0,
+             "args": {"name": "host"}},
+            {"name": "process_name", "ph": "M", "pid": 1,
+             "args": {"name": "device (XLA ops)"}}]
+    with open(path, "w") as f:
+        json.dump({"traceEvents": meta + events,
+                   "displayTimeUnit": "ms"}, f)
+    return path
 
 
 def reset_profiler():
     _events.clear()
+    del _spans[:]
 
 
 def summary(sorted_key=None):
@@ -120,14 +191,14 @@ def summary(sorted_key=None):
 
 @contextlib.contextmanager
 def profiler(state="All", sorted_key=None, profile_path=None,
-             tracer_option="Default", trace_dir=None):
+             tracer_option="Default", trace_dir=None, timeline_path=None):
     """Reference ``fluid.profiler.profiler`` context manager."""
     reset_profiler()
     start_profiler(state, tracer_option, trace_dir)
     try:
         yield
     finally:
-        stop_profiler(sorted_key, profile_path)
+        stop_profiler(sorted_key, profile_path, timeline_path)
 
 
 @contextlib.contextmanager

@@ -69,7 +69,17 @@ class DistributeTranspiler:
                 "with fluid.layers.embedding(..., is_distributed=True)")
 
     # -- trainer side -------------------------------------------------------
-    def get_trainer_program(self, wait_port=True):
+    def get_trainer_program(self, wait_port=True, push_init=True):
+        """Swap local host tables for ShardedRemoteTable proxies. With
+        ``push_init`` (default), trainer 0 ships its LOCAL tables'
+        initial values to UNTOUCHED pservers first — fresh-start PS
+        training then begins from exactly the single-process init (the
+        reference ships init through the split startup program; ADVICE
+        r3 #2). Shards that already saw a push or a checkpoint load
+        report themselves touched and are never overwritten, so resume
+        flows keep their restored state even through fleet.init_worker.
+        Trainer 0 should reach this call before others take training
+        steps (the usual launch order)."""
         from ...distributed import ps
         from ...distributed.ps_server import ShardedRemoteTable
 
@@ -78,8 +88,12 @@ class DistributeTranspiler:
 
             wait_server_ready(self._eps)
         for name, (vocab, dim) in self._tables.items():
-            ps.register_table(
-                name, ShardedRemoteTable(self._eps, name, vocab, dim))
+            local = ps.get_table(name)
+            remote = ShardedRemoteTable(self._eps, name, vocab, dim)
+            if push_init and self._trainer_id == 0 and local is not None \
+                    and hasattr(local, "dump") and not remote.touched:
+                remote.load(local.dump())
+            ps.register_table(name, remote)
         return self._program
 
     # -- pserver side -------------------------------------------------------
@@ -128,8 +142,8 @@ def build_server_from_attrs(attrs):
                                 attrs["table_vocabs"],
                                 attrs["table_dims"]):
         rows = shard_vocab(vocab, n, k)
-        # reuse the trainer-side init seed so shard rows match the
-        # single-process table: row r of shard k is global id r*n + k —
-        # tests LOAD exact values anyway; fresh shards just need the shape
+        # shard-local seed only shapes the placeholder rows: the real
+        # initial values arrive from trainer 0's push_init load (or an
+        # explicit restore) before training pulls them
         tables[name] = ps.EmbeddingTable(rows, dim, seed=1000 + k)
     return TableServer(host=host, port=int(port), tables=tables)

@@ -190,3 +190,29 @@ class Channel:
         if self._h:
             self._lib.chn_destroy(self._h)
             self._h = 0
+
+
+@functools.lru_cache(maxsize=None)
+def build_predictor_lib():
+    """Build libpredictor.so (embedded-CPython inference entry,
+    c_api.h prd_*). Needs the Python dev headers; returns the .so path
+    or None. Not loaded via ctypes from within Python (the interpreter
+    is already here) — this is the artifact C embedders link."""
+    import subprocess
+    import sysconfig
+
+    so = os.path.join(_DIR, "libpredictor.so")
+    src = os.path.join(_DIR, "predictor.cc")
+    if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(src):
+        return so
+    inc = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR")
+    pyver = "python%d.%d" % tuple(__import__("sys").version_info[:2])
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+           "-I", inc, "-o", so, src,
+           "-L", libdir, "-l" + pyver]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=180)
+    except Exception:
+        return None
+    return so

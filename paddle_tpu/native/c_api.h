@@ -91,6 +91,22 @@ long long chn_size(long long handle);
 int chn_close(long long handle);
 int chn_destroy(long long handle);
 
+/* ---- libpredictor: C inference entry (reference inference/capi/,
+ * analysis_predictor.h:47). Hosts an embedded CPython interpreter and
+ * brokers float32 buffers into paddle_tpu.inference.Predictor — the
+ * XLA-compiled serve path — so non-Python embedders can run a saved
+ * model. Single-threaded callers; outputs fetched by index; out_shape
+ * must have room for 8 dims. 0/handle = success; negatives: -1 init,
+ * -2 python exception (printed to stderr), -3 bad handle, -4 output
+ * buffer too small. */
+
+int64_t prd_create(const char* model_dir, int use_bf16);
+int prd_run(int64_t h, const char** in_names, const float** in_bufs,
+            const int64_t* in_shapes, const int64_t* in_ranks,
+            int64_t n_in, int64_t out_index, float* out_buf,
+            int64_t out_cap, int64_t* out_shape, int64_t* out_rank);
+int prd_destroy(int64_t h);
+
 #ifdef __cplusplus
 }  /* extern "C" */
 #endif

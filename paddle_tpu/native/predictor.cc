@@ -1,0 +1,189 @@
+/* libpredictor — C inference entry over an embedded CPython interpreter
+ * (the reference serves non-Python embedders through
+ * paddle/fluid/inference/capi/ + analysis_predictor.h:47; here the
+ * compute path is JAX/XLA, so the C ABI hosts the interpreter and
+ * brokers buffers into paddle_tpu.inference.Predictor).
+ *
+ * Contract (documented, deliberately minimal like the reference's
+ * minimal C surface): float32 tensors only, single-threaded callers
+ * (one embedded interpreter, no GIL hand-off), outputs fetched by
+ * index. Returns 0/handles on success, negative codes on error:
+ *   -1 interpreter/init failure   -3 bad handle
+ *   -2 python exception (printed) -4 output buffer too small
+ */
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "c_api.h"
+
+namespace {
+
+std::mutex g_mu;
+std::vector<PyObject*> g_predictors;  // index+1 = handle; nullptr = freed
+bool g_py_owned = false;
+
+bool ensure_python() {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    g_py_owned = true;
+  }
+  return Py_IsInitialized();
+}
+
+PyObject* np_module() {
+  static PyObject* np = nullptr;
+  if (!np) np = PyImport_ImportModule("numpy");
+  return np;
+}
+
+/* wrap a caller buffer as a numpy array (copy — caller keeps ownership) */
+PyObject* buf_to_ndarray(const float* buf, const int64_t* shape,
+                         int64_t rank) {
+  int64_t n = 1;
+  for (int64_t i = 0; i < rank; ++i) n *= shape[i];
+  PyObject* np = np_module();
+  if (!np) return nullptr;
+  PyObject* mem = PyMemoryView_FromMemory(
+      reinterpret_cast<char*>(const_cast<float*>(buf)),
+      n * sizeof(float), PyBUF_READ);
+  if (!mem) return nullptr;
+  PyObject* frombuffer = PyObject_GetAttrString(np, "frombuffer");
+  PyObject* arr = PyObject_CallFunction(frombuffer, "Os", mem, "float32");
+  Py_XDECREF(frombuffer);
+  Py_DECREF(mem);
+  if (!arr) return nullptr;
+  PyObject* shp = PyTuple_New(rank);
+  for (int64_t i = 0; i < rank; ++i)
+    PyTuple_SET_ITEM(shp, i, PyLong_FromLongLong(shape[i]));
+  PyObject* reshaped = PyObject_CallMethod(arr, "reshape", "O", shp);
+  Py_DECREF(shp);
+  Py_DECREF(arr);
+  /* copy() detaches from the caller's buffer lifetime */
+  if (!reshaped) return nullptr;
+  PyObject* copied = PyObject_CallMethod(reshaped, "copy", nullptr);
+  Py_DECREF(reshaped);
+  return copied;
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t prd_create(const char* model_dir, int use_bf16) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (!ensure_python()) return 0;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int64_t handle = 0;
+  PyObject* mod = PyImport_ImportModule("paddle_tpu.inference");
+  if (mod) {
+    PyObject* cfg_cls = PyObject_GetAttrString(mod, "Config");
+    PyObject* cfg = cfg_cls ? PyObject_CallFunction(cfg_cls, "s", model_dir)
+                            : nullptr;
+    if (cfg && use_bf16) {
+      PyObject* r = PyObject_CallMethod(cfg, "enable_bf16", nullptr);
+      Py_XDECREF(r);
+    }
+    PyObject* pred_cls =
+        cfg ? PyObject_GetAttrString(mod, "Predictor") : nullptr;
+    PyObject* pred =
+        pred_cls ? PyObject_CallFunction(pred_cls, "O", cfg) : nullptr;
+    if (pred) {
+      g_predictors.push_back(pred); /* keep the reference */
+      handle = static_cast<int64_t>(g_predictors.size());
+    }
+    Py_XDECREF(pred_cls);
+    Py_XDECREF(cfg);
+    Py_XDECREF(cfg_cls);
+    Py_DECREF(mod);
+  }
+  if (!handle && PyErr_Occurred()) PyErr_Print();
+  PyGILState_Release(gil);
+  return handle;
+}
+
+int prd_run(int64_t h, const char** in_names, const float** in_bufs,
+            const int64_t* in_shapes, const int64_t* in_ranks,
+            int64_t n_in, int64_t out_index, float* out_buf,
+            int64_t out_cap, int64_t* out_shape, int64_t* out_rank) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (h < 1 || h > static_cast<int64_t>(g_predictors.size()) ||
+      !g_predictors[h - 1])
+    return -3;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -2;
+  PyObject* feed = PyDict_New();
+  const int64_t* shp = in_shapes;
+  bool ok = true;
+  for (int64_t i = 0; ok && i < n_in; ++i) {
+    PyObject* arr = buf_to_ndarray(in_bufs[i], shp, in_ranks[i]);
+    shp += in_ranks[i];
+    if (!arr) {
+      ok = false;
+      break;
+    }
+    PyDict_SetItemString(feed, in_names[i], arr);
+    Py_DECREF(arr);
+  }
+  PyObject* outs =
+      ok ? PyObject_CallMethod(g_predictors[h - 1], "run", "O", feed)
+         : nullptr;
+  Py_DECREF(feed);
+  if (outs) {
+    PyObject* out = PySequence_GetItem(outs, out_index);
+    PyObject* np = np_module();
+    PyObject* asarray =
+        out ? PyObject_GetAttrString(np, "ascontiguousarray") : nullptr;
+    PyObject* arr =
+        asarray ? PyObject_CallFunction(asarray, "Os", out, "float32")
+                : nullptr;
+    if (arr) {
+      PyObject* shape_t = PyObject_GetAttrString(arr, "shape");
+      int64_t rank = PyTuple_Size(shape_t);
+      int64_t n = 1;
+      *out_rank = rank;
+      for (int64_t i = 0; i < rank && i < 8; ++i) {
+        out_shape[i] = PyLong_AsLongLong(PyTuple_GET_ITEM(shape_t, i));
+        n *= out_shape[i];
+      }
+      Py_DECREF(shape_t);
+      if (n <= out_cap) {
+        PyObject* tob = PyObject_CallMethod(arr, "tobytes", nullptr);
+        if (tob) {
+          std::memcpy(out_buf, PyBytes_AsString(tob),
+                      static_cast<size_t>(n) * sizeof(float));
+          Py_DECREF(tob);
+          rc = 0;
+        }
+      } else {
+        rc = -4;
+      }
+      Py_DECREF(arr);
+    }
+    Py_XDECREF(asarray);
+    Py_XDECREF(out);
+    Py_DECREF(outs);
+  }
+  if (rc == -2 && PyErr_Occurred()) PyErr_Print();
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int prd_destroy(int64_t h) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (h < 1 || h > static_cast<int64_t>(g_predictors.size()) ||
+      !g_predictors[h - 1])
+    return -3;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  Py_DECREF(g_predictors[h - 1]);
+  g_predictors[h - 1] = nullptr;
+  PyGILState_Release(gil);
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,56 @@
+/* C embedder smoke driver: serves a saved model through the prd_* ABI
+ * (libpredictor.so) with no Python code in this translation unit.
+ * Usage: c_predict_main <model_dir> <input_name> <C> <H> <W>
+ * Feeds a deterministic [1, C, H, W] ramp image and prints output 0. */
+
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "../paddle_tpu/native/c_api.h"
+
+int main(int argc, char** argv) {
+  if (argc < 6) {
+    fprintf(stderr, "usage: %s model_dir input_name C H W\n", argv[0]);
+    return 2;
+  }
+  const char* model_dir = argv[1];
+  const char* input_name = argv[2];
+  int64_t c = atoll(argv[3]), h = atoll(argv[4]), w = atoll(argv[5]);
+
+  int64_t handle = prd_create(model_dir, /*use_bf16=*/0);
+  if (!handle) {
+    fprintf(stderr, "prd_create failed\n");
+    return 3;
+  }
+
+  int64_t n = c * h * w;
+  float* img = (float*)malloc(n * sizeof(float));
+  for (int64_t i = 0; i < n; ++i) img[i] = (float)(i % 17) / 17.0f;
+
+  const char* names[1] = {input_name};
+  const float* bufs[1] = {img};
+  int64_t shape[4] = {1, c, h, w};
+  int64_t ranks[1] = {4};
+
+  float out[4096];
+  int64_t out_shape[8];
+  int64_t out_rank = 0;
+  int rc = prd_run(handle, names, bufs, shape, ranks, 1,
+                   /*out_index=*/0, out, 4096, out_shape, &out_rank);
+  if (rc != 0) {
+    fprintf(stderr, "prd_run rc=%d\n", rc);
+    return 4;
+  }
+  int64_t total = 1;
+  printf("shape:");
+  for (int64_t i = 0; i < out_rank; ++i) {
+    printf(" %lld", (long long)out_shape[i]);
+    total *= out_shape[i];
+  }
+  printf("\nvalues:");
+  for (int64_t i = 0; i < total && i < 64; ++i) printf(" %.6f", out[i]);
+  printf("\n");
+  free(img);
+  return prd_destroy(handle) == 0 ? 0 : 5;
+}

@@ -25,9 +25,12 @@ assert len(jax.devices()) == 8, "expected 8 virtual CPU devices for mesh tests"
 
 def pytest_sessionfinish(session, exitstatus):
     """Dump the executed-op-type set so the execution-coverage gate's
-    EXEMPT list can be audited (and partial-run investigations have the
-    data): tests/.executed_op_types.txt."""
+    EXEMPT list can be audited: tests/.executed_op_types.txt. Only
+    full-suite sessions write it (partial runs would clobber the
+    meaningful dump with a tiny one)."""
     try:
+        if len(getattr(session, "items", [])) < 400:
+            return
         from paddle_tpu.fluid.registry import EXECUTED_OP_TYPES, registry
 
         here = os.path.dirname(os.path.abspath(__file__))

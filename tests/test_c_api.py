@@ -3,6 +3,8 @@ run against the shipped shared objects (the reference's framework/c/c_api
 capability + ABI regression guard for the ctypes bindings)."""
 
 import os
+
+import numpy as np
 import subprocess
 
 import pytest
@@ -92,3 +94,72 @@ def test_c_program_against_header(tmp_path):
                          capture_output=True, text=True)
     assert out.returncode == 0, (out.returncode, out.stdout, out.stderr)
     assert "C_API_OK" in out.stdout
+
+
+def test_c_predictor_serves_lenet(tmp_path):
+    """A pure-C embedder (tests/c_predict_main.c) serves a saved conv
+    model through the prd_* ABI: libpredictor.so hosts an embedded
+    interpreter over the XLA serve path (reference inference/capi/)."""
+    import shutil
+    import sys
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    so = native.build_predictor_lib()
+    if so is None:
+        pytest.skip("libpredictor build unavailable (no python headers?)")
+
+    # tiny LeNet-ish model, saved as an inference model
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("img", shape=[1, 12, 12], dtype="float32")
+        c1 = layers.conv2d(x, 4, 3, padding=1, act="relu")
+        p1 = layers.pool2d(c1, 2, "max", pool_stride=2)
+        prob = layers.softmax(layers.fc(p1, 10))
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    model_dir = str(tmp_path / "lenet_model")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_inference_model(model_dir, ["img"], [prob], exe,
+                                      main_program=main)
+        # python-side reference on the SAME deterministic ramp the C
+        # driver feeds: img[i] = (i % 17) / 17
+        n = 1 * 12 * 12
+        img = (np.arange(n) % 17 / 17.0).astype(np.float32).reshape(
+            1, 1, 12, 12)
+        (expect,) = exe.run(main, feed={"img": img}, fetch_list=[prob])
+    expect = np.asarray(expect)
+
+    drv_src = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "c_predict_main.c")
+    drv = str(tmp_path / "c_predict")
+    subprocess.run(
+        ["g++", "-x", "c", drv_src, "-x", "none", "-o", drv, so,
+         "-Wl,-rpath," + os.path.dirname(so),
+         "-Wl,-rpath," + "/usr/local/lib"],
+        check=True, capture_output=True)
+    import site
+
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # the embedded interpreter needs the BASE stdlib as home (a venv has
+    # no stdlib) plus the venv's site-packages on the path
+    env["PYTHONHOME"] = sys.base_prefix
+    env["PYTHONPATH"] = os.pathsep.join(
+        [repo] + [p for p in site.getsitepackages() if "site-packages" in p])
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run([drv, model_dir, "img", "1", "12", "12"],
+                         capture_output=True, text=True, env=env,
+                         timeout=300)
+    assert out.returncode == 0, (out.returncode, out.stdout[-500:],
+                                 out.stderr[-2000:])
+    lines = out.stdout.strip().splitlines()
+    shape = [int(v) for v in lines[0].split(":")[1].split()]
+    vals = np.array([float(v) for v in lines[1].split(":")[1].split()],
+                    np.float32)
+    assert shape == [1, 10]
+    np.testing.assert_allclose(vals, expect.ravel(), rtol=1e-4, atol=1e-5)

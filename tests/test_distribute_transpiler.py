@@ -365,3 +365,36 @@ def test_ps_server_crash_restart_resume():
         rt.close()
     finally:
         srv2.stop()
+
+
+def test_transpiler_fresh_init_matches_local():
+    """get_trainer_program (trainer 0) ships the local tables' initial
+    values to the pservers — fresh-start PS training begins from exactly
+    the single-process init, no explicit load() (ADVICE r3 #2)."""
+    vocab, dim = 10, 4
+    main, startup, loss = _build_ctr_program(vocab, dim)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        local_init = ps.get_table("dt_emb").dump().copy()
+
+        probes = [TableServer() for _ in range(2)]
+        eps = [s.endpoint for s in probes]
+        for s in probes:
+            s.stop()
+        t = fluid.DistributeTranspiler()
+        t.transpile(trainer_id=0, program=main, pservers=",".join(eps),
+                    trainers=1)
+        threads = []
+        for ep in eps:
+            prog = t.get_pserver_program(ep)
+            th = threading.Thread(
+                target=lambda p=prog: fluid.Executor().run(p), daemon=True)
+            th.start()
+            threads.append(th)
+        wait_server_ready(eps)
+        t.get_trainer_program()
+        remote = ps.get_table("dt_emb")
+        np.testing.assert_allclose(remote.pull(np.arange(vocab)),
+                                   local_init, rtol=1e-6)
+    ps.reset_tables()

@@ -191,10 +191,10 @@ def test_unsupported_apis_raise_with_alternatives():
                    (layers.filter_by_instag, {})):
         with pytest.raises(NotImplementedError):
             fn()
-    with pytest.raises(NotImplementedError, match="cond"):
-        layers.IfElse(None)
-    with pytest.raises(NotImplementedError, match="rnn"):
-        layers.DynamicRNN()
+    # IfElse / DynamicRNN are real since round 4 (test_control_flow.py);
+    # constructing them must NOT raise anymore
+    assert layers.IfElse(None) is not None
+    assert layers.DynamicRNN(maxlen=4) is not None
 
 
 def test_lod_append_sets_innermost_level():

@@ -190,3 +190,55 @@ def test_sub_block_op_error_attributed():
                                 "y": np.ones((2, 4), np.float32)},
                     fetch_list=[out])
     assert "elementwise_add" in str(ei.value)
+
+
+def test_chrome_timeline_export(tmp_path):
+    """stop_profiler(timeline_path=...) writes chrome://tracing JSON with
+    the host spans (reference tools/timeline.py output shape)."""
+    import json
+
+    from paddle_tpu.fluid import profiler
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("tl_x", [4, 4], append_batch_size=False)
+        y = layers.reduce_sum(layers.square(x))
+    exe = fluid.Executor()
+    path = str(tmp_path / "timeline.json")
+    with fluid.scope_guard(fluid.Scope()):
+        with profiler.profiler(timeline_path=path):
+            for _ in range(2):
+                with profiler.record_event("tl_section"):
+                    exe.run(main, feed={"tl_x": np.ones((4, 4), np.float32)},
+                            fetch_list=[y])
+    doc = json.load(open(path))
+    evts = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    names = {e["name"] for e in evts}
+    assert any("tl_section" in n for n in names)
+    assert any("executor_run" in n for n in names)
+    assert all(e["dur"] >= 0 and e["ts"] >= 0 for e in evts)
+
+
+def test_dropout_inference_scales_by_exact_keep():
+    """downgrade_in_infer inference multiplies by EXACT 1-p (reference
+    checkpoint parity) while training folds the realized-keep correction
+    in, so E[train] == E[test] stays true (ADVICE r3 #3)."""
+    p = 0.37   # keep=0.63 -> thresh 161/256 = 0.62890625 != keep
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("do_x", [512, 64], append_batch_size=False)
+        te = layers.dropout(x, p, is_test=True,
+                            dropout_implementation="downgrade_in_infer")
+        tr = layers.dropout(x, p, is_test=False,
+                            dropout_implementation="downgrade_in_infer")
+    exe = fluid.Executor()
+    xv = np.ones((512, 64), np.float32)
+    with fluid.scope_guard(fluid.Scope()):
+        tev, trv = exe.run(main, feed={"do_x": xv}, fetch_list=[te, tr])
+    np.testing.assert_allclose(np.asarray(tev), xv * (1 - p), rtol=1e-6)
+    # train-mode kept cells carry keep/realized, so the mean matches the
+    # inference scale despite the 1/256 mask grid
+    np.testing.assert_allclose(np.asarray(trv).mean(), 1 - p, rtol=0.02)
+    kept = np.asarray(trv)[np.asarray(trv) > 0]
+    np.testing.assert_allclose(kept, kept[0], rtol=1e-6)  # uniform scale
+    np.testing.assert_allclose(kept[0], (1 - p) / (161 / 256.0), rtol=1e-5)
