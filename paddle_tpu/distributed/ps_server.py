@@ -246,9 +246,10 @@ class TableServer(FramedServer):
 
         self._push_seq = collections.OrderedDict()
         self._push_mu = threading.Lock()
-        # tables that ever received a push/load: reported in _META so a
-        # joining trainer can tell a fresh shard from a restored one
-        # (get_trainer_program's push_init guard)
+        # table NAMES that ever received a push/load: reported in _META
+        # so a joining trainer can tell a fresh shard from a restored one
+        # (get_trainer_program's push_init guard); keyed by name, not
+        # object identity (add_table may replace objects)
         self._touched = set()
         self._push_seq_cap = int(os.environ.get(
             "PADDLE_PS_PUSH_DEDUP_CAP", 4096))
@@ -333,12 +334,12 @@ class TableServer(FramedServer):
                                optimizer=_OPT_NAME.get(opt_code, "sgd"),
                                eps=eps)
                     st["last"] = seq
-                self._touched.add(id(table))
+                self._touched.add(name)
                 return b"\x00"
             if op == _META:
                 return b"\x00" + struct.pack(
                     "<QQB", table.vocab, table.dim,
-                    1 if id(table) in self._touched else 0)
+                    1 if name in self._touched else 0)
             if op == _DUMP:
                 start, n = struct.unpack_from("<QQ", req, off)
                 return b"\x00" + _pack_arr(table.dump_rows(start, n))
@@ -346,11 +347,11 @@ class TableServer(FramedServer):
                 (start,) = struct.unpack_from("<Q", req, off)
                 rows, _ = _unpack_arr(req, off + 8)
                 table.load_rows(start, rows)
-                self._touched.add(id(table))
+                self._touched.add(name)
                 return b"\x00"
             if op == _RESET:
                 table.reinit()
-                self._touched.discard(id(table))
+                self._touched.discard(name)
                 return b"\x00"
             return b"\x01unknown opcode"
         except Exception as e:  # surface to the client, keep serving

@@ -146,10 +146,12 @@ def _barrier(ctx, op):
     import jax
 
     axis = _axis_for(ctx, op)
-    if axis is not None and op.input("X"):
+    if op.input("X"):
         x = ctx.get_input(op, "X")
-        # psum of zeros = synchronization point
-        ctx.set_output(op, "Out", x + 0 * jax.lax.psum(x * 0, axis))
+        if axis is not None:
+            # psum of zeros = synchronization point
+            x = x + 0 * jax.lax.psum(x * 0, axis)
+        ctx.set_output(op, "Out", x)  # single-rank: identity
 
 
 @register("shard_tensor")

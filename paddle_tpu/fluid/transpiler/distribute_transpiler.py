@@ -91,8 +91,17 @@ class DistributeTranspiler:
             local = ps.get_table(name)
             remote = ShardedRemoteTable(self._eps, name, vocab, dim)
             if push_init and self._trainer_id == 0 and local is not None \
-                    and hasattr(local, "dump") and not remote.touched:
-                remote.load(local.dump())
+                    and hasattr(local, "dump"):
+                # PER-SHARD: only untouched shards receive init, so a
+                # partially-restarted cluster gets its fresh shard
+                # initialized while restored shards keep their state
+                full = None
+                for k, shard in enumerate(remote._shards):
+                    if shard.touched:
+                        continue
+                    if full is None:
+                        full = local.dump()
+                    shard.load(full[k::remote._n])
             ps.register_table(name, remote)
         return self._program
 

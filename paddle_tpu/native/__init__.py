@@ -15,15 +15,19 @@ import subprocess
 _DIR = os.path.dirname(os.path.abspath(__file__))
 
 
-def _build(name, srcs):
+def _build(name, srcs, extra_flags=(), timeout=120):
     so = os.path.join(_DIR, name + ".so")
     src_paths = [os.path.join(_DIR, s) for s in srcs]
     if os.path.exists(so) and all(
             os.path.getmtime(so) >= os.path.getmtime(s) for s in src_paths):
         return so
-    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", so] + src_paths
+    # extra_flags go AFTER the sources: -l libraries only record a
+    # DT_NEEDED when they appear after the objects that use them
+    cmd = (["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", so] +
+           src_paths + list(extra_flags))
     try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        subprocess.run(cmd, check=True, capture_output=True,
+                       timeout=timeout)
     except Exception:
         return None
     return so
@@ -197,22 +201,15 @@ def build_predictor_lib():
     """Build libpredictor.so (embedded-CPython inference entry,
     c_api.h prd_*). Needs the Python dev headers; returns the .so path
     or None. Not loaded via ctypes from within Python (the interpreter
-    is already here) — this is the artifact C embedders link."""
-    import subprocess
+    is already here) — this is the artifact C embedders link. Always
+    built locally (never shipped prebuilt: it links this interpreter's
+    libpython, so a foreign binary would be ABI-incompatible)."""
+    import sys
     import sysconfig
 
-    so = os.path.join(_DIR, "libpredictor.so")
-    src = os.path.join(_DIR, "predictor.cc")
-    if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(src):
-        return so
     inc = sysconfig.get_paths()["include"]
     libdir = sysconfig.get_config_var("LIBDIR")
-    pyver = "python%d.%d" % tuple(__import__("sys").version_info[:2])
-    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-           "-I", inc, "-o", so, src,
-           "-L", libdir, "-l" + pyver]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=180)
-    except Exception:
-        return None
-    return so
+    pyver = "python%d.%d" % sys.version_info[:2]
+    return _build("libpredictor", ["predictor.cc"],
+                  extra_flags=["-I", inc, "-L", libdir, "-l" + pyver],
+                  timeout=180)

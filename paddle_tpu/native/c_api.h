@@ -96,9 +96,12 @@ int chn_destroy(long long handle);
  * brokers float32 buffers into paddle_tpu.inference.Predictor — the
  * XLA-compiled serve path — so non-Python embedders can run a saved
  * model. Single-threaded callers; outputs fetched by index; out_shape
- * must have room for 8 dims. 0/handle = success; negatives: -1 init,
- * -2 python exception (printed to stderr), -3 bad handle, -4 output
- * buffer too small. */
+ * must have room for 8 dims (outputs of rank > 8 return -4).
+ * prd_create returns a positive handle on success and 0 on ANY failure
+ * (init or python exception — details go to stderr); prd_run/
+ * prd_destroy return 0 on success, negatives on error: -2 python
+ * exception (printed to stderr), -3 bad handle, -4 output buffer too
+ * small / rank > 8. */
 
 int64_t prd_create(const char* model_dir, int use_bf16);
 int prd_run(int64_t h, const char** in_names, const float** in_bufs,

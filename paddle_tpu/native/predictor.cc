@@ -152,7 +152,9 @@ int prd_run(int64_t h, const char** in_names, const float** in_bufs,
         n *= out_shape[i];
       }
       Py_DECREF(shape_t);
-      if (n <= out_cap) {
+      if (rank > 8) {
+        rc = -4; /* out_shape only holds 8 dims (c_api.h contract) */
+      } else if (n <= out_cap) {
         PyObject* tob = PyObject_CallMethod(arr, "tobytes", nullptr);
         if (tob) {
           std::memcpy(out_buf, PyBytes_AsString(tob),
