@@ -43,8 +43,10 @@ class CompatibleInfo:
 
 # Op types consumed structurally by the executor/autodiff rather than via a
 # lowering rule.
+# listen_and_serv is run specially by the Executor (a host serving
+# loop, executor.py), not via a lowering rule — structural too.
 _STRUCTURAL_OPS = frozenset({"feed", "fetch", "autodiff", "save", "load",
-                             "py_func"})
+                             "py_func", "listen_and_serv"})
 
 
 def check_program_compatible(program, version=None):

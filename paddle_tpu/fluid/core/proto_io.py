@@ -2,10 +2,24 @@
 
 Parity: the reference serializes ``ProgramDesc`` protobuf directly
 (``program_desc.h:30``); here the in-memory IR is plain Python and this
-module is the (de)serialization boundary.
+module is the (de)serialization boundary. Loads are version-gated
+(reference ``framework/version.h`` IsProgramVersionSupported) and
+op-compat checked (``op_compatible_info.cc``): a program written by a
+newer framework, or using op types this build doesn't register, fails
+loudly at load instead of mid-execution.
 """
 
 from . import framework_pb2 as pb
+
+# Version + op-compat POLICY lives in fluid/compat.py (PROGRAM_VERSION,
+# is_program_version_supported, check_program_compatible with its
+# structural/_grad exemptions) — this module only ENFORCES it at the
+# deserialization boundary so raw loads (Program.parse_from_string)
+# cannot bypass the gate the io.py loader applies.
+
+
+class ProgramVersionError(RuntimeError):
+    pass
 
 
 def _attr_to_pb(a, value):
@@ -62,7 +76,9 @@ def _attr_from_pb(a):
 
 def program_to_bytes(desc):
     p = pb.ProgramDesc()
-    p.version = desc.get("version", 1)
+    from ..compat import PROGRAM_VERSION
+
+    p.version = desc.get("version", PROGRAM_VERSION)
     p.random_seed = desc.get("random_seed", 0)
     for k, v in desc.get("param_grad_map", {}).items():
         p.param_grad_map[k] = v
@@ -98,7 +114,11 @@ def program_to_bytes(desc):
     return p.SerializeToString()
 
 
-def program_from_bytes(data):
+def program_from_bytes(data, check=True):
+    """Parse + validate against fluid.compat (reference
+    ``framework/version.h`` IsProgramVersionSupported +
+    ``op_compatible_info.cc``). ``check=False`` skips the gate (tooling
+    that only inspects the graph)."""
     p = pb.ProgramDesc()
     p.ParseFromString(data)
     blocks = []
@@ -131,7 +151,7 @@ def program_from_bytes(data):
                 ],
             }
         )
-    return {
+    desc = {
         "version": p.version,
         "random_seed": p.random_seed,
         "blocks": blocks,
@@ -139,3 +159,11 @@ def program_from_bytes(data):
         "feed_names": list(p.feed_names),
         "fetch_names": list(p.fetch_names),
     }
+    if check:
+        from ..compat import check_program_compatible
+
+        info = check_program_compatible(desc)
+        if not info:
+            raise ProgramVersionError(
+                "program is not loadable by this build: %r" % (info,))
+    return desc

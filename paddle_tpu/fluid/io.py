@@ -142,13 +142,8 @@ def load_inference_model(dirname, executor, model_filename=None,
 
     model_path = os.path.join(dirname, model_filename or "__model__")
     with open(model_path, "rb") as f:
+        # program_from_bytes enforces check_program_compatible itself
         desc = proto_io.program_from_bytes(f.read())
-    from .compat import check_program_compatible
-
-    info = check_program_compatible(desc)
-    if not info:
-        raise RuntimeError("loaded model is not runnable by this build: %s"
-                           % info)
     program = Program.from_desc(desc)
     feed_names = desc.get("feed_names", [])
     fetch_names = desc.get("fetch_names", [])

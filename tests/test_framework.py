@@ -99,3 +99,50 @@ def test_operator_sugar():
         c = x < y
     assert z.dtype == np.dtype("float32")
     assert c.dtype == np.dtype("bool")
+
+
+def test_program_version_gating_and_op_compat():
+    """Load-time compat checks (reference framework/version.h +
+    op_compatible_info.cc): newer-writer programs and unknown op types
+    fail loudly at load, not mid-execution."""
+    from paddle_tpu.fluid import compat
+    from paddle_tpu.fluid.core import proto_io
+    from paddle_tpu.fluid.core import framework_pb2 as pb
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("vx", [4], dtype="float32")
+        layers.relu(x)
+    data = proto_io.program_to_bytes(main.to_desc())
+    # round trip under the current version is clean
+    desc = proto_io.program_from_bytes(data)
+    assert desc["version"] == compat.PROGRAM_VERSION
+
+    # a NEWER writer version must be refused at the parse boundary
+    p = pb.ProgramDesc()
+    p.ParseFromString(data)
+    p.version = compat.PROGRAM_VERSION + 1
+    with pytest.raises(proto_io.ProgramVersionError, match="version"):
+        proto_io.program_from_bytes(p.SerializeToString())
+    assert not compat.is_program_version_supported(
+        compat.PROGRAM_VERSION + 1)
+
+    # an unknown op type is named in the load error
+    p.version = compat.PROGRAM_VERSION
+    p.blocks[0].ops.add().type = "made_up_future_op"
+    with pytest.raises(proto_io.ProgramVersionError,
+                       match="made_up_future_op"):
+        proto_io.program_from_bytes(p.SerializeToString())
+    # ...tooling can still inspect it with the gate off
+    desc2 = proto_io.program_from_bytes(p.SerializeToString(),
+                                        check=False)
+    assert not compat.check_program_compatible(desc2)
+
+    # structural ops (run specially by the executor) stay loadable:
+    # a pserver program round-trips
+    sp = fluid.Program()
+    sp.global_block().append_op("listen_and_serv", inputs={}, outputs={},
+                                attrs={"endpoint": "x"})
+    rt = proto_io.program_from_bytes(proto_io.program_to_bytes(
+        sp.to_desc()))
+    assert rt["blocks"][0]["ops"][0]["type"] == "listen_and_serv"
