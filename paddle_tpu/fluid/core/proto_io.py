@@ -18,7 +18,17 @@ from . import framework_pb2 as pb
 # cannot bypass the gate the io.py loader applies.
 
 
-class ProgramVersionError(RuntimeError):
+class ProgramCompatError(RuntimeError):
+    """Load-gate failure; ``status`` is the CompatibleInfo status
+    (``unsupported_version`` or ``undefined_op``) so callers can offer
+    the right remedy without string-matching."""
+
+    def __init__(self, message, status=""):
+        super().__init__(message)
+        self.status = status
+
+
+class ProgramVersionError(ProgramCompatError):
     pass
 
 
@@ -162,8 +172,13 @@ def program_from_bytes(data, check=True):
     if check:
         from ..compat import check_program_compatible
 
+        from ..compat import CompatibleInfo
+
         info = check_program_compatible(desc)
         if not info:
-            raise ProgramVersionError(
-                "program is not loadable by this build: %r" % (info,))
+            cls = (ProgramVersionError
+                   if info.status == CompatibleInfo.UNSUPPORTED_VERSION
+                   else ProgramCompatError)
+            raise cls("program is not loadable by this build: %r"
+                      % (info,), status=info.status)
     return desc

@@ -514,7 +514,9 @@ class Program:
     # -- serialization ------------------------------------------------------
     def to_desc(self):
         return {
-            "version": 1,
+            "version": __import__(
+                "paddle_tpu.fluid.compat", fromlist=["PROGRAM_VERSION"]
+            ).PROGRAM_VERSION,
             "random_seed": self.random_seed,
             "blocks": [b.to_desc() for b in self.blocks],
             "param_grad_map": dict(self.param_grad_map),

@@ -127,12 +127,15 @@ def test_program_version_gating_and_op_compat():
     assert not compat.is_program_version_supported(
         compat.PROGRAM_VERSION + 1)
 
-    # an unknown op type is named in the load error
+    # an unknown op type is named in the load error, distinguishable
+    # from version failures by type/status
     p.version = compat.PROGRAM_VERSION
     p.blocks[0].ops.add().type = "made_up_future_op"
-    with pytest.raises(proto_io.ProgramVersionError,
-                       match="made_up_future_op"):
+    with pytest.raises(proto_io.ProgramCompatError,
+                       match="made_up_future_op") as ei:
         proto_io.program_from_bytes(p.SerializeToString())
+    assert ei.value.status == compat.CompatibleInfo.UNDEFINED_OP
+    assert not isinstance(ei.value, proto_io.ProgramVersionError)
     # ...tooling can still inspect it with the gate off
     desc2 = proto_io.program_from_bytes(p.SerializeToString(),
                                         check=False)
