@@ -170,9 +170,7 @@ def program_from_bytes(data, check=True):
         "fetch_names": list(p.fetch_names),
     }
     if check:
-        from ..compat import check_program_compatible
-
-        from ..compat import CompatibleInfo
+        from ..compat import CompatibleInfo, check_program_compatible
 
         info = check_program_compatible(desc)
         if not info:

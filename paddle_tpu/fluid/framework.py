@@ -22,6 +22,12 @@ import numpy as np
 
 from . import unique_name
 
+
+def _program_version():
+    from .compat import PROGRAM_VERSION
+
+    return PROGRAM_VERSION
+
 # ---------------------------------------------------------------------------
 # dtype handling
 # ---------------------------------------------------------------------------
@@ -514,9 +520,7 @@ class Program:
     # -- serialization ------------------------------------------------------
     def to_desc(self):
         return {
-            "version": __import__(
-                "paddle_tpu.fluid.compat", fromlist=["PROGRAM_VERSION"]
-            ).PROGRAM_VERSION,
+            "version": _program_version(),
             "random_seed": self.random_seed,
             "blocks": [b.to_desc() for b in self.blocks],
             "param_grad_map": dict(self.param_grad_map),

@@ -9,6 +9,7 @@ from . import (  # noqa: F401
     bert,
     deepfm,
     lenet,
+    recommender,
     resnet,
     sentiment,
     seq2seq,

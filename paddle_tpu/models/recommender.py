@@ -1,0 +1,102 @@
+"""Recommender system (reference book
+``tests/book/test_recommender_system.py``): two embedding towers — user
+(id/gender/age/job) and movie (id, category multi-hot sequence, title
+word sequence) — fused by fcs, scored with cos_sim*5 against the rating.
+
+TPU-first notes: the two ragged movie inputs (categories, title) ride the
+bounded-LoD substrate ([total_bound, 1] + @LOD lengths) so the whole step
+compiles to one static-shape XLA program; the title tower is the
+``nets.sequence_conv_pool`` composite (conv over time + max pool), the
+category tower a plain sequence sum pool — same shapes as the reference
+model, re-built from the fluid layer surface.
+"""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers, nets, optimizer
+
+USR_VOCAB = 6041      # movielens max_user_id + 1
+MOV_VOCAB = 3953      # movielens max_movie_id + 1
+JOB_VOCAB = 21
+AGE_VOCAB = 7
+CAT_VOCAB = 19
+TITLE_VOCAB = 5175
+
+
+def user_tower():
+    uid = layers.data("user_id", [1], dtype="int64")
+    gender = layers.data("gender_id", [1], dtype="int64")
+    age = layers.data("age_id", [1], dtype="int64")
+    job = layers.data("job_id", [1], dtype="int64")
+
+    def emb_fc(x, vocab, dim, size):
+        e = layers.embedding(x, size=[vocab, dim], is_sparse=False)
+        e = layers.reshape(e, [-1, dim])
+        return layers.fc(e, size)
+
+    feats = [emb_fc(uid, USR_VOCAB, 32, 32),
+             emb_fc(gender, 2, 16, 16),
+             emb_fc(age, AGE_VOCAB, 16, 16),
+             emb_fc(job, JOB_VOCAB, 16, 16)]
+    combined = layers.fc(layers.concat(feats, axis=1), 200, act="tanh")
+    return combined, [uid, gender, age, job]
+
+
+def movie_tower():
+    mid = layers.data("movie_id", [1], dtype="int64")
+    cats = layers.data("category_id", [1], dtype="int64", lod_level=1)
+    title = layers.data("movie_title", [1], dtype="int64", lod_level=1)
+
+    m = layers.embedding(mid, size=[MOV_VOCAB, 32], is_sparse=False)
+    m = layers.fc(layers.reshape(m, [-1, 32]), 32)
+
+    ce = layers.embedding(cats, size=[CAT_VOCAB, 32], is_sparse=False)
+    c = layers.sequence_pool(ce, "sum")
+
+    te = layers.embedding(title, size=[TITLE_VOCAB, 32], is_sparse=False)
+    t = nets.sequence_conv_pool(te, num_filters=32, filter_size=3,
+                                act="tanh", pool_type="sum")
+
+    combined = layers.fc(layers.concat([m, c, t], axis=1), 200,
+                         act="tanh")
+    return combined, [mid, cats, title]
+
+
+def build_train_program(lr=0.2):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 90
+    with fluid.program_guard(main, startup):
+        usr, usr_vars = user_tower()
+        mov, mov_vars = movie_tower()
+        score = layers.cos_sim(usr, mov)
+        scaled = layers.scale(score, scale=5.0)
+        label = layers.data("score", [1], dtype="float32")
+        loss = layers.reduce_mean(
+            layers.square_error_cost(scaled, label))
+        optimizer.SGD(learning_rate=lr).minimize(loss)
+    feeds = [v.name for v in usr_vars + mov_vars] + ["score"]
+    return main, startup, loss, feeds
+
+
+def synthetic_batch(batch, rng=None, title_maxlen=4, cat_maxlen=3):
+    """Feed dict shaped like movielens rows (ragged fields as
+    LoDTensors); deterministic given ``rng``."""
+    rng = rng or np.random.RandomState(0)
+
+    def ragged(vocab, maxlen):
+        lens = rng.randint(1, maxlen + 1, batch)
+        flat = rng.randint(0, vocab, int(lens.sum()))
+        return fluid.create_lod_tensor(
+            flat.astype(np.int64).reshape(-1, 1), [list(map(int, lens))])
+
+    return {
+        "user_id": rng.randint(0, USR_VOCAB, (batch, 1)).astype(np.int64),
+        "gender_id": rng.randint(0, 2, (batch, 1)).astype(np.int64),
+        "age_id": rng.randint(0, AGE_VOCAB, (batch, 1)).astype(np.int64),
+        "job_id": rng.randint(0, JOB_VOCAB, (batch, 1)).astype(np.int64),
+        "movie_id": rng.randint(0, MOV_VOCAB, (batch, 1)).astype(np.int64),
+        "category_id": ragged(CAT_VOCAB, cat_maxlen),
+        "movie_title": ragged(TITLE_VOCAB, title_maxlen),
+        "score": rng.randint(1, 6, (batch, 1)).astype(np.float32),
+    }

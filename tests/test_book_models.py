@@ -57,3 +57,23 @@ def test_vgg16_smoke_trains():
     losses = _train(main, startup, loss, lambda i: batches[i % 3], 9)
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0] * 1.05, (losses[0], losses[-1])
+
+
+def test_recommender_system_trains():
+    """Book recommender (reference test_recommender_system.py): dual
+    embedding towers + cos_sim*5 regression; loss decreases on a fixed
+    synthetic batch, ragged movie fields riding bounded-LoD feeds."""
+    from paddle_tpu.models import recommender
+
+    main, startup, loss, feeds = recommender.build_train_program(lr=0.2)
+    assert set(feeds) >= {"user_id", "movie_title", "score"}
+    exe = fluid.Executor()
+    batch = recommender.synthetic_batch(16)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(8):
+            (lv,) = exe.run(main, feed=batch, fetch_list=[loss])
+            losses.append(float(np.asarray(lv).ravel()[0]))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0], losses
