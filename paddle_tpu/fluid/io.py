@@ -17,7 +17,10 @@ from . import framework
 from .executor import global_scope
 from .framework import Program, Variable
 
+from ..reader.decorator import batch, shuffle  # noqa: F401  (io.batch parity)
+
 __all__ = [
+    "batch", "shuffle",
     "save_vars", "save_params", "save_persistables", "load_vars",
     "load_params", "load_persistables", "save_inference_model",
     "load_inference_model", "save", "load",

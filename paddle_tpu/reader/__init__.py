@@ -1,6 +1,7 @@
 """Reader composition library (reference ``python/paddle/reader/``)."""
 
 from .decorator import (  # noqa: F401
+    batch,
     buffered,
     cache,
     chain,

@@ -12,7 +12,7 @@ import queue as _queue
 import random as _random
 import threading
 
-__all__ = ["map_readers", "shuffle", "chain", "compose", "buffered",
+__all__ = ["batch", "map_readers", "shuffle", "chain", "compose", "buffered",
            "firstn", "xmap_readers", "multiprocess_reader", "cache"]
 
 
@@ -185,5 +185,27 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
                 done += 1
                 continue
             yield s
+
+    return rd
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Group a sample reader into lists of ``batch_size`` samples
+    (reference ``python/paddle/batch.py`` — the book pipelines' standard
+    outermost decorator; also surfaced as ``fluid.io.batch``)."""
+    batch_size = int(batch_size)
+    if batch_size <= 0:
+        raise ValueError("batch_size must be a positive integer, got %r"
+                         % (batch_size,))
+
+    def rd():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
 
     return rd

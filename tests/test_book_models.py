@@ -7,6 +7,7 @@ import numpy as np
 import pytest
 
 import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
 from paddle_tpu.models import sentiment, vgg, word2vec
 
 
@@ -77,3 +78,37 @@ def test_recommender_system_trains():
             losses.append(float(np.asarray(lv).ravel()[0]))
     assert all(np.isfinite(losses))
     assert losses[-1] < losses[0], losses
+
+
+def test_fit_a_line_book():
+    """Book hello-world (reference tests/book/test_fit_a_line.py): one
+    fc over the 13 uci_housing features, SGD on square error — loss
+    decreases over epochs of the real reader pipeline."""
+    from paddle_tpu import dataset
+
+    reader = fluid.io.batch(
+        fluid.io.shuffle(dataset.uci_housing.train(), buf_size=128),
+        batch_size=20)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("house_x", [13], dtype="float32")
+        y = layers.data("house_y", [1], dtype="float32")
+        pred = layers.fc(x, 1)
+        loss = layers.reduce_mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        epoch_losses = []
+        for _ in range(3):
+            vals = []
+            for batch in reader():
+                xs = np.stack([b[0] for b in batch]).astype(np.float32)
+                ys = np.stack([b[1] for b in batch]).astype(
+                    np.float32).reshape(-1, 1)
+                (lv,) = exe.run(main, feed={"house_x": xs, "house_y": ys},
+                                fetch_list=[loss])
+                vals.append(float(np.asarray(lv).ravel()[0]))
+            epoch_losses.append(np.mean(vals))
+    assert all(np.isfinite(epoch_losses))
+    assert epoch_losses[-1] < epoch_losses[0], epoch_losses
