@@ -37,6 +37,7 @@ __all__ = [
     "autoincreased_step_counter", "smooth_l1", "dice_loss", "py_func",
     "linear_chain_crf", "crf_decoding", "ctc_greedy_decoder",
     "shard_tensor", "fused_attention", "fused_attention_packed",
+    "einsum",
 ]
 
 
@@ -1570,6 +1571,20 @@ def fused_attention(q, k, v, attn_bias=None, scale=None, dropout_prob=0.0,
         attrs["scale"] = float(scale)
     helper.append_op(type="fused_multihead_attention", inputs=inputs,
                      outputs={"Out": [out]}, attrs=attrs)
+    return out
+
+
+def einsum(equation, *operands, name=None):
+    """Tensor contraction by equation (``paddle.einsum`` capability,
+    lowered to jnp.einsum — XLA chooses fused layouts, so e.g. attention
+    scores contract straight out of the [B, S, H, d] projection layout
+    with no materialized transpose)."""
+    helper = LayerHelper("einsum", name=name)
+    out = helper.create_variable_for_type_inference(operands[0].dtype)
+    helper.append_op(type="einsum",
+                     inputs={"Operands": list(operands)},
+                     outputs={"Out": [out]},
+                     attrs={"equation": equation})
     return out
 
 

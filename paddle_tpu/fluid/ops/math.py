@@ -253,3 +253,15 @@ def _bilinear_tensor_product(ctx, op):
     if bias is not None:
         out = out + bias
     ctx.set_output(op, "Out", out)
+
+
+@register("einsum")
+def _einsum(ctx, op):
+    """General tensor contraction by equation (the ``paddle.einsum``
+    capability; lowered directly to jnp.einsum so XLA picks operand
+    layouts — e.g. attention scores from the fc-native [B, S, H, d]
+    layout without materialized head transposes)."""
+    import jax.numpy as jnp
+
+    xs = ctx.get_inputs(op, "Operands")
+    ctx.set_output(op, "Out", jnp.einsum(op.attr("equation"), *xs))
