@@ -1,0 +1,77 @@
+"""ParallelExecutor — the reference's pre-CompiledProgram multi-device
+API (``python/paddle/fluid/parallel_executor.py:28``; internally the SSA
+graph executor, ``details/fast_threaded_ssa_graph_executor.cc``).
+
+TPU-native: multi-device execution is GSPMD over a mesh, so this class
+is a faithful API adapter binding ``CompiledProgram.with_data_parallel``
+to an Executor + scope — exactly the migration the reference itself
+performs (its ParallelExecutor constructs a CompiledProgram under the
+hood in later versions). ``use_cuda`` is accepted for signature parity
+and ignored (placement is the JAX backend's)."""
+
+from . import compiler, framework
+from .executor import Executor, global_scope
+
+__all__ = ["ParallelExecutor"]
+
+
+class ParallelExecutor:
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None,
+                 build_strategy=None, num_trainers=1, trainer_id=0,
+                 scope=None):
+        if int(num_trainers) > 1:
+            # multi-trainer PE in the reference wires NCCL across nodes;
+            # here cross-process DP goes through fleet/jax.distributed
+            # (distributed/env.py) — refusing beats silent divergence
+            raise ValueError(
+                "ParallelExecutor(num_trainers>1) is not supported: use "
+                "fleet collective mode / paddle_tpu.distributed for "
+                "multi-process data parallelism")
+        self._main = main_program or framework.default_main_program()
+        self._compiled = compiler.CompiledProgram(
+            self._main, build_strategy).with_data_parallel(
+            loss_name=loss_name, exec_strategy=exec_strategy)
+        self._exe = Executor()
+        if share_vars_from is not None:
+            if scope is not None and scope is not share_vars_from._scope:
+                raise ValueError(
+                    "pass either share_vars_from or scope, not both — "
+                    "share_vars_from reuses the other executor's scope")
+            # reference semantics: reuse the training PE's variables
+            # (e.g. a test-program PE sharing weights)
+            self._scope = share_vars_from._scope
+        else:
+            self._scope = scope or global_scope()
+
+    @property
+    def device_count(self):
+        import jax
+
+        return len(jax.devices())
+
+    def run(self, fetch_list, feed=None, feed_dict=None,
+            return_numpy=True):
+        """Reference signature: fetch_list FIRST. ``feed_dict`` is the
+        deprecated alias the reference still accepts. A per-device feed
+        (list of dicts, the reference's explicit-placement form) is
+        accepted by concatenating along dim 0 — GSPMD re-shards the
+        global batch itself."""
+        if feed is None:
+            feed = feed_dict
+        if isinstance(feed, (list, tuple)):
+            import numpy as np
+
+            merged = {}
+            for k in feed[0]:
+                merged[k] = np.concatenate(
+                    [np.asarray(d[k]) for d in feed], axis=0)
+            feed = merged
+        return self._exe.run(self._compiled, feed=feed,
+                             fetch_list=list(fetch_list),
+                             scope=self._scope,
+                             return_numpy=return_numpy)
+
+    def drop_local_exe_scopes(self):
+        """Reference API: frees per-device local scopes between
+        iterations. GSPMD holds no per-device scopes — nothing to drop."""
