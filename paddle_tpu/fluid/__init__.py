@@ -36,6 +36,7 @@ from . import (  # noqa: F401
 from .backward import append_backward, calc_gradient, gradients  # noqa: F401
 from .compiler import BuildStrategy, CompiledProgram, ExecutionStrategy  # noqa: F401
 from .parallel_executor import ParallelExecutor  # noqa: F401
+from . import core  # noqa: F401  (fluid.core.EOFException etc.)
 from .data_feeder import DataFeeder  # noqa: F401
 from .dataset import DatasetFactory  # noqa: F401
 from .reader import DataLoader, PyReader  # noqa: F401

@@ -1,3 +1,9 @@
+class EOFException(Exception):
+    """py_reader queue drained (reference ``fluid.core.EOFException``):
+    the loop-shape contract is `reader.start(); while True: exe.run()`
+    until this raises, then `reader.reset()` for the next epoch."""
+
+
 
 
 def memory_stats(device_index=0):

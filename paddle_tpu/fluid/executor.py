@@ -164,7 +164,9 @@ class Executor:
 
         # pserver programs don't compile — their listen_and_serv op is a
         # host serving loop; running one blocks, like the reference's
-        # pserver Executor (listen_and_serv_op.cc RunSyncLoop)
+        # pserver Executor (listen_and_serv_op.cc RunSyncLoop). The same
+        # scan collects py_reader queues so EOF can surface after the step.
+        py_readers = []
         for op in block.ops:
             if op.type == "listen_and_serv":
                 from .transpiler.distribute_transpiler import (
@@ -172,6 +174,31 @@ class Executor:
 
                 build_server_from_attrs(op.attrs).serve_forever()
                 return []
+            if op.type == "py_reader_dequeue":
+                from .layers.py_reader import _READERS
+
+                r = _READERS.get(int(op.attr("reader_id")))
+                if r is None:
+                    raise RuntimeError(
+                        "the py_reader feeding this program was "
+                        "garbage-collected — keep the object returned "
+                        "by layers.py_reader() alive and start() it")
+                py_readers.append(r)
+        for r in py_readers:
+            # pull the batch on the host BEFORE dispatch and ride the
+            # normal feed path (works under any sharding strategy); an
+            # empty queue raises EOF with no step run — nothing to
+            # discard, donation stays on
+            vals = r._next()
+            if vals is None:
+                from . import core as _core
+
+                for rr in py_readers:
+                    rr.reset()
+                raise _core.EOFException(
+                    "py_reader queue exhausted — reader.reset() and "
+                    "re-start() for the next pass")
+            feed.update(zip(r.names, vals))
 
         # normalize feeds to declared dtype; device-resident jax Arrays pass
         # through untouched (the DataLoader/buffered-reader path pre-stages

@@ -13,6 +13,7 @@ from .loss import *  # noqa: F401,F403
 from .metric_op import accuracy, auc
 from .nn import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403
+from .py_reader import *  # noqa: F401,F403
 from .rnn import *  # noqa: F401,F403
 from .sequence_lod import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403

@@ -1,0 +1,204 @@
+"""py_reader — the reference's in-graph feeding queue
+(``layers/io.py:py_reader`` / ``create_py_reader_by_data`` +
+``reader_op_registry``): the training loop runs ``reader.start()`` then
+``exe.run(program)`` WITHOUT a feed until ``core.EOFException``.
+
+TPU-native redesign: the reference's C++ blocking queue + read op become
+a host-side Python queue the EXECUTOR drains — ``exe.run`` pulls the
+next batch before dispatching the step and injects it through the
+normal feed path, so the dequeue op lowers to identity, works under
+GSPMD/shard_map unchanged, donation stays on, and EOF raises
+``fluid.core.EOFException`` BEFORE any step runs (no sentinel step to
+discard). Shapes/dtypes are declared up front (XLA needs static
+shapes); ``reset()`` re-arms the queue for the next epoch.
+``DataLoader.from_generator`` (fluid/reader.py) remains the recommended
+API — this exists so reference py_reader training loops run unchanged.
+"""
+
+import logging
+import weakref
+
+import numpy as np
+
+from ..layer_helper import LayerHelper
+
+_LOG = logging.getLogger(__name__)
+
+__all__ = ["py_reader", "create_py_reader_by_data", "read_file",
+           "double_buffer"]
+
+
+class _PyReader:
+    """Host-side state: the provider function and the live iterator."""
+
+    def __init__(self, names, shapes, dtypes):
+        self.names = list(names)
+        self.shapes = [tuple(int(d) for d in s) for s in shapes]
+        self.dtypes = [np.dtype(d) for d in dtypes]
+        self._provider = None
+        self._it = None
+        self.exhausted = False
+
+    # -- decoration (reference py_reader surface) -------------------------
+    def decorate_paddle_reader(self, reader, places=None):
+        """reader() yields per-sample tuples; samples are batched by the
+        caller's reader decorators (fluid.io.batch), so each yielded item
+        here is one BATCH (list of sample tuples) or an ndarray tuple."""
+        self._provider = reader
+        return self
+
+    decorate_sample_list_generator = decorate_paddle_reader
+
+    def decorate_tensor_provider(self, reader, places=None):
+        self._provider = reader
+        return self
+
+    decorate_batch_generator = decorate_tensor_provider
+
+    # -- run control -------------------------------------------------------
+    def start(self):
+        if self._provider is None:
+            raise RuntimeError(
+                "py_reader.start(): decorate a reader first "
+                "(decorate_paddle_reader / decorate_tensor_provider)")
+        self._it = iter(self._provider())
+        self.exhausted = False
+
+    def reset(self):
+        self._it = None
+        self.exhausted = False
+
+    def _to_arrays(self, item):
+        if isinstance(item, dict):
+            vals = [item[n] for n in self.names]
+        else:
+            vals = list(item)
+        if vals and not isinstance(vals[0], np.ndarray) \
+                and isinstance(vals[0], (list, tuple)):
+            # a batch of per-sample tuples -> stack per slot
+            vals = [np.stack([np.asarray(s[i]) for s in vals])
+                    for i in range(len(self.names))]
+        out = []
+        for v, dt, shp in zip(vals, self.dtypes, self.shapes):
+            a = np.ascontiguousarray(np.asarray(v, dtype=dt))
+            if a.shape == shp:
+                pass
+            elif a.shape[0] == shp[0] and a.size == int(np.prod(shp)):
+                a = a.reshape(shp)        # e.g. (B,) label -> (B, 1)
+            elif 0 < a.shape[0] < shp[0] and \
+                    a.size == a.shape[0] * int(np.prod(shp[1:])):
+                # a trailing PARTIAL batch (paddle.batch
+                # drop_last=False) cannot fill the declared static
+                # shape: drop it, like drop_last, and end the pass
+                _LOG.warning(
+                    "py_reader: dropping a partial final batch of shape "
+                    "%s (declared %s) — use fluid.io.batch(..., "
+                    "drop_last=True) to silence", a.shape, shp)
+                raise StopIteration
+            else:
+                raise ValueError(
+                    "py_reader batch shape %s does not match the "
+                    "declared slot shape %s" % (a.shape, shp))
+            out.append(a)
+        return tuple(out)
+
+    def _next(self):
+        """Called by Executor.run BEFORE dispatching the step; returns
+        the batch or sets ``exhausted`` (the executor then raises
+        core.EOFException without running anything)."""
+        if self._it is None:
+            raise RuntimeError("py_reader: call start() before exe.run()")
+        try:
+            # _to_arrays raises StopIteration itself on a partial final
+            # batch (drop_last semantics)
+            return self._to_arrays(next(self._it))
+        except StopIteration:
+            self.exhausted = True
+            return None
+
+
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    """Reference ``layers/io.py`` py_reader: declares the queue and
+    returns the reader object; ``read_file(reader)`` yields the data
+    vars. ``capacity``/``use_double_buffer`` are accepted for parity —
+    buffering is the XLA async-dispatch pipeline's job here. Batch dims
+    must be static (XLA), so pass concrete shapes."""
+    for s in shapes:
+        if any(int(d) < 0 for d in s):
+            raise ValueError(
+                "py_reader shapes must be fully static (XLA), got %r — "
+                "pass the concrete batch size (fluid.layers.data vars "
+                "prepend -1; build with append_batch_size=False)"
+                % (list(s),))
+    helper = LayerHelper(name or "py_reader")
+    prefix = helper.name_prefix
+    names = ["%s.slot%d" % (prefix, i) for i in range(len(shapes))]
+    reader = _PyReader(names, shapes, dtypes)
+    blk = helper.main_program.current_block()
+    out_vars = []
+    for n, s, d in zip(names, reader.shapes, reader.dtypes):
+        out_vars.append(blk.create_var(name=n, shape=s, dtype=str(d)))
+    blk.append_op(
+        "py_reader_dequeue", inputs={},
+        outputs={"Out": out_vars},
+        attrs={"reader_id": _register(reader),
+               "shapes": [list(s) for s in reader.shapes],
+               "dtypes": [str(d) for d in reader.dtypes]})
+    reader._out_vars = out_vars
+    return reader
+
+
+def create_py_reader_by_data(capacity, feed_list, name=None,
+                             use_double_buffer=True):
+    """Reference variant taking data vars instead of shapes."""
+    return py_reader(capacity,
+                     shapes=[v.shape for v in feed_list],
+                     dtypes=[v.dtype for v in feed_list],
+                     name=name, use_double_buffer=use_double_buffer)
+
+
+def read_file(reader):
+    """Reference ``layers/io.py`` read_file: the data vars the dequeue op
+    produces (one per declared slot)."""
+    vs = reader._out_vars
+    return vs[0] if len(vs) == 1 else vs
+
+
+def double_buffer(reader, place=None, name=None):
+    """Buffering is the runtime's (async dispatch + DataLoader staging);
+    identity for parity."""
+    return reader
+
+
+# -- lowering ----------------------------------------------------------------
+
+# weak registry: the program only records the id, the USER's reader
+# object keeps the entry alive — dropping the reader frees its provider,
+# iterator, and any cached trace values
+_READERS = weakref.WeakValueDictionary()
+_NEXT_ID = [0]
+
+
+def _register(reader):
+    rid = _NEXT_ID[0]
+    _NEXT_ID[0] += 1
+    _READERS[rid] = reader
+    return rid
+
+
+def _register_dequeue_op():
+    from ..registry import register
+
+    @register("py_reader_dequeue")
+    def _dequeue(ctx, op):
+        # Executor.run already injected this step's batch into the env
+        # under the slot names (identical to the out var names) — the op
+        # is an identity marker binding them as this op's outputs. The
+        # autodiff replay re-lowers it against the same env values, so
+        # no batch is ever consumed twice.
+        for n in op.output("Out"):
+            ctx.set(n, ctx.get(n))
+
+
+_register_dequeue_op()

@@ -124,3 +124,50 @@ def test_dropout_train_vs_test():
         (test_out,) = exe.run(test_prog, feed={"x": xv}, fetch_list=[d.name])
     assert (train_out == 0).any()
     np.testing.assert_allclose(test_out, xv)
+
+
+def test_py_reader_loop_reference_shape():
+    """py_reader (reference layers/io.py): start() -> exe.run without
+    feed until core.EOFException; the queue-draining step is DISCARDED
+    (state identical before/after EOF), reset() re-arms for epoch 2."""
+    B, D = 4, 3
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        reader = layers.py_reader(capacity=8, shapes=[[B, D], [B, 1]],
+                                  dtypes=["float32", "float32"])
+        x, y = layers.read_file(reader)
+        pred = layers.fc(x, 1, name="pyr_fc")
+        loss = layers.reduce_mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    rng = np.random.RandomState(0)
+    batches = [(rng.rand(B, D).astype(np.float32),
+                rng.rand(B, 1).astype(np.float32)) for _ in range(4)]
+    reader.decorate_tensor_provider(lambda: iter(batches))
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    wname = [v.name for v in main.list_vars()
+             if v.persistable and ".w_" in v.name][0]
+    first_losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for epoch in range(2):
+            reader.start()
+            steps, losses = 0, []
+            while True:
+                try:
+                    (lv,) = exe.run(main, fetch_list=[loss])
+                    losses.append(float(np.asarray(lv).ravel()[0]))
+                    steps += 1
+                    if steps == len(batches):
+                        w_before_eof = np.asarray(
+                            scope.find_var(wname)).copy()
+                except fluid.core.EOFException:
+                    reader.reset()
+                    break
+            assert steps == len(batches)
+            # the EOF (sentinel) step committed nothing
+            np.testing.assert_array_equal(
+                np.asarray(scope.find_var(wname)), w_before_eof)
+            first_losses.append(losses[0])
+    # epoch 2 revisits batch 0 with trained weights
+    assert first_losses[1] < first_losses[0], first_losses
