@@ -96,3 +96,89 @@ def data(name, shape, dtype="float32", lod_level=0):
     """fluid.data (reference 1.6 new-style): shape given verbatim."""
     return layers.io.data(name, shape, dtype=dtype, append_batch_size=False,
                           lod_level=lod_level)
+
+
+from .framework import name_scope  # noqa: F401,E402
+from .io import load, save  # noqa: F401,E402
+
+# 1.6 top-level layer aliases (fluid.embedding / fluid.one_hot)
+embedding = layers.embedding
+one_hot = layers.one_hot
+
+
+def cpu_places(device_count=None):
+    n = device_count or 1
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """Accelerator places — TPU chips here (CUDAPlace aliases TPUPlace)."""
+    import jax
+
+    ids = device_ids if device_ids is not None else range(len(jax.devices()))
+    return [CUDAPlace(i) for i in ids]
+
+
+import contextlib as _contextlib  # noqa: E402
+
+
+@_contextlib.contextmanager
+def device_guard(device=None):
+    """Reference op-placement hint; placement is XLA's here — no-op."""
+    yield
+
+
+def memory_optimize(*args, **kwargs):
+    """Deprecated in the reference 1.6 (a no-op there too); XLA owns
+    buffer lifetime (see compiler.BuildStrategy.enable_inplace)."""
+
+
+def release_memory(*args, **kwargs):
+    """Deprecated reference API; XLA owns buffer lifetime."""
+
+
+def load_op_library(lib_path):
+    """The reference loads custom C++ op libraries; custom ops here
+    register Python lowerings via ``paddle_tpu.fluid.registry.register``
+    (host-native pieces ride ctypes — see paddle_tpu/native)."""
+    raise NotImplementedError(
+        "custom ops register via paddle_tpu.fluid.registry.register "
+        "(JAX lowering) + ctypes for host-native code; there is no "
+        "paddle C++ OpKernel ABI in this build")
+
+
+def in_dygraph_mode():
+    from . import dygraph as _dy
+
+    return _dy.in_dygraph_mode() if hasattr(_dy, "in_dygraph_mode") \
+        else _dy.enabled()
+
+
+def require_version(min_version, max_version=None):
+    """Reference ``fluid.require_version``: checks the FRAMEWORK version
+    this build tracks (capability parity with 1.6.x)."""
+    def parse(v):
+        out = []
+        for x in str(v).split(".")[:3]:
+            digits = ""
+            for ch in x:
+                if not ch.isdigit():
+                    break
+                digits += ch
+            out.append(int(digits or 0))
+        while len(out) < 3:
+            out.append(0)           # zero-pad: "1.6" == 1.6.0 series
+        return tuple(out)
+
+    ours = parse(_TRACKED_VERSION)
+    if parse(min_version) > ours:
+        raise Exception(
+            "this build tracks fluid %s < required %s"
+            % (_TRACKED_VERSION, min_version))
+    if max_version is not None and parse(max_version) < ours:
+        raise Exception(
+            "this build tracks fluid %s > allowed %s"
+            % (_TRACKED_VERSION, max_version))
+
+
+_TRACKED_VERSION = "1.6.0"

@@ -184,21 +184,32 @@ class Executor:
                         "garbage-collected — keep the object returned "
                         "by layers.py_reader() alive and start() it")
                 py_readers.append(r)
-        for r in py_readers:
-            # pull the batch on the host BEFORE dispatch and ride the
-            # normal feed path (works under any sharding strategy); an
-            # empty queue raises EOF with no step run — nothing to
-            # discard, donation stays on
-            vals = r._next()
-            if vals is None:
+        if py_readers:
+            # pull every reader's batch on the host BEFORE dispatch and
+            # ride the normal feed path (works under any sharding
+            # strategy); any empty queue raises EOF with no step run —
+            # nothing to discard, donation stays on. All batches are
+            # pulled before deciding, so uneven readers lose at most
+            # the final ragged step (logged), exactly one epoch ends.
+            pulled = [(r, r._next()) for r in py_readers]
+            if any(v is None for _, v in pulled):
                 from . import core as _core
 
-                for rr in py_readers:
-                    rr.reset()
+                dropped = [r.names[0] for r, v in pulled if v is not None]
+                if dropped:
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "py_reader EOF: discarding the already-pulled "
+                        "batch of %s (readers have unequal lengths)",
+                        dropped)
+                for r in py_readers:
+                    r.reset()
                 raise _core.EOFException(
                     "py_reader queue exhausted — reader.reset() and "
                     "re-start() for the next pass")
-            feed.update(zip(r.names, vals))
+            for r, vals in pulled:
+                feed.update(zip(r.names, vals))
 
         # normalize feeds to declared dtype; device-resident jax Arrays pass
         # through untouched (the DataLoader/buffered-reader path pre-stages

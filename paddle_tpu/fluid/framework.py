@@ -23,6 +23,23 @@ import numpy as np
 from . import unique_name
 
 
+_name_scope_stack = []
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    """Reference ``fluid.name_scope``: cosmetic op grouping recorded as
+    the ``op_namescope`` attr (what the reference's graph viewer
+    groups by); no effect on execution."""
+    if prefix:
+        _name_scope_stack.append(str(prefix))
+    try:
+        yield
+    finally:
+        if prefix:
+            _name_scope_stack.pop()
+
+
 def _program_version():
     from .compat import PROGRAM_VERSION
 
@@ -384,6 +401,11 @@ class Block:
 
     def append_op(self, type, inputs=None, outputs=None, attrs=None):
         op = Operator(self, type, inputs, outputs, attrs)
+        if _name_scope_stack:
+            # cosmetic namespace for viz/debug tools (reference
+            # op_desc "op_namescope"); ignored by every lowering
+            op.attrs.setdefault("op_namescope",
+                                "/".join(_name_scope_stack))
         self.ops.append(op)
         for name in op.output_arg_names():
             v = self._find_var_recursive(name)

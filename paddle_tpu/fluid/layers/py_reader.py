@@ -37,7 +37,6 @@ class _PyReader:
         self.dtypes = [np.dtype(d) for d in dtypes]
         self._provider = None
         self._it = None
-        self.exhausted = False
 
     # -- decoration (reference py_reader surface) -------------------------
     def decorate_paddle_reader(self, reader, places=None):
@@ -62,11 +61,9 @@ class _PyReader:
                 "py_reader.start(): decorate a reader first "
                 "(decorate_paddle_reader / decorate_tensor_provider)")
         self._it = iter(self._provider())
-        self.exhausted = False
 
     def reset(self):
         self._it = None
-        self.exhausted = False
 
     def _to_arrays(self, item):
         if isinstance(item, dict):
@@ -104,7 +101,7 @@ class _PyReader:
 
     def _next(self):
         """Called by Executor.run BEFORE dispatching the step; returns
-        the batch or sets ``exhausted`` (the executor then raises
+        the batch, or None at end-of-pass (the executor then raises
         core.EOFException without running anything)."""
         if self._it is None:
             raise RuntimeError("py_reader: call start() before exe.run()")
@@ -113,7 +110,6 @@ class _PyReader:
             # batch (drop_last semantics)
             return self._to_arrays(next(self._it))
         except StopIteration:
-            self.exhausted = True
             return None
 
 

@@ -156,3 +156,38 @@ def test_wait_server_ready():
     srv.close()
     with pytest.raises(TimeoutError):
         wait_server_ready(["127.0.0.1:1"], timeout=0.5, interval=0.1)
+
+
+def test_fluid_top_level_parity_attrs():
+    """1.6 top-level surface: name_scope annotates ops, require_version
+    gates, places/device_guard/memory_optimize accept the reference
+    calls, save/load and embedding/one_hot are reachable."""
+    assert callable(fluid.save) and callable(fluid.load)
+    assert fluid.embedding is fluid.layers.embedding
+    assert len(fluid.cpu_places(3)) == 3
+    fluid.memory_optimize(None)      # deprecated no-op
+    fluid.release_memory(None)
+    with fluid.device_guard("gpu:0"):
+        pass
+    fluid.require_version("1.5.0")
+    with pytest.raises(Exception, match="tracks"):
+        fluid.require_version("9.9.9")
+    with pytest.raises(NotImplementedError, match="registry"):
+        fluid.load_op_library("/tmp/libfoo.so")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("ns_x", [4])
+        with fluid.name_scope("outer"):
+            with fluid.name_scope("inner"):
+                fluid.layers.relu(x)
+    op = main.global_block().ops[-1]
+    assert op.attrs.get("op_namescope") == "outer/inner"
+    # the annotated program still runs and round-trips
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        exe.run(main, feed={"ns_x": np.ones((2, 4), np.float32)},
+                fetch_list=[main.global_block().ops[-1].output("Out")[0]])
+    from paddle_tpu.fluid.core import proto_io
+
+    proto_io.program_from_bytes(proto_io.program_to_bytes(main.to_desc()))
