@@ -107,8 +107,12 @@ one_hot = layers.one_hot
 
 
 def cpu_places(device_count=None):
-    n = device_count or 1
-    return [CPUPlace() for _ in range(n)]
+    """Reference semantics: count from the arg, else CPU_NUM env."""
+    import os as _os
+
+    if device_count is None:
+        device_count = int(_os.environ.get("CPU_NUM", 1))
+    return [CPUPlace() for _ in range(max(1, int(device_count)))]
 
 
 def cuda_places(device_ids=None):
@@ -147,11 +151,7 @@ def load_op_library(lib_path):
         "paddle C++ OpKernel ABI in this build")
 
 
-def in_dygraph_mode():
-    from . import dygraph as _dy
-
-    return _dy.in_dygraph_mode() if hasattr(_dy, "in_dygraph_mode") \
-        else _dy.enabled()
+from .framework import in_dygraph_mode  # noqa: F401,E402
 
 
 def require_version(min_version, max_version=None):

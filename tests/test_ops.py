@@ -508,3 +508,25 @@ def test_py_func_multiple_outputs_no_backward():
         a, b = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=list(outs))
     np.testing.assert_allclose(a, xv + yv)
     np.testing.assert_allclose(b, xv * yv)
+
+
+def test_einsum_op():
+    """einsum (paddle.einsum capability): contraction by equation,
+    checked against numpy on the attention-score pattern."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+
+    rng = np.random.RandomState(3)
+    q = rng.rand(2, 5, 3, 4).astype(np.float32)
+    k = rng.rand(2, 6, 3, 4).astype(np.float32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        qv = layers.data("eq", list(q.shape), append_batch_size=False)
+        kv = layers.data("ek", list(k.shape), append_batch_size=False)
+        out = layers.einsum("bqhd,bkhd->bhqk", qv, kv)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        (r,) = exe.run(main, feed={"eq": q, "ek": k}, fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(r),
+                               np.einsum("bqhd,bkhd->bhqk", q, k),
+                               rtol=1e-4, atol=1e-5)
