@@ -180,7 +180,7 @@ def _profile_table(exe, main, batch, loss, jax, steps=3,
         shutil.rmtree(tracedir, ignore_errors=True)
 
 
-def bench_bert(batch_size=128, seq_len=128, warmup=3, iters=20):
+def bench_bert(batch_size=128, seq_len=128, warmup=8, iters=25):
     import paddle_tpu.fluid as fluid
     from paddle_tpu.models import bert
 
