@@ -530,3 +530,35 @@ def test_einsum_op():
     np.testing.assert_allclose(np.asarray(r),
                                np.einsum("bqhd,bkhd->bhqk", q, k),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_batch_norm_single_pass_stats_anchored():
+    """BN computes batch stats in ONE sweep (shifted by the running
+    mean): must stay accurate even for channels with |mean| >> std,
+    where the naive E[x^2]-E[x]^2 form catastrophically cancels."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+
+    rng = np.random.RandomState(0)
+    x = (1000.0 + 0.1 * rng.randn(64, 8, 4, 4)).astype(np.float32)
+    main, st = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, st):
+        xv = layers.data("bn_x", list(x.shape), append_batch_size=False)
+        y = layers.batch_norm(xv)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    mname = [v.name for v in main.list_vars()
+             if v.persistable and v.name.endswith(".stat_0")][0]
+    with fluid.scope_guard(scope):
+        exe.run(st)
+        # settled-training regime: running mean near the true mean
+        scope.set_var(mname, np.full(8, 1000.0, np.float32))
+        (yv,) = exe.run(main, feed={"bn_x": x}, fetch_list=[y])
+    ref = (x - x.mean((0, 2, 3), keepdims=True)) / np.sqrt(
+        x.var((0, 2, 3), keepdims=True) + 1e-5)
+    assert np.abs(np.asarray(yv) - ref).max() < 0.05
+    # Y keeps the input dtype (no silent promotion in bf16 programs)
+    import jax.numpy as jnp
+
+    from paddle_tpu.fluid.ops.nn import _batch_norm  # noqa: F401
+    assert np.asarray(yv).dtype == np.float32
