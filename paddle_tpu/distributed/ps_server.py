@@ -460,11 +460,23 @@ class RemoteTable:
         self._name = name
         self._client_id = uuid.uuid4().bytes     # push-dedup identity
         self._push_seq = 0
+        # pushes must reach the server in seq order or the dedup
+        # high-water mark drops the late lower-seq push; this lock spans
+        # seq assignment AND the request so interleaving can't reorder
+        self._push_mu = threading.Lock()
         meta = self._conn.request(_req(_META, name))
         self.vocab, self.dim = struct.unpack_from("<QQ", meta)
         # servers report whether the shard ever saw a push/load (older
         # 16-byte replies imply unknown -> treated as touched for safety)
         self.touched = bool(meta[16]) if len(meta) > 16 else True
+
+    def refresh_touched(self):
+        """Re-query the shard's touched flag (used by joining trainers to
+        wait for trainer 0's init push before training on placeholder
+        rows)."""
+        meta = self._conn.request(_req(_META, self._name))
+        self.touched = bool(meta[16]) if len(meta) > 16 else True
+        return self.touched
 
     def pull(self, ids):
         ids = np.ascontiguousarray(np.asarray(ids).reshape(-1), np.int64)
@@ -476,12 +488,13 @@ class RemoteTable:
         ids = np.ascontiguousarray(np.asarray(ids).reshape(-1), np.int64)
         grads = np.ascontiguousarray(np.asarray(grads, np.float32)
                                      .reshape(ids.shape[0], self.dim))
-        self._push_seq += 1
-        body = (struct.pack("<16sQ", self._client_id, self._push_seq) +
-                _pack_arr(ids) + _pack_arr(grads) +
-                struct.pack("<dBd", float(lr),
-                            _OPT_CODE.get(optimizer, 0), float(eps)))
-        self._conn.request(_req(_PUSH, self._name, body))
+        with self._push_mu:
+            self._push_seq += 1
+            body = (struct.pack("<16sQ", self._client_id, self._push_seq) +
+                    _pack_arr(ids) + _pack_arr(grads) +
+                    struct.pack("<dBd", float(lr),
+                                _OPT_CODE.get(optimizer, 0), float(eps)))
+            self._conn.request(_req(_PUSH, self._name, body))
 
     # frames carry a u32 length, so dump/load chunk rows to stay far
     # below the 4 GiB frame ceiling on big shards
@@ -545,6 +558,27 @@ class ShardedRemoteTable:
                 raise ValueError(
                     "endpoint %d serves [%d, %d], want >= [%d, %d]"
                     % (k, sh.vocab, sh.dim, expect, self.dim))
+
+    def refresh_touched(self):
+        # materialized: every shard's cached flag refreshes (any() over a
+        # generator would stop at the first touched shard)
+        flags = [sh.refresh_touched() for sh in self._shards]
+        self.touched = any(flags)
+        return self.touched
+
+    def wait_touched(self, timeout=60.0, interval=0.1):
+        """Block until EVERY shard reports touched (trainer 0's init or a
+        checkpoint restore landed) or ``timeout`` elapses. Returns True
+        when all shards are touched."""
+        deadline = time.monotonic() + timeout
+        while True:
+            flags = [sh.refresh_touched() for sh in self._shards]
+            self.touched = any(flags)
+            if all(flags):
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(interval)
 
     def _split(self, ids):
         ids = np.asarray(ids).reshape(-1)

@@ -57,11 +57,22 @@ class ExchangeServer(FramedServer):
 
     def __init__(self, host="127.0.0.1", port=0, token=None):
         super().__init__(host=host, port=port, token=token, backlog=64)
-        self._samples = []
-        self._done = 0
+        # frames carry the sender's round id so back-to-back shuffles
+        # cannot bleed into each other: a fast peer's round-(r+1) SENDs
+        # queue in their own bucket while this trainer still collects
+        # round r (ADVICE r4 #4). Stale rounds (< current) are acked and
+        # dropped — their wait() already returned.
+        self.round = 0
+        self._rounds = {}
         self._mu = threading.Lock()
         self._cv = threading.Condition(self._mu)
         self.start()
+
+    def _bucket(self, r):
+        # caller holds self._mu
+        if r not in self._rounds:
+            self._rounds[r] = {"samples": [], "done": 0}
+        return self._rounds[r]
 
     def _serve_authenticated(self, conn):
         try:
@@ -70,15 +81,28 @@ class ExchangeServer(FramedServer):
                 if not req:
                     return
                 if req[0] == _SEND:
-                    batch, _ = _unpack_samples(req, 1)
+                    (rnd,) = struct.unpack_from("<I", req, 1)
+                    batch, _ = _unpack_samples(req, 5)
                     with self._mu:
-                        self._samples.extend(batch)
-                    _send_all(conn, _frame(b"\x00"))
+                        ok = rnd >= self.round
+                        if ok:
+                            self._bucket(rnd)["samples"].extend(batch)
+                    # a stale round means the sender desynced (e.g. its
+                    # wait() timed out while this peer advanced) — NACK
+                    # so it raises instead of silently losing its share
+                    _send_all(conn, _frame(
+                        b"\x00" if ok else b"\x01stale round %d < %d"
+                        % (rnd, self.round)))
                 elif req[0] == _DONE:
+                    (rnd,) = struct.unpack_from("<I", req, 1)
                     with self._cv:
-                        self._done += 1
-                        self._cv.notify_all()
-                    _send_all(conn, _frame(b"\x00"))
+                        ok = rnd >= self.round
+                        if ok:
+                            self._bucket(rnd)["done"] += 1
+                            self._cv.notify_all()
+                    _send_all(conn, _frame(
+                        b"\x00" if ok else b"\x01stale round %d < %d"
+                        % (rnd, self.round)))
                     return
                 else:
                     return
@@ -87,15 +111,16 @@ class ExchangeServer(FramedServer):
 
     def wait(self, n_senders, timeout=300):
         with self._cv:
-            ok = self._cv.wait_for(lambda: self._done >= n_senders,
-                                   timeout=timeout)
+            rnd = self.round
+            ok = self._cv.wait_for(
+                lambda: self._bucket(rnd)["done"] >= n_senders,
+                timeout=timeout)
             if not ok:
                 raise TimeoutError(
-                    "exchange: %d/%d senders finished within %ds"
-                    % (self._done, n_senders, timeout))
-            out = self._samples
-            self._samples = []
-            self._done = 0
+                    "exchange round %d: %d/%d senders finished within %ds"
+                    % (rnd, self._bucket(rnd)["done"], n_senders, timeout))
+            out = self._rounds.pop(rnd)["samples"]
+            self.round = rnd + 1
         return out
 
 
@@ -122,15 +147,26 @@ class _Sender:
         if not resp or resp[0] != 0:
             raise ConnectionError("exchange peer rejected handshake")
 
-    def send(self, samples):
-        _send_all(self._sock,
-                  _frame(bytes([_SEND]) + _pack_samples(samples)))
-        _read_frame(self._sock)  # ack
+    @staticmethod
+    def _check_ack(resp):
+        if not resp or resp[0] != 0:
+            raise RuntimeError(
+                "exchange peer rejected frame: %s"
+                % (resp[1:].decode("utf-8", "replace") if resp
+                   else "connection closed"))
 
-    def done(self):
-        _send_all(self._sock, _frame(bytes([_DONE])))
-        _read_frame(self._sock)
+    def send(self, samples, rnd=0):
+        _send_all(self._sock,
+                  _frame(bytes([_SEND]) + struct.pack("<I", rnd) +
+                         _pack_samples(samples)))
+        self._check_ack(_read_frame(self._sock))
+
+    def done(self, rnd=0):
+        _send_all(self._sock,
+                  _frame(bytes([_DONE]) + struct.pack("<I", rnd)))
+        resp = _read_frame(self._sock)
         self._sock.close()
+        self._check_ack(resp)
 
 
 def exchange_shuffle(samples, server, endpoints, seed=0, token=None):
@@ -145,6 +181,10 @@ def exchange_shuffle(samples, server, endpoints, seed=0, token=None):
     token = server.token if token is None else token
     dests = rng.randint(0, n, size=len(samples))
     senders = [_Sender(ep, token) for ep in endpoints]
+    # every trainer has completed the same number of shuffles, so the
+    # local server's round counter IS the cluster-wide round id
+    rnd = server.round
+    stale_err = None
     try:
         for k, snd in enumerate(senders):
             mine = [s for s, d in zip(samples, dests) if d == k]
@@ -153,16 +193,24 @@ def exchange_shuffle(samples, server, endpoints, seed=0, token=None):
                 batch.append(s)
                 size += sum(a.nbytes + 16 for a in s)
                 if size >= _BATCH_BYTES:
-                    snd.send(batch)
+                    snd.send(batch, rnd)
                     batch, size = [], 0
             if batch:
-                snd.send(batch)
+                snd.send(batch, rnd)
     finally:
+        # DONE every peer even when one NACKs (a desynced trainer must
+        # not stall the others' wait for the full timeout); the first
+        # stale-round error resurfaces below rather than masking the
+        # body's own exception here
         for snd in senders:
             try:
-                snd.done()
+                snd.done(rnd)
             except (ConnectionError, OSError):
                 pass
+            except RuntimeError as e:
+                stale_err = stale_err or e
+    if stale_err is not None:
+        raise stale_err
     got = server.wait(n_senders=n)
     rng2 = np.random.RandomState(seed + 31)
     rng2.shuffle(got)

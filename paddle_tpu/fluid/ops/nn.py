@@ -298,6 +298,13 @@ def _batch_norm(ctx, op):
         # algebraically (var = E[(x-a)^2] - E[x-a]^2), and the
         # cancellation error scales with |batch_mean - running_mean|
         # instead of |mean|, vanishing as training settles.
+        # Early-training caveat (anchor = fresh running mean = 0): the
+        # f32 relative error of use_var is ~(1 + mc^2/var) * 2^-24, so
+        # losing even half the mantissa needs |batch_mean - anchor| >
+        # ~64*sigma — orders beyond any real pre-BN activation (std-init
+        # convs give |mc| ~ 0.01*sigma). The max(., 0) clamp plus eps in
+        # rsqrt bound the fallout if it ever triggers; the off-anchor
+        # regime is pinned by test_batch_norm_far_anchor_stats.
         anchor = mean.astype(jnp.float32).reshape(bshape)
         xc = x.astype(jnp.float32) - anchor
         mc = jnp.mean(xc, axis=axes)

@@ -14,6 +14,9 @@ holding one ``listen_and_serv`` op — running it with an Executor blocks
 and serves that endpoint's row shards, exactly like the reference's
 pserver loop."""
 
+import logging
+import os
+
 from .. import framework
 from ..framework import Program
 
@@ -78,8 +81,10 @@ class DistributeTranspiler:
         r3 #2). Shards that already saw a push or a checkpoint load
         report themselves touched and are never overwritten, so resume
         flows keep their restored state even through fleet.init_worker.
-        Trainer 0 should reach this call before others take training
-        steps (the usual launch order)."""
+        Trainers 1..N-1 BLOCK here (up to PADDLE_PS_INIT_WAIT_SECS,
+        default 60) until every shard reports touched, so they cannot
+        pull placeholder-seeded rows before trainer 0's init lands
+        (ADVICE r4 #3); on timeout they log and proceed."""
         from ...distributed import ps
         from ...distributed.ps_server import ShardedRemoteTable
 
@@ -102,6 +107,15 @@ class DistributeTranspiler:
                     if full is None:
                         full = local.dump()
                     shard.load(full[k::remote._n])
+            elif push_init and self._trainer_id != 0:
+                wait = float(os.environ.get(
+                    "PADDLE_PS_INIT_WAIT_SECS", "60"))
+                if not remote.wait_touched(timeout=wait):
+                    logging.getLogger(__name__).warning(
+                        "table %s: trainer 0's init did not land within "
+                        "%.0fs — proceeding on server-side init (set "
+                        "PADDLE_PS_INIT_WAIT_SECS to wait longer)",
+                        name, wait)
             ps.register_table(name, remote)
         return self._program
 

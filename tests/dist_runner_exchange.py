@@ -51,11 +51,15 @@ def main():
     endpoints = ["127.0.0.1:%d" % p for p in ports]
     ds.set_exchange(server, endpoints, seed=100 + tid)
     ds.global_shuffle()
+    keys = ["%.6f" % float(s[0][0]) for s in ds._samples]
+    # back-to-back second round: peers proceed at whatever skew they
+    # have — the round id in the exchange frames keeps rounds apart
+    ds.global_shuffle()
+    keys2 = ["%.6f" % float(s[0][0]) for s in ds._samples]
     server.stop()
 
-    keys = ["%.6f" % float(s[0][0]) for s in ds._samples]
     with open(cfg["out"][tid], "w") as f:
-        json.dump({"loaded": n_loaded, "keys": keys}, f)
+        json.dump({"loaded": n_loaded, "keys": keys, "keys2": keys2}, f)
 
 
 if __name__ == "__main__":

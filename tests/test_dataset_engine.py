@@ -489,18 +489,64 @@ def test_global_shuffle_exchange_nprocess(tmp_path):
     for p in procs:
         out, err = p.communicate(timeout=180)
         assert p.returncode == 0, err.decode()[-2000:]
-    shares = []
+    shares, shares2 = [], []
     for k in range(n):
         with open(outs[k]) as f:
             r = json.load(f)
         assert r["loaded"] == 6 + k       # only its own file was loaded
         shares.append(set(r["keys"]))
         assert len(r["keys"]) == len(shares[-1])  # no dup within a share
-    union = set().union(*shares)
-    assert union == expected
-    for a in range(n):
-        for b in range(a + 1, n):
-            assert not (shares[a] & shares[b])
+        shares2.append(set(r["keys2"]))
+        assert len(r["keys2"]) == len(shares2[-1])
+    # both back-to-back rounds must partition the global set exactly —
+    # round ids keep a fast peer's second-round frames out of a slow
+    # peer's first-round collection
+    for sh in (shares, shares2):
+        assert set().union(*sh) == expected
+        for a in range(n):
+            for b in range(a + 1, n):
+                assert not (sh[a] & sh[b])
+
+
+def test_exchange_round_isolation():
+    """A fast peer's round-(r+1) SEND/DONE frames arriving BEFORE the
+    slow peer drains round r must queue, not bleed: wait() for round r
+    returns only round-r samples, and the queued round-(r+1) frames are
+    returned by the next wait() (ADVICE r4 #4)."""
+    from paddle_tpu.distributed.sample_exchange import (ExchangeServer,
+                                                        _Sender)
+
+    server = ExchangeServer(port=0, token="xchg")
+    try:
+        ep = "127.0.0.1:%d" % server.port
+        r0 = [(np.array([0.5], np.float32),)]
+        r1 = [(np.array([1.5], np.float32),),
+              (np.array([2.5], np.float32),)]
+        # the fast peer finishes round 0 AND round 1 before the slow
+        # peer's server owner ever calls wait()
+        s = _Sender(ep, "xchg")
+        s.send(r0, rnd=0)
+        s.done(rnd=0)
+        s2 = _Sender(ep, "xchg")
+        s2.send(r1, rnd=1)
+        s2.done(rnd=1)
+
+        got0 = server.wait(n_senders=1, timeout=30)
+        assert [float(x[0][0]) for x in got0] == [0.5]
+        got1 = server.wait(n_senders=1, timeout=30)
+        assert sorted(float(x[0][0]) for x in got1) == [1.5, 2.5]
+        # stale frames (round already drained) are NACKed so a desynced
+        # sender raises instead of silently losing its share
+        s3 = _Sender(ep, "xchg")
+        with pytest.raises(RuntimeError, match="stale round"):
+            s3.send(r0, rnd=0)
+        s4 = _Sender(ep, "xchg")
+        s4.send([(np.array([9.5], np.float32),)], rnd=2)
+        s4.done(rnd=2)
+        got2 = server.wait(n_senders=1, timeout=30)
+        assert [float(x[0][0]) for x in got2] == [9.5]
+    finally:
+        server.stop()
 
 
 def test_train_from_dataset_double_buffer_loss_identical(tmp_path):

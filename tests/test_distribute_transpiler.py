@@ -52,6 +52,35 @@ def test_remote_table_roundtrip():
         srv.stop()
 
 
+def test_wait_touched_barrier():
+    """Joining trainers block on wait_touched until trainer 0's init
+    push lands (ADVICE r4 #3): before any push the flag times out False;
+    after a push/load it flips True without re-constructing the proxy."""
+    from paddle_tpu.distributed.ps_server import ShardedRemoteTable
+
+    local = ps.EmbeddingTable(vocab=8, dim=2, init_scale=0.0)
+    srv = _start_server({"t": local})
+    try:
+        wait_server_ready([srv.endpoint])
+        rt = ShardedRemoteTable([srv.endpoint], "t", 8, 2)
+        assert not rt.touched
+        assert not rt.wait_touched(timeout=0.3, interval=0.05)
+        # trainer 0's init arrives concurrently with the waiter
+        def _init():
+            other = RemoteTable(srv.endpoint, "t")
+            other.load(np.full((8, 2), 3.0, np.float32))
+            other.close()
+
+        t = threading.Timer(0.2, _init)
+        t.start()
+        assert rt.wait_touched(timeout=10.0, interval=0.05)
+        assert rt.touched
+        t.join()
+        rt.close()
+    finally:
+        srv.stop()
+
+
 def test_sharded_remote_matches_local_table():
     vocab, dim, n = 17, 4, 3
     servers = []

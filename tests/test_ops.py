@@ -562,3 +562,28 @@ def test_batch_norm_single_pass_stats_anchored():
 
     from paddle_tpu.fluid.ops.nn import _batch_norm  # noqa: F401
     assert np.asarray(yv).dtype == np.float32
+
+
+def test_batch_norm_far_anchor_stats():
+    """Early-training regime for the single-pass anchored BN stats: the
+    anchor is the FRESH running mean (0) while activations sit at
+    |mean| = 50*sigma. The shifted-moment correction loses ~mc^2/var *
+    2^-24 relative precision (~1e-4 here) — normalization must still be
+    accurate. Pins the bound documented at ops/nn.py _batch_norm."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+
+    rng = np.random.RandomState(7)
+    x = (50.0 + rng.randn(64, 8, 4, 4)).astype(np.float32)
+    main, st = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, st):
+        xv = layers.data("bnf_x", list(x.shape), append_batch_size=False)
+        y = layers.batch_norm(xv)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(st)  # running mean stays at its 0.0 init — worst anchor
+        (yv,) = exe.run(main, feed={"bnf_x": x}, fetch_list=[y])
+    ref = (x - x.mean((0, 2, 3), keepdims=True)) / np.sqrt(
+        x.var((0, 2, 3), keepdims=True) + 1e-5)
+    assert np.abs(np.asarray(yv) - ref).max() < 0.05
