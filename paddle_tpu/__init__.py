@@ -10,3 +10,4 @@ __version__ = "0.1.0"
 from . import fluid  # noqa: F401
 from . import inference  # noqa: F401
 from . import fs  # noqa: F401
+from . import utils  # noqa: F401

@@ -1,7 +1,18 @@
 """DyGraph (eager) mode — reference ``python/paddle/fluid/dygraph/``."""
 
-from . import base, checkpoint, jit, layers, nn, parallel
+from . import (base, checkpoint, jit, layers, learning_rate_scheduler, nn,
+               parallel)
 from .parallel import DataParallel, ParallelEnv, prepare_context  # noqa: F401
+from .learning_rate_scheduler import (  # noqa: F401
+    CosineDecay,
+    ExponentialDecay,
+    InverseTimeDecay,
+    LearningRateDecay,
+    NaturalExpDecay,
+    NoamDecay,
+    PiecewiseDecay,
+    PolynomialDecay,
+)
 from .base import (  # noqa: F401
     Tracer,
     VarBase,

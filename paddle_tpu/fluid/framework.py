@@ -526,16 +526,49 @@ class Program:
         Returns a cloned pruned Program. Persistable writes (optimizer
         updates) are dropped unless needed — this is what
         ``save_inference_model`` uses.
+
+        Control-flow ops (while/cond/...) declare only part of their
+        data flow as explicit inputs/outputs; the rest rides their
+        sub-blocks (a branch reads a parent-block fc output, a while
+        body writes an array the tail reads). Reverse reachability
+        therefore matches against each op's TRANSITIVE reads/writes —
+        explicit args plus every nested sub-block op's args (reference
+        prune.h walks sub-block descs the same way). Sub-block-internal
+        names never collide into block 0 (unique-name generation), so
+        the widening only ever keeps more, never less.
         """
         target_names = set(_as_name_list(targets))
         p = self.clone(for_test=True)
         blk = p.global_block()
+
+        def _transitive_args(op):
+            reads = set(op.input_arg_names())
+            writes = set(op.output_arg_names())
+            seen, stack = set(), [op]
+            while stack:
+                for key, val in stack.pop().attrs.items():
+                    if key == "sub_block" or key.endswith("_block"):
+                        idxs = [val] if isinstance(val, int) else []
+                    elif key == "blocks" and isinstance(val, (list, tuple)):
+                        idxs = [v for v in val if isinstance(v, int)]
+                    else:
+                        continue
+                    for idx in idxs:
+                        if 0 <= idx < len(p.blocks) and idx not in seen:
+                            seen.add(idx)
+                            for sub_op in p.blocks[idx].ops:
+                                reads.update(sub_op.input_arg_names())
+                                writes.update(sub_op.output_arg_names())
+                                stack.append(sub_op)
+            return reads, writes
+
         needed = set(target_names)
         kept = []
         for op in reversed(blk.ops):
-            if any(n in needed for n in op.output_arg_names()):
+            reads, writes = _transitive_args(op)
+            if writes & needed:
                 kept.append(op)
-                needed.update(op.input_arg_names())
+                needed.update(reads)
         blk.ops = list(reversed(kept))
         return p
 

@@ -1,0 +1,7 @@
+"""paddle.utils tier (reference ``python/paddle/utils/``): host-side
+helpers around the framework. Implemented: ``image_util`` (the piece
+models feed data through). ``plot``/``torch2paddle``/``show_pb`` are
+deliberate non-goals — see README "Deliberate non-goals".
+"""
+
+from . import image_util  # noqa: F401

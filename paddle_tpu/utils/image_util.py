@@ -1,0 +1,184 @@
+"""Image preprocessing helpers (reference
+``python/paddle/utils/image_util.py:20-236``): the classic
+resize / crop / oversample / transformer pipeline book models feed
+images through. Host-side numpy + PIL — augmentation stays on CPU while
+the TPU consumes the already-batched arrays.
+
+Deviations from the reference (deliberate):
+- integer-safe border math (the reference's py2 ``/`` divisions produce
+  float indices under py3);
+- random crop/flip take an optional ``rng`` (np.random.RandomState) so
+  input pipelines can be made deterministic per worker.
+"""
+
+import io
+
+import numpy as np
+
+__all__ = [
+    "resize_image", "flip", "crop_img", "decode_jpeg", "preprocess_img",
+    "load_meta", "load_image", "oversample", "ImageTransformer",
+]
+
+
+def _pil_image():
+    from PIL import Image
+
+    return Image
+
+
+def resize_image(img, target_size):
+    """Resize a PIL image so its SHORTER edge equals ``target_size``
+    (aspect preserved)."""
+    Image = _pil_image()
+    scale = target_size / float(min(img.size))
+    new_size = (int(round(img.size[0] * scale)),
+                int(round(img.size[1] * scale)))
+    return img.resize(new_size, Image.LANCZOS)
+
+
+def flip(im):
+    """Horizontal flip. ``im`` is (K, H, W) color or (H, W) gray — the
+    last axis is width either way."""
+    return im[..., ::-1]
+
+
+def crop_img(im, inner_size, color=True, test=True, rng=None):
+    """Center (test) or random (train) ``inner_size``-square crop of a
+    CHW (color) / HW (gray) image, zero-padding images smaller than the
+    crop; train mode also flips with p=0.5."""
+    im = np.asarray(im, np.float32)
+    rng = np.random if rng is None else rng
+    h_ax, w_ax = (1, 2) if color else (0, 1)
+    height = max(inner_size, im.shape[h_ax])
+    width = max(inner_size, im.shape[w_ax])
+    if (height, width) != (im.shape[h_ax], im.shape[w_ax]):
+        shape = (im.shape[0], height, width) if color else (height, width)
+        padded = np.zeros(shape, np.float32)
+        y0 = (height - im.shape[h_ax]) // 2
+        x0 = (width - im.shape[w_ax]) // 2
+        region = (slice(y0, y0 + im.shape[h_ax]),
+                  slice(x0, x0 + im.shape[w_ax]))
+        padded[(slice(None),) + region if color else region] = im
+        im = padded
+    if test:
+        y0 = (height - inner_size) // 2
+        x0 = (width - inner_size) // 2
+    else:
+        y0 = rng.randint(0, height - inner_size + 1)
+        x0 = rng.randint(0, width - inner_size + 1)
+    region = (slice(y0, y0 + inner_size), slice(x0, x0 + inner_size))
+    pic = im[(slice(None),) + region if color else region]
+    if not test and rng.randint(2) == 0:
+        pic = flip(pic)
+    return pic
+
+
+def decode_jpeg(jpeg_bytes):
+    """Decode an in-memory JPEG to a CHW (color) / HW (gray) ndarray."""
+    Image = _pil_image()
+    arr = np.array(Image.open(io.BytesIO(jpeg_bytes)))
+    if arr.ndim == 3:
+        arr = np.transpose(arr, (2, 0, 1))
+    return arr
+
+
+def preprocess_img(im, img_mean, crop_size, is_train, color=True, rng=None):
+    """Train: random crop + flip; test: center crop. Mean-subtract and
+    flatten (the feed layout the book models expect)."""
+    pic = crop_img(np.asarray(im, np.float32), crop_size, color,
+                   test=not is_train, rng=rng)
+    # crop_img may return a VIEW of the caller's array — subtract into a
+    # fresh buffer so cached images aren't mutated across epochs
+    return (pic - img_mean).flatten()
+
+
+def load_meta(meta_path, mean_img_size, crop_size, color=True):
+    """Load a dataset's mean image (``data_mean`` of an .npz) and
+    center-crop it to ``crop_size``."""
+    mean = np.load(meta_path)["data_mean"]
+    border = (mean_img_size - crop_size) // 2
+    if color:
+        assert mean_img_size * mean_img_size * 3 == mean.shape[0]
+        mean = mean.reshape(3, mean_img_size, mean_img_size)
+        mean = mean[:, border:border + crop_size,
+                    border:border + crop_size]
+    else:
+        assert mean_img_size * mean_img_size == mean.shape[0]
+        mean = mean.reshape(mean_img_size, mean_img_size)
+        mean = mean[border:border + crop_size, border:border + crop_size]
+    return mean.astype("float32")
+
+
+def load_image(img_path, is_color=True):
+    """Open and fully load an image file as PIL."""
+    Image = _pil_image()
+    img = Image.open(img_path)
+    img.load()
+    if is_color and img.mode != "RGB":
+        img = img.convert("RGB")
+    elif not is_color and img.mode != "L":
+        img = img.convert("L")
+    return img
+
+
+def oversample(imgs, crop_dims):
+    """Ten-crop TTA: 4 corners + center of each HWK image, plus their
+    mirrors → (10*N, ch, cw, K) float32."""
+    im_shape = np.asarray(imgs[0].shape)
+    ch, cw = int(crop_dims[0]), int(crop_dims[1])
+    centers = im_shape[:2] / 2.0
+    corners = [(i, j) for i in (0, im_shape[0] - ch)
+               for j in (0, im_shape[1] - cw)]
+    corners.append((int(centers[0] - ch / 2.0), int(centers[1] - cw / 2.0)))
+    crops = np.empty((10 * len(imgs), ch, cw, im_shape[-1]), np.float32)
+    ix = 0
+    for im in imgs:
+        for (y0, x0) in corners:
+            crops[ix] = im[y0:y0 + ch, x0:x0 + cw, :]
+            ix += 1
+        # mirrors of the 5 crops just written
+        crops[ix:ix + 5] = crops[ix - 5:ix, :, ::-1, :]
+        ix += 5
+    return crops
+
+
+class ImageTransformer:
+    """Configurable transpose → channel-swap → mean-subtract chain
+    (reference ``:184``)."""
+
+    def __init__(self, transpose=None, channel_swap=None, mean=None,
+                 is_color=True):
+        self.is_color = is_color
+        self.set_transpose(transpose)
+        self.set_channel_swap(channel_swap)
+        self.set_mean(mean)
+
+    def set_transpose(self, order):
+        if order is not None and self.is_color:
+            assert len(order) == 3
+        self.transpose = order
+
+    def set_channel_swap(self, order):
+        if order is not None and self.is_color:
+            assert len(order) == 3
+        self.channel_swap = order
+
+    def set_mean(self, mean):
+        if mean is not None:
+            mean = np.asarray(mean, np.float32)
+            if mean.ndim == 1:
+                mean = mean[:, np.newaxis, np.newaxis]
+            elif self.is_color:
+                assert mean.ndim == 3
+        self.mean = mean
+
+    def transformer(self, data):
+        data = np.asarray(data, np.float32)
+        if self.transpose is not None:
+            data = data.transpose(self.transpose)
+        if self.channel_swap is not None:
+            data = data[list(self.channel_swap), :, :]
+        if self.mean is not None:
+            data = data - self.mean
+        return data

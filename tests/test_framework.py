@@ -70,6 +70,79 @@ def test_prune():
     assert "mean" in types
 
 
+def test_prune_keeps_subblock_dependencies(tmp_path):
+    """Multi-block prune (reference prune.h): a cond branch reads a
+    block-0 fc output that is NOT an explicit input of the cond op, and
+    a While body WRITES the served var without the while op declaring
+    outputs. Pruning must keep both chains; the saved model must reload
+    and serve the same values (VERDICT r4 #6)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers, optimizer
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        label = layers.data("label", shape=[1])
+        h = layers.fc(x, size=3, act="relu")       # read ONLY inside cond
+        pred = layers.reduce_mean(x) > 0.0
+        # branch closures capture h — the cond op's explicit inputs list
+        # only the predicate
+        branched = layers.cond(pred,
+                               lambda: h * 2.0,
+                               lambda: h + 1.0)
+        # While body mutates `acc` in the parent block; the while op
+        # declares no outputs at all
+        acc = layers.fill_constant([1], "float32", 0.0)
+        i = layers.fill_constant([1], "int64", 0)
+        n = layers.fill_constant([1], "int64", 3)
+        w_cond = layers.less_than(i, n)
+        w = layers.While(w_cond)
+        with w.block():
+            layers.assign(acc + 1.0, acc)
+            layers.increment(i)
+            layers.less_than(i, n, cond=w_cond)
+        # Switch stores its branch blocks as attrs["blocks"] (a LIST)
+        # and declares no outputs — the LR-scheduling idiom
+        lr = layers.fill_constant([1], "float32", 0.0)
+        with layers.Switch() as sw:
+            with sw.case(layers.reduce_mean(x) > -1000.0):  # always true
+                layers.assign(layers.fill_constant([1], "float32", 10.0),
+                              lr)
+            with sw.default():
+                layers.assign(layers.fill_constant([1], "float32", 20.0),
+                              lr)
+        out = branched + acc + lr                  # serve this
+        loss = layers.reduce_mean(
+            layers.square_error_cost(layers.reduce_sum(out, keep_dim=True),
+                                     label))
+        optimizer.SGD(learning_rate=0.01).minimize(loss)
+
+    exe = fluid.Executor()
+    rng = np.random.RandomState(3)
+    feed = {"x": rng.rand(2, 4).astype(np.float32),
+            "label": rng.rand(2, 1).astype(np.float32)}
+    model_dir = str(tmp_path / "cf_model")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        exe.run(main, feed=feed, fetch_list=[loss])
+        fluid.io.save_inference_model(model_dir, ["x"], [out], exe,
+                                      main_program=main)
+        (expect,) = exe.run(main, feed=feed, fetch_list=[out])
+    # the pruned program kept the hidden fc AND the while chain, and
+    # dropped the training tail
+    pruned = main._prune([out])
+    types = [op.type for op in pruned.global_block().ops]
+    assert "cond" in types and "while" in types and "switch" in types
+    assert "mul" in types and "relu" in types           # h's fc survives
+    assert "sgd" not in types and "square_error_cost" not in types
+    # reload in a fresh scope and serve: identical values
+    with fluid.scope_guard(fluid.Scope()):
+        prog, feeds, fetches = fluid.io.load_inference_model(model_dir, exe)
+        (got,) = exe.run(prog, feed={"x": feed["x"]}, fetch_list=fetches)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
+                               rtol=1e-5)
+
+
 def test_protobuf_roundtrip():
     main, _, loss = _build_program()
     data = main.serialize_to_string()

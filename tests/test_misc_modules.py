@@ -191,3 +191,84 @@ def test_fluid_top_level_parity_attrs():
     from paddle_tpu.fluid.core import proto_io
 
     proto_io.program_from_bytes(proto_io.program_to_bytes(main.to_desc()))
+
+
+def test_image_util_resize_crop_flip(tmp_path):
+    """paddle.utils.image_util tier (reference utils/image_util.py:20):
+    short-edge resize, center/random crop with padding, deterministic
+    rng, jpeg decode round trip."""
+    from PIL import Image
+
+    from paddle_tpu.utils import image_util as iu
+
+    img = Image.fromarray(
+        (np.random.RandomState(0).rand(40, 60, 3) * 255).astype(np.uint8))
+    small = iu.resize_image(img, 20)
+    assert min(small.size) == 20 and max(small.size) == 30
+
+    chw = np.transpose(np.asarray(img, np.float32), (2, 0, 1))
+    center = iu.crop_img(chw, 24, color=True, test=True)
+    assert center.shape == (3, 24, 24)
+    np.testing.assert_allclose(center, chw[:, 8:32, 18:42])
+    # gray path + padding when the image is smaller than the crop
+    gray = np.ones((10, 12), np.float32)
+    padded = iu.crop_img(gray, 16, color=False, test=True)
+    assert padded.shape == (16, 16) and padded.sum() == gray.sum()
+    # train mode: same rng seed -> same crop
+    a = iu.crop_img(chw, 24, test=False, rng=np.random.RandomState(3))
+    b = iu.crop_img(chw, 24, test=False, rng=np.random.RandomState(3))
+    np.testing.assert_array_equal(a, b)
+
+    # flip is width-axis for both layouts
+    np.testing.assert_array_equal(iu.flip(chw)[:, :, 0], chw[:, :, -1])
+    np.testing.assert_array_equal(iu.flip(gray)[:, 0], gray[:, -1])
+
+    # decode_jpeg: CHW out, content approximately survives the codec
+    buf = tmp_path / "x.jpg"
+    img.save(str(buf), quality=95)
+    dec = iu.decode_jpeg(open(str(buf), "rb").read())
+    assert dec.shape == (3, 40, 60)
+    loaded = iu.load_image(str(buf))
+    assert loaded.size == (60, 40)
+
+    # preprocess = crop + mean-subtract + flatten; the INPUT must not be
+    # mutated even when the crop is a view (cached-image pipelines)
+    mean = np.full((3, 24, 24), 5.0, np.float32)
+    before = chw.copy()
+    flat = iu.preprocess_img(chw, mean, 24, is_train=False)
+    assert flat.shape == (3 * 24 * 24,)
+    np.testing.assert_allclose(flat, center.flatten() - 5.0)
+    np.testing.assert_array_equal(chw, before)
+    # non-3-channel padding path
+    two_ch = np.ones((2, 10, 10), np.float32)
+    assert iu.crop_img(two_ch, 16, color=True, test=True).shape == (2, 16, 16)
+
+
+def test_image_util_oversample_meta_transformer(tmp_path):
+    from paddle_tpu.utils import image_util as iu
+
+    im = np.arange(32 * 32 * 3, dtype=np.float32).reshape(32, 32, 3)
+    crops = iu.oversample([im], (24, 24))
+    assert crops.shape == (10, 24, 24, 3)
+    # crop 0 is the top-left corner; crop 5 is its mirror
+    np.testing.assert_array_equal(crops[0], im[:24, :24, :])
+    np.testing.assert_array_equal(crops[5], crops[0][:, ::-1, :])
+    # center crop present
+    np.testing.assert_array_equal(crops[4], im[4:28, 4:28, :])
+
+    # load_meta: mean image center-cropped
+    mean_flat = np.arange(3 * 32 * 32, dtype=np.float64)
+    np.savez(str(tmp_path / "meta.npz"), data_mean=mean_flat)
+    m = iu.load_meta(str(tmp_path / "meta.npz"), 32, 24)
+    assert m.shape == (3, 24, 24) and m.dtype == np.float32
+    np.testing.assert_allclose(
+        m, mean_flat.reshape(3, 32, 32)[:, 4:28, 4:28])
+
+    # transformer chain: transpose -> swap -> mean
+    t = iu.ImageTransformer(transpose=(2, 0, 1), channel_swap=(2, 1, 0),
+                            mean=np.array([1.0, 2.0, 3.0]))
+    hwc = np.random.RandomState(1).rand(8, 8, 3).astype(np.float32)
+    out = t.transformer(hwc)
+    ref = hwc.transpose(2, 0, 1)[[2, 1, 0]] - np.array(
+        [1.0, 2.0, 3.0], np.float32)[:, None, None]
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
