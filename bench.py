@@ -482,4 +482,6 @@ if __name__ == "__main__":
         out.update(bench_longseq())
         out.update(bench_longseq(batch_size=4, seq_len=4096,
                                  prefix="longseq4k"))
+        out.update(bench_longseq(batch_size=2, seq_len=8192,
+                                 prefix="longseq8k"))
     print(json.dumps(out))

@@ -55,6 +55,24 @@ def _insert_cast(block, new_ops, cache, name, dest_dtype, suffix):
     return cast_name
 
 
+# gray ops whose STATE inputs must never be pulled down to the low
+# dtype: batch_norm's running stats feed momentum updates whose
+# (1-momentum)*delta terms fall below the bf16 ulp, and its scale/bias
+# are optimizer-owned parameters — only the activation X follows the
+# low chain (the lowering computes stats and rsqrt in f32 regardless)
+_KEEP_FP32_SLOTS = {
+    "batch_norm": ("Scale", "Bias", "Mean", "Variance"),
+}
+
+# gray ops where only SOME outputs become low-precision: batch_norm's
+# MeanOut/VarianceOut alias the f32 running stats and SavedMean/
+# SavedVariance stay in the stats dtype — only Y follows X. Ops absent
+# from this map mark all float outputs low (the default gray rule).
+_LOW_OUTPUT_SLOTS = {
+    "batch_norm": ("Y",),
+}
+
+
 def rewrite_program(main_program, amp_lists, dest_dtype="bfloat16"):
     """Walk the forward block: white ops get low-precision inputs, black ops
     get fp32 inputs. Gray ops are untouched (jnp promotion handles mixed
@@ -103,7 +121,10 @@ def rewrite_program(main_program, amp_lists, dest_dtype="bfloat16"):
             # inputs down too (else jnp promotion silently re-widens the
             # whole chain, e.g. a conv's fp32 bias) and mark outputs low
             if any(n in low_vars for n in op.input_arg_names()):
+                keep = _KEEP_FP32_SLOTS.get(op.type, ())
                 for slot, names in op.inputs.items():
+                    if slot in keep:
+                        continue
                     casted = []
                     for n in names:
                         v = block._find_var_recursive(n)
@@ -113,11 +134,15 @@ def rewrite_program(main_program, amp_lists, dest_dtype="bfloat16"):
                         else:
                             casted.append(n)
                     op.inputs[slot] = casted
-                for out in op.output_arg_names():
-                    v = block._find_var_recursive(out)
-                    if v is not None and v.dtype is not None and \
-                            _is_float(v.dtype):
-                        low_vars.add(out)
+                low_slots = _LOW_OUTPUT_SLOTS.get(op.type)
+                for slot, names in op.outputs.items():
+                    if low_slots is not None and slot not in low_slots:
+                        continue
+                    for out in names:
+                        v = block._find_var_recursive(out)
+                        if v is not None and v.dtype is not None and \
+                                _is_float(v.dtype):
+                            low_vars.add(out)
         new_ops.append(op)
     block.ops = new_ops
     main_program._bump()

@@ -11,6 +11,8 @@ MXU for convs, so no manual layout transform is needed. Convs and matmuls
 stay whole — XLA tiles them; elementwise epilogues (bias, act) fuse.
 """
 
+import os
+
 import numpy as np
 
 from ..registry import register
@@ -271,6 +273,7 @@ def _batch_norm(ctx, op):
     """Training mode computes batch stats and updates running stats
     (persistable writes, committed by the executor); test mode uses running
     stats. Reference ``operators/batch_norm_op.cc``."""
+    import jax
     import jax.numpy as jnp
 
     x = ctx.get_input(op, "X")
@@ -306,10 +309,21 @@ def _batch_norm(ctx, op):
         # rsqrt bound the fallout if it ever triggers; the off-anchor
         # regime is pinned by test_batch_norm_far_anchor_stats.
         anchor = mean.astype(jnp.float32).reshape(bshape)
-        xc = x.astype(jnp.float32) - anchor
-        mc = jnp.mean(xc, axis=axes)
-        use_var = jnp.maximum(
-            jnp.mean(xc * xc, axis=axes) - mc * mc, 0.0)
+
+        # remat the stats sweep: without it autodiff stores the CENTERED
+        # f32 activations (xc) as a residual — a full-activation f32
+        # write+read per BN, the single largest HBM term in ResNet's
+        # step. Recomputing the sweep in backward costs one extra bf16
+        # read of x instead (PADDLE_TPU_BN_REMAT=0 restores the stored
+        # form for comparison).
+        def _stats(xin):
+            xc = xin.astype(jnp.float32) - anchor
+            return jnp.mean(xc, axis=axes), jnp.mean(xc * xc, axis=axes)
+
+        if os.environ.get("PADDLE_TPU_BN_REMAT", "1") != "0":
+            _stats = jax.checkpoint(_stats)
+        mc, m2 = _stats(x)
+        use_var = jnp.maximum(m2 - mc * mc, 0.0)
         use_mean = mc + anchor.reshape(-1)
         new_mean = momentum * mean + (1.0 - momentum) * use_mean
         new_var = momentum * var + (1.0 - momentum) * use_var

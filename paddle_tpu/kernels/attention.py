@@ -165,54 +165,44 @@ def _fallback_attention(q, k, v, bias, scale, p_drop, seed):
     return _ref_attention(q, k, v, bias, scale, p_drop, seed)
 
 
-def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, *,
-                scale, p_drop, n_heads):
-    """One grid step = a BLOCK of batches for one head: batched matmuls
-    keep the MXU busy (a single (b, h) pair at S=128 is DMA-bound)."""
-    from jax.experimental import pallas as pl
+def _attn_block_fwd(q, k, v, bias_b, seed_ref, scale, p_drop, stream):
+    """Shared per-(batch-block, head) forward math: q/k/v [Bb, S, d],
+    bias_b [Bb, Sq|1, S] additive. Returns o [Bb, S, d] f32."""
     from jax.experimental.pallas import tpu as pltpu
 
-    q = q_ref[:, 0]                              # [Bb, S, d] native dtype
-    k = k_ref[:, 0]
-    v = v_ref[:, 0]
     dn = (((2,), (2,)), ((0,), (0,)))            # batched q·kᵀ
     # matmuls in the input dtype (bf16 MXU under AMP), f32 accumulate
     s = jax.lax.dot_general(q, k, dn,
                             preferred_element_type=jnp.float32) * scale
-    s = s + bias_ref[:, 0]                       # [Bb, Sq|1, S]
+    s = s + bias_b
     m = jnp.max(s, axis=-1, keepdims=True)
     e = jnp.exp(s - m)
     p = e / jnp.sum(e, axis=-1, keepdims=True)
     if p_drop > 0.0:
-        b, h = pl.program_id(0), pl.program_id(1)
-        pltpu.prng_seed(seed_ref[0] + b * n_heads + h)
+        pltpu.prng_seed(seed_ref[0] + stream)
         u = _uniform_from_bits(pltpu.prng_random_bits(p.shape))
         p = jnp.where(u >= p_drop, p / (1.0 - p_drop), 0.0)
-    o_ref[:, 0] = jax.lax.dot_general(
+    return jax.lax.dot_general(
         p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32).astype(o_ref.dtype)
+        preferred_element_type=jnp.float32)
 
 
-def _bwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
-                dq_ref, dk_ref, dv_ref, dbias_ref, *, scale, p_drop,
-                n_heads, acc_heads, reduce_rows):
-    from jax.experimental import pallas as pl
+def _attn_block_bwd(q, k, v, do, bias_b, seed_ref, scale, p_drop, stream):
+    """Shared per-(batch-block, head) backward math (probabilities
+    recomputed flash-style, dropout mask regenerated from the forward's
+    stream). Returns (dq, dk, dv, ds) — ds [Bb, S, S] f32 pre-reduction
+    for the bias gradient."""
     from jax.experimental.pallas import tpu as pltpu
 
-    q = q_ref[:, 0]                              # [Bb, S, d] native dtype
-    k = k_ref[:, 0]
-    v = v_ref[:, 0]
-    do = do_ref[:, 0]
     dn_qk = (((2,), (2,)), ((0,), (0,)))
     s = jax.lax.dot_general(q, k, dn_qk,
                             preferred_element_type=jnp.float32) * scale
-    s = s + bias_ref[:, 0]
+    s = s + bias_b
     m = jnp.max(s, axis=-1, keepdims=True)
     e = jnp.exp(s - m)
     p = e / jnp.sum(e, axis=-1, keepdims=True)   # pre-dropout probs
     if p_drop > 0.0:
-        b, h = pl.program_id(0), pl.program_id(1)
-        pltpu.prng_seed(seed_ref[0] + b * n_heads + h)  # same stream as fwd
+        pltpu.prng_seed(seed_ref[0] + stream)    # same stream as fwd
         u = _uniform_from_bits(pltpu.prng_random_bits(p.shape))
         keep = u >= p_drop
         pd = jnp.where(keep, p / (1.0 - p_drop), 0.0)
@@ -233,6 +223,31 @@ def _bwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
                              preferred_element_type=jnp.float32) * scale
     dk = jax.lax.dot_general(ds_lp, q, (((1,), (1,)), ((0,), (0,))),
                              preferred_element_type=jnp.float32) * scale
+    return dq, dk, dv, ds
+
+
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, *,
+                scale, p_drop, n_heads):
+    """One grid step = a BLOCK of batches for one head: batched matmuls
+    keep the MXU busy (a single (b, h) pair at S=128 is DMA-bound)."""
+    from jax.experimental import pallas as pl
+
+    b, h = pl.program_id(0), pl.program_id(1)
+    o = _attn_block_fwd(q_ref[:, 0], k_ref[:, 0], v_ref[:, 0],
+                        bias_ref[:, 0], seed_ref, scale, p_drop,
+                        b * n_heads + h)
+    o_ref[:, 0] = o.astype(o_ref.dtype)
+
+
+def _bwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
+                dq_ref, dk_ref, dv_ref, dbias_ref, *, scale, p_drop,
+                n_heads, acc_heads, reduce_rows):
+    from jax.experimental import pallas as pl
+
+    b, h = pl.program_id(0), pl.program_id(1)
+    dq, dk, dv, ds = _attn_block_bwd(
+        q_ref[:, 0], k_ref[:, 0], v_ref[:, 0], do_ref[:, 0],
+        bias_ref[:, 0], seed_ref, scale, p_drop, b * n_heads + h)
     dq_ref[:, 0] = dq.astype(dq_ref.dtype)
     dk_ref[:, 0] = dk.astype(dk_ref.dtype)
     dv_ref[:, 0] = dv.astype(dv_ref.dtype)
@@ -414,6 +429,8 @@ def _use_long_kernel(q, p_drop, bias):
     B, H, S, d = q.shape
     if not _supports_pallas():
         return False
+    if os.environ.get("PADDLE_TPU_ATTN_FORCE") == "flash":
+        return False        # measurement escape hatch: skip to flash
     if not (_MAX_FUSED_SEQ < S <= _MAX_LONG_SEQ) or _long_qb(S, d) is None:
         return False
     if bias.shape[1] == 1 and bias.shape[2] != 1 and H > 1:
@@ -1006,8 +1023,235 @@ def _pallas_attention_packed_bwd(q3, k3, v3, bias, seed, do, scale,
             dv.reshape(B, S, HD), dbias)
 
 
+# -- RESIDENT tier: fc-native operands, per-(batch-block, head) grid ----
+#
+# Same batched-dot math as the fused tier (_attn_block_fwd/_bwd), but
+# the operands keep the layout the QKV projections produce: blocks span
+# ALL heads with an index map CONSTANT in the head grid dim, so each
+# q/k/v block DMAs once per batch block and is revisited across the H
+# head steps; the kernel extracts head h with a dynamic slice in VMEM.
+# No [B, H, S, d] relayout in the graph (the per-head tier's 16.5 ms of
+# copies) and no in-VMEM swapaxes + per-chunk python loop (the packed
+# tier's latency trap). The backward splits into a dq/dbias kernel and
+# a dk/dv kernel so each call's revisited in/out blocks fit VMEM.
+#
+# Mosaic constraints force the HEAD-PAIR design (measured on v5e):
+# dynamic lane offsets must be provable multiples of 128 and dynamic
+# sublane offsets multiples of 8, so neither a [B, S, H, d] view with a
+# dynamic head index (also NOT a free bitcast — Mosaic pads (H, d) =
+# (12, 64) to (16, 128)) nor a d=64-wide dynamic lane slice compiles.
+# A PAIR of heads is a 2d=128-wide dynamic lane slice (hp*128 —
+# provably aligned); the two 64-lane halves split with STATIC slices,
+# which Mosaic supports as an in-VMEM relayout. One grid step therefore
+# computes two heads.
+
+
+def _res_bb(B, S, HD, itemsize, n_io, n_live):
+    """Largest divisor of B whose revisited IO blocks (double-buffered
+    [Bb, S, HD] in the operand dtype) plus live f32 [Bb, S, S]
+    score-family temporaries stay inside the 13 MB acceptance bound
+    (16 MB scoped VMEM minus headroom; same bound the long tier uses)."""
+    best = None
+    for bb in range(1, B + 1):
+        if B % bb:
+            continue
+        est = n_io * bb * S * HD * itemsize * 2 + n_live * bb * S * S * 4
+        if est <= 13 * 1024 * 1024:
+            best = bb
+    return best
+
+
+def _res_blocks(B, S, HD, itemsize):
+    # ONE block size for every resident kernel: the dropout PRNG draw
+    # shape [Bb, S, S] per (b, h) stream must match between the forward
+    # and both backward kernels (the dk/dv call is the tightest: 6 io
+    # blocks, ~10 live tiles)
+    return _res_bb(B, S, HD, itemsize, n_io=6, n_live=10)
+
+
+def _use_res_kernel(q3, n_heads, p_drop, bias):
+    B, S, HD = q3.shape
+    if not _supports_pallas() or S > _MAX_FUSED_SEQ:
+        return False
+    if os.environ.get("PADDLE_TPU_ATTN_FORCE") == "packed":
+        return False        # measurement/bypass hatch: old packed tier
+    d = HD // n_heads
+    # head pairs: 2d must hit the 128-lane alignment Mosaic can prove
+    if HD % n_heads or n_heads % 2 or (2 * d) % 128:
+        return False
+    if _res_blocks(B, S, HD, jnp.dtype(q3.dtype).itemsize) is None:
+        return False
+    if bias.shape[2] != 1 or bias.shape[1] not in (1, n_heads):
+        return False
+    return not (_interpret() and p_drop > 0.0)
+
+
+def _res_pair(ref, hp, d):
+    """Load the 128-lane-aligned head PAIR ``hp`` and split it into two
+    [Bb, S, d] halves (static sub-128 slices relayout in VMEM)."""
+    from jax.experimental import pallas as pl
+
+    pair = ref[:, :, pl.dslice(hp * 2 * d, 2 * d)]
+    return pair[:, :, :d], pair[:, :, d:]
+
+
+def _res_put_pair(ref, hp, d, a, b):
+    from jax.experimental import pallas as pl
+
+    ref[:, :, pl.dslice(hp * 2 * d, 2 * d)] = jnp.concatenate(
+        [a, b], axis=-1)
+
+
+def _res_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, *,
+                    scale, p_drop, n_heads, d):
+    from jax.experimental import pallas as pl
+
+    b, hp = pl.program_id(0), pl.program_id(1)
+    qs = _res_pair(q_ref, hp, d)
+    ks = _res_pair(k_ref, hp, d)
+    vs = _res_pair(v_ref, hp, d)
+    outs = []
+    for j in (0, 1):
+        bias_b = _res_bias(bias_ref, j)
+        o = _attn_block_fwd(qs[j], ks[j], vs[j], bias_b, seed_ref,
+                            scale, p_drop, b * n_heads + hp * 2 + j)
+        outs.append(o.astype(o_ref.dtype))
+    _res_put_pair(o_ref, hp, d, outs[0], outs[1])
+
+
+def _res_bias(bias_ref, j):
+    # broadcast bias blocks are (Bb, 1, 1, S); per-head blocks carry the
+    # PAIR (Bb, 2, 1, S) and half j selects its head's row
+    if bias_ref.shape[1] == 2:
+        return bias_ref[:, j]
+    return bias_ref[:, 0]
+
+
+def _res_dq_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
+                   dq_ref, dbias_ref, *, scale, p_drop, n_heads, d,
+                   acc_heads):
+    from jax.experimental import pallas as pl
+
+    b, hp = pl.program_id(0), pl.program_id(1)
+    qs = _res_pair(q_ref, hp, d)
+    ks = _res_pair(k_ref, hp, d)
+    vs = _res_pair(v_ref, hp, d)
+    dos = _res_pair(do_ref, hp, d)
+    dqs, contribs = [], []
+    for j in (0, 1):
+        dq, _, _, ds = _attn_block_bwd(
+            qs[j], ks[j], vs[j], dos[j], _res_bias(bias_ref, j),
+            seed_ref, scale, p_drop, b * n_heads + hp * 2 + j)
+        dqs.append(dq.astype(dq_ref.dtype))
+        contribs.append(jnp.sum(ds, axis=1, keepdims=True))  # [Bb, 1, S]
+    _res_put_pair(dq_ref, hp, d, dqs[0], dqs[1])
+    if acc_heads:
+        both = contribs[0] + contribs[1]
+
+        @pl.when(hp == 0)
+        def _init():
+            dbias_ref[:, 0] = both
+
+        @pl.when(hp != 0)
+        def _acc():
+            dbias_ref[:, 0] += both
+    else:
+        dbias_ref[:, 0] = contribs[0]
+        dbias_ref[:, 1] = contribs[1]
+
+
+def _res_dkdv_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
+                     dk_ref, dv_ref, *, scale, p_drop, n_heads, d):
+    from jax.experimental import pallas as pl
+
+    b, hp = pl.program_id(0), pl.program_id(1)
+    qs = _res_pair(q_ref, hp, d)
+    ks = _res_pair(k_ref, hp, d)
+    vs = _res_pair(v_ref, hp, d)
+    dos = _res_pair(do_ref, hp, d)
+    dks, dvs = [], []
+    for j in (0, 1):
+        _, dk, dv, _ = _attn_block_bwd(
+            qs[j], ks[j], vs[j], dos[j], _res_bias(bias_ref, j),
+            seed_ref, scale, p_drop, b * n_heads + hp * 2 + j)
+        dks.append(dk.astype(dk_ref.dtype))
+        dvs.append(dv.astype(dv_ref.dtype))
+    _res_put_pair(dk_ref, hp, d, dks[0], dks[1])
+    _res_put_pair(dv_ref, hp, d, dvs[0], dvs[1])
+
+
+def _res_specs(q3, n_heads, bias):
+    from jax.experimental import pallas as pl
+
+    B, S, HD = q3.shape
+    d = HD // n_heads
+    Bb = _res_blocks(B, S, HD, jnp.dtype(q3.dtype).itemsize)
+    grid = (B // Bb, n_heads // 2)
+    qspec = pl.BlockSpec((Bb, S, HD), lambda b, hp: (b, 0, 0))
+    per_head = bias.shape[1] > 1
+    bspec = pl.BlockSpec((Bb, 2 if per_head else 1, 1, S),
+                         lambda b, hp, _ph=per_head:
+                         (b, hp if _ph else 0, 0, 0))
+    return grid, qspec, bspec, d
+
+
+def _pallas_attention_res(q3, k3, v3, bias, scale, p_drop, seed, n_heads):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    grid, qspec, bspec, d = _res_specs(q3, n_heads, bias)
+    return pl.pallas_call(
+        functools.partial(_res_fwd_kernel, scale=scale, p_drop=p_drop,
+                          n_heads=n_heads, d=d),
+        grid=grid,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  qspec, qspec, qspec, bspec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct(q3.shape, q3.dtype),
+        interpret=_interpret(),
+    )(seed, q3, k3, v3, bias)
+
+
+def _pallas_attention_res_bwd(q3, k3, v3, bias, seed, do, scale, p_drop,
+                              n_heads):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, S, HD = q3.shape
+    grid, qspec, bspec, d = _res_specs(q3, n_heads, bias)
+    acc_heads = bias.shape[1] == 1
+    dbias_shape = (B, bias.shape[1], 1, S)
+    ops = (seed, q3, k3, v3, bias, do)
+    in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM),
+                qspec, qspec, qspec, bspec, qspec]
+    dq, dbias = pl.pallas_call(
+        functools.partial(_res_dq_kernel, scale=scale, p_drop=p_drop,
+                          n_heads=n_heads, d=d, acc_heads=acc_heads),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[qspec, bspec],
+        out_shape=[jax.ShapeDtypeStruct(q3.shape, q3.dtype),
+                   jax.ShapeDtypeStruct(dbias_shape, jnp.float32)],
+        interpret=_interpret(),
+    )(*ops)
+    dk, dv = pl.pallas_call(
+        functools.partial(_res_dkdv_kernel, scale=scale, p_drop=p_drop,
+                          n_heads=n_heads, d=d),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[qspec, qspec],
+        out_shape=[jax.ShapeDtypeStruct(q3.shape, q3.dtype),
+                   jax.ShapeDtypeStruct(q3.shape, q3.dtype)],
+        interpret=_interpret(),
+    )(*ops)
+    return dq, dk, dv, dbias
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
 def _packed(q3, k3, v3, bias, scale, p_drop, n_heads, seed):
+    if _use_res_kernel(q3, n_heads, p_drop, bias):
+        return _pallas_attention_res(q3, k3, v3, bias, scale, p_drop,
+                                     seed, n_heads)
     if _use_packed_kernel(q3, n_heads, p_drop, bias):
         return _pallas_attention_packed(q3, k3, v3, bias, scale, p_drop,
                                         seed, n_heads)
@@ -1034,6 +1278,10 @@ def _packed_fwd(q3, k3, v3, bias, scale, p_drop, n_heads, seed):
 
 def _packed_bwd(scale, p_drop, n_heads, res, do):
     q3, k3, v3, bias, seed = res
+    if _use_res_kernel(q3, n_heads, p_drop, bias):
+        dq, dk, dv, dbias = _pallas_attention_res_bwd(
+            q3, k3, v3, bias, seed, do, scale, p_drop, n_heads)
+        return dq, dk, dv, dbias.astype(bias.dtype), _seed_ct(seed)
     if _use_packed_kernel(q3, n_heads, p_drop, bias):
         dq, dk, dv, dbias = _pallas_attention_packed_bwd(
             q3, k3, v3, bias, seed, do, scale, p_drop, n_heads)

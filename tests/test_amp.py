@@ -235,3 +235,47 @@ def test_amp_dynamic_preserves_selected_rows_grads():
     # untouched rows frozen (sparse update semantics survived AMP)
     untouched = np.setdiff1d(np.arange(vocab), [1, 3, 9])
     np.testing.assert_array_equal(amp1[untouched], amp0[untouched])
+
+
+def test_amp_batch_norm_bf16_io_f32_stats():
+    """batch_norm is AMP-gray on TPU: the activation X follows the bf16
+    chain but the running Mean/Variance and Scale/Bias inputs must stay
+    f32 (momentum deltas below the bf16 ulp would vanish), and only Y
+    propagates as low precision — MeanOut aliases the f32 stat var."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", shape=[3, 8, 8], dtype="float32")
+        c = layers.conv2d(img, 4, 3)         # white -> bf16 output
+        bn = layers.batch_norm(c)
+        pred = layers.fc(layers.flatten(bn), 2)
+        loss = layers.mean(pred)
+    mixed_precision.rewrite_program(
+        main, mixed_precision.AutoMixedPrecisionLists(), "bfloat16")
+    bn_op = next(op for op in main.global_block().ops
+                 if op.type == "batch_norm")
+    blk = main.global_block()
+    # X rides the low chain; state inputs stay on the f32 vars
+    for slot in ("Scale", "Bias", "Mean", "Variance"):
+        for n in bn_op.inputs[slot]:
+            assert "cast_bfloat16" not in n, (slot, n)
+            assert str(blk._find_var_recursive(n).dtype) == "float32"
+    # Y follows the low chain: the downstream (white) matmul consumes it
+    # directly, with no fresh .cast_bfloat16 inserted for it
+    (yname,) = bn_op.outputs["Y"]
+    consumers = [op for op in blk.ops
+                 if any(yname == n or n.startswith(yname + ".")
+                        for n in op.input_arg_names())]
+    assert consumers and all(op.type != "cast" for op in consumers), (
+        [op.type for op in consumers])
+    # and the program executes with the running stats committed as f32
+    exe = fluid.Executor()
+    rng = np.random.RandomState(0)
+    feed = {"img": rng.rand(4, 3, 8, 8).astype(np.float32)}
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        stat = next(v.name for v in main.list_vars()
+                    if v.persistable and v.name.endswith(".stat_0"))
+        (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
+        assert np.isfinite(np.asarray(lv)).all()
+        got = fluid.global_scope().find_var(stat)
+        assert np.asarray(got).dtype == np.float32

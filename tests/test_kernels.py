@@ -524,3 +524,49 @@ class TestPackedKernels:
             exe.run(startup)
             (l0,) = exe.run(main, feed=feed, fetch_list=[loss])
             assert np.isfinite(np.asarray(l0)).all()
+
+
+@pytest.mark.skipif(not _supports_pallas(), reason="no pallas")
+class TestResidentKernels:
+    """Resident tier: fc-native [B, S, H*d] operands, head-PAIR grid
+    (128-lane-aligned dynamic slices, static half splits in VMEM).
+    Gate needs even H and 2d % 128 == 0."""
+
+    def _setup(self, bias_shape):
+        from paddle_tpu.kernels import attention as A
+
+        rng = np.random.RandomState(29)
+        b, s, h, d = 4, 64, 4, 64
+        hd = h * d
+        mk = lambda: jnp.asarray((rng.randn(b, s, hd) * 0.4)
+                                 .astype(np.float32))
+        bias = np.zeros(bias_shape, np.float32)
+        bias[..., -5:] = -1e4
+        return A, mk(), mk(), mk(), jnp.asarray(bias), h, d
+
+    def _ref(self, A, q, k, v, bias, h, d):
+        B, S, HD = q.shape
+
+        def split(t):
+            return jnp.transpose(t.reshape(B, S, h, d), (0, 2, 1, 3))
+
+        o = A._ref_attention(split(q), split(k), split(v), bias,
+                             1.0 / np.sqrt(d), 0.0,
+                             jnp.zeros((1,), jnp.int32))
+        return jnp.transpose(o, (0, 2, 1, 3)).reshape(B, S, HD)
+
+    @pytest.mark.parametrize("bias_shape", [(4, 1, 1, 64), (4, 4, 1, 64)])
+    def test_matches_reference(self, bias_shape):
+        A, q, k, v, bias, h, d = self._setup(bias_shape)
+        assert A._use_res_kernel(q, h, 0.0, bias)
+        out = A.fused_attention_packed(q, k, v, bias, n_heads=h)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(self._ref(A, q, k, v, bias, h, d)),
+            rtol=2e-4, atol=2e-5)
+        gp = jax.grad(lambda *a: (A.fused_attention_packed(
+            *a, n_heads=h) ** 2).sum(), argnums=(0, 1, 2, 3))(q, k, v, bias)
+        gr = jax.grad(lambda *a: (self._ref(A, *a, h, d) ** 2).sum(),
+                      argnums=(0, 1, 2, 3))(q, k, v, bias)
+        for a, b in zip(gp, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=3e-4, atol=1e-4)
