@@ -1,0 +1,39 @@
+"""C-embedder TRAINING entry (reference
+``paddle/fluid/train/demo/demo_trainer.cc:1`` proves C++-only training;
+here the compute path is XLA, so the ``trn_*`` C ABI in
+``native/predictor.cc`` hosts an embedded interpreter and drives this
+class): a train program saved with ``fluid.save`` (.pdmodel with
+backward + optimizer ops, .pdparams, .pdopt) is reloaded and stepped
+with caller-fed batches; the whole step still executes as one compiled
+XLA program with donated buffers."""
+
+import numpy as np
+
+from . import io as fluid_io
+from .executor import Executor, Scope, scope_guard
+from .framework import Program
+
+__all__ = ["CTrainer"]
+
+
+class CTrainer:
+    def __init__(self, model_path):
+        with open(model_path + ".pdmodel", "rb") as f:
+            self.program = Program.parse_from_string(f.read())
+        self.scope = Scope()
+        self.exe = Executor()
+        with scope_guard(self.scope):
+            fluid_io.load(self.program, model_path)
+
+    def step(self, feed, fetch_name):
+        """One optimizer step; returns the fetched value as a
+        contiguous float32 ndarray (the C ABI's output dtype)."""
+        with scope_guard(self.scope):
+            (out,) = self.exe.run(self.program, feed=feed,
+                                  fetch_list=[fetch_name])
+        return np.ascontiguousarray(np.asarray(out), dtype=np.float32)
+
+    def save(self, model_path):
+        """Checkpoint params + optimizer state + program back out."""
+        with scope_guard(self.scope):
+            fluid_io.save(self.program, model_path)

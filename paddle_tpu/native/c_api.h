@@ -110,6 +110,24 @@ int prd_run(int64_t h, const char** in_names, const float** in_bufs,
             int64_t out_cap, int64_t* out_shape, int64_t* out_rank);
 int prd_destroy(int64_t h);
 
+/* trn_* — C-only TRAINING over the same embedded interpreter
+ * (reference fluid/train/demo/demo_trainer.cc capability): loads a
+ * TRAIN program saved with fluid.save(program, path) — .pdmodel with
+ * backward + optimizer ops, .pdparams, .pdopt — and steps it with
+ * caller-fed batches. in_dtypes holds a per-input code (0 = float32,
+ * 1 = int64; NULL = all float32); the fetched tensor (typically the
+ * loss) returns as float32. trn_save checkpoints params + optimizer
+ * state + program back out. Same error codes as prd_*. */
+
+int64_t trn_create(const char* model_path);
+int trn_step(int64_t h, const char** in_names, const void** in_bufs,
+             const int64_t* in_shapes, const int64_t* in_ranks,
+             const int32_t* in_dtypes, int64_t n_in,
+             const char* fetch_name, float* out_buf, int64_t out_cap,
+             int64_t* out_shape, int64_t* out_rank);
+int trn_save(int64_t h, const char* model_path);
+int trn_destroy(int64_t h);
+
 #ifdef __cplusplus
 }  /* extern "C" */
 #endif

@@ -42,19 +42,22 @@ PyObject* np_module() {
   return np;
 }
 
-/* wrap a caller buffer as a numpy array (copy — caller keeps ownership) */
-PyObject* buf_to_ndarray(const float* buf, const int64_t* shape,
-                         int64_t rank) {
+/* wrap a caller buffer as a numpy array (copy — caller keeps ownership).
+ * dtype: "float32" (4 B) or "int64" (8 B) — the two feed dtypes training
+ * programs need (activations and label/id tensors). */
+PyObject* buf_to_ndarray_t(const void* buf, const int64_t* shape,
+                           int64_t rank, const char* dtype,
+                           size_t elsize) {
   int64_t n = 1;
   for (int64_t i = 0; i < rank; ++i) n *= shape[i];
   PyObject* np = np_module();
   if (!np) return nullptr;
   PyObject* mem = PyMemoryView_FromMemory(
-      reinterpret_cast<char*>(const_cast<float*>(buf)),
-      n * sizeof(float), PyBUF_READ);
+      reinterpret_cast<char*>(const_cast<void*>(buf)),
+      n * elsize, PyBUF_READ);
   if (!mem) return nullptr;
   PyObject* frombuffer = PyObject_GetAttrString(np, "frombuffer");
-  PyObject* arr = PyObject_CallFunction(frombuffer, "Os", mem, "float32");
+  PyObject* arr = PyObject_CallFunction(frombuffer, "Os", mem, dtype);
   Py_XDECREF(frombuffer);
   Py_DECREF(mem);
   if (!arr) return nullptr;
@@ -70,6 +73,71 @@ PyObject* buf_to_ndarray(const float* buf, const int64_t* shape,
   Py_DECREF(reshaped);
   return copied;
 }
+
+/* copy a float32 ndarray out into the caller's buffer (shared by the
+ * prd_ and trn_ run paths). Returns 0 / -2 python / -4 capacity. */
+int ndarray_out(PyObject* out, float* out_buf, int64_t out_cap,
+                int64_t* out_shape, int64_t* out_rank) {
+  int rc = -2;
+  PyObject* np = np_module();
+  PyObject* asarray =
+      out ? PyObject_GetAttrString(np, "ascontiguousarray") : nullptr;
+  PyObject* arr =
+      asarray ? PyObject_CallFunction(asarray, "Os", out, "float32")
+              : nullptr;
+  if (arr) {
+    PyObject* shape_t = PyObject_GetAttrString(arr, "shape");
+    int64_t rank = PyTuple_Size(shape_t);
+    int64_t n = 1;
+    *out_rank = rank;
+    for (int64_t i = 0; i < rank && i < 8; ++i) {
+      out_shape[i] = PyLong_AsLongLong(PyTuple_GET_ITEM(shape_t, i));
+      n *= out_shape[i];
+    }
+    Py_DECREF(shape_t);
+    if (rank > 8) {
+      rc = -4; /* out_shape only holds 8 dims (c_api.h contract) */
+    } else if (n <= out_cap) {
+      PyObject* tob = PyObject_CallMethod(arr, "tobytes", nullptr);
+      if (tob) {
+        std::memcpy(out_buf, PyBytes_AsString(tob),
+                    static_cast<size_t>(n) * sizeof(float));
+        Py_DECREF(tob);
+        rc = 0;
+      }
+    } else {
+      rc = -4;
+    }
+    Py_DECREF(arr);
+  }
+  Py_XDECREF(asarray);
+  return rc;
+}
+
+/* build a feed dict from parallel name/buffer/shape/dtype arrays.
+ * dtypes may be null (all float32) or per-input codes: 0 f32, 1 i64. */
+PyObject* build_feed(const char** in_names, const void** in_bufs,
+                     const int64_t* in_shapes, const int64_t* in_ranks,
+                     const int32_t* in_dtypes, int64_t n_in) {
+  PyObject* feed = PyDict_New();
+  const int64_t* shp = in_shapes;
+  for (int64_t i = 0; i < n_in; ++i) {
+    int dt = in_dtypes ? in_dtypes[i] : 0;
+    PyObject* arr = buf_to_ndarray_t(
+        in_bufs[i], shp, in_ranks[i], dt == 1 ? "int64" : "float32",
+        dt == 1 ? sizeof(int64_t) : sizeof(float));
+    shp += in_ranks[i];
+    if (!arr) {
+      Py_DECREF(feed);
+      return nullptr;
+    }
+    PyDict_SetItemString(feed, in_names[i], arr);
+    Py_DECREF(arr);
+  }
+  return feed;
+}
+
+std::vector<PyObject*> g_trainers;  // index+1 = handle; nullptr = freed
 
 }  // namespace
 
@@ -117,57 +185,16 @@ int prd_run(int64_t h, const char** in_names, const float** in_bufs,
     return -3;
   PyGILState_STATE gil = PyGILState_Ensure();
   int rc = -2;
-  PyObject* feed = PyDict_New();
-  const int64_t* shp = in_shapes;
-  bool ok = true;
-  for (int64_t i = 0; ok && i < n_in; ++i) {
-    PyObject* arr = buf_to_ndarray(in_bufs[i], shp, in_ranks[i]);
-    shp += in_ranks[i];
-    if (!arr) {
-      ok = false;
-      break;
-    }
-    PyDict_SetItemString(feed, in_names[i], arr);
-    Py_DECREF(arr);
-  }
+  PyObject* feed =
+      build_feed(in_names, reinterpret_cast<const void**>(in_bufs),
+                 in_shapes, in_ranks, nullptr, n_in);
   PyObject* outs =
-      ok ? PyObject_CallMethod(g_predictors[h - 1], "run", "O", feed)
-         : nullptr;
-  Py_DECREF(feed);
+      feed ? PyObject_CallMethod(g_predictors[h - 1], "run", "O", feed)
+           : nullptr;
+  Py_XDECREF(feed);
   if (outs) {
     PyObject* out = PySequence_GetItem(outs, out_index);
-    PyObject* np = np_module();
-    PyObject* asarray =
-        out ? PyObject_GetAttrString(np, "ascontiguousarray") : nullptr;
-    PyObject* arr =
-        asarray ? PyObject_CallFunction(asarray, "Os", out, "float32")
-                : nullptr;
-    if (arr) {
-      PyObject* shape_t = PyObject_GetAttrString(arr, "shape");
-      int64_t rank = PyTuple_Size(shape_t);
-      int64_t n = 1;
-      *out_rank = rank;
-      for (int64_t i = 0; i < rank && i < 8; ++i) {
-        out_shape[i] = PyLong_AsLongLong(PyTuple_GET_ITEM(shape_t, i));
-        n *= out_shape[i];
-      }
-      Py_DECREF(shape_t);
-      if (rank > 8) {
-        rc = -4; /* out_shape only holds 8 dims (c_api.h contract) */
-      } else if (n <= out_cap) {
-        PyObject* tob = PyObject_CallMethod(arr, "tobytes", nullptr);
-        if (tob) {
-          std::memcpy(out_buf, PyBytes_AsString(tob),
-                      static_cast<size_t>(n) * sizeof(float));
-          Py_DECREF(tob);
-          rc = 0;
-        }
-      } else {
-        rc = -4;
-      }
-      Py_DECREF(arr);
-    }
-    Py_XDECREF(asarray);
+    if (out) rc = ndarray_out(out, out_buf, out_cap, out_shape, out_rank);
     Py_XDECREF(out);
     Py_DECREF(outs);
   }
@@ -184,6 +211,90 @@ int prd_destroy(int64_t h) {
   PyGILState_STATE gil = PyGILState_Ensure();
   Py_DECREF(g_predictors[h - 1]);
   g_predictors[h - 1] = nullptr;
+  PyGILState_Release(gil);
+  return 0;
+}
+
+/* -- trn_*: C-only TRAINING (reference fluid/train/demo proves the
+ * capability; here the trainer hosts paddle_tpu.fluid.train_entry
+ * .CTrainer over a fluid.save'd train program) ---------------------- */
+
+int64_t trn_create(const char* model_path) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (!ensure_python()) return 0;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int64_t handle = 0;
+  PyObject* mod = PyImport_ImportModule("paddle_tpu.fluid.train_entry");
+  if (mod) {
+    PyObject* cls = PyObject_GetAttrString(mod, "CTrainer");
+    PyObject* trainer =
+        cls ? PyObject_CallFunction(cls, "s", model_path) : nullptr;
+    if (trainer) {
+      g_trainers.push_back(trainer);
+      handle = static_cast<int64_t>(g_trainers.size());
+    }
+    Py_XDECREF(cls);
+    Py_DECREF(mod);
+  }
+  if (!handle && PyErr_Occurred()) PyErr_Print();
+  PyGILState_Release(gil);
+  return handle;
+}
+
+int trn_step(int64_t h, const char** in_names, const void** in_bufs,
+             const int64_t* in_shapes, const int64_t* in_ranks,
+             const int32_t* in_dtypes, int64_t n_in,
+             const char* fetch_name, float* out_buf, int64_t out_cap,
+             int64_t* out_shape, int64_t* out_rank) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (h < 1 || h > static_cast<int64_t>(g_trainers.size()) ||
+      !g_trainers[h - 1])
+    return -3;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -2;
+  PyObject* feed =
+      build_feed(in_names, in_bufs, in_shapes, in_ranks, in_dtypes, n_in);
+  PyObject* out =
+      feed ? PyObject_CallMethod(g_trainers[h - 1], "step", "Os", feed,
+                                 fetch_name)
+           : nullptr;
+  Py_XDECREF(feed);
+  if (out) {
+    rc = ndarray_out(out, out_buf, out_cap, out_shape, out_rank);
+    Py_DECREF(out);
+  }
+  if (rc == -2 && PyErr_Occurred()) PyErr_Print();
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int trn_save(int64_t h, const char* model_path) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (h < 1 || h > static_cast<int64_t>(g_trainers.size()) ||
+      !g_trainers[h - 1])
+    return -3;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -2;
+  PyObject* r =
+      PyObject_CallMethod(g_trainers[h - 1], "save", "s", model_path);
+  if (r) {
+    rc = 0;
+    Py_DECREF(r);
+  } else if (PyErr_Occurred()) {
+    PyErr_Print();
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int trn_destroy(int64_t h) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (h < 1 || h > static_cast<int64_t>(g_trainers.size()) ||
+      !g_trainers[h - 1])
+    return -3;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  Py_DECREF(g_trainers[h - 1]);
+  g_trainers[h - 1] = nullptr;
   PyGILState_Release(gil);
   return 0;
 }

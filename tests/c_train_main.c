@@ -1,0 +1,74 @@
+/* C embedder TRAINING driver: loads a fluid.save'd train program
+ * through the trn_* ABI (libpredictor.so), runs N optimizer steps on a
+ * deterministic synthetic batch (float32 features + int64 labels), and
+ * checkpoints back out — no Python in this translation unit.
+ * Usage: c_train_main <model_path> <out_model_path> <steps>
+ * Prints "first_loss <f> last_loss <f>"; exits nonzero on any error or
+ * if the loss failed to decrease. */
+
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "../paddle_tpu/native/c_api.h"
+
+#define BATCH 16
+#define DIM 4
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    fprintf(stderr, "usage: %s model_path out_model_path steps\n",
+            argv[0]);
+    return 2;
+  }
+  int steps = atoi(argv[3]);
+
+  int64_t h = trn_create(argv[1]);
+  if (!h) {
+    fprintf(stderr, "trn_create failed\n");
+    return 3;
+  }
+
+  /* deterministic batch: x[i][j] ramp, label = j-index of max feature */
+  float x[BATCH * DIM];
+  int64_t label[BATCH];
+  for (int i = 0; i < BATCH; ++i) {
+    for (int j = 0; j < DIM; ++j)
+      x[i * DIM + j] = (float)((i * 7 + j * 3) % 11) / 11.0f;
+    int best = 0;
+    for (int j = 1; j < DIM; ++j)
+      if (x[i * DIM + j] > x[i * DIM + best]) best = j;
+    label[i] = best % 3;
+  }
+
+  const char* names[2] = {"x", "label"};
+  const void* bufs[2] = {x, label};
+  int64_t shapes[4] = {BATCH, DIM, BATCH, 1};
+  int64_t ranks[2] = {2, 2};
+  int32_t dtypes[2] = {0, 1};
+
+  float first = 0.0f, last = 0.0f;
+  for (int s = 0; s < steps; ++s) {
+    float out[16];
+    int64_t out_shape[8];
+    int64_t out_rank = 0;
+    int rc = trn_step(h, names, bufs, shapes, ranks, dtypes, 2, "loss",
+                      out, 16, out_shape, &out_rank);
+    if (rc != 0) {
+      fprintf(stderr, "trn_step rc=%d at step %d\n", rc, s);
+      return 4;
+    }
+    if (s == 0) first = out[0];
+    last = out[0];
+  }
+  printf("first_loss %.6f last_loss %.6f\n", first, last);
+  if (!(last < first)) {
+    fprintf(stderr, "loss did not decrease\n");
+    return 5;
+  }
+  if (trn_save(h, argv[2]) != 0) {
+    fprintf(stderr, "trn_save failed\n");
+    return 6;
+  }
+  return trn_destroy(h) == 0 ? 0 : 7;
+}
