@@ -62,6 +62,7 @@ def _insert_cast(block, new_ops, cache, name, dest_dtype, suffix):
 # low chain (the lowering computes stats and rsqrt in f32 regardless)
 _KEEP_FP32_SLOTS = {
     "batch_norm": ("Scale", "Bias", "Mean", "Variance"),
+    "layer_norm": ("Scale", "Bias"),
 }
 
 # gray ops where only SOME outputs become low-precision: batch_norm's
@@ -70,6 +71,7 @@ _KEEP_FP32_SLOTS = {
 # from this map mark all float outputs low (the default gray rule).
 _LOW_OUTPUT_SLOTS = {
     "batch_norm": ("Y",),
+    "layer_norm": ("Y",),
 }
 
 

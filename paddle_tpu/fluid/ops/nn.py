@@ -359,20 +359,30 @@ def _layer_norm(ctx, op):
     begin = op.attr("begin_norm_axis", 1)
     axes = tuple(range(begin, x.ndim))
     # two-pass (x - mean)^2 form: measured FASTER than the single-pass
-    # E[x^2] + f32-cast variant on BERT-base (189k vs 177k tok/s — the
-    # explicit f32 copy costs more than the fused second reduce) and
-    # numerically stabler per-row; batch_norm differs (see there)
-    mean = jnp.mean(x, axis=axes, keepdims=True)
-    var = jnp.var(x, axis=axes, keepdims=True)
-    out = (x - mean) / jnp.sqrt(var + eps)
+    # E[x^2] variant on BERT-base (189k vs 177k tok/s — the single-pass
+    # rewrite cost more than the fused second reduce) and numerically
+    # stabler per-row; batch_norm differs (see there). Under AMP the op
+    # is GRAY: x arrives bf16, stats and normalize run in f32 (the
+    # converts fuse into the reduces), and Y casts back to x's dtype —
+    # per-row bf16 stats over 768 elements would be too coarse.
+    xf = x.astype(jnp.float32) if x.dtype != jnp.float32 else x
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    out = (xf - mean) / jnp.sqrt(var + eps)
     norm_shape = x.shape[begin:]
     if scale is not None:
-        out = out * scale.reshape(norm_shape)
+        out = out * scale.astype(jnp.float32).reshape(norm_shape)
     if bias is not None:
-        out = out + bias.reshape(norm_shape)
-    ctx.set_output(op, "Y", out)
-    ctx.set_output(op, "Mean", jnp.reshape(mean, (-1,)))
-    ctx.set_output(op, "Variance", jnp.reshape(var, (-1,)))
+        out = out + bias.astype(jnp.float32).reshape(norm_shape)
+    ctx.set_output(op, "Y", out.astype(x.dtype))
+    # stats keep their DECLARED dtype (f32 under AMP where X is bf16 but
+    # the stat vars stay f32; the input dtype in all-bf16 programs) —
+    # same convention as batch_norm's SavedMean/SavedVariance
+    for slot, val in (("Mean", mean), ("Variance", var)):
+        names = op.output(slot)
+        if names:
+            ctx.set(names[0], jnp.reshape(val, (-1,)).astype(
+                ctx.var_dtype(names[0])))
 
 
 @register("group_norm")

@@ -5,9 +5,11 @@
  * brokers buffers into paddle_tpu.inference.Predictor).
  *
  * Contract (documented, deliberately minimal like the reference's
- * minimal C surface): float32 tensors only, single-threaded callers
- * (one embedded interpreter, no GIL hand-off), outputs fetched by
- * index. Returns 0/handles on success, negative codes on error:
+ * minimal C surface): single-threaded callers (one embedded
+ * interpreter, no GIL hand-off). prd_* serves inference: float32
+ * feeds, outputs fetched by index. trn_* trains: float32/int64 feeds
+ * (per-input dtype codes), the fetch (typically the loss) is by NAME
+ * and returns float32. Returns 0/handles on success, negative codes:
  *   -1 interpreter/init failure   -3 bad handle
  *   -2 python exception (printed) -4 output buffer too small
  */

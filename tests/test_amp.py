@@ -279,3 +279,42 @@ def test_amp_batch_norm_bf16_io_f32_stats():
         assert np.isfinite(np.asarray(lv)).all()
         got = fluid.global_scope().find_var(stat)
         assert np.asarray(got).dtype == np.float32
+
+
+def test_amp_layer_norm_bf16_io_f32_stats():
+    """layer_norm mirrors batch_norm's AMP-gray contract: X rides the
+    bf16 chain, Scale/Bias inputs stay on the f32 vars (no cast
+    inserted), Y feeds downstream ops directly (no re-cast), and the
+    lowering computes stats in f32 internally."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[8, 16], dtype="float32")
+        h = layers.fc(x, 16, num_flatten_dims=2)   # white -> bf16
+        ln = layers.layer_norm(h, begin_norm_axis=2)
+        out = layers.fc(ln, 4, num_flatten_dims=2)
+        loss = layers.mean(out)
+    mixed_precision.rewrite_program(
+        main, mixed_precision.AutoMixedPrecisionLists(), "bfloat16")
+    blk = main.global_block()
+    ln_op = next(op for op in blk.ops if op.type == "layer_norm")
+    for slot in ("Scale", "Bias"):
+        for n in ln_op.inputs.get(slot, []):
+            assert "cast_bfloat16" not in n, (slot, n)
+            assert str(blk._find_var_recursive(n).dtype) == "float32"
+    # X arrives low (produced by the white matmul chain), uncast
+    (xname,) = ln_op.inputs["X"]
+    assert "cast" not in xname.split(".")[-1] or "bfloat16" in xname
+    # Y flows into the next white matmul without a fresh bf16 cast
+    (yname,) = ln_op.outputs["Y"]
+    consumers = [op for op in blk.ops
+                 if yname in op.input_arg_names()]
+    assert consumers and all(op.type != "cast" for op in consumers), (
+        [op.type for op in consumers])
+    # executes and trains
+    exe = fluid.Executor()
+    feed = {"x": np.random.RandomState(0).rand(2, 8, 16)
+            .astype(np.float32)}
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
+        assert np.isfinite(np.asarray(lv)).all()

@@ -96,21 +96,46 @@ def test_c_program_against_header(tmp_path):
     assert "C_API_OK" in out.stdout
 
 
-def test_c_predictor_serves_lenet(tmp_path):
-    """A pure-C embedder (tests/c_predict_main.c) serves a saved conv
-    model through the prd_* ABI: libpredictor.so hosts an embedded
-    interpreter over the XLA serve path (reference inference/capi/)."""
+def _build_embedder(tmp_path, driver_c, exe_name):
+    """Shared C-embedder harness: compile a driver against
+    libpredictor.so and build the env its embedded interpreter needs
+    (PYTHONHOME = the BASE stdlib — a venv has none — plus the venv's
+    site-packages and this repo on the path). Returns (exe, env) or
+    skips when the toolchain/library is unavailable."""
     import shutil
+    import site
     import sys
-
-    import paddle_tpu.fluid as fluid
-    from paddle_tpu.fluid import layers
 
     if shutil.which("g++") is None:
         pytest.skip("no g++")
     so = native.build_predictor_lib()
     if so is None:
         pytest.skip("libpredictor build unavailable (no python headers?)")
+    drv_src = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           driver_c)
+    drv = str(tmp_path / exe_name)
+    subprocess.run(
+        ["g++", "-x", "c", drv_src, "-x", "none", "-o", drv, so,
+         "-Wl,-rpath," + os.path.dirname(so),
+         "-Wl,-rpath," + "/usr/local/lib"],
+        check=True, capture_output=True)
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONHOME"] = sys.base_prefix
+    env["PYTHONPATH"] = os.pathsep.join(
+        [repo] + [p for p in site.getsitepackages() if "site-packages" in p])
+    env["JAX_PLATFORMS"] = "cpu"
+    return drv, env
+
+
+def test_c_predictor_serves_lenet(tmp_path):
+    """A pure-C embedder (tests/c_predict_main.c) serves a saved conv
+    model through the prd_* ABI: libpredictor.so hosts an embedded
+    interpreter over the XLA serve path (reference inference/capi/)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+
+    drv, env = _build_embedder(tmp_path, "c_predict_main.c", "c_predict")
 
     # tiny LeNet-ish model, saved as an inference model
     main, startup = fluid.Program(), fluid.Program()
@@ -134,24 +159,6 @@ def test_c_predictor_serves_lenet(tmp_path):
         (expect,) = exe.run(main, feed={"img": img}, fetch_list=[prob])
     expect = np.asarray(expect)
 
-    drv_src = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "c_predict_main.c")
-    drv = str(tmp_path / "c_predict")
-    subprocess.run(
-        ["g++", "-x", "c", drv_src, "-x", "none", "-o", drv, so,
-         "-Wl,-rpath," + os.path.dirname(so),
-         "-Wl,-rpath," + "/usr/local/lib"],
-        check=True, capture_output=True)
-    import site
-
-    env = dict(os.environ)
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    # the embedded interpreter needs the BASE stdlib as home (a venv has
-    # no stdlib) plus the venv's site-packages on the path
-    env["PYTHONHOME"] = sys.base_prefix
-    env["PYTHONPATH"] = os.pathsep.join(
-        [repo] + [p for p in site.getsitepackages() if "site-packages" in p])
-    env["JAX_PLATFORMS"] = "cpu"
     out = subprocess.run([drv, model_dir, "img", "1", "12", "12"],
                          capture_output=True, text=True, env=env,
                          timeout=300)
@@ -172,18 +179,10 @@ def test_c_trainer_trains_and_checkpoints(tmp_path):
     the loss decrease, and checkpoints back out; python then reloads
     the C-written checkpoint and the trained loss is preserved
     (reference fluid/train/demo/demo_trainer.cc capability)."""
-    import shutil
-    import site
-    import sys
-
     import paddle_tpu.fluid as fluid
     from paddle_tpu.fluid import layers, optimizer
 
-    if shutil.which("g++") is None:
-        pytest.skip("no g++")
-    so = native.build_predictor_lib()
-    if so is None:
-        pytest.skip("libpredictor build unavailable (no python headers?)")
+    drv, env = _build_embedder(tmp_path, "c_train_main.c", "c_train")
 
     main, startup = fluid.Program(), fluid.Program()
     main.random_seed = 11
@@ -204,20 +203,6 @@ def test_c_trainer_trains_and_checkpoints(tmp_path):
         exe.run(startup)
         fluid.io.save(main, model_path)
 
-    drv_src = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "c_train_main.c")
-    drv = str(tmp_path / "c_train")
-    subprocess.run(
-        ["g++", "-x", "c", drv_src, "-x", "none", "-o", drv, so,
-         "-Wl,-rpath," + os.path.dirname(so),
-         "-Wl,-rpath," + "/usr/local/lib"],
-        check=True, capture_output=True)
-    env = dict(os.environ)
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env["PYTHONHOME"] = sys.base_prefix
-    env["PYTHONPATH"] = os.pathsep.join(
-        [repo] + [p for p in site.getsitepackages() if "site-packages" in p])
-    env["JAX_PLATFORMS"] = "cpu"
     out_path = str(tmp_path / "trained" / "model")
     out = subprocess.run([drv, model_path, out_path, "40"],
                          capture_output=True, text=True, env=env,

@@ -212,5 +212,8 @@ def test_parallel_executor_per_device_feed_and_guards():
                   {"pd_x": np.full((2, 4), 2.0, np.float32)}]
         (sv,) = pe.run([s.name], feed=halves)
         np.testing.assert_allclose(float(np.asarray(sv).ravel()[0]), 24.0)
-    with pytest.raises(ValueError, match="num_trainers"):
+    # the refusal must point at the working multi-process path (fleet
+    # collective / paddle_tpu.distributed — whose single-vs-multi
+    # equivalence tests/test_fleet_collective.py pins)
+    with pytest.raises(ValueError, match="fleet collective"):
         fluid.ParallelExecutor(main_program=main, num_trainers=4)
