@@ -83,6 +83,14 @@ class _EagerCtx:
     def var(self, name):
         return None
 
+    def var_dtype(self, name):
+        # eager mode has no declared program vars; lowerings asking for
+        # an output's declared dtype get f32 (matching LowerCtx's
+        # missing-var default)
+        import numpy as np
+
+        return np.dtype("float32")
+
     def next_rng(self):
         key = self._keys.pop(0)
         self.used_keys.append(key)

@@ -19,7 +19,11 @@ Sequence-length dispatch (single chip):
       one (batch, head) live in VMEM (S·d stays small when S² doesn't),
       scores exist only as [Qb, S] tiles; dk/dv accumulate across the
       q-tile grid dim. Measured v5e BERT-base s=2048: 3.1x over the
-      blockwise fallback (20k -> 63k tokens/sec).
+      blockwise fallback (20k -> 63k tokens/sec), and +1.5% over the
+      flash tier (r5 interleaved pairs: 64.3k vs 63.3k, spread ±0.4% —
+      whole-K/V residency plus a single backward beats flash's
+      logsumexp I/O and split-backward re-reads at this scale), so the
+      tier stays. Force flash with PADDLE_TPU_ATTN_FORCE=flash.
   ~3k < S — flash tier (_flash_*): BOTH q and k are tiled, so no VMEM
       term scales with S². The forward runs online softmax over k-tiles
       in VMEM scratch and saves per-row logsumexp; the backward is the
@@ -34,16 +38,20 @@ Sequence-length dispatch (single chip):
       the ring/Ulysses layers in ``paddle_tpu.parallel`` shard S over
       chips (SURVEY §5.7).
 
-There is also a PACKED tier (``fused_attention_packed``): q/k/v in the
-fc-native [B, S, H*d] layout with heads split/merged inside the kernel,
-eliminating the head transposes from the graph. Honest status from v5e
-measurement at BERT-base b=128/s=128: it LOSES to XLA's batched-GEMM
-chain end-to-end (157 ms step vs 87 ms — the per-(batch, head-chunk)
-grid is latency-bound at tiny S), as does the per-head fused kernel
-(126 ms — layout glue around the custom call). It is kept as a
-correct, tested building block for shapes with larger S·heads per
-block; BERT's ``use_fused_attention="auto"`` picks the GEMM chain
-below S=256.
+There is also a PACKED entry (``fused_attention_packed``): q/k/v in the
+fc-native [B, S, H*d] layout with heads handled inside the kernel,
+eliminating the head transposes from the graph. It dispatches to the
+RESIDENT head-pair tier (r5; see the resident section below) with the
+r4 chunked kernel as fallback. Honest status from v5e measurement at
+BERT-base b=128/s=128 — every in-kernel design loses to XLA's
+batched-GEMM chain end-to-end:
+  einsum chain 87-89 ms | resident 122 ms | per-head fused 126 ms |
+  packed-chunked 157 ms; ablation puts the attention core at ~16 ms of
+  the 88 ms step, so the chain leaves little on the table that kernel
+  relayout/latency costs don't eat (full analysis: PROFILE_r05.md §1).
+They are kept as correct, tested building blocks for shapes with
+larger S·heads per block; BERT's ``use_fused_attention="auto"`` picks
+the GEMM chain below S=256.
 """
 
 import functools
