@@ -87,8 +87,6 @@ class _EagerCtx:
         # eager mode has no declared program vars; lowerings asking for
         # an output's declared dtype get f32 (matching LowerCtx's
         # missing-var default)
-        import numpy as np
-
         return np.dtype("float32")
 
     def next_rng(self):

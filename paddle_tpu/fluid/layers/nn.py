@@ -144,12 +144,13 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
 
 def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
            groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
-           act=None, name=None):
+           act=None, name=None, data_format="NCHW"):
     helper = LayerHelper("conv2d", **locals())
     groups = groups or 1
     if isinstance(filter_size, int):
         filter_size = [filter_size, filter_size]
-    num_channels = input.shape[1]
+    num_channels = (input.shape[-1] if data_format == "NHWC"
+                    else input.shape[1])
     filter_shape = [num_filters, num_channels // groups] + list(filter_size)
     from ..initializer import Normal
 
@@ -168,9 +169,11 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
             "paddings": [padding, padding] if isinstance(padding, int) else list(padding),
             "dilations": [dilation, dilation] if isinstance(dilation, int) else list(dilation),
             "groups": groups,
+            "data_format": data_format,
         },
     )
-    out = _append_bias(helper, out, bias_attr, channel_dim=1)
+    out = _append_bias(helper, out, bias_attr,
+                       channel_dim=-1 if data_format == "NHWC" else 1)
     return helper.append_activation(out, act)
 
 
@@ -269,7 +272,7 @@ def softmax(input, use_cudnn=False, name=None, axis=-1):
 
 def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1, pool_padding=0,
            global_pooling=False, use_cudnn=True, ceil_mode=False, name=None,
-           exclusive=True, adaptive=False):
+           exclusive=True, adaptive=False, data_format="NCHW"):
     helper = LayerHelper("pool2d", **locals())
     out = helper.create_variable_for_type_inference(input.dtype)
     helper.append_op(
@@ -285,6 +288,7 @@ def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1, pool_padding=0,
             "ceil_mode": ceil_mode,
             "exclusive": exclusive,
             "adaptive": adaptive,
+            "data_format": data_format,
         },
     )
     return out

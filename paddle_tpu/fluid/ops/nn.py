@@ -29,14 +29,15 @@ def _pair(v):
 def _conv2d(ctx, op):
     import jax
 
-    x = ctx.get_input(op, "Input")  # NCHW
-    w = ctx.get_input(op, "Filter")  # OIHW
+    x = ctx.get_input(op, "Input")  # NCHW or NHWC (data_format attr)
+    w = ctx.get_input(op, "Filter")  # OIHW either way
     strides = _pair(op.attr("strides", [1, 1]))
     pads = _pair(op.attr("paddings", [0, 0]))
     dil = _pair(op.attr("dilations", [1, 1]))
     groups = op.attr("groups", 1) or 1
+    fmt = op.attr("data_format", "NCHW")
     if op.type == "depthwise_conv2d":
-        groups = x.shape[1]
+        groups = x.shape[-1] if fmt == "NHWC" else x.shape[1]
     out = jax.lax.conv_general_dilated(
         x,
         w,
@@ -44,7 +45,7 @@ def _conv2d(ctx, op):
         padding=((pads[0], pads[0]), (pads[1], pads[1])),
         rhs_dilation=dil,
         feature_group_count=groups,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        dimension_numbers=(fmt, "OIHW", fmt),
     )
     ctx.set_output(op, "Output", out)
 
@@ -120,11 +121,13 @@ def _conv3d_transpose(ctx, op):
     ctx.set_output(op, "Output", _deconv(x, w, strides, pads, dil, groups))
 
 
-def _pool(x, pooling_type, ksize, strides, pads, ceil_mode, exclusive, global_pool, adaptive):
+def _pool(x, pooling_type, ksize, strides, pads, ceil_mode, exclusive,
+          global_pool, adaptive, data_format="NCHW"):
     import jax
     import jax.numpy as jnp
 
-    n, c, h, w = x.shape
+    nhwc = data_format == "NHWC"
+    h, w = (x.shape[1], x.shape[2]) if nhwc else (x.shape[2], x.shape[3])
     if global_pool:
         ksize = (h, w)
         strides = (1, 1)
@@ -135,17 +138,19 @@ def _pool(x, pooling_type, ksize, strides, pads, ceil_mode, exclusive, global_po
         assert h % oh == 0 and w % ow == 0, "adaptive pool needs divisible dims"
         kh, kw = h // oh, w // ow
         ksize, strides, pads = (kh, kw), (kh, kw), (0, 0)
-    window = (1, 1) + tuple(ksize)
-    strides_full = (1, 1) + tuple(strides)
-    pad_full = ((0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1]))
+    ph, pw = (pads[0], pads[0]), (pads[1], pads[1])
     if ceil_mode:
         # add extra (stride-1) padding on the high side so partial windows count
-        pad_full = (
-            (0, 0),
-            (0, 0),
-            (pads[0], pads[0] + strides[0] - 1),
-            (pads[1], pads[1] + strides[1] - 1),
-        )
+        ph = (pads[0], pads[0] + strides[0] - 1)
+        pw = (pads[1], pads[1] + strides[1] - 1)
+    if nhwc:
+        window = (1,) + tuple(ksize) + (1,)
+        strides_full = (1,) + tuple(strides) + (1,)
+        pad_full = ((0, 0), ph, pw, (0, 0))
+    else:
+        window = (1, 1) + tuple(ksize)
+        strides_full = (1, 1) + tuple(strides)
+        pad_full = ((0, 0), (0, 0), ph, pw)
     if pooling_type == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
         return jax.lax.reduce_window(x, init, jax.lax.max, window, strides_full, pad_full)
@@ -171,6 +176,7 @@ def _pool2d(ctx, op):
         op.attr("exclusive", True),
         op.attr("global_pooling", False),
         op.attr("adaptive", False),
+        op.attr("data_format", "NCHW"),
     )
     ctx.set_output(op, "Out", out)
 

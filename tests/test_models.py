@@ -92,3 +92,31 @@ def test_transformer_jit_trace_matches_eager():
     static_out = traced([src, tgt, pos, pos, bias])
     np.testing.assert_allclose(np.asarray(static_out[0]), eager_out,
                                rtol=2e-4, atol=2e-4)
+
+
+def test_resnet_nhwc_matches_nchw():
+    """data_format="NHWC" runs the SAME math as NCHW (feed contract
+    unchanged — one transpose at graph entry): losses agree to float
+    tolerance over steps (reduce orders may differ per layout). On v5e
+    the two compile to identical step times (XLA layout assignment
+    normalizes; PROFILE_r05.md §2)."""
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import resnet
+
+    rng = np.random.RandomState(0)
+    feed = {"img": rng.rand(4, 3, 32, 32).astype("float32"),
+            "label": rng.randint(0, 10, (4, 1)).astype("int64")}
+    out = {}
+    for fmt in ("NCHW", "NHWC"):
+        main, st, loss, acc = resnet.build_train_program(
+            depth=18, num_classes=10, image_size=32, seed=3,
+            data_format=fmt)
+        exe = fluid.Executor()
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(st)
+            out[fmt] = [float(np.asarray(
+                exe.run(main, feed=feed, fetch_list=[loss])[0]).ravel()[0])
+                for _ in range(3)]
+    np.testing.assert_allclose(out["NCHW"], out["NHWC"], rtol=2e-4)
