@@ -1,0 +1,217 @@
+"""Federated parameter server (reference
+``operators/distributed_ops/fl_listen_and_serv_op.cc:100`` RunSyncLoop):
+per ROUND, every trainer fetches the current parameters, trains locally,
+and sends its updated copy back; when all N copies arrive the server
+merges them (weighted FedAvg) and opens the next round — the
+trainer-suffixed merge the reference runs as its optimize blocks.
+
+Rides the hardened PS framing (magic + token handshake, length-capped
+frames, round ids with stale NACKs like the sample exchange). The
+server's executor hook is the ``fl_listen_and_serv`` op: running a
+program containing it serves forever, like ``listen_and_serv``.
+"""
+
+import struct
+import threading
+
+import numpy as np
+
+from .ps_server import (_MAGIC, FramedServer, _frame, _pack_arr,
+                        _read_frame, _send_all, _unpack_arr)
+
+__all__ = ["FLServer", "FLTrainerClient", "build_fl_server_program"]
+
+
+def build_fl_server_program(endpoint, n_trainers, param_names):
+    """A Program whose single ``fl_listen_and_serv`` op serves federated
+    rounds when run (Executor blocks; initial parameter values are read
+    from the running scope by name — run/load them first)."""
+    from ..fluid.framework import Program
+
+    prog = Program()
+    prog.global_block().append_op(
+        "fl_listen_and_serv", inputs={}, outputs={},
+        attrs={"endpoint": endpoint, "n_trainers": int(n_trainers),
+               "param_names": list(param_names)})
+    return prog
+
+_GET, _PUT = 1, 2
+
+
+def _pack_params(params):
+    names = sorted(params)
+    out = [struct.pack("<I", len(names))]
+    for n in names:
+        nb = n.encode()
+        out.append(struct.pack("<H", len(nb)) + nb)
+        out.append(_pack_arr(np.ascontiguousarray(params[n], np.float32)))
+    return b"".join(out)
+
+
+def _unpack_params(buf, off=0):
+    (n,) = struct.unpack_from("<I", buf, off)
+    off += 4
+    params = {}
+    for _ in range(n):
+        (ln,) = struct.unpack_from("<H", buf, off)
+        off += 2
+        name = buf[off:off + ln].decode()
+        off += ln
+        arr, off = _unpack_arr(buf, off)
+        params[name] = arr
+    return params, off
+
+
+class FLServer(FramedServer):
+    """Round-synchronous federated averaging over ``n_trainers``.
+
+    GET → (round id, current params). PUT(round, client id, weight,
+    params) blocks its connection until the round's merge completes,
+    then acks — the trainer's next GET therefore always sees the merged
+    state (the reference enforces the same ordering with its send/get
+    barriers). Contributions key on the client id, so a retried push
+    REPLACES the trainer's copy instead of double-counting toward the
+    round quorum; stale-round and malformed PUTs are NACKed before they
+    can touch round state."""
+
+    def __init__(self, params, n_trainers, host="127.0.0.1", port=0,
+                 token=None):
+        super().__init__(host=host, port=port, token=token, backlog=64)
+        self.params = {k: np.asarray(v, np.float32)
+                       for k, v in params.items()}
+        self.n_trainers = int(n_trainers)
+        self.round = 0
+        self._pending = {}      # client id -> (weight, params)
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        self.start()
+
+    def _check(self, got):
+        """Reject a malformed contribution BEFORE it joins the round —
+        a bad entry inside _merge would wedge every later round."""
+        for name, ref in self.params.items():
+            arr = got.get(name)
+            if arr is None:
+                return "missing param %r" % name
+            if arr.size != ref.size:
+                return ("param %r size %d != %d"
+                        % (name, arr.size, ref.size))
+        return None
+
+    def _serve_authenticated(self, conn):
+        try:
+            while not self._stop.is_set():
+                req = _read_frame(conn)
+                if not req:
+                    return
+                if req[0] == _GET:
+                    with self._mu:
+                        rnd, snap = self.round, self.params
+                    # params replace wholesale on merge — packing the
+                    # snapshot outside the lock is safe and keeps GETs
+                    # from serializing behind each other
+                    body = struct.pack("<I", rnd) + _pack_params(snap)
+                    _send_all(conn, _frame(b"\x00" + body))
+                elif req[0] == _PUT:
+                    rnd, weight = struct.unpack_from("<Id", req, 1)
+                    client = bytes(req[13:29])
+                    got, _ = _unpack_params(req, 29)
+                    bad = self._check(got)
+                    if bad is not None:
+                        _send_all(conn, _frame(b"\x01" + bad.encode()))
+                        continue
+                    with self._cv:
+                        if rnd != self.round:
+                            _send_all(conn, _frame(
+                                b"\x01stale round %d != %d"
+                                % (rnd, self.round)))
+                            continue
+                        self._pending[client] = (float(weight), got)
+                        if len(self._pending) >= self.n_trainers:
+                            self._merge()
+                            self.round += 1
+                            self._cv.notify_all()
+                        else:
+                            target = self.round + 1
+                            ok = self._cv.wait_for(
+                                lambda: self.round >= target or
+                                self._stop.is_set(), timeout=300)
+                            if not ok or self._stop.is_set():
+                                _send_all(conn, _frame(
+                                    b"\x01round never completed"))
+                                continue
+                    _send_all(conn, _frame(b"\x00"))
+                else:
+                    return
+        except (ConnectionError, OSError, struct.error):
+            return
+
+    def _merge(self):
+        # caller holds the lock; weighted FedAvg over the N copies
+        entries = list(self._pending.values())
+        total = sum(w for w, _ in entries) or 1.0
+        merged = {}
+        for name in self.params:
+            merged[name] = sum(
+                w * p[name].reshape(self.params[name].shape)
+                for w, p in entries).astype(np.float32) / total
+        self.params = merged
+        self._pending = {}
+
+    def serve_forever(self):
+        """Blocking serve — what running an ``fl_listen_and_serv``
+        program does (the accept loop already runs; block on it)."""
+        self._accept_thread.join()
+
+    def stop(self):
+        with self._cv:
+            self._cv.notify_all()
+        super().stop()
+
+
+class FLTrainerClient:
+    """One trainer's connection: ``pull()`` the round's parameters,
+    train locally, ``push(params, weight)`` — returns after the server
+    merged every trainer's copy, so the next ``pull`` sees the new
+    round (weight = e.g. the local sample count for FedAvg)."""
+
+    def __init__(self, endpoint, token=None):
+        import socket
+        import uuid
+
+        from .ps_server import _default_token
+
+        host, port = endpoint.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=330)
+        tok = (_default_token() if token is None else str(token)).encode()
+        _send_all(self._sock, _MAGIC + struct.pack("<H", len(tok)) + tok)
+        resp = _read_frame(self._sock)
+        if not resp or resp[0] != 0:
+            raise ConnectionError("fl server rejected handshake")
+        self.round = 0
+        self._client_id = uuid.uuid4().bytes    # round-contribution key
+
+    def _req(self, payload):
+        _send_all(self._sock, _frame(payload))
+        resp = _read_frame(self._sock)
+        if not resp or resp[0] != 0:
+            raise RuntimeError(
+                "fl server error: %s"
+                % (resp[1:].decode("utf-8", "replace") if resp
+                   else "connection closed"))
+        return resp[1:]
+
+    def pull(self):
+        body = self._req(bytes([_GET]))
+        (self.round,) = struct.unpack_from("<I", body, 0)
+        params, _ = _unpack_params(body, 4)
+        return params
+
+    def push(self, params, weight=1.0):
+        self._req(bytes([_PUT]) +
+                  struct.pack("<Id", self.round, float(weight)) +
+                  self._client_id + _pack_params(params))
+
+    def close(self):
+        self._sock.close()

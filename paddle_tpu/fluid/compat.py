@@ -46,7 +46,8 @@ class CompatibleInfo:
 # listen_and_serv is run specially by the Executor (a host serving
 # loop, executor.py), not via a lowering rule — structural too.
 _STRUCTURAL_OPS = frozenset({"feed", "fetch", "autodiff", "save", "load",
-                             "py_func", "listen_and_serv"})
+                             "py_func", "listen_and_serv",
+                             "fl_listen_and_serv"})
 
 
 def check_program_compatible(program, version=None):

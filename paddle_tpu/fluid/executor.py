@@ -174,6 +174,27 @@ class Executor:
 
                 build_server_from_attrs(op.attrs).serve_forever()
                 return []
+            if op.type == "fl_listen_and_serv":
+                # federated variant (reference fl_listen_and_serv_op):
+                # initial params come from this scope's vars by name
+                from ..distributed.fl_server import FLServer
+
+                params = {}
+                for name in op.attr("param_names"):
+                    val = scope.find_var(name)
+                    if val is None:
+                        raise RuntimeError(
+                            "fl_listen_and_serv param %r not in scope — "
+                            "run the startup program first" % name)
+                    params[name] = np.asarray(val)
+                host, port = op.attr("endpoint").rsplit(":", 1)
+                srv = FLServer(params, op.attr("n_trainers"),
+                               host=host, port=int(port))
+                try:
+                    srv.serve_forever()
+                finally:
+                    srv.stop()
+                return []
             if op.type == "py_reader_dequeue":
                 from .layers.py_reader import _READERS
 

@@ -1,0 +1,156 @@
+"""Federated pserver variant (reference fl_listen_and_serv_op.cc
+RunSyncLoop): round-synchronous FedAvg — trainers pull params, train
+locally, push weighted copies; the server merges when all arrive."""
+
+import threading
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.distributed.fl_server import (FLServer, FLTrainerClient,
+                                              build_fl_server_program)
+
+
+def test_fl_fedavg_rounds():
+    srv = FLServer({"w": np.zeros(4, np.float32)}, n_trainers=2)
+    try:
+        results = {}
+
+        def trainer(tid, delta, weight):
+            c = FLTrainerClient(srv.endpoint, token=srv.token)
+            traj = []
+            for _ in range(3):
+                p = c.pull()["w"]
+                local = p + delta          # "train locally"
+                c.push({"w": local}, weight=weight)
+                traj.append(p.copy())
+            results[tid] = traj
+            c.close()
+
+        t0 = threading.Thread(target=trainer, args=(0, 1.0, 1.0))
+        t1 = threading.Thread(target=trainer, args=(1, 4.0, 3.0))
+        t0.start(), t1.start()
+        t0.join(30), t1.join(30)
+        assert not t0.is_alive() and not t1.is_alive()
+        # weighted FedAvg per round: merged delta = (1*1 + 3*4)/4 = 3.25
+        for traj in results.values():
+            np.testing.assert_allclose(
+                [t[0] for t in traj], [0.0, 3.25, 6.5], rtol=1e-6)
+        np.testing.assert_allclose(srv.params["w"],
+                                   np.full(4, 9.75, np.float32))
+        assert srv.round == 3
+    finally:
+        srv.stop()
+
+
+def test_fl_stale_round_nacks():
+    srv = FLServer({"w": np.zeros(2, np.float32)}, n_trainers=1)
+    try:
+        a = FLTrainerClient(srv.endpoint, token=srv.token)
+        a.pull()
+        a.push({"w": np.ones(2, np.float32)})       # round 0 done
+        b = FLTrainerClient(srv.endpoint, token=srv.token)
+        b.round = 0                                  # desynced trainer
+        try:
+            b.push({"w": np.zeros(2, np.float32)})
+            raise AssertionError("stale push must NACK")
+        except RuntimeError as e:
+            assert "stale round" in str(e)
+        a.close(), b.close()
+    finally:
+        srv.stop()
+
+
+def test_fl_malformed_and_duplicate_pushes():
+    """A malformed PUT (missing/mis-sized param) NACKs without touching
+    round state, and a retried push from the SAME client replaces its
+    contribution instead of completing the round alone."""
+    srv = FLServer({"w": np.zeros(3, np.float32)}, n_trainers=2)
+    try:
+        a = FLTrainerClient(srv.endpoint, token=srv.token)
+        a.pull()
+        try:
+            a.push({"bogus": np.ones(3, np.float32)})
+            raise AssertionError("malformed push must NACK")
+        except RuntimeError as e:
+            assert "missing param" in str(e)
+        try:
+            a.push({"w": np.ones(7, np.float32)})
+            raise AssertionError("mis-sized push must NACK")
+        except RuntimeError as e:
+            assert "size" in str(e)
+        assert srv.round == 0 and not srv._pending
+
+        # same-client retry must REPLACE, not double-count: a pushes
+        # 2.0, then a RETRY on a fresh connection with the SAME client
+        # id pushes 6.0 — the round must still wait for a second
+        # trainer, and the merge must use the replaced value
+        done = {}
+
+        def push_as(key, client, val):
+            client.push({"w": np.full(3, val, np.float32)})
+            done[key] = True
+
+        t1 = threading.Thread(target=push_as, args=("a1", a, 2.0),
+                              daemon=True)
+        t1.start()
+        t1.join(0.5)
+        assert t1.is_alive(), "single client completed a 2-trainer round"
+        a_retry = FLTrainerClient(srv.endpoint, token=srv.token)
+        a_retry._client_id = a._client_id
+        a_retry.round = 0
+        t2 = threading.Thread(target=push_as, args=("a2", a_retry, 6.0),
+                              daemon=True)
+        t2.start()
+        t2.join(0.5)
+        assert t2.is_alive(), "same-client retry was double-counted"
+        b = FLTrainerClient(srv.endpoint, token=srv.token)
+        b.round = 0
+        b.push({"w": np.full(3, 4.0, np.float32)})
+        t1.join(10), t2.join(10)
+        assert done.get("a1") and done.get("a2") and srv.round == 1
+        # merge of {a: 6.0 (replaced), b: 4.0} — 3.0 would mean the
+        # stale 2.0 survived, 4.0 would mean a double-counted round
+        np.testing.assert_allclose(srv.params["w"],
+                                   np.full(3, 5.0, np.float32))
+        a.close(), a_retry.close(), b.close()
+    finally:
+        srv.stop()
+
+
+def test_fl_listen_and_serv_program():
+    """An Executor serving an fl_listen_and_serv program behaves like
+    the reference pserver: blocks, serves rounds from scope-held
+    params, and stops when the server is severed."""
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        scope.set_var("fc_w", np.full(3, 2.0, np.float32))
+    # bind the port ourselves via a probe server to avoid TOCTOU
+    probe = FLServer({"x": np.zeros(1, np.float32)}, 1)
+    ep, tok = probe.endpoint, probe.token
+    probe.stop()
+    prog = build_fl_server_program(ep, 1, ["fc_w"])
+    assert any(op.type == "fl_listen_and_serv"
+               for op in prog.global_block().ops)
+
+    holder = {}
+
+    def serve():
+        exe = fluid.Executor()
+        with fluid.scope_guard(scope):
+            exe.run(prog)
+        holder["done"] = True
+
+    th = threading.Thread(target=serve, daemon=True)
+    th.start()
+    from paddle_tpu.distributed import wait_server_ready
+
+    wait_server_ready([ep])
+    import os
+
+    c = FLTrainerClient(ep, token=os.environ.get("PADDLE_PS_TOKEN"))
+    p = c.pull()
+    np.testing.assert_allclose(p["fc_w"], np.full(3, 2.0))
+    c.push({"fc_w": p["fc_w"] * 2})
+    np.testing.assert_allclose(c.pull()["fc_w"], np.full(3, 4.0))
+    c.close()
