@@ -19,7 +19,13 @@ import numpy as np
 from .ps_server import (_MAGIC, FramedServer, _frame, _pack_arr,
                         _read_frame, _send_all, _unpack_arr)
 
-__all__ = ["FLServer", "FLTrainerClient", "build_fl_server_program"]
+__all__ = ["FLServer", "FLTrainerClient", "build_fl_server_program",
+           "SERVING"]
+
+# endpoint -> FLServer for programs currently served by an Executor, so
+# an operator (or test) can stop a blocking serve loop — the reference
+# stops its pservers with a signal handler (FlSignalHandler)
+SERVING = {}
 
 
 def build_fl_server_program(endpoint, n_trainers, param_names):
@@ -136,7 +142,12 @@ class FLServer(FramedServer):
                             ok = self._cv.wait_for(
                                 lambda: self.round >= target or
                                 self._stop.is_set(), timeout=300)
-                            if not ok or self._stop.is_set():
+                            if not ok or (self._stop.is_set() and
+                                          self.round < target):
+                                # the trainer is TOLD this push failed —
+                                # withdraw it so a retry (fresh uuid
+                                # after a crash) cannot double-count
+                                self._pending.pop(client, None)
                                 _send_all(conn, _frame(
                                     b"\x01round never completed"))
                                 continue
@@ -164,6 +175,10 @@ class FLServer(FramedServer):
         self._accept_thread.join()
 
     def stop(self):
+        # set the stop flag BEFORE notifying: a waiter that wakes and
+        # re-checks its predicate must observe it (else it sleeps out
+        # the full wait_for timeout with nothing left to notify)
+        self._stop.set()
         with self._cv:
             self._cv.notify_all()
         super().stop()

@@ -41,7 +41,16 @@ class Layer:
             Constant(0.0) if is_bias else Xavier()
         )
         value = _run_initializer(init, shape, dtype)
-        p = VarBase(value, name=attr.name, stop_gradient=not attr.trainable,
+        # parameters get STABLE generated names (reference
+        # layer_object_helper naming): "<layer>.w_k" from the layer's
+        # unique-name scope rather than the raw eager counter, so
+        # name-keyed state (optimizer accumulators) survives a
+        # rebuild-and-restore under the same unique_name scope
+        from .. import unique_name
+
+        name = attr.name or unique_name.generate(
+            "%s.%s" % (self._full_name, "b" if is_bias else "w"))
+        p = VarBase(value, name=name, stop_gradient=not attr.trainable,
                     persistable=True)
         p.trainable = attr.trainable
         p.regularizer = attr.regularizer

@@ -187,13 +187,17 @@ class Executor:
                             "fl_listen_and_serv param %r not in scope — "
                             "run the startup program first" % name)
                     params[name] = np.asarray(val)
+                from ..distributed import fl_server as _fl
+
                 host, port = op.attr("endpoint").rsplit(":", 1)
                 srv = FLServer(params, op.attr("n_trainers"),
                                host=host, port=int(port))
+                _fl.SERVING[srv.endpoint] = srv
                 try:
                     srv.serve_forever()
                 finally:
                     srv.stop()
+                    _fl.SERVING.pop(srv.endpoint, None)
                 return []
             if op.type == "py_reader_dequeue":
                 from .layers.py_reader import _READERS

@@ -183,13 +183,68 @@ class Optimizer:
         st = self._eager_state.get(id(p))
         if st is None:
             st = {}
+            pending = getattr(self, "_loaded_state", None) or {}
             for name, init in names_and_init:
-                if np.isscalar(init):
+                key = "%s@%s" % (p.name, name)
+                if key in pending:          # set_dict restore, by name
+                    st[name] = jnp.asarray(pending.pop(key))
+                elif np.isscalar(init):
                     st[name] = jnp.full((1,), init, dtype=p._ivar.dtype)
                 else:
                     st[name] = jnp.full(p._ivar.shape, 0.0, dtype=p._ivar.dtype)
             self._eager_state[id(p)] = st
+            if not hasattr(self, "_eager_names"):
+                self._eager_names = {}
+            self._eager_names[id(p)] = p.name
         return st
+
+    # -- dygraph checkpointing (reference optimizer.py:100 state_dict /
+    # :131 set_dict): eager accumulators keyed "<param>@<slot>", plus
+    # global_step when the LR is a LearningRateDecay object ------------
+    def state_dict(self):
+        if not framework.in_dygraph_mode():
+            raise RuntimeError(
+                "optimizer.state_dict() is dygraph-only; static graph "
+                "optimizer state lives in scope persistables "
+                "(fluid.io.save)")
+        # still-pending restored state (set_dict before any minimize)
+        # must survive a re-save — it simply hasn't allocated yet
+        out = dict(getattr(self, "_loaded_state", None) or {})
+        names = getattr(self, "_eager_names", {})
+        for pid, st in getattr(self, "_eager_state", {}).items():
+            for slot, arr in st.items():
+                out["%s@%s" % (names[pid], slot)] = np.asarray(arr)
+        from .dygraph.learning_rate_scheduler import LearningRateDecay
+
+        if isinstance(self._learning_rate, LearningRateDecay):
+            out["global_step"] = np.asarray(
+                [self._learning_rate.step_num], np.int64)
+        return out
+
+    def set_dict(self, state_dict):
+        """Restore from ``state_dict``. Accumulators apply lazily by
+        param NAME at first use (eager state allocates on first
+        minimize); global_step steps the LR decay object now."""
+        state = dict(state_dict)
+        gs = state.pop("global_step", None)
+        if gs is not None:
+            from .dygraph.learning_rate_scheduler import LearningRateDecay
+
+            if isinstance(self._learning_rate, LearningRateDecay):
+                self._learning_rate.step_num = int(
+                    np.asarray(gs).ravel()[0])
+        self._loaded_state = state
+        # already-allocated eager state updates in place
+        names = getattr(self, "_eager_names", {})
+        for pid, st in getattr(self, "_eager_state", {}).items():
+            for slot in list(st):
+                key = "%s@%s" % (names[pid], slot)
+                if key in self._loaded_state:
+                    import jax.numpy as jnp
+
+                    st[slot] = jnp.asarray(self._loaded_state.pop(key))
+
+    set_state_dict = set_dict
 
     def _eager_update(self, p, g, lr):
         raise NotImplementedError(

@@ -154,3 +154,9 @@ def test_fl_listen_and_serv_program():
     c.push({"fc_w": p["fc_w"] * 2})
     np.testing.assert_allclose(c.pull()["fc_w"], np.full(3, 4.0))
     c.close()
+    # stopping the served instance unblocks the Executor promptly
+    from paddle_tpu.distributed import fl_server as fl_mod
+
+    fl_mod.SERVING[ep].stop()
+    th.join(10)
+    assert holder.get("done"), "exe.run(fl program) did not return"
