@@ -11,20 +11,42 @@ __all__ = ["save_dygraph", "load_dygraph"]
 
 
 def save_dygraph(state_dict, model_path):
+    """Parameter dicts save as ``.pdparams``; anything else (an
+    optimizer ``state_dict``, whose values are plain arrays) as
+    ``.pdopt`` — the reference's suffix rule (``checkpoint.py:66``)."""
     from ..core import tensor_io
 
+    if not state_dict:
+        # the reference asserts the same — an empty dict would pick the
+        # .pdparams suffix and clobber a model checkpoint at this prefix
+        raise ValueError("state_dict is empty, nothing to save (an "
+                         "SGD-with-float-LR optimizer has no state)")
+    suffix = ".pdparams"
+    for v in state_dict.values():
+        if not isinstance(v, VarBase):
+            suffix = ".pdopt"
+        break          # first value decides, like the reference
     arrays = {}
     for k, v in state_dict.items():
         arrays[k] = v.numpy() if isinstance(v, VarBase) else np.asarray(v)
     os.makedirs(os.path.dirname(model_path) or ".", exist_ok=True)
-    tensor_io.save_combine(model_path + ".pdparams", arrays)
+    tensor_io.save_combine(model_path + suffix, arrays)
 
 
 def load_dygraph(model_path):
-    path = model_path + ".pdparams"
-    if not os.path.exists(path):
-        raise FileNotFoundError(path)
-    # PTC1 (native serde) or legacy npz — same dispatch as fluid.io
+    """Returns ``(param_dict, opt_dict)``; either may be None when its
+    file is absent (the reference requires .pdparams — relaxed here so
+    an optimizer-only prefix loads too)."""
     from ..io import _load_combined
 
-    return _load_combined(path), None
+    para, opti = None, None
+    ppath = model_path + ".pdparams"
+    opath = model_path + ".pdopt"
+    if os.path.exists(ppath):
+        # PTC1 (native serde) or legacy npz — same dispatch as fluid.io
+        para = _load_combined(ppath)
+    if os.path.exists(opath):
+        opti = _load_combined(opath)
+    if para is None and opti is None:
+        raise FileNotFoundError(ppath)
+    return para, opti

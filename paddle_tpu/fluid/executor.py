@@ -189,15 +189,21 @@ class Executor:
                     params[name] = np.asarray(val)
                 from ..distributed import fl_server as _fl
 
-                host, port = op.attr("endpoint").rsplit(":", 1)
+                configured = op.attr("endpoint")
+                host, port = configured.rsplit(":", 1)
                 srv = FLServer(params, op.attr("n_trainers"),
                                host=host, port=int(port))
-                _fl.SERVING[srv.endpoint] = srv
+                # register under BOTH the endpoint the program named and
+                # the socket's resolved one (getsockname may differ,
+                # e.g. localhost vs 127.0.0.1)
+                for key in {configured, srv.endpoint}:
+                    _fl.SERVING[key] = srv
                 try:
                     srv.serve_forever()
                 finally:
                     srv.stop()
-                    _fl.SERVING.pop(srv.endpoint, None)
+                    for key in {configured, srv.endpoint}:
+                        _fl.SERVING.pop(key, None)
                 return []
             if op.type == "py_reader_dequeue":
                 from .layers.py_reader import _READERS

@@ -40,6 +40,12 @@ class Optimizer:
         self._accumulators = {}  # acc_name -> {param_name: var}
         self._lr_var = None
         self.type = self.__class__.__name__.replace("Optimizer", "").lower()
+        # dygraph (eager) optimizer state: per-param accumulators, their
+        # names for state_dict keys, and checkpoint state restored by
+        # set_dict awaiting first allocation
+        self._eager_state = {}
+        self._eager_names = {}
+        self._loaded_state = {}
 
     # -- learning rate ------------------------------------------------------
     def _create_global_learning_rate(self):
@@ -150,8 +156,6 @@ class Optimizer:
             loss.backward()
         import jax.numpy as jnp
 
-        if not hasattr(self, "_eager_state"):
-            self._eager_state = {}
         if isinstance(self._learning_rate, VarBase):
             lr = float(self._learning_rate.numpy().reshape(-1)[0])
         elif callable(self._learning_rate):
@@ -183,7 +187,7 @@ class Optimizer:
         st = self._eager_state.get(id(p))
         if st is None:
             st = {}
-            pending = getattr(self, "_loaded_state", None) or {}
+            pending = self._loaded_state
             for name, init in names_and_init:
                 key = "%s@%s" % (p.name, name)
                 if key in pending:          # set_dict restore, by name
@@ -193,8 +197,6 @@ class Optimizer:
                 else:
                     st[name] = jnp.full(p._ivar.shape, 0.0, dtype=p._ivar.dtype)
             self._eager_state[id(p)] = st
-            if not hasattr(self, "_eager_names"):
-                self._eager_names = {}
             self._eager_names[id(p)] = p.name
         return st
 
@@ -209,9 +211,9 @@ class Optimizer:
                 "(fluid.io.save)")
         # still-pending restored state (set_dict before any minimize)
         # must survive a re-save — it simply hasn't allocated yet
-        out = dict(getattr(self, "_loaded_state", None) or {})
-        names = getattr(self, "_eager_names", {})
-        for pid, st in getattr(self, "_eager_state", {}).items():
+        out = dict(self._loaded_state)
+        names = self._eager_names
+        for pid, st in self._eager_state.items():
             for slot, arr in st.items():
                 out["%s@%s" % (names[pid], slot)] = np.asarray(arr)
         from .dygraph.learning_rate_scheduler import LearningRateDecay
@@ -233,10 +235,18 @@ class Optimizer:
             if isinstance(self._learning_rate, LearningRateDecay):
                 self._learning_rate.step_num = int(
                     np.asarray(gs).ravel()[0])
+            else:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "set_dict: checkpoint carries global_step=%d but "
+                    "this optimizer's learning_rate is not a "
+                    "LearningRateDecay object — the schedule position "
+                    "is dropped", int(np.asarray(gs).ravel()[0]))
         self._loaded_state = state
         # already-allocated eager state updates in place
-        names = getattr(self, "_eager_names", {})
-        for pid, st in getattr(self, "_eager_state", {}).items():
+        names = self._eager_names
+        for pid, st in self._eager_state.items():
             for slot in list(st):
                 key = "%s@%s" % (names[pid], slot)
                 if key in self._loaded_state:

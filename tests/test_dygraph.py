@@ -294,11 +294,13 @@ def test_optimizer_state_dict_roundtrip_with_lr_decay():
         import tempfile
 
         path = tempfile.mkdtemp() + "/ckpt"
-        save_dygraph(sd_m, path)
+        save_dygraph(sd_m, path)          # -> ckpt.pdparams
+        save_dygraph(sd_o, path)          # -> ckpt.pdopt (suffix rule)
         m_b, o_b = make()
-        loaded, _ = load_dygraph(path)
+        loaded, loaded_opt = load_dygraph(path)
+        assert loaded_opt is not None and "global_step" in loaded_opt
         m_b.set_dict(loaded)
-        o_b.set_dict(sd_o)
+        o_b.set_dict(loaded_opt)
         assert o_b._learning_rate.step_num == 4
         # a re-save BEFORE the first step must not lose the restored
         # (still-pending) accumulators
