@@ -42,7 +42,9 @@ from .dataset import DatasetFactory  # noqa: F401
 from .reader import DataLoader, PyReader  # noqa: F401
 from .executor import Executor, Scope, global_scope, scope_guard  # noqa: F401
 from .flags import get_flags, set_flags  # noqa: F401
-from .lod import LoDTensor, create_lod_tensor  # noqa: F401
+from .lod import LoDTensor, LoDTensorArray, create_lod_tensor  # noqa: F401
+from .data_feed_desc import DataFeedDesc  # noqa: F401
+from . import incubate  # noqa: F401
 from .framework import (  # noqa: F401
     Program,
     Variable,

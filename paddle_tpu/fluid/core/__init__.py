@@ -26,3 +26,7 @@ def max_memory_allocated(device_index=0):
 def memory_allocated(device_index=0):
     """Current bytes in use on the device."""
     return int(memory_stats(device_index).get("bytes_in_use", 0))
+
+
+# host-side tensor containers (reference binds these from C++ core)
+from ..lod import LoDTensor, LoDTensorArray  # noqa: F401,E402

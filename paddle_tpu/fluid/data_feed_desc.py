@@ -1,0 +1,117 @@
+"""DataFeedDesc — describes multislot training-data format (reference
+``python/paddle/fluid/data_feed_desc.py:21``): a textproto of
+``name``/``batch_size``/``pipe_command`` plus ``multi_slot_desc.slots``
+entries, consumed by the Dataset engine's native parser. The restricted
+grammar is parsed directly (no protobuf codegen — the reference's
+``data_feed.proto`` fields are scalar + one repeated message)."""
+
+import re
+
+__all__ = ["DataFeedDesc"]
+
+_SCALAR = re.compile(r'^\s*(\w+)\s*:\s*(?:"([^"]*)"|(\S+))\s*$')
+
+
+class _Slot:
+    def __init__(self):
+        self.name = ""
+        self.type = "uint64"
+        self.is_dense = False
+        self.is_used = False
+
+    def text(self, indent="    "):
+        return (
+            "%sslots {\n"
+            '%s    name: "%s"\n'
+            '%s    type: "%s"\n'
+            "%s    is_dense: %s\n"
+            "%s    is_used: %s\n"
+            "%s}\n"
+        ) % (indent, indent, self.name, indent, self.type, indent,
+             str(self.is_dense).lower(), indent, str(self.is_used).lower(),
+             indent)
+
+
+class DataFeedDesc:
+    """Parse ``proto_file`` (MultiSlotDataFeed textproto) and expose the
+    reference's mutators; ``desc()`` re-emits the textproto the Dataset
+    engine consumes."""
+
+    def __init__(self, proto_file):
+        self.name = ""
+        self.batch_size = 1
+        self.pipe_command = "cat"
+        self.slots = []
+        self._extra = {}        # unhandled top-level scalars, preserved
+        with open(proto_file) as f:
+            self._parse(f.read())
+        self._index = {s.name: i for i, s in enumerate(self.slots)}
+
+    def _parse(self, text):
+        stack = []      # nesting: "multi_slot_desc" / "slots"
+        cur_slot = None
+        for raw in text.splitlines():
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            if line.endswith("{"):
+                key = line[:-1].strip()
+                stack.append(key)
+                if key == "slots":
+                    cur_slot = _Slot()
+                    self.slots.append(cur_slot)
+                continue
+            if line == "}":
+                if stack and stack.pop() == "slots":
+                    cur_slot = None
+                continue
+            m = _SCALAR.match(line)
+            if not m:
+                raise ValueError("unparseable DataFeedDesc line: %r" % raw)
+            key, sval, bare = m.group(1), m.group(2), m.group(3)
+            val = sval if sval is not None else bare
+            if cur_slot is not None:
+                if key in ("is_dense", "is_used"):
+                    setattr(cur_slot, key, val.lower() == "true")
+                elif key in ("name", "type"):
+                    setattr(cur_slot, key, val)
+            elif key == "batch_size":
+                self.batch_size = int(val)
+            elif key in ("name", "pipe_command"):
+                setattr(self, key, val)
+            else:
+                # preserve unhandled fields (thread_num, fs_name, ...)
+                # verbatim so a parse -> desc() round trip is lossless
+                self._extra[key] = raw.strip()
+
+    def set_batch_size(self, batch_size):
+        self.batch_size = int(batch_size)
+
+    def _each(self, names, fn):
+        for n in names:
+            if n not in self._index:
+                raise ValueError(
+                    "slot %r not found in the DataFeedDesc (have %s)"
+                    % (n, sorted(self._index)))
+            fn(self.slots[self._index[n]])
+
+    def set_dense_slots(self, dense_slots_name):
+        """Mark slots dense (fed as plain Tensors); all slots default
+        sparse, like the reference."""
+        self._each(dense_slots_name,
+                   lambda s: setattr(s, "is_dense", True))
+
+    def set_use_slots(self, use_slots_name):
+        """Mark slots used — only used slots are fed to the program."""
+        self._each(use_slots_name, lambda s: setattr(s, "is_used", True))
+
+    def desc(self):
+        out = ['name: "%s"' % self.name,
+               "batch_size: %d" % self.batch_size,
+               'pipe_command: "%s"' % self.pipe_command]
+        out.extend(self._extra.values())
+        out.append("multi_slot_desc {")
+        for s in self.slots:
+            out.append(s.text().rstrip("\n"))
+        out.append("}")
+        return "\n".join(out) + "\n"

@@ -86,3 +86,36 @@ class LoDTensor:
 def create_lod_tensor(data, recursive_seq_lens, place=None):
     """Reference ``fluid.create_lod_tensor``; ``place`` is advisory."""
     return LoDTensor(data, recursive_seq_lens)
+
+
+class LoDTensorArray(list):
+    """Ordered container of LoDTensors (reference ``core.LoDTensorArray``
+    — a bound ``vector<LoDTensor>`` with ``append``). The in-graph
+    analogue is the bounded TensorArray (``layers.create_array`` +
+    ``array_write``/``array_read``); this host-side type carries arrays
+    between runs, e.g. beam-search outputs. Every insertion path
+    coerces plain arrays, so elements always honor the LoDTensor API."""
+
+    @staticmethod
+    def _coerce(value):
+        return value if isinstance(value, LoDTensor) else LoDTensor(value,
+                                                                    None)
+
+    def __init__(self, iterable=()):
+        super().__init__(self._coerce(v) for v in iterable)
+
+    def append(self, value):
+        super().append(self._coerce(value))
+
+    def extend(self, iterable):
+        super().extend(self._coerce(v) for v in iterable)
+
+    def insert(self, index, value):
+        super().insert(index, self._coerce(value))
+
+    def __setitem__(self, index, value):
+        if isinstance(index, slice):
+            value = [self._coerce(v) for v in value]
+        else:
+            value = self._coerce(value)
+        super().__setitem__(index, value)

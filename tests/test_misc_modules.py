@@ -272,3 +272,79 @@ def test_image_util_oversample_meta_transformer(tmp_path):
     ref = hwc.transpose(2, 0, 1)[[2, 1, 0]] - np.array(
         [1.0, 2.0, 3.0], np.float32)[:, None, None]
     np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+def test_data_feed_desc_roundtrip(tmp_path):
+    """fluid.DataFeedDesc (reference data_feed_desc.py:21): parse the
+    MultiSlotDataFeed textproto, mutate via the reference API, re-emit."""
+    proto = tmp_path / "data.proto"
+    proto.write_text(
+        'name: "MultiSlotDataFeed"\n'
+        "batch_size: 2\n"
+        "multi_slot_desc {\n"
+        "    slots {\n"
+        '         name: "words"\n'
+        '         type: "uint64"\n'
+        "         is_dense: false\n"
+        "         is_used: false\n"
+        "     }\n"
+        "     slots {\n"
+        '         name: "label"\n'
+        '         type: "uint64"\n'
+        "         is_dense: false\n"
+        "         is_used: false\n"
+        "    }\n"
+        "}\n")
+    d = fluid.DataFeedDesc(str(proto))
+    assert d.name == "MultiSlotDataFeed" and d.batch_size == 2
+    d.set_batch_size(128)
+    d.set_dense_slots(["words"])
+    d.set_use_slots(["words", "label"])
+    text = d.desc()
+    assert "batch_size: 128" in text
+    assert text.count("is_used: true") == 2
+    assert text.count("is_dense: true") == 1
+    with pytest.raises(ValueError, match="not found"):
+        d.set_use_slots(["bogus"])
+    # re-parse what we emitted
+    proto2 = tmp_path / "rt.proto"
+    proto2.write_text(text)
+    d2 = fluid.DataFeedDesc(str(proto2))
+    assert d2.batch_size == 128
+    assert [s.is_dense for s in d2.slots] == [True, False]
+
+
+def test_lod_tensor_array():
+    import numpy as np
+
+    arr = fluid.LoDTensorArray()
+    arr.append(fluid.create_lod_tensor(
+        np.ones((3, 2), np.float32), [[2, 1]]))
+    arr.append(np.zeros((2, 2), np.float32))   # coerced
+    assert len(arr) == 2
+    assert all(isinstance(t, fluid.LoDTensor) for t in arr)
+    from paddle_tpu.fluid import core
+    assert core.LoDTensorArray is fluid.LoDTensorArray
+
+
+def test_data_feed_desc_preserves_unknown_fields(tmp_path):
+    p = tmp_path / "d2.proto"
+    p.write_text('name: "MultiSlotDataFeed"\nbatch_size: 4\n'
+                 'thread_num: 7\nfs_name: "hdfs://x"\n'
+                 'multi_slot_desc {\n  slots {\n    name: "a"\n'
+                 '    type: "float"\n    is_dense: true\n'
+                 '    is_used: true\n  }\n}\n')
+    d = fluid.DataFeedDesc(str(p))
+    text = d.desc()
+    assert "thread_num: 7" in text and 'fs_name: "hdfs://x"' in text
+
+
+def test_lod_tensor_array_coerces_every_path():
+    import numpy as np
+
+    a = fluid.LoDTensorArray([np.zeros((2, 2), np.float32)])
+    a.extend([np.ones((1, 2), np.float32)])
+    a.insert(0, np.ones((3, 2), np.float32))
+    a[0] = np.zeros((1, 2), np.float32)
+    a[0:1] = [np.ones((2, 2), np.float32)]
+    assert all(isinstance(t, fluid.LoDTensor) for t in a)
