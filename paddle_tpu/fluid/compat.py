@@ -42,10 +42,10 @@ class CompatibleInfo:
 
 
 # Op types consumed structurally by the executor/autodiff rather than via a
-# lowering rule.
-# listen_and_serv is run specially by the Executor (a host serving
-# loop, executor.py), not via a lowering rule — structural too.
-_STRUCTURAL_OPS = frozenset({"feed", "fetch", "autodiff", "save", "load",
+# lowering rule. (save/load have real lowerings in ops/creation.py; the
+# listen_and_serv pair is run specially by the Executor as host serving
+# loops, executor.py.)
+_STRUCTURAL_OPS = frozenset({"feed", "fetch", "autodiff",
                              "py_func", "listen_and_serv",
                              "fl_listen_and_serv"})
 

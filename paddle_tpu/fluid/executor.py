@@ -22,6 +22,8 @@ SSA-graph executor (``details/fast_threaded_ssa_graph_executor.cc``) is
 replaced by XLA partitioning + ICI collectives.
 """
 
+import os
+
 import numpy as np
 
 from . import framework
@@ -167,6 +169,11 @@ class Executor:
         # pserver Executor (listen_and_serv_op.cc RunSyncLoop). The same
         # scan collects py_reader queues so EOF can surface after the step.
         py_readers = []
+        # save ops are honored in EVERY block (a While body may carry a
+        # checkpoint op); they write once per run, after commit
+        save_ops = [(op.input("X")[0], op.attr("file_path"))
+                    for blk in program.blocks for op in blk.ops
+                    if op.type == "save"]
         for op in block.ops:
             if op.type == "listen_and_serv":
                 from .transpiler.distribute_transpiler import (
@@ -312,6 +319,26 @@ class Executor:
         scope.set_var(RNG_STATE_VAR, new_rng)
         for n, v in new_state.items():
             scope.set_var(n, v)
+
+        if save_ops:
+            # TPU deviation from save_op.cc (which executes at its
+            # program-order position): the whole block runs as ONE
+            # compiled step, so saves always record the POST-step
+            # committed value, and only persistable (scope-held) vars
+            # are saveable. One PTC1 entry per file — exactly what
+            # layers.load reads back.
+            from .core import tensor_io
+
+            for name, path in save_ops:
+                val = scope.find_var(name)
+                if val is None:
+                    raise RuntimeError(
+                        "save op: var %r is not in the scope — only "
+                        "PERSISTABLE vars can be saved (the step "
+                        "commits those; intermediates are fused away "
+                        "by XLA). fetch_list the value instead." % name)
+                os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+                tensor_io.save_combine(path, {name: _fetch_numpy(val)})
 
         if _flags.check_nan_inf_enabled():
             # debug mode (reference FLAGS_check_nan_inf / nan_inf_utils):

@@ -7,7 +7,7 @@ from . import (control_flow, detection, distributions, extras, io,
 from .control_flow import *  # noqa: F401,F403
 from .detection import *  # noqa: F401,F403
 from .extras import *  # noqa: F401,F403
-from .io import data
+from .io import data, load
 from .learning_rate_scheduler import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .metric_op import accuracy, auc

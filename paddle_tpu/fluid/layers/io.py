@@ -3,7 +3,22 @@
 from .. import framework
 from ..layer_helper import LayerHelper
 
-__all__ = ["data"]
+__all__ = ["data", "load"]
+
+
+def load(out, file_path, load_as_fp16=None):
+    """Load a tensor file into ``out`` (reference ``layers/io.py:884``
+    load op). Accepts a PTC1 combined file (first/only entry) or an
+    ``.npy`` written by ``save_vars``. TPU deviation: the file is read
+    at program-lowering time and enters the compiled step as a
+    constant — the reference's executor re-reads per run, but the op's
+    canonical use is startup-program initialization, which runs once."""
+    helper = LayerHelper("load", name=None)
+    attrs = {"file_path": file_path}
+    if load_as_fp16 is not None:
+        attrs["load_as_fp16"] = bool(load_as_fp16)
+    helper.append_op(type="load", inputs={}, outputs={"Out": [out]},
+                     attrs=attrs)
 
 
 def data(name, shape, dtype="float32", append_batch_size=True,

@@ -7,6 +7,8 @@ Parity: reference ``operators/fill_constant_op.cc``, ``uniform_random_op.cc``,
 threaded PRNG stream (see ``registry.LowerCtx.next_rng``).
 """
 
+import os
+
 import numpy as np
 
 from ..registry import register
@@ -131,6 +133,49 @@ def _assign_value(ctx, op):
     shape = _shape_attr(ctx, op)
     values = op.attr("values")
     ctx.set_output(op, "Out", jnp.asarray(values, dtype=dtype).reshape(shape))
+
+
+@register("load")
+def _load_tensor_file(ctx, op):
+    """Reference ``load_op.cc``: read one tensor from disk into Out.
+    TPU design: the read happens at lowering (trace) time, so the value
+    enters the compiled step as a constant — create the file BEFORE
+    building/running the program (the op's canonical home is a startup
+    program, which runs once)."""
+    import jax.numpy as jnp
+
+    path = op.attr("file_path")
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            "layers.load: tensor file %r does not exist at lowering "
+            "time (write it before building/running the program)" % path)
+    with open(path, "rb") as f:
+        magic = f.read(4)
+    if magic in (b"PTC1", b"PK\x03\x04"):        # native serde / npz
+        from ..io import _load_combined
+
+        entries = _load_combined(path)
+        if len(entries) != 1:
+            raise ValueError(
+                "layers.load expects ONE tensor in %r, found %d "
+                "(use fluid.io.load_vars for combined files)"
+                % (path, len(entries)))
+        (arr,) = entries.values()
+    else:
+        arr = np.load(path, allow_pickle=False)   # plain .npy
+    if op.attr("load_as_fp16", False):
+        arr = np.asarray(arr, np.float16)
+    ctx.set_output(op, "Out", jnp.asarray(arr))
+
+
+@register("save")
+def _save_tensor_file(ctx, op):
+    """Reference ``save_op.cc`` capability: persist X to file_path. TPU
+    deviation: the whole block is ONE compiled step, so the Executor
+    performs the write AFTER the step commits — the file always holds
+    the post-step value regardless of the op's position, and only
+    persistable vars are saveable (executor.py run). The lowering is a
+    no-op pass-through so programs containing save ops compile."""
 
 
 @register("range")

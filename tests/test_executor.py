@@ -171,3 +171,56 @@ def test_py_reader_loop_reference_shape():
             first_losses.append(losses[0])
     # epoch 2 revisits batch 0 with trained weights
     assert first_losses[1] < first_losses[0], first_losses
+
+
+def test_save_load_ops_roundtrip(tmp_path):
+    """The save/load op pair (reference save_op.cc / load_op.cc): a
+    program's save op writes the POST-step value after commit; a second
+    program's load op (fluid.layers.load) reads it back as a constant
+    of the compiled step."""
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+
+    path = str(tmp_path / "w.ptc")
+    main, st = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, st):
+        x = layers.data("slx", [3])
+        w = layers.create_parameter(
+            [3], "float32",
+            default_initializer=fluid.initializer.Constant(2.0))
+        y = layers.reduce_sum(layers.elementwise_mul(x, w))
+        # append a save op for the PARAM — written after the step runs
+        main.current_block().append_op(
+            "save", inputs={"X": [w]}, outputs={},
+            attrs={"file_path": path})
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(y)
+    exe = fluid.Executor()
+    feed = {"slx": np.ones((1, 3), np.float32)}
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(st)
+        exe.run(main, feed=feed, fetch_list=[y])
+        expect = np.asarray(fluid.global_scope().find_var(w.name))
+    # post-step value: 2.0 - 0.1*1 = 1.9
+    np.testing.assert_allclose(expect, np.full(3, 1.9, np.float32))
+
+    main2, st2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, st2):
+        t = layers.create_tensor("float32")
+        layers.load(t, path)
+        out = layers.scale(t, scale=10.0)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(st2)
+        (r,) = exe.run(main2, fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(r), np.full(3, 19.0), rtol=1e-6)
+    # missing file fails loudly when the program is lowered (build-time
+    # shape inference is best-effort and defers; the run must raise)
+    main3, st3 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main3, st3):
+        t3 = layers.create_tensor("float32")
+        layers.load(t3, str(tmp_path / "absent.ptc"))
+        out3 = layers.scale(t3, scale=2.0)
+    with fluid.scope_guard(fluid.Scope()):
+        with pytest.raises(Exception, match="does not exist"):
+            exe.run(main3, fetch_list=[out3])
