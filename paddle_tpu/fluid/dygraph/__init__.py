@@ -1,7 +1,9 @@
 """DyGraph (eager) mode — reference ``python/paddle/fluid/dygraph/``."""
 
-from . import (base, checkpoint, jit, layers, learning_rate_scheduler, nn,
-               parallel)
+from . import (backward_strategy, base, checkpoint, container, jit, layers,
+               learning_rate_scheduler, nn, parallel)
+from .backward_strategy import BackwardStrategy  # noqa: F401
+from .container import Sequential  # noqa: F401
 from .parallel import DataParallel, ParallelEnv, prepare_context  # noqa: F401
 from .learning_rate_scheduler import (  # noqa: F401
     CosineDecay,

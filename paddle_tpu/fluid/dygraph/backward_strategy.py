@@ -1,0 +1,16 @@
+"""BackwardStrategy (reference ``dygraph/backward_strategy.py`` — a
+bound C++ struct with one knob)."""
+
+__all__ = ["BackwardStrategy"]
+
+
+class BackwardStrategy:
+    """``sort_sum_gradient``: the reference sums a var's gradient
+    contributions in a deterministic (sorted) order when True. The TPU
+    tape replays in recorded order and accumulates with jnp adds inside
+    one compiled step, so gradient accumulation here is ALWAYS
+    deterministic — the knob is accepted for API parity and recorded,
+    but both settings produce the same (deterministic) result."""
+
+    def __init__(self):
+        self.sort_sum_gradient = False

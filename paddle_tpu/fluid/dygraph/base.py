@@ -137,7 +137,10 @@ class VarBase:
         self._ivar = jnp.asarray(value, dtype=self._ivar.dtype)
 
     # -- autograd -----------------------------------------------------------
-    def backward(self):
+    def backward(self, backward_strategy=None):
+        # backward_strategy (reference BackwardStrategy) is accepted for
+        # parity; tape replay is always deterministic (see
+        # backward_strategy.py), so sort_sum_gradient changes nothing
         tracer = framework._dygraph_tracer()
         if tracer is None:
             raise RuntimeError("backward() outside dygraph guard")

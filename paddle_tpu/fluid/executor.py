@@ -169,11 +169,22 @@ class Executor:
         # pserver Executor (listen_and_serv_op.cc RunSyncLoop). The same
         # scan collects py_reader queues so EOF can surface after the step.
         py_readers = []
-        # save ops are honored in EVERY block (a While body may carry a
-        # checkpoint op); they write once per run, after commit
+        # save ops write once per run, after commit — which is only
+        # truthful at the top level. Inside control flow (a cond branch
+        # that may not run, a While body that may run 0 or N times) a
+        # host file write cannot follow the predicate from within one
+        # compiled step, so refuse rather than silently firing.
         save_ops = [(op.input("X")[0], op.attr("file_path"))
-                    for blk in program.blocks for op in blk.ops
-                    if op.type == "save"]
+                    for op in block.ops if op.type == "save"]
+        for blk in program.blocks:
+            if blk is not block and any(op.type == "save"
+                                        for op in blk.ops):
+                raise RuntimeError(
+                    "a save op inside a control-flow sub-block is not "
+                    "supported: the compiled step cannot conditionally "
+                    "write host files — move the save op to the global "
+                    "block or checkpoint from the host loop "
+                    "(fluid.io.save)")
         for op in block.ops:
             if op.type == "listen_and_serv":
                 from .transpiler.distribute_transpiler import (

@@ -135,20 +135,22 @@ def _assign_value(ctx, op):
     ctx.set_output(op, "Out", jnp.asarray(values, dtype=dtype).reshape(shape))
 
 
-@register("load")
-def _load_tensor_file(ctx, op):
-    """Reference ``load_op.cc``: read one tensor from disk into Out.
-    TPU design: the read happens at lowering (trace) time, so the value
-    enters the compiled step as a constant — create the file BEFORE
-    building/running the program (the op's canonical home is a startup
-    program, which runs once)."""
-    import jax.numpy as jnp
+# (path) -> (mtime, size, array): one load op is lowered at least twice
+# (build-time shape inference under eval_shape, then the executor's jit
+# trace) — memoizing by file identity avoids re-reading a potentially
+# multi-GB tensor file, while an mtime/size change (file rewritten
+# between build and run) still triggers a fresh read. Bounded: entries
+# evict once consumed by a newer path.
+_LOAD_CACHE = {}
+_LOAD_CACHE_MAX = 4
 
-    path = op.attr("file_path")
-    if not os.path.exists(path):
-        raise FileNotFoundError(
-            "layers.load: tensor file %r does not exist at lowering "
-            "time (write it before building/running the program)" % path)
+
+def _read_tensor_file(path):
+    st = os.stat(path)
+    key = (st.st_mtime_ns, st.st_size)
+    hit = _LOAD_CACHE.get(path)
+    if hit is not None and hit[0] == key:
+        return hit[1]
     with open(path, "rb") as f:
         magic = f.read(4)
     if magic in (b"PTC1", b"PK\x03\x04"):        # native serde / npz
@@ -163,6 +165,27 @@ def _load_tensor_file(ctx, op):
         (arr,) = entries.values()
     else:
         arr = np.load(path, allow_pickle=False)   # plain .npy
+    while len(_LOAD_CACHE) >= _LOAD_CACHE_MAX:
+        _LOAD_CACHE.pop(next(iter(_LOAD_CACHE)))
+    _LOAD_CACHE[path] = (key, arr)
+    return arr
+
+
+@register("load")
+def _load_tensor_file(ctx, op):
+    """Reference ``load_op.cc``: read one tensor from disk into Out.
+    TPU design: the read happens at lowering (trace) time, so the value
+    enters the compiled step as a constant — create the file BEFORE
+    building/running the program (the op's canonical home is a startup
+    program, which runs once)."""
+    import jax.numpy as jnp
+
+    path = op.attr("file_path")
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            "layers.load: tensor file %r does not exist at lowering "
+            "time (write it before building/running the program)" % path)
+    arr = _read_tensor_file(path)
     if op.attr("load_as_fp16", False):
         arr = np.asarray(arr, np.float16)
     ctx.set_output(op, "Out", jnp.asarray(arr))

@@ -311,3 +311,37 @@ def test_optimizer_state_dict_roundtrip_with_lr_decay():
         got = [p.numpy().copy() for p in m_b.parameters()]
     for a, b in zip(got, ref):
         np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_sequential_and_backward_strategy():
+    """dygraph.Sequential (reference container.py:20) chains sublayers
+    in order (positional and (name, layer) forms, mutation protocol);
+    BackwardStrategy is accepted by backward()."""
+    with dygraph.guard():
+        m = dygraph.Sequential(nn.Linear(4, 8, act="relu"),
+                               nn.Linear(8, 2))
+        x = to_variable(np.random.RandomState(0)
+                        .rand(3, 4).astype(np.float32))
+        out = m(x)
+        assert out.numpy().shape == (3, 2)
+        assert len(m) == 2 and isinstance(m[0], nn.Linear)
+        # named form + replacement
+        m2 = dygraph.Sequential(("a", nn.Linear(4, 4)),
+                                ("b", nn.Linear(4, 2)))
+        m2["b"] = nn.Linear(4, 3)
+        assert m2(x).numpy().shape == (3, 3)
+        del m2["a"]
+        assert len(m2) == 1
+        # parameters flow through the container for the optimizer
+        assert len(m.parameters()) == 4
+        bs = dygraph.BackwardStrategy()
+        bs.sort_sum_gradient = True
+        x.stop_gradient = False
+        y = m(x)
+        s = (y * y)._binary(y, "elementwise_mul")
+        tracer = fluid.framework._dygraph_tracer()
+        (loss,) = tracer.trace_op("reduce_sum", {"X": [s]}, ["Out"],
+                                  {"reduce_all": True, "dim": [0],
+                                   "keep_dim": False})
+        loss.backward(bs)
+        assert m.parameters()[0].gradient() is not None
