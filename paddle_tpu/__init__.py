@@ -11,3 +11,16 @@ from . import fluid  # noqa: F401
 from . import inference  # noqa: F401
 from . import fs  # noqa: F401
 from . import utils  # noqa: F401
+from . import compat  # noqa: F401
+from . import dataset  # noqa: F401
+from . import distributed  # noqa: F401
+from . import reader  # noqa: F401
+from . import sysconfig  # noqa: F401
+from . import version  # noqa: F401
+from .reader.decorator import batch  # noqa: F401
+
+
+def check_import_scipy(_os_name=None):
+    """Reference windows-only scipy import diagnostic — scipy imports
+    cleanly on this platform; kept for API parity."""
+    return True

@@ -1,0 +1,58 @@
+"""paddle.compat (reference ``python/paddle/compat.py``): string/number
+helpers the 1.x API exposed for python2/3 portability. Python 3 only
+here, so the conversions are straightforward — kept because user code
+written against the reference calls them."""
+
+import math
+
+__all__ = ["long_type", "to_text", "to_bytes", "round", "floor_division",
+           "get_exception_message"]
+
+long_type = int
+
+
+def _convert(obj, fn, inplace):
+    if obj is None:
+        return obj
+    if isinstance(obj, (list, set)):
+        if inplace:
+            items = [_convert(o, fn, False) for o in obj]
+            obj.clear()
+            (obj.extend if isinstance(obj, list) else obj.update)(items)
+            return obj
+        return type(obj)(_convert(o, fn, False) for o in obj)
+    return fn(obj)
+
+
+def to_text(obj, encoding="utf-8", inplace=False):
+    """bytes → str (lists/sets convert elementwise, optionally in
+    place); everything else passes through."""
+    return _convert(
+        obj, lambda o: o.decode(encoding) if isinstance(o, bytes) else o,
+        inplace)
+
+
+def to_bytes(obj, encoding="utf-8", inplace=False):
+    """str → bytes, the inverse of ``to_text``."""
+    return _convert(
+        obj, lambda o: o.encode(encoding) if isinstance(o, str) else o,
+        inplace)
+
+
+def round(x, d=0):
+    """Python-2-style round (half away from zero), which the reference
+    preserved across interpreter versions."""
+    p = 10 ** d
+    if x > 0:
+        return float(math.floor((x * p) + math.copysign(0.5, x))) / p
+    if x < 0:
+        return float(math.ceil((x * p) + math.copysign(0.5, x))) / p
+    return math.copysign(0.0, x)
+
+
+def floor_division(x, y):
+    return x // y
+
+
+def get_exception_message(exc):
+    return str(exc)

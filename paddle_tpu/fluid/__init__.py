@@ -183,4 +183,4 @@ def require_version(min_version, max_version=None):
             % (_TRACKED_VERSION, max_version))
 
 
-_TRACKED_VERSION = "1.6.0"
+from ..version import full_version as _TRACKED_VERSION  # noqa: E402

@@ -1,0 +1,22 @@
+"""paddle.sysconfig (reference ``python/paddle/sysconfig.py``): paths C
+embedders compile/link against. Here the native surface is
+``native/c_api.h`` plus the on-demand shared objects in the same
+directory."""
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_NATIVE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "native")
+
+
+def get_include():
+    """Directory containing ``c_api.h``."""
+    return _NATIVE
+
+
+def get_lib():
+    """Directory containing the built shared objects (built on demand by
+    ``paddle_tpu.native``; e.g. ``native.build_predictor_lib()``)."""
+    return _NATIVE

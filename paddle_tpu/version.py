@@ -1,0 +1,23 @@
+"""paddle.version (reference generates this at build time)."""
+
+full_version = "1.6.0"
+major = "1"
+minor = "6"
+patch = "0"
+rc = "0"
+istaged = True
+commit = "tpu-native"
+with_mkl = "OFF"
+
+
+def mkl():
+    return with_mkl
+
+
+def show():
+    print("full_version:", full_version)
+    print("major:", major)
+    print("minor:", minor)
+    print("patch:", patch)
+    print("rc:", rc)
+    print("commit:", commit)

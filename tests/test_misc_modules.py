@@ -348,3 +348,32 @@ def test_lod_tensor_array_coerces_every_path():
     a[0] = np.zeros((1, 2), np.float32)
     a[0:1] = [np.ones((2, 2), np.float32)]
     assert all(isinstance(t, fluid.LoDTensor) for t in a)
+
+
+def test_paddle_top_level_surface():
+    """Reference ``python/paddle/__init__.py`` top-level exports:
+    batch/compat/dataset/distributed/reader/sysconfig/version are
+    importable attributes with working behavior."""
+    import os
+
+    import paddle_tpu as paddle
+
+    for m in ("batch", "compat", "dataset", "distributed", "reader",
+              "sysconfig", "version"):
+        assert hasattr(paddle, m), m
+    assert paddle.compat.to_text(b"ab") == "ab"
+    assert paddle.compat.to_bytes({"x"}) == {b"x"}
+    s = ["a", b"c"]
+    paddle.compat.to_text(s, inplace=True)
+    assert s == ["a", "c"]
+    # py2-style half-away-from-zero rounding
+    assert paddle.compat.round(0.5) == 1.0
+    assert paddle.compat.round(-0.5) == -1.0
+    assert paddle.compat.floor_division(7, 2) == 3
+    assert os.path.exists(os.path.join(paddle.sysconfig.get_include(),
+                                       "c_api.h"))
+    assert paddle.sysconfig.get_lib() == paddle.sysconfig.get_include()
+    assert paddle.version.full_version.startswith("1.6")
+    batches = list(paddle.batch(lambda: iter(range(5)), 2)())
+    assert batches[0] == [0, 1]
+    assert paddle.check_import_scipy()
