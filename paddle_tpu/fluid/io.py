@@ -113,9 +113,15 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
                          params_filename=None, export_for_deployment=True,
                          program_only=False):
     """Prunes to the inference subgraph and saves program + params
-    (reference ``io.py:1011``)."""
+    (reference ``io.py:1011``). ``export_for_deployment=False`` keeps the
+    full (unpruned) program so it can be re-optimized later;
+    ``program_only=True`` writes ``__model__`` without parameter files.
+    """
     main_program = main_program or framework.default_main_program()
-    pruned = main_program._prune(target_vars)
+    if export_for_deployment:
+        pruned = main_program._prune(target_vars)
+    else:
+        pruned = main_program.clone(for_test=True)
     pruned._feed_names = list(feeded_var_names)
     pruned._fetch_names = [
         v.name if isinstance(v, Variable) else v for v in target_vars
@@ -127,15 +133,32 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     desc["fetch_names"] = pruned._fetch_names
     from .core import proto_io
 
+    model_bytes = proto_io.program_to_bytes(desc)
+    # Structural cross-check of the pruned program through the native IR
+    # (program_graph.cc lint — the reference validates saved descs on
+    # its native side too). Advisory when the toolchain is absent.
+    try:
+        from .native_program import NativeProgram
+
+        native_prog = NativeProgram.from_bytes(model_bytes)
+        if native_prog is not None:
+            defects = [i for i in native_prog.lint() if i.startswith("E: ")]
+            if defects:
+                raise RuntimeError(
+                    "save_inference_model produced a structurally broken "
+                    "program:\n" + "\n".join(defects))
+    except ImportError:
+        pass
     with open(model_path, "wb") as f:
-        f.write(proto_io.program_to_bytes(desc))
-    # only save params the pruned program still references
-    needed = {n for blk in pruned.blocks for op in blk.ops
-              for n in op.input_arg_names()}
-    vars = [v for v in main_program.list_vars()
-            if v.persistable and v.name in needed]
-    save_vars(executor, dirname, main_program, vars=vars,
-              filename=params_filename)
+        f.write(model_bytes)
+    if not program_only:
+        # only save params the pruned program still references
+        needed = {n for blk in pruned.blocks for op in blk.ops
+                  for n in op.input_arg_names()}
+        vars = [v for v in main_program.list_vars()
+                if v.persistable and v.name in needed]
+        save_vars(executor, dirname, main_program, vars=vars,
+                  filename=params_filename)
     return pruned._fetch_names
 
 

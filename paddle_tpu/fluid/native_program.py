@@ -1,0 +1,162 @@
+"""Python face of the native ProgramDesc IR library.
+
+``native/program_graph.cc`` re-expresses the reference's native desc /
+graph tier (``program_desc.h:30``, ``prune.h``, ``ir/graph_helper.*``,
+``ir/graph_viz_pass.cc``) in C++ over the framework.proto wire format.
+This module wraps it behind the same failure contract as the rest of
+the native tier: every entry degrades to ``None`` when the toolchain is
+absent, so callers must treat the native path as an accelerator /
+cross-checker, never the only implementation (the Python Program is
+authoritative).
+
+Used by ``io.save_inference_model`` as a structural cross-check of the
+pruned program before it hits disk, and by tests to pin that the C++
+prune/lint agree with the Python implementations they mirror.
+"""
+
+import ctypes
+
+
+def _lib():
+    from .. import native
+
+    return native.load_program_graph()
+
+
+class NativeProgram(object):
+    """A parsed ProgramDesc handle in the native library.
+
+    Construct with :meth:`from_bytes` (wire bytes) or
+    :meth:`from_program` (a fluid Program). Both return ``None`` when
+    the native library is unavailable; ``from_bytes`` raises
+    ``ValueError`` on malformed bytes.
+    """
+
+    def __init__(self, lib, handle):
+        self._lib = lib
+        self._h = handle
+
+    @classmethod
+    def from_bytes(cls, data):
+        lib = _lib()
+        if lib is None:
+            return None
+        h = lib.prg_parse(data, len(data))
+        if not h:
+            raise ValueError("native parse failed: %s" %
+                             lib.prg_last_error().decode())
+        return cls(lib, h)
+
+    @classmethod
+    def from_program(cls, program):
+        return cls.from_bytes(program.serialize_to_string())
+
+    def __del__(self):
+        h, self._h = self._h, 0
+        if h:
+            self._lib.prg_destroy(h)
+
+    # -- structure ----------------------------------------------------------
+    @property
+    def version(self):
+        return self._lib.prg_version(self._h)
+
+    @property
+    def num_blocks(self):
+        return self._lib.prg_num_blocks(self._h)
+
+    def num_ops(self, block=0):
+        return self._lib.prg_num_ops(self._h, block)
+
+    def num_vars(self, block=0):
+        return self._lib.prg_num_vars(self._h, block)
+
+    def op_types(self, block=0):
+        buf = ctypes.create_string_buffer(512)
+        out = []
+        for i in range(self.num_ops(block)):
+            rc = self._lib.prg_op_type(self._h, block, i, buf, len(buf))
+            out.append(buf.value.decode() if rc == 0 else "?")
+        return out
+
+    # -- transforms / reports -----------------------------------------------
+    def _take_buf(self, ptr, nbytes=None):
+        if not ptr:
+            return b""
+        data = (ctypes.string_at(ptr, nbytes) if nbytes is not None
+                else ctypes.string_at(ptr))
+        self._lib.prg_free(ptr)
+        return data
+
+    def serialize(self):
+        """Canonical proto3 re-serialization of the parsed program."""
+        out = ctypes.POINTER(ctypes.c_char)()
+        n = ctypes.c_int64()
+        rc = self._lib.prg_serialize(self._h, ctypes.byref(out),
+                                     ctypes.byref(n))
+        if rc != 0:
+            raise RuntimeError("prg_serialize failed: %d" % rc)
+        return self._take_buf(out, n.value)
+
+    def prune(self, targets):
+        """New NativeProgram holding the program pruned to ``targets``
+        (same semantics as ``Program._prune``)."""
+        if isinstance(targets, str):
+            targets = [targets]
+        arr = (ctypes.c_char_p * len(targets))(
+            *[t.encode() for t in targets])
+        h = self._lib.prg_prune(self._h, arr, len(targets))
+        if not h:
+            raise RuntimeError("prg_prune failed: %s" %
+                               self._lib.prg_last_error().decode())
+        return NativeProgram(self._lib, h)
+
+    def lint(self):
+        """List of issue strings ("E: ..." defects, "W: ..." advisory).
+
+        The native count return is not cross-checked against the line
+        split: a defect message quotes var names verbatim, so a
+        pathological name containing a newline may split one issue into
+        two lines — the lines are still the full report.
+        """
+        out = ctypes.POINTER(ctypes.c_char)()
+        self._lib.prg_lint(self._h, ctypes.byref(out))
+        text = self._take_buf(out).decode()
+        return [l for l in text.splitlines() if l]
+
+    def last_use(self, block=0):
+        """Eager-deletion plan: {op_index: [var, ...]} — after which op
+        each non-persistable declared var is dead (reference
+        reference_count_pass semantics; advisory under XLA)."""
+        out = ctypes.POINTER(ctypes.c_char)()
+        rc = self._lib.prg_last_use(self._h, block, ctypes.byref(out))
+        if rc != 0:
+            raise RuntimeError("prg_last_use failed: %d" % rc)
+        plan = {}
+        # one "<op_idx>\x1f<name>" record per dead var (see
+        # program_graph.cc last_use_plan)
+        for line in self._take_buf(out).decode().splitlines():
+            idx, _, name = line.partition("\x1f")
+            plan.setdefault(int(idx), []).append(name)
+        return plan
+
+    def to_dot(self, block=0):
+        """Graphviz digraph source for one block."""
+        out = ctypes.POINTER(ctypes.c_char)()
+        rc = self._lib.prg_to_dot(self._h, block, ctypes.byref(out))
+        if rc != 0:
+            raise RuntimeError("prg_to_dot failed: %d" % rc)
+        return self._take_buf(out).decode()
+
+
+def check_program_native(program):
+    """Structural lint of ``program`` via the native library.
+
+    Returns the list of "E: " defect lines (advisory "W: " lines are
+    dropped), or ``None`` when the native library is unavailable —
+    callers must not treat None as a pass.
+    """
+    np_ = NativeProgram.from_program(program)
+    if np_ is None:
+        return None
+    return [i for i in np_.lint() if i.startswith("E: ")]

@@ -213,3 +213,49 @@ def build_predictor_lib():
     return _build("libpredictor", ["predictor.cc"],
                   extra_flags=["-I", inc, "-L", libdir, "-l" + pyver],
                   timeout=180)
+
+
+@functools.lru_cache(maxsize=None)
+def load_program_graph():
+    """ctypes handle to the native ProgramDesc IR library (c_api.h
+    prg_*: wire parse/serialize, prune, lint, last-use plan, graphviz),
+    or None when no toolchain is available."""
+    import ctypes
+
+    so = _build("libprogram_graph", ["program_graph.cc"])
+    if so is None:
+        return None
+    lib = ctypes.CDLL(so)
+    i64 = ctypes.c_int64
+    # Out-buffers are POINTER(c_char) (NOT c_char_p): serialized wire
+    # bytes contain NULs, callers read them with ctypes.string_at(p, n)
+    # and release with prg_free.
+    buf = ctypes.POINTER(ctypes.c_char)
+    bufp = ctypes.POINTER(buf)
+    lib.prg_parse.restype = i64
+    lib.prg_parse.argtypes = [ctypes.c_char_p, i64]
+    lib.prg_last_error.restype = ctypes.c_char_p
+    lib.prg_last_error.argtypes = []
+    for fn in ("prg_version", "prg_num_blocks"):
+        getattr(lib, fn).restype = i64
+        getattr(lib, fn).argtypes = [i64]
+    for fn in ("prg_num_ops", "prg_num_vars"):
+        getattr(lib, fn).restype = i64
+        getattr(lib, fn).argtypes = [i64, i64]
+    lib.prg_op_type.restype = ctypes.c_int
+    lib.prg_op_type.argtypes = [i64, i64, i64, ctypes.c_char_p, ctypes.c_int]
+    lib.prg_serialize.restype = ctypes.c_int
+    lib.prg_serialize.argtypes = [i64, bufp, ctypes.POINTER(i64)]
+    lib.prg_prune.restype = i64
+    lib.prg_prune.argtypes = [i64, ctypes.POINTER(ctypes.c_char_p), i64]
+    lib.prg_lint.restype = i64
+    lib.prg_lint.argtypes = [i64, bufp]
+    lib.prg_last_use.restype = ctypes.c_int
+    lib.prg_last_use.argtypes = [i64, i64, bufp]
+    lib.prg_to_dot.restype = ctypes.c_int
+    lib.prg_to_dot.argtypes = [i64, i64, bufp]
+    lib.prg_free.restype = None
+    lib.prg_free.argtypes = [buf]
+    lib.prg_destroy.restype = ctypes.c_int
+    lib.prg_destroy.argtypes = [i64]
+    return lib

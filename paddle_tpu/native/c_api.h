@@ -128,6 +128,31 @@ int trn_step(int64_t h, const char** in_names, const void** in_bufs,
 int trn_save(int64_t h, const char* model_path);
 int trn_destroy(int64_t h);
 
+/* ---- libprogram_graph: native ProgramDesc IR (reference
+ * program_desc.h / prune.h / ir/graph_helper / graph_viz_pass).
+ * Hand-rolled proto3 wire codec over core/framework.proto — no
+ * libprotobuf dependency. Handles from prg_parse/prg_prune are heap
+ * pointers (0 = failure, see prg_last_error); buffers returned through
+ * char** are freed with prg_free. prg_lint returns the issue count
+ * (lines prefixed "E: " structural defects, "W: " advisory) and
+ * prg_prune mirrors Python Program._prune exactly (reverse
+ * reachability on block 0, transitive sub-block args, is_test flip). */
+
+int64_t prg_parse(const void* buf, int64_t len);
+const char* prg_last_error(void);
+int64_t prg_version(int64_t h);
+int64_t prg_num_blocks(int64_t h);
+int64_t prg_num_ops(int64_t h, int64_t block);
+int64_t prg_num_vars(int64_t h, int64_t block);
+int prg_op_type(int64_t h, int64_t block, int64_t op_idx, char* buf, int cap);
+int prg_serialize(int64_t h, char** out, int64_t* len);
+int64_t prg_prune(int64_t h, const char** targets, int64_t n);
+int64_t prg_lint(int64_t h, char** report);
+int prg_last_use(int64_t h, int64_t block, char** out);
+int prg_to_dot(int64_t h, int64_t block, char** out);
+void prg_free(char* p);
+int prg_destroy(int64_t h);
+
 #ifdef __cplusplus
 }  /* extern "C" */
 #endif

@@ -1,0 +1,817 @@
+/* Native ProgramDesc IR: wire-format parse/serialize + graph analysis.
+ *
+ * The C++ counterpart of the reference's desc/graph tier, which lives
+ * native there and (until this file) was Python-only here:
+ *   - program_desc.h:30 / block_desc.h:38 / op_desc.h:30 — in-memory IR
+ *     over the framework.proto wire format (decoded by protobuf there,
+ *     by the hand-rolled proto3 reader below — no libprotobuf runtime
+ *     dependency, matching the rest of the native tier);
+ *   - prune.h — reverse-reachability inference pruning, including the
+ *     sub-block walk for control-flow ops (semantics kept bit-identical
+ *     to Python Program._prune in fluid/framework.py so either side can
+ *     validate the other);
+ *   - framework/ir/graph_helper.* — structural validation (lint): ops
+ *     reading vars never defined or written, sub-block indices out of
+ *     range, duplicate var defs, orphan blocks;
+ *   - ir/memory_optimize_pass/reference_count_pass.cc — last-use
+ *     analysis producing the eager-deletion plan (here: advisory, XLA
+ *     owns device buffers; the plan feeds tooling/tests);
+ *   - ir/graph_viz_pass.cc — graphviz export.
+ *
+ * Wire format: paddle_tpu/fluid/core/framework.proto (proto3). The
+ * parser accepts packed and unpacked repeated scalars and skips unknown
+ * fields; the serializer emits canonical proto3 (defaults omitted,
+ * fields in number order, oneof members always emitted).
+ *
+ * ABI: prg_* in c_api.h. Handles are heap pointers (0 = failure); all
+ * returned buffers are freed with prg_free.
+ */
+
+#include "c_api.h"
+
+#include <cstring>
+#include <map>
+#include <set>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace {
+
+/* ---------------- proto3 wire reader ---------------- */
+
+struct Reader {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool ok = true;
+
+  bool done() const { return p >= end; }
+
+  uint64_t varint() {
+    uint64_t v = 0;
+    int shift = 0;
+    while (p < end && shift < 64) {
+      uint8_t b = *p++;
+      v |= (uint64_t)(b & 0x7f) << shift;
+      if (!(b & 0x80)) return v;
+      shift += 7;
+    }
+    ok = false;
+    return 0;
+  }
+
+  uint64_t fixed64() {
+    if (end - p < 8) { ok = false; return 0; }
+    uint64_t v;
+    std::memcpy(&v, p, 8);
+    p += 8;
+    return v;
+  }
+
+  uint32_t fixed32() {
+    if (end - p < 4) { ok = false; return 0; }
+    uint32_t v;
+    std::memcpy(&v, p, 4);
+    p += 4;
+    return v;
+  }
+
+  /* Returns a sub-reader over a length-delimited payload. */
+  Reader len_slice() {
+    uint64_t n = varint();
+    if (!ok || (uint64_t)(end - p) < n) { ok = false; return {p, p}; }
+    Reader r{p, p + n};
+    p += n;
+    return r;
+  }
+
+  std::string str() {
+    Reader r = len_slice();
+    return std::string((const char*)r.p, (size_t)(r.end - r.p));
+  }
+
+  void skip(uint32_t wire) {
+    switch (wire) {
+      case 0: varint(); break;
+      case 1: fixed64(); break;
+      case 2: len_slice(); break;
+      case 5: fixed32(); break;
+      default: ok = false;
+    }
+  }
+};
+
+/* ---------------- proto3 wire writer ---------------- */
+
+struct Writer {
+  std::string out;
+
+  void varint(uint64_t v) {
+    while (v >= 0x80) {
+      out.push_back((char)((v & 0x7f) | 0x80));
+      v >>= 7;
+    }
+    out.push_back((char)v);
+  }
+  void key(int field, int wire) { varint(((uint64_t)field << 3) | wire); }
+  void v_int(int field, int64_t v) { key(field, 0); varint((uint64_t)v); }
+  void v_double(int field, double d) {
+    key(field, 1);
+    uint64_t bits;
+    std::memcpy(&bits, &d, 8);
+    for (int i = 0; i < 8; i++) out.push_back((char)((bits >> (8 * i)) & 0xff));
+  }
+  void v_str(int field, const std::string& s) {
+    key(field, 2);
+    varint(s.size());
+    out += s;
+  }
+  void v_msg(int field, const std::string& body) { v_str(field, body); }
+};
+
+/* ---------------- in-memory IR ---------------- */
+
+enum AttrKind {
+  ATTR_NONE = 0, ATTR_I, ATTR_F, ATTR_S, ATTR_B,
+  ATTR_INTS, ATTR_FLOATS, ATTR_STRS,
+};
+
+struct Attr {
+  int kind = ATTR_NONE;
+  int64_t i = 0;
+  double f = 0;
+  std::string s;
+  bool b = false;
+  std::vector<int64_t> ints;
+  std::vector<double> floats;
+  std::vector<std::string> strs;
+};
+
+struct VarSlot {
+  std::string slot;
+  std::vector<std::string> args;
+};
+
+struct VarD {
+  std::string name;
+  std::vector<int64_t> shape;
+  std::string dtype;
+  bool persistable = false, stop_gradient = false, is_data = false,
+       is_parameter = false, trainable = false;
+};
+
+struct OpD {
+  std::string type;
+  std::vector<VarSlot> inputs, outputs;
+  std::vector<std::pair<std::string, Attr>> attrs;
+
+  const Attr* find_attr(const std::string& k) const {
+    for (auto& kv : attrs)
+      if (kv.first == k) return &kv.second;
+    return nullptr;
+  }
+};
+
+struct BlockD {
+  int64_t idx = 0, parent_idx = 0;
+  std::vector<VarD> vars;
+  std::vector<OpD> ops;
+};
+
+struct ProgD {
+  int64_t version = 0, random_seed = 0;
+  std::vector<BlockD> blocks;
+  std::vector<std::pair<std::string, std::string>> param_grad_map;
+  std::vector<std::string> feed_names, fetch_names;
+};
+
+thread_local std::string g_err;
+
+/* ---------------- parsing ---------------- */
+
+void parse_packed_i64(Reader r, std::vector<int64_t>* out) {
+  while (!r.done() && r.ok) out->push_back((int64_t)r.varint());
+}
+
+void parse_packed_f64(Reader r, std::vector<double>* out) {
+  while (!r.done() && r.ok) {
+    uint64_t bits = r.fixed64();
+    double d;
+    std::memcpy(&d, &bits, 8);
+    out->push_back(d);
+  }
+}
+
+bool parse_attr(Reader r, Attr* a) {
+  while (!r.done() && r.ok) {
+    uint64_t k = r.varint();
+    int field = (int)(k >> 3), wire = (int)(k & 7);
+    switch (field) {
+      case 1: a->kind = ATTR_I; a->i = (int64_t)r.varint(); break;
+      case 2: {
+        a->kind = ATTR_F;
+        uint64_t bits = r.fixed64();
+        std::memcpy(&a->f, &bits, 8);
+        break;
+      }
+      case 3: a->kind = ATTR_S; a->s = r.str(); break;
+      case 4: a->kind = ATTR_B; a->b = r.varint() != 0; break;
+      case 5: {  /* IntList { repeated int64 val = 1 } */
+        a->kind = ATTR_INTS;
+        Reader m = r.len_slice();
+        while (!m.done() && m.ok) {
+          uint64_t mk = m.varint();
+          if ((mk >> 3) == 1 && (mk & 7) == 2) parse_packed_i64(m.len_slice(), &a->ints);
+          else if ((mk >> 3) == 1 && (mk & 7) == 0) a->ints.push_back((int64_t)m.varint());
+          else m.skip((uint32_t)(mk & 7));
+        }
+        break;
+      }
+      case 6: {  /* FloatList { repeated double val = 1 } */
+        a->kind = ATTR_FLOATS;
+        Reader m = r.len_slice();
+        while (!m.done() && m.ok) {
+          uint64_t mk = m.varint();
+          if ((mk >> 3) == 1 && (mk & 7) == 2) parse_packed_f64(m.len_slice(), &a->floats);
+          else if ((mk >> 3) == 1 && (mk & 7) == 1) {
+            uint64_t bits = m.fixed64();
+            double d;
+            std::memcpy(&d, &bits, 8);
+            a->floats.push_back(d);
+          } else m.skip((uint32_t)(mk & 7));
+        }
+        break;
+      }
+      case 7: {  /* StringList { repeated string val = 1 } */
+        a->kind = ATTR_STRS;
+        Reader m = r.len_slice();
+        while (!m.done() && m.ok) {
+          uint64_t mk = m.varint();
+          if ((mk >> 3) == 1 && (mk & 7) == 2) a->strs.push_back(m.str());
+          else m.skip((uint32_t)(mk & 7));
+        }
+        break;
+      }
+      default: r.skip((uint32_t)wire);
+    }
+  }
+  return r.ok;
+}
+
+bool parse_var_slot(Reader r, VarSlot* s) {
+  while (!r.done() && r.ok) {
+    uint64_t k = r.varint();
+    switch (k >> 3) {
+      case 1: s->slot = r.str(); break;
+      case 2: s->args.push_back(r.str()); break;
+      default: r.skip((uint32_t)(k & 7));
+    }
+  }
+  return r.ok;
+}
+
+bool parse_op(Reader r, OpD* op) {
+  while (!r.done() && r.ok) {
+    uint64_t k = r.varint();
+    switch (k >> 3) {
+      case 1: op->type = r.str(); break;
+      case 2: {
+        VarSlot s;
+        if (!parse_var_slot(r.len_slice(), &s)) return false;
+        op->inputs.push_back(std::move(s));
+        break;
+      }
+      case 3: {
+        VarSlot s;
+        if (!parse_var_slot(r.len_slice(), &s)) return false;
+        op->outputs.push_back(std::move(s));
+        break;
+      }
+      case 4: {  /* map<string, Attr> entry */
+        Reader m = r.len_slice();
+        std::string key;
+        Attr a;
+        while (!m.done() && m.ok) {
+          uint64_t mk = m.varint();
+          if ((mk >> 3) == 1) key = m.str();
+          else if ((mk >> 3) == 2) {
+            if (!parse_attr(m.len_slice(), &a)) return false;
+          } else m.skip((uint32_t)(mk & 7));
+        }
+        if (!m.ok) return false;
+        op->attrs.emplace_back(std::move(key), std::move(a));
+        break;
+      }
+      default: r.skip((uint32_t)(k & 7));
+    }
+  }
+  return r.ok;
+}
+
+bool parse_var(Reader r, VarD* v) {
+  while (!r.done() && r.ok) {
+    uint64_t k = r.varint();
+    switch (k >> 3) {
+      case 1: v->name = r.str(); break;
+      case 2:
+        if ((k & 7) == 2) parse_packed_i64(r.len_slice(), &v->shape);
+        else v->shape.push_back((int64_t)r.varint());
+        break;
+      case 3: v->dtype = r.str(); break;
+      case 4: v->persistable = r.varint() != 0; break;
+      case 5: v->stop_gradient = r.varint() != 0; break;
+      case 6: v->is_data = r.varint() != 0; break;
+      case 7: v->is_parameter = r.varint() != 0; break;
+      case 8: v->trainable = r.varint() != 0; break;
+      default: r.skip((uint32_t)(k & 7));
+    }
+  }
+  return r.ok;
+}
+
+bool parse_block(Reader r, BlockD* b) {
+  while (!r.done() && r.ok) {
+    uint64_t k = r.varint();
+    switch (k >> 3) {
+      case 1: b->idx = (int64_t)r.varint(); break;
+      case 2: b->parent_idx = (int64_t)r.varint(); break;
+      case 3: {
+        VarD v;
+        if (!parse_var(r.len_slice(), &v)) return false;
+        b->vars.push_back(std::move(v));
+        break;
+      }
+      case 4: {
+        OpD op;
+        if (!parse_op(r.len_slice(), &op)) return false;
+        b->ops.push_back(std::move(op));
+        break;
+      }
+      default: r.skip((uint32_t)(k & 7));
+    }
+  }
+  return r.ok;
+}
+
+bool parse_program(const uint8_t* buf, int64_t len, ProgD* p) {
+  Reader r{buf, buf + len};
+  while (!r.done() && r.ok) {
+    uint64_t k = r.varint();
+    switch (k >> 3) {
+      case 1: p->version = (int64_t)r.varint(); break;
+      case 2: p->random_seed = (int64_t)r.varint(); break;
+      case 3: {
+        BlockD b;
+        if (!parse_block(r.len_slice(), &b)) return false;
+        p->blocks.push_back(std::move(b));
+        break;
+      }
+      case 4: {  /* map<string,string> entry */
+        Reader m = r.len_slice();
+        std::string key, val;
+        while (!m.done() && m.ok) {
+          uint64_t mk = m.varint();
+          if ((mk >> 3) == 1) key = m.str();
+          else if ((mk >> 3) == 2) val = m.str();
+          else m.skip((uint32_t)(mk & 7));
+        }
+        if (!m.ok) return false;
+        p->param_grad_map.emplace_back(std::move(key), std::move(val));
+        break;
+      }
+      case 5: p->feed_names.push_back(r.str()); break;
+      case 6: p->fetch_names.push_back(r.str()); break;
+      default: r.skip((uint32_t)(k & 7));
+    }
+  }
+  return r.ok;
+}
+
+/* ---------------- serialization ---------------- */
+
+std::string ser_attr(const Attr& a) {
+  Writer w;
+  /* oneof members are emitted even at their default value — presence IS
+   * the information (a bool attr set to false must survive). */
+  switch (a.kind) {
+    case ATTR_I: w.v_int(1, a.i); break;
+    case ATTR_F: w.v_double(2, a.f); break;
+    case ATTR_S: w.v_str(3, a.s); break;
+    case ATTR_B: w.v_int(4, a.b ? 1 : 0); break;
+    case ATTR_INTS: {
+      Writer m;
+      if (!a.ints.empty()) {
+        Writer packed;
+        for (int64_t v : a.ints) packed.varint((uint64_t)v);
+        m.v_str(1, packed.out);
+      }
+      w.v_msg(5, m.out);
+      break;
+    }
+    case ATTR_FLOATS: {
+      Writer m;
+      if (!a.floats.empty()) {
+        Writer packed;
+        for (double d : a.floats) {
+          uint64_t bits;
+          std::memcpy(&bits, &d, 8);
+          for (int i = 0; i < 8; i++) packed.out.push_back((char)((bits >> (8 * i)) & 0xff));
+        }
+        m.v_str(1, packed.out);
+      }
+      w.v_msg(6, m.out);
+      break;
+    }
+    case ATTR_STRS: {
+      Writer m;
+      for (auto& s : a.strs) m.v_str(1, s);
+      w.v_msg(7, m.out);
+      break;
+    }
+    default: break;
+  }
+  return w.out;
+}
+
+std::string ser_program(const ProgD& p) {
+  Writer w;
+  if (p.version) w.v_int(1, p.version);
+  if (p.random_seed) w.v_int(2, p.random_seed);
+  for (auto& b : p.blocks) {
+    Writer bw;
+    if (b.idx) bw.v_int(1, b.idx);
+    if (b.parent_idx) bw.v_int(2, b.parent_idx);
+    for (auto& v : b.vars) {
+      Writer vw;
+      if (!v.name.empty()) vw.v_str(1, v.name);
+      if (!v.shape.empty()) {
+        Writer packed;
+        for (int64_t d : v.shape) packed.varint((uint64_t)d);
+        vw.v_str(2, packed.out);
+      }
+      if (!v.dtype.empty()) vw.v_str(3, v.dtype);
+      if (v.persistable) vw.v_int(4, 1);
+      if (v.stop_gradient) vw.v_int(5, 1);
+      if (v.is_data) vw.v_int(6, 1);
+      if (v.is_parameter) vw.v_int(7, 1);
+      if (v.trainable) vw.v_int(8, 1);
+      bw.v_msg(3, vw.out);
+    }
+    for (auto& op : b.ops) {
+      Writer ow;
+      if (!op.type.empty()) ow.v_str(1, op.type);
+      for (auto& s : op.inputs) {
+        Writer sw;
+        if (!s.slot.empty()) sw.v_str(1, s.slot);
+        for (auto& a : s.args) sw.v_str(2, a);
+        ow.v_msg(2, sw.out);
+      }
+      for (auto& s : op.outputs) {
+        Writer sw;
+        if (!s.slot.empty()) sw.v_str(1, s.slot);
+        for (auto& a : s.args) sw.v_str(2, a);
+        ow.v_msg(3, sw.out);
+      }
+      for (auto& kv : op.attrs) {
+        Writer ew;
+        ew.v_str(1, kv.first);
+        ew.v_msg(2, ser_attr(kv.second));
+        ow.v_msg(4, ew.out);
+      }
+      bw.v_msg(4, ow.out);
+    }
+    w.v_msg(3, bw.out);
+  }
+  for (auto& kv : p.param_grad_map) {
+    Writer ew;
+    ew.v_str(1, kv.first);
+    ew.v_str(2, kv.second);
+    w.v_msg(4, ew.out);
+  }
+  for (auto& s : p.feed_names) w.v_str(5, s);
+  for (auto& s : p.fetch_names) w.v_str(6, s);
+  return w.out;
+}
+
+/* ---------------- graph analysis ---------------- */
+
+bool ends_with(const std::string& s, const char* suf) {
+  size_t n = std::strlen(suf);
+  return s.size() >= n && s.compare(s.size() - n, n, suf) == 0;
+}
+
+/* Sub-block indices referenced by an op's attrs — the control-flow
+ * convention shared with Python (Operator attrs "sub_block",
+ * "*_block": int; "blocks": int list). */
+std::vector<int64_t> sub_block_idxs(const OpD& op) {
+  std::vector<int64_t> out;
+  for (auto& kv : op.attrs) {
+    if ((kv.first == "sub_block" || ends_with(kv.first, "_block")) &&
+        kv.second.kind == ATTR_I)
+      out.push_back(kv.second.i);
+    else if (kv.first == "blocks" && kv.second.kind == ATTR_INTS)
+      out.insert(out.end(), kv.second.ints.begin(), kv.second.ints.end());
+  }
+  return out;
+}
+
+/* Transitive reads/writes of an op: its explicit args plus every nested
+ * sub-block op's args. Mirrors Program._prune._transitive_args. */
+void transitive_args(const ProgD& p, const OpD& op,
+                     std::set<std::string>* reads,
+                     std::set<std::string>* writes) {
+  for (auto& s : op.inputs)
+    for (auto& a : s.args) reads->insert(a);
+  for (auto& s : op.outputs)
+    for (auto& a : s.args) writes->insert(a);
+  std::set<int64_t> seen;
+  std::vector<const OpD*> stack{&op};
+  while (!stack.empty()) {
+    const OpD* cur = stack.back();
+    stack.pop_back();
+    for (int64_t idx : sub_block_idxs(*cur)) {
+      if (idx < 0 || idx >= (int64_t)p.blocks.size() || seen.count(idx)) continue;
+      seen.insert(idx);
+      for (auto& sub_op : p.blocks[idx].ops) {
+        for (auto& s : sub_op.inputs)
+          for (auto& a : s.args) reads->insert(a);
+        for (auto& s : sub_op.outputs)
+          for (auto& a : s.args) writes->insert(a);
+        stack.push_back(&sub_op);
+      }
+    }
+  }
+}
+
+/* Reverse-reachability prune of block 0 toward `targets`, with the
+ * clone(for_test=True) is_test flip. Same result as Python _prune. */
+ProgD prune(const ProgD& src, const std::vector<std::string>& targets) {
+  ProgD p = src;
+  for (auto& b : p.blocks)
+    for (auto& op : b.ops)
+      for (auto& kv : op.attrs)
+        if (kv.first == "is_test" && kv.second.kind == ATTR_B && !kv.second.b)
+          kv.second.b = true;
+  if (p.blocks.empty()) return p;
+  std::set<std::string> needed(targets.begin(), targets.end());
+  std::vector<OpD> kept;
+  auto& ops = p.blocks[0].ops;
+  for (auto it = ops.rbegin(); it != ops.rend(); ++it) {
+    std::set<std::string> reads, writes;
+    transitive_args(p, *it, &reads, &writes);
+    bool hit = false;
+    for (auto& w : writes)
+      if (needed.count(w)) { hit = true; break; }
+    if (hit) {
+      kept.push_back(*it);
+      needed.insert(reads.begin(), reads.end());
+    }
+  }
+  p.blocks[0].ops.assign(kept.rbegin(), kept.rend());
+  return p;
+}
+
+/* Structural lint. E: lines are genuine IR defects; W: lines advisory. */
+std::vector<std::string> lint(const ProgD& p) {
+  std::vector<std::string> issues;
+  int64_t nblocks = (int64_t)p.blocks.size();
+  std::set<int64_t> referenced_blocks{0};
+
+  for (int64_t bi = 0; bi < nblocks; bi++) {
+    const BlockD& b = p.blocks[bi];
+    if (b.idx != bi)
+      issues.push_back("E: block at position " + std::to_string(bi) +
+                       " has idx " + std::to_string(b.idx));
+    if (b.parent_idx >= nblocks)
+      issues.push_back("E: block " + std::to_string(bi) + " parent_idx " +
+                       std::to_string(b.parent_idx) + " out of range");
+    std::set<std::string> names;
+    for (auto& v : b.vars)
+      if (!names.insert(v.name).second)
+        issues.push_back("E: block " + std::to_string(bi) +
+                         " duplicate var '" + v.name + "'");
+  }
+
+  /* Var visibility: declared in the block or any ancestor (reference
+   * Scope/Block lookup), or written earlier by an op in scope (derived
+   * names — grads, @-suffixed side bindings — are op outputs first). */
+  for (int64_t bi = 0; bi < nblocks; bi++) {
+    const BlockD& b = p.blocks[bi];
+    std::set<std::string> visible;
+    int64_t cur = bi;
+    std::set<int64_t> chain;
+    while (cur >= 0 && cur < nblocks && !chain.count(cur)) {
+      chain.insert(cur);
+      for (auto& v : p.blocks[cur].vars) visible.insert(v.name);
+      for (auto& op : p.blocks[cur].ops)
+        for (auto& s : op.outputs)
+          for (auto& a : s.args) visible.insert(a);
+      cur = p.blocks[cur].parent_idx;
+    }
+    for (size_t oi = 0; oi < b.ops.size(); oi++) {
+      const OpD& op = b.ops[oi];
+      for (int64_t sb : sub_block_idxs(op)) {
+        if (sb < 0 || sb >= nblocks)
+          issues.push_back("E: block " + std::to_string(bi) + " op " +
+                           std::to_string(oi) + " (" + op.type +
+                           ") sub-block " + std::to_string(sb) +
+                           " out of range");
+        else
+          referenced_blocks.insert(sb);
+      }
+      for (auto& s : op.inputs)
+        for (auto& a : s.args)
+          if (!a.empty() && !visible.count(a))
+            issues.push_back("E: block " + std::to_string(bi) + " op " +
+                             std::to_string(oi) + " (" + op.type +
+                             ") reads undefined var '" + a + "'");
+    }
+  }
+
+  for (int64_t bi = 1; bi < nblocks; bi++)
+    if (!referenced_blocks.count(bi))
+      issues.push_back("W: block " + std::to_string(bi) +
+                       " is not referenced by any op");
+  return issues;
+}
+
+/* Last-use (eager-deletion) plan for one block: after which op index
+ * each non-persistable, non-data declared var can be freed. Vars also
+ * touched by a later op's sub-blocks stay live through that op. */
+std::string last_use_plan(const ProgD& p, int64_t bi) {
+  const BlockD& b = p.blocks[bi];
+  std::map<std::string, size_t> last;
+  for (size_t oi = 0; oi < b.ops.size(); oi++) {
+    std::set<std::string> reads, writes;
+    transitive_args(p, b.ops[oi], &reads, &writes);
+    for (auto& n : reads) last[n] = oi;
+    for (auto& n : writes) last[n] = oi;
+  }
+  /* One record per dead var: "<op_idx>\x1f<name>\n". The unit separator
+   * cannot appear in framework-generated names, and a per-var record
+   * keeps names containing ',' or ' ' unambiguous. */
+  std::string out;
+  for (size_t oi = 0; oi < b.ops.size(); oi++) {
+    for (auto& v : b.vars) {
+      if (v.persistable || v.is_data) continue;
+      auto it = last.find(v.name);
+      if (it != last.end() && it->second == oi)
+        out += std::to_string(oi) + "\x1f" + v.name + "\n";
+    }
+  }
+  return out;
+}
+
+std::string dot_escape(const std::string& s) {
+  std::string out;
+  for (char c : s) {
+    if (c == '"' || c == '\\') out.push_back('\\');
+    out.push_back(c);
+  }
+  return out;
+}
+
+/* Graphviz export of one block (reference ir/graph_viz_pass.cc). */
+std::string to_dot(const ProgD& p, int64_t bi) {
+  const BlockD& b = p.blocks[bi];
+  std::string out = "digraph block" + std::to_string(bi) + " {\n"
+                    "  rankdir=TB;\n";
+  std::set<std::string> vars_seen;
+  auto var_node = [&](const std::string& name) {
+    if (vars_seen.insert(name).second)
+      out += "  \"v_" + dot_escape(name) + "\" [label=\"" + dot_escape(name) +
+             "\", shape=ellipse, fontsize=10];\n";
+  };
+  for (size_t oi = 0; oi < b.ops.size(); oi++) {
+    const OpD& op = b.ops[oi];
+    std::string op_id = "op_" + std::to_string(oi);
+    out += "  \"" + op_id + "\" [label=\"" + dot_escape(op.type) +
+           "\", shape=box, style=filled, fillcolor=lightgrey];\n";
+    for (auto& s : op.inputs)
+      for (auto& a : s.args) {
+        var_node(a);
+        out += "  \"v_" + dot_escape(a) + "\" -> \"" + op_id + "\";\n";
+      }
+    for (auto& s : op.outputs)
+      for (auto& a : s.args) {
+        var_node(a);
+        out += "  \"" + op_id + "\" -> \"v_" + dot_escape(a) + "\";\n";
+      }
+  }
+  out += "}\n";
+  return out;
+}
+
+ProgD* as_prog(int64_t h) { return reinterpret_cast<ProgD*>(h); }
+
+char* dup_cstr(const std::string& s) {
+  char* p = (char*)std::malloc(s.size() + 1);
+  if (p) {
+    std::memcpy(p, s.data(), s.size());
+    p[s.size()] = 0;
+  }
+  return p;
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t prg_parse(const void* buf, int64_t len) {
+  if (!buf || len < 0) { g_err = "null buffer"; return 0; }
+  ProgD* p = new ProgD();
+  if (!parse_program((const uint8_t*)buf, len, p)) {
+    g_err = "malformed ProgramDesc wire bytes";
+    delete p;
+    return 0;
+  }
+  g_err.clear();
+  return reinterpret_cast<int64_t>(p);
+}
+
+const char* prg_last_error(void) { return g_err.c_str(); }
+
+int64_t prg_version(int64_t h) { return h ? as_prog(h)->version : -1; }
+int64_t prg_num_blocks(int64_t h) {
+  return h ? (int64_t)as_prog(h)->blocks.size() : -1;
+}
+int64_t prg_num_ops(int64_t h, int64_t block) {
+  if (!h) return -1;
+  ProgD* p = as_prog(h);
+  if (block < 0 || block >= (int64_t)p->blocks.size()) return -1;
+  return (int64_t)p->blocks[block].ops.size();
+}
+int64_t prg_num_vars(int64_t h, int64_t block) {
+  if (!h) return -1;
+  ProgD* p = as_prog(h);
+  if (block < 0 || block >= (int64_t)p->blocks.size()) return -1;
+  return (int64_t)p->blocks[block].vars.size();
+}
+
+int prg_op_type(int64_t h, int64_t block, int64_t op_idx, char* buf, int cap) {
+  if (!h || !buf || cap <= 0) return -3;
+  ProgD* p = as_prog(h);
+  if (block < 0 || block >= (int64_t)p->blocks.size()) return -1;
+  auto& ops = p->blocks[block].ops;
+  if (op_idx < 0 || op_idx >= (int64_t)ops.size()) return -1;
+  const std::string& t = ops[op_idx].type;
+  if ((int)t.size() + 1 > cap) return -4;
+  std::memcpy(buf, t.c_str(), t.size() + 1);
+  return 0;
+}
+
+int prg_serialize(int64_t h, char** out, int64_t* len) {
+  if (!h || !out || !len) return -3;
+  std::string bytes = ser_program(*as_prog(h));
+  *out = (char*)std::malloc(bytes.size() ? bytes.size() : 1);
+  if (!*out) return -1;
+  std::memcpy(*out, bytes.data(), bytes.size());
+  *len = (int64_t)bytes.size();
+  return 0;
+}
+
+int64_t prg_prune(int64_t h, const char** targets, int64_t n) {
+  if (!h || (n > 0 && !targets)) { g_err = "bad arguments"; return 0; }
+  std::vector<std::string> t;
+  for (int64_t i = 0; i < n; i++) t.push_back(targets[i] ? targets[i] : "");
+  ProgD* out = new ProgD(prune(*as_prog(h), t));
+  g_err.clear();
+  return reinterpret_cast<int64_t>(out);
+}
+
+int64_t prg_lint(int64_t h, char** report) {
+  if (!h) return -3;
+  std::vector<std::string> issues = lint(*as_prog(h));
+  if (report) {
+    std::string joined;
+    for (auto& s : issues) joined += s + "\n";
+    *report = dup_cstr(joined);
+  }
+  return (int64_t)issues.size();
+}
+
+int prg_last_use(int64_t h, int64_t block, char** out) {
+  if (!h || !out) return -3;
+  ProgD* p = as_prog(h);
+  if (block < 0 || block >= (int64_t)p->blocks.size()) return -1;
+  *out = dup_cstr(last_use_plan(*p, block));
+  return *out ? 0 : -1;
+}
+
+int prg_to_dot(int64_t h, int64_t block, char** out) {
+  if (!h || !out) return -3;
+  ProgD* p = as_prog(h);
+  if (block < 0 || block >= (int64_t)p->blocks.size()) return -1;
+  *out = dup_cstr(to_dot(*p, block));
+  return *out ? 0 : -1;
+}
+
+void prg_free(char* p) { std::free(p); }
+
+int prg_destroy(int64_t h) {
+  if (!h) return -3;
+  delete as_prog(h);
+  return 0;
+}
+
+}  // extern "C"
