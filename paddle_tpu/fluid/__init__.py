@@ -8,20 +8,24 @@ from . import (  # noqa: F401
     average,
     backward,
     clip,
+    communicator,
     compat,
     contrib,
     compiler,
     data_feeder,
     dataset,
     debugger,
+    dygraph_grad_clip,
     evaluator,
     executor,
     flags,
     framework,
     initializer,
+    input,
     install_check,
     io,
     layers,
+    lod_tensor,
     metrics,
     net_drawer,
     nets,
@@ -34,6 +38,7 @@ from . import (  # noqa: F401
     unique_name,
 )
 from .backward import append_backward, calc_gradient, gradients  # noqa: F401
+from .lod_tensor import create_random_int_lodtensor  # noqa: F401
 from .compiler import BuildStrategy, CompiledProgram, ExecutionStrategy  # noqa: F401
 from .parallel_executor import ParallelExecutor  # noqa: F401
 from . import core  # noqa: F401  (fluid.core.EOFException etc.)

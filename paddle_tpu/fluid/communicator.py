@@ -1,0 +1,137 @@
+"""Async communicator for parameter-server training.
+
+Parity: reference ``fluid/communicator.py`` (``Communicator:26`` —
+start/stop/is_running over the C++ async communicator,
+``operators/distributed/communicator.h:175``). There the communicator
+owns background merge-and-send threads so trainer iterations never
+block on parameter-server RPCs; here the same role is played by
+``distributed.ps.AsyncPusher`` threads: ``start()`` interposes an
+async-pushing proxy in front of every distributed table the program
+uses (pulls stay synchronous — the device graph needs the rows), and
+``stop()`` drains the queues and restores direct tables. Used inside
+the fleet parameter-server path the same way the reference uses it.
+"""
+
+from . import framework
+
+__all__ = ["Communicator"]
+
+
+class _TableProxy(object):
+    """Attribute-forwarding view over a registered table; subclasses
+    override the communication entry points."""
+
+    def __init__(self, table):
+        self._table = table
+
+    def __getattr__(self, name):
+        # only fires for names not on the proxy: everything else (vocab,
+        # dim, dump, load, ...) serves from the wrapped table
+        return getattr(self.__dict__["_table"], name)
+
+
+class _AsyncTableProxy(_TableProxy):
+    """``push`` queues onto the background pusher thread (async-SGD
+    staleness model); everything else is direct."""
+
+    def __init__(self, table, pusher):
+        super().__init__(table)
+        self._pusher = pusher
+
+    def push(self, ids, grads, **kw):
+        self._pusher.push(ids, grads, **kw)
+
+
+class _GeoTableProxy(_TableProxy):
+    """Geo-SGD table view (reference GeoSgdCommunicator,
+    ``communicator.h:332``): pulls serve the worker's LOCAL mirror, pushes
+    apply SGD on the mirror only; every ``k_steps`` pushes the
+    accumulated delta ships to the global table through
+    ``GeoCommunicator.maybe_sync`` and the mirror rebases."""
+
+    def __init__(self, table, comm):
+        super().__init__(table)
+        self._comm = comm
+
+    def pull(self, ids):
+        import numpy as np
+
+        return self._comm.local[np.asarray(ids)]
+
+    def push(self, ids, grads, lr=0.01, **kw):
+        import numpy as np
+
+        # duplicate ids must accumulate, like the table's own sgd apply
+        np.subtract.at(self._comm.local, np.asarray(ids),
+                       float(lr) * np.asarray(grads))
+        self._comm.maybe_sync()
+
+
+class Communicator(object):
+    def __init__(self, program=None, vars_info=None, trainers=None,
+                 geo_sgd_need_push_nums=None):
+        """``program`` is the transpiled trainer program; its
+        ``distributed_lookup_table`` ops name the tables to communicate.
+        ``vars_info``/``trainers``/``geo_sgd_need_push_nums`` are the
+        reference's geo-SGD knobs: when all three are given, tables are
+        synced through ``distributed.ps.GeoCommunicator`` cadence
+        instead of per-push queues."""
+        program = program or framework.default_main_program()
+        names = []
+        for blk in program.blocks:
+            for op in blk.ops:
+                if op.type == "distributed_lookup_table":
+                    name = op.attr("table_name")
+                    if name not in names:
+                        names.append(name)
+        self._names = names
+        self._geo = bool(vars_info and trainers and geo_sgd_need_push_nums)
+        self._geo_k = int(geo_sgd_need_push_nums) if self._geo else 0
+        self._running = False
+        self._pushers = {}
+        self._geo_comms = {}
+        self._originals = {}
+
+    def start(self):
+        """Interpose async pushers (or geo communicators) in front of the
+        program's tables. Idempotent while running."""
+        if self._running:
+            return
+        from ..distributed import ps
+
+        # resolve every table BEFORE interposing any proxy: an unknown
+        # name raises here with the registry untouched, so a failed
+        # start() never leaves a half-proxied registry behind
+        tables = {name: ps.get_table(name) for name in self._names}
+        for name, table in tables.items():
+            self._originals[name] = table
+            if self._geo:
+                comm = ps.GeoCommunicator(table, k_steps=self._geo_k)
+                self._geo_comms[name] = comm
+                ps.register_table(name, _GeoTableProxy(table, comm))
+            else:
+                pusher = ps.AsyncPusher(table)
+                self._pushers[name] = pusher
+                ps.register_table(name, _AsyncTableProxy(table, pusher))
+        self._running = True
+
+    def stop(self):
+        """Drain queued pushes / force a final geo sync, then restore the
+        direct tables."""
+        if not self._running:
+            return
+        from ..distributed import ps
+
+        for name, pusher in self._pushers.items():
+            pusher.stop()
+            ps.register_table(name, self._originals[name])
+        for name, comm in self._geo_comms.items():
+            comm.maybe_sync(force=True)
+            ps.register_table(name, self._originals[name])
+        self._pushers.clear()
+        self._geo_comms.clear()
+        self._originals.clear()
+        self._running = False
+
+    def is_running(self):
+        return self._running

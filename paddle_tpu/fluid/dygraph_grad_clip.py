@@ -1,0 +1,100 @@
+"""Dygraph gradient clipping strategies.
+
+Parity: reference ``fluid/dygraph_grad_clip.py`` (GradClipBase:34,
+GradClipByValue:46, GradClipByNorm:120, GradClipByGlobalNorm:191).
+Passed as ``optimizer.minimize(loss, grad_clip=...)`` in dygraph mode;
+the optimizer hands the clip the full ``[(param, grad), ...]`` list
+after the backward pass (grads are device arrays) and applies the
+returned grads. TPU note: each strategy is a handful of jnp ops that
+XLA fuses into the per-parameter update dispatch; the global-norm
+variant reduces once over all grads, exactly like the static
+``GradientClipByGlobalNorm`` pass.
+"""
+
+import jax.numpy as jnp
+
+__all__ = [
+    "GradClipBase", "GradClipByValue", "GradClipByNorm",
+    "GradClipByGlobalNorm",
+]
+
+
+class GradClipBase(object):
+    def _clip(self, para_and_grad):
+        raise NotImplementedError
+
+    def __call__(self, para_and_grad):
+        return self._clip(para_and_grad)
+
+
+class GradClipByValue(GradClipBase):
+    """Elementwise clamp into [min_value, max_value]. With one argument,
+    the range is symmetric: [-min_value, min_value] (reference :92)."""
+
+    def __init__(self, min_value, max_value=None):
+        if max_value is None:
+            min_value, max_value = -abs(min_value), abs(min_value)
+        self.min_value = float(min_value)
+        self.max_value = float(max_value)
+
+    def __str__(self):
+        return "ClipByValue, min=%f, max=%f" % (self.min_value,
+                                                self.max_value)
+
+    def _clip(self, para_and_grad):
+        out = []
+        for p, g in para_and_grad:
+            if g is None:
+                out.append((p, g))
+                continue
+            out.append((p, jnp.clip(g, self.min_value, self.max_value)))
+        return out
+
+
+class GradClipByNorm(GradClipBase):
+    """Per-tensor L2-norm clip: g * clip_norm / max(norm(g), clip_norm)."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __str__(self):
+        return "ClipByNorm, clip_norm=%f" % self.clip_norm
+
+    def _clip(self, para_and_grad):
+        out = []
+        for p, g in para_and_grad:
+            if g is None:
+                out.append((p, g))
+                continue
+            norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            scale = self.clip_norm / jnp.maximum(norm, self.clip_norm)
+            out.append((p, (g.astype(jnp.float32) * scale).astype(g.dtype)))
+        return out
+
+
+class GradClipByGlobalNorm(GradClipBase):
+    """Joint clip by the global norm over ALL grads:
+    g_i * clip_norm / max(global_norm, clip_norm), with
+    global_norm = sqrt(sum_i ||g_i||^2) (reference :191)."""
+
+    def __init__(self, max_global_norm):
+        self.max_global_norm = float(max_global_norm)
+
+    def __str__(self):
+        return "ClipByGlobalNorm, max_global_norm=%f" % self.max_global_norm
+
+    def _clip(self, para_and_grad):
+        grads = [g for _, g in para_and_grad if g is not None]
+        if not grads:
+            return list(para_and_grad)
+        sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads)
+        global_norm = jnp.sqrt(sq)
+        scale = self.max_global_norm / jnp.maximum(global_norm,
+                                                   self.max_global_norm)
+        out = []
+        for p, g in para_and_grad:
+            if g is None:
+                out.append((p, g))
+                continue
+            out.append((p, (g.astype(jnp.float32) * scale).astype(g.dtype)))
+        return out

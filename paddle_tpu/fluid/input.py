@@ -1,0 +1,8 @@
+"""v1.6 input-layer module (reference ``fluid/input.py``: the new-style
+``fluid.input.embedding`` / ``fluid.input.one_hot`` entry points, which
+there wrap the v2 ops). The implementations live in ``layers``; this
+module keeps the reference's import path working."""
+
+from .layers import embedding, one_hot  # noqa: F401
+
+__all__ = ["one_hot", "embedding"]

@@ -1,0 +1,26 @@
+"""LoDTensor construction helpers.
+
+Parity: reference ``fluid/lod_tensor.py`` (``create_lod_tensor:24``,
+``create_random_int_lodtensor:114``). The in-memory LoDTensor itself
+lives in ``fluid/lod.py`` (bounded-LoD design); this module keeps the
+reference's user-facing module path and adds the random-int builder
+book models use for vocabulary-id sequences.
+"""
+
+import numpy as np
+
+from .lod import LoDTensor, create_lod_tensor  # noqa: F401
+
+__all__ = ["create_lod_tensor", "create_random_int_lodtensor"]
+
+
+def create_random_int_lodtensor(recursive_seq_lens, base_shape, place=None,
+                                low=0, high=1):
+    """LoDTensor of random ints in [low, high] with the given length-based
+    LoD: first dim = sum of sequence lengths, trailing dims =
+    ``base_shape`` (reference ``lod_tensor.py:114``; ``place`` is
+    accepted for API compatibility — XLA owns placement here)."""
+    total = int(np.sum(recursive_seq_lens[-1]))
+    shape = [total] + list(base_shape)
+    data = np.random.randint(low, high + 1, shape).astype("int64")
+    return create_lod_tensor(data, recursive_seq_lens, place)

@@ -136,16 +136,19 @@ class Optimizer:
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None, grad_clip=None):
         if framework.in_dygraph_mode():
-            return self._dygraph_minimize(loss, parameter_list)
+            return self._dygraph_minimize(loss, parameter_list,
+                                          grad_clip=grad_clip)
         params_grads = self.backward(loss, startup_program, parameter_list,
                                      no_grad_set)
         optimize_ops = self.apply_gradients(params_grads)
         return optimize_ops, params_grads
 
     # -- dygraph (eager) path ----------------------------------------------
-    def _dygraph_minimize(self, loss, parameter_list):
-        """Eager update: runs loss.backward() if grads are absent, then the
-        optimizer op eagerly per param (reference dygraph minimize)."""
+    def _dygraph_minimize(self, loss, parameter_list, grad_clip=None):
+        """Eager update: runs loss.backward() if grads are absent, applies
+        the optional ``grad_clip`` strategy (dygraph_grad_clip.py, the
+        reference's optimizer.py:680 hook), then the optimizer op eagerly
+        per param (reference dygraph minimize)."""
         from .dygraph.base import VarBase
 
         tracer = framework._dygraph_tracer()
@@ -167,7 +170,16 @@ class Optimizer:
             for p in parameter_list:
                 if p is None or p._grad is None or p.stop_gradient:
                     continue
-                g = p._grad
+                params_grads.append((p, p._grad))
+            # clip RAW grads, then fold regularization in — the static
+            # path's apply_gradients order (clip ops before
+            # append_regularization_ops), so both modes update identically.
+            # The call-site grad_clip wins over the constructor-level one.
+            clip = grad_clip if grad_clip is not None else self._grad_clip
+            if clip is not None:
+                params_grads = clip(params_grads)
+            regularized = []
+            for p, g in params_grads:
                 if getattr(p, "regularizer", None) is not None or \
                         self.regularization is not None:
                     reg = getattr(p, "regularizer", None) or self.regularization
@@ -177,8 +189,10 @@ class Optimizer:
                         g = g + reg._coeff * jnp.sign(p._ivar)
                     else:
                         g = g + reg._coeff * p._ivar
+                regularized.append((p, g))
+            params_grads = regularized
+            for p, g in params_grads:
                 p._ivar = self._eager_update(p, g, lr)
-                params_grads.append((p, g))
         return None, params_grads
 
     def _eager_state_for(self, p, names_and_init):
