@@ -269,6 +269,9 @@ def bench_resnet(batch_size=256, image_size=224, warmup=3, iters=10):
         ips, _, step_s = _stable_throughput(
             exe, main, feed, loss, iters, jax, batch_size,
             "resnet images/sec")
+        if os.environ.get("BENCH_PROFILE") == "1":
+            _profile_table(exe, main, feed, loss, jax,
+                           out_path="bench_profile_resnet.txt")
     step_ms = step_s * 1e3
     flops = resnet50_train_flops_per_step(batch_size, image_size)
     peak, peak_source = _peak_flops(jax.devices()[0])

@@ -56,14 +56,33 @@ class _GeoTableProxy(_TableProxy):
     def pull(self, ids):
         import numpy as np
 
-        return self._comm.local[np.asarray(ids)]
+        # same contract as EmbeddingTable.pull (ps.py): flatten to 1-D,
+        # always return (N, dim), reject out-of-range ids loudly
+        ids = np.asarray(ids).reshape(-1).astype(np.int64)
+        local = self._comm.local
+        if ids.size and (ids.min() < 0 or ids.max() >= local.shape[0]):
+            raise IndexError("id out of range for vocab %d" % local.shape[0])
+        return local[ids].copy()
 
-    def push(self, ids, grads, lr=0.01, **kw):
+    def push(self, ids, grads, lr=0.01, optimizer="sgd", **kw):
         import numpy as np
 
+        if optimizer != "sgd":
+            # Geo-SGD is SGD-by-construction: the shipped quantity is a
+            # parameter DELTA, which only equals an optimizer step for
+            # plain SGD. Refuse rather than silently change update math.
+            raise ValueError(
+                "geo communication supports optimizer='sgd' only, got %r "
+                "(reference GeoSgdCommunicator has the same constraint)"
+                % (optimizer,))
+        ids = np.asarray(ids).reshape(-1).astype(np.int64)
+        local = self._comm.local
+        grads = np.asarray(grads, np.float32).reshape(ids.shape[0],
+                                                      local.shape[1])
+        if ids.size and (ids.min() < 0 or ids.max() >= local.shape[0]):
+            raise IndexError("id out of range for vocab %d" % local.shape[0])
         # duplicate ids must accumulate, like the table's own sgd apply
-        np.subtract.at(self._comm.local, np.asarray(ids),
-                       float(lr) * np.asarray(grads))
+        np.subtract.at(local, ids, float(lr) * grads)
         self._comm.maybe_sync()
 
 
@@ -122,16 +141,30 @@ class Communicator(object):
             return
         from ..distributed import ps
 
+        # every table must be restored even when a drain re-raises a
+        # deferred push error — record the first error, finish the
+        # restores, then surface it
+        first_exc = None
         for name, pusher in self._pushers.items():
-            pusher.stop()
+            try:
+                pusher.stop()
+            except Exception as e:
+                if first_exc is None:
+                    first_exc = e
             ps.register_table(name, self._originals[name])
         for name, comm in self._geo_comms.items():
-            comm.maybe_sync(force=True)
+            try:
+                comm.maybe_sync(force=True)
+            except Exception as e:
+                if first_exc is None:
+                    first_exc = e
             ps.register_table(name, self._originals[name])
         self._pushers.clear()
         self._geo_comms.clear()
         self._originals.clear()
         self._running = False
+        if first_exc is not None:
+            raise first_exc
 
     def is_running(self):
         return self._running

@@ -38,6 +38,20 @@ def _conv2d(ctx, op):
     fmt = op.attr("data_format", "NCHW")
     if op.type == "depthwise_conv2d":
         groups = x.shape[-1] if fmt == "NHWC" else x.shape[1]
+    if (os.environ.get("PADDLE_TPU_CONV1X1_GEMM") == "1"
+            and tuple(w.shape[2:]) == (1, 1) and strides == (1, 1)
+            and pads == (0, 0) and groups == 1):
+        # Measured NEGATIVE (r5, v5e, ResNet-50 B=256 AMP): pointwise
+        # convs as explicit contractions — so autodiff emits dots, not
+        # transposed convs, for dx/dw — run at 1566 img/s vs 2424 for
+        # the conv lowering (-35%). XLA's conv path fuses the NCHW
+        # layouts/epilogues better than its dot path at these shapes;
+        # kept env-gated for re-measurement on future toolchains.
+        import jax.numpy as jnp
+
+        eq = ("nchw,oc->nohw" if fmt == "NCHW" else "nhwc,oc->nhwo")
+        ctx.set_output(op, "Output", jnp.einsum(eq, x, w[:, :, 0, 0]))
+        return
     out = jax.lax.conv_general_dilated(
         x,
         w,

@@ -54,11 +54,15 @@ def test_communicator_async_mode_trains():
         for _ in range(6):
             (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
             losses.append(float(np.asarray(lv).ravel()[0]))
-    comm.stop()
-    assert not comm.is_running()
-    # direct table restored, queued pushes drained and applied
-    assert ps.get_table("comm_emb") is table
-    assert losses[-1] < losses[0]
+        comm.stop()  # drains the queue: ALL 6 pushes are applied now
+        assert not comm.is_running()
+        # direct table restored, queued pushes drained and applied
+        assert ps.get_table("comm_emb") is table
+        # deterministic post-drain check (the async worker may lag the
+        # loop arbitrarily): an eval step after the drain must beat the
+        # first step — it sees every push plus the trained dense head
+        (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
+        assert float(np.asarray(lv).ravel()[0]) < losses[0]
     touched = np.unique(feed["ids"])
     assert np.abs(table.dump()[touched] - base[touched]).max() > 0
     # start/stop again is clean (idempotency)
@@ -90,6 +94,15 @@ def test_communicator_geo_mode_syncs_every_k():
     proxy.push(ids2, g, lr=0.5)  # k-th push ships the delta
     shipped = table.dump()
     assert np.abs(shipped[ids2] - base[ids2]).max() > 0
+    # geo is SGD-by-construction: other optimizers must refuse loudly
+    import pytest
+
+    with pytest.raises(ValueError, match="sgd"):
+        proxy.push(ids2, g, lr=0.5, optimizer="adagrad")
+    with pytest.raises(IndexError):
+        proxy.pull(np.array([vocab + 1], np.int64))
+    # pull contract matches EmbeddingTable.pull: 2-D ids flatten to (N, dim)
+    assert proxy.pull(np.array([[1], [3]], np.int64)).shape == (2, dim)
     comm.stop()
     assert ps.get_table("geo_comm_t") is table
 
