@@ -30,3 +30,11 @@ from .tp import (  # noqa: F401
     vocab_parallel_embedding,
 )
 from .pipeline import pipeline  # noqa: F401
+from .moe import (  # noqa: F401
+    MoEConfig,
+    init_moe_params,
+    moe_ffn,
+    moe_param_specs,
+    make_moe_train_step,
+    shard_moe_params,
+)

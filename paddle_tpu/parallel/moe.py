@@ -1,0 +1,201 @@
+"""Mixture-of-Experts FFN with expert parallelism (the ``ep`` mesh axis).
+
+A NEW capability, like ring attention (SURVEY §5.7): the 2019 reference
+has no MoE; this is the TPU-native expert-parallel design the mesh axis
+inventory (``parallel/mesh.py``) reserves ``ep`` for. The formulation is
+the standard dispatch/combine einsum MoE (Switch top-1 / GShard top-2):
+
+  1. router: logits = x @ wg, probabilities per token/expert;
+  2. capacity-bounded assignment via cumsum position (static shapes —
+     no sorting, no dynamic sizes: XLA-friendly);
+  3. dispatch:  expert_in[e,c,h] = einsum('tec,th->ech', D, x)
+  4. expert FFN per expert e (batched GEMMs on the MXU);
+  5. combine:   y[t,h] = einsum('tec,ech->th', D * gate, expert_out)
+
+Expert weights carry ``PartitionSpec(("ep",) ...)`` over their leading
+E dimension and the dispatched activations are constrained to the same
+axis, so GSPMD inserts the token all-to-all between the data-parallel
+token layout and the expert-parallel compute layout — the ICI-native
+equivalent of DeepSpeed-MoE's explicit all-to-all.
+
+Tokens the capacity drops pass through on the residual path (standard
+Switch behavior). ``aux_loss`` is the load-balancing term
+(E * sum_e fraction_e * prob_mass_e) from the Switch paper.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+__all__ = ["MoEConfig", "init_moe_params", "moe_param_specs", "moe_ffn",
+           "make_moe_train_step", "shard_moe_params"]
+
+
+class MoEConfig:
+    def __init__(self, hidden=64, ffn=128, n_experts=4, k=1,
+                 capacity_factor=1.25):
+        if k not in (1, 2):
+            raise ValueError("k must be 1 (Switch) or 2 (GShard), got %r"
+                             % (k,))
+        self.hidden = hidden
+        self.ffn = ffn
+        self.n_experts = n_experts
+        self.k = k
+        self.capacity_factor = capacity_factor
+
+    def capacity(self, n_tokens):
+        # ceil(k * tokens / E * factor), at least 1, static
+        import math
+
+        return max(1, int(math.ceil(
+            self.k * n_tokens / self.n_experts * self.capacity_factor)))
+
+
+def init_moe_params(cfg, seed=0, dtype=jnp.float32):
+    k = jax.random.PRNGKey(seed)
+    kg, k1, k2 = jax.random.split(k, 3)
+    h, f, e = cfg.hidden, cfg.ffn, cfg.n_experts
+    s1 = (2.0 / h) ** 0.5
+    s2 = (2.0 / f) ** 0.5
+    return {
+        "wg": (jax.random.normal(kg, (h, e)) * s1).astype(dtype),
+        "w1": (jax.random.normal(k1, (e, h, f)) * s1).astype(dtype),
+        "b1": jnp.zeros((e, f), dtype),
+        "w2": (jax.random.normal(k2, (e, f, h)) * s2).astype(dtype),
+        "b2": jnp.zeros((e, h), dtype),
+    }
+
+
+def moe_param_specs():
+    """PartitionSpecs: experts sharded over ``ep``; the router replicated."""
+    return {
+        "wg": P(),
+        "w1": P("ep", None, None),
+        "b1": P("ep", None),
+        "w2": P("ep", None, None),
+        "b2": P("ep", None),
+    }
+
+
+def _assign(gates, capacity, mask=None, slot_offset=None):
+    """One assignment round: returns (one-hot dispatch [T, E, C],
+    per-token gate value, chosen expert one-hot [T, E]).
+
+    ``mask`` excludes experts already chosen in an earlier round (top-2);
+    ``slot_offset`` [E] shifts this round's capacity positions past the
+    slots an earlier round already occupied (the GShard offset — without
+    it, round-1 and round-2 tokens collide in the same buffer entry).
+    Position within each expert = cumsum of earlier tokens choosing it;
+    tokens past capacity drop out of the dispatch tensor (residual path).
+    """
+    t, e = gates.shape
+    g = gates if mask is None else gates * (1.0 - mask)
+    choice = jnp.argmax(g, axis=-1)                      # [T]
+    choice_1h = jax.nn.one_hot(choice, e, dtype=gates.dtype)  # [T, E]
+    pos = (jnp.cumsum(choice_1h, axis=0) - choice_1h)    # tokens before me
+    if slot_offset is not None:
+        pos = pos + slot_offset[None, :].astype(pos.dtype)
+    pos = jnp.sum(pos * choice_1h, axis=-1).astype(jnp.int32)  # [T] slot
+    keep = pos < capacity
+    pos_1h = jax.nn.one_hot(pos, capacity, dtype=gates.dtype)  # [T, C]
+    dispatch = (choice_1h[:, :, None] * pos_1h[:, None, :] *
+                keep[:, None, None].astype(gates.dtype))  # [T, E, C]
+    gate_val = jnp.sum(gates * choice_1h, axis=-1) * keep.astype(gates.dtype)
+    return dispatch, gate_val, choice_1h
+
+
+def moe_ffn(params, x, cfg, with_aux=True, mesh=None, ep_axis="ep"):
+    """x: [..., H] (any leading token dims). Returns (y, aux_loss).
+
+    With ``mesh`` given (a Mesh containing ``ep_axis``), the dispatched
+    activations are sharding-constrained onto the expert axis so GSPMD
+    routes tokens over ICI; without it the layout is left to sharding
+    propagation (single-device use).
+    """
+    h = cfg.hidden
+    lead = x.shape[:-1]
+    xt = x.reshape(-1, h)
+    t = xt.shape[0]
+    cap = cfg.capacity(t)
+
+    logits = xt @ params["wg"].astype(xt.dtype)          # [T, E]
+    gates = jax.nn.softmax(logits.astype(jnp.float32), -1)
+
+    d1, g1, c1 = _assign(gates, cap)
+    if cfg.k == 2:
+        # round-2 slots start after round-1's per-expert occupancy
+        used = jnp.sum(c1, axis=0)                       # [E]
+        d2, g2, _ = _assign(gates, cap, mask=c1, slot_offset=used)
+        # renormalize the two gate values (GShard)
+        denom = g1 + g2 + 1e-9
+        dispatch = d1 * (g1 / denom)[:, None, None] + \
+            d2 * (g2 / denom)[:, None, None]
+        raw_dispatch = (d1 + d2).astype(xt.dtype)
+    else:
+        dispatch = d1 * g1[:, None, None]
+        raw_dispatch = d1.astype(xt.dtype)
+
+    expert_in = jnp.einsum("tec,th->ech", raw_dispatch, xt)
+
+    if mesh is not None:
+        def on_ep(v):
+            return jax.lax.with_sharding_constraint(
+                v, NamedSharding(mesh, P(ep_axis, None, None)))
+    else:
+        def on_ep(v):
+            return v
+
+    expert_in = on_ep(expert_in)
+    w1 = params["w1"].astype(xt.dtype)
+    w2 = params["w2"].astype(xt.dtype)
+    hmid = jax.nn.gelu(jnp.einsum("ech,ehf->ecf", expert_in, w1) +
+                       params["b1"][:, None, :].astype(xt.dtype))
+    out = jnp.einsum("ecf,efh->ech", hmid, w2) + \
+        params["b2"][:, None, :].astype(xt.dtype)
+    out = on_ep(out)
+
+    y = jnp.einsum("tec,ech->th", dispatch.astype(xt.dtype), out)
+    y = y.reshape(*lead, h)
+
+    if not with_aux:
+        return y, jnp.zeros((), jnp.float32)
+    # Switch load-balance loss: E * sum_e (token fraction_e * prob mass_e)
+    frac = jnp.mean(c1.astype(jnp.float32), axis=0)       # [E]
+    prob = jnp.mean(gates, axis=0)                        # [E]
+    aux = cfg.n_experts * jnp.sum(frac * prob)
+    return y, aux
+
+
+def make_moe_train_step(cfg, mesh, lr=0.1, aux_weight=0.01,
+                        dp_axis="dp", ep_axis="ep"):
+    """Jitted GSPMD train step over a (dp, ep) mesh: regression of the
+    MoE FFN output against targets + load-balance aux. Tokens are
+    dp-sharded; experts ep-sharded; GSPMD derives the all-to-alls."""
+    specs = moe_param_specs()
+
+    def loss_fn(params, x, target):
+        y, aux = moe_ffn(params, x, cfg, mesh=mesh, ep_axis=ep_axis)
+        mse = jnp.mean(jnp.square(y - target).astype(jnp.float32))
+        return mse + aux_weight * aux
+
+    def step(params, x, target):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, target)
+        params = jax.tree_util.tree_map(lambda p, g: p - lr * g,
+                                        params, grads)
+        return params, loss
+
+    param_sh = {k: NamedSharding(mesh, s) for k, s in specs.items()}
+    data_sh = NamedSharding(mesh, P(dp_axis, None, None))
+    return jax.jit(
+        step,
+        in_shardings=(param_sh, data_sh, data_sh),
+        out_shardings=(param_sh, NamedSharding(mesh, P())),
+        donate_argnums=(0,),
+    )
+
+
+def shard_moe_params(params, mesh):
+    """Place initialized params onto the mesh per moe_param_specs."""
+    specs = moe_param_specs()
+    return {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+            for k, v in params.items()}
