@@ -15,6 +15,7 @@ from . import (  # noqa: F401
     data_feeder,
     dataset,
     debugger,
+    distribute_lookup_table,
     dygraph_grad_clip,
     evaluator,
     executor,

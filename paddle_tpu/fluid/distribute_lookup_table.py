@@ -1,0 +1,58 @@
+"""Locate distributed embedding tables in a Program.
+
+Parity: reference ``fluid/distribute_lookup_table.py`` (the transpiler/
+fleet helper that finds the single distributed ``lookup_table`` and its
+ids/outputs). Here the distributed embedding lowers to the
+``distributed_lookup_table`` op (``ops/distributed_ops.py``) whose table
+lives in the host PS store keyed by the ``table_name`` attr, so the
+search matches on that op type.
+"""
+
+LOOKUP_TABLE_TYPE = "distributed_lookup_table"
+
+__all__ = [
+    "find_distributed_lookup_table",
+    "find_distributed_lookup_table_inputs",
+    "find_distributed_lookup_table_outputs",
+]
+
+
+def _table_of(op):
+    return op.attr("table_name")
+
+
+def find_distributed_lookup_table(program):
+    """The single distributed table's name, or None. More than one
+    distinct table raises (same contract as the reference — the PS
+    split path assumes one)."""
+    table_name = None
+    for op in program.global_block().ops:
+        if op.type == LOOKUP_TABLE_TYPE:
+            name = _table_of(op)
+            if table_name is None:
+                table_name = name
+            elif table_name != name:
+                raise RuntimeError(
+                    "all distributed lookup_table ops should share one "
+                    "table; found %r and %r" % (table_name, name))
+    return table_name
+
+
+def find_distributed_lookup_table_inputs(program, table_name):
+    """Ids variables feeding the distributed table's lookups."""
+    local_vars = program.current_block().vars
+    inputs = []
+    for op in program.global_block().ops:
+        if op.type == LOOKUP_TABLE_TYPE and _table_of(op) == table_name:
+            inputs.extend(local_vars[name] for name in op.input("Ids"))
+    return inputs
+
+
+def find_distributed_lookup_table_outputs(program, table_name):
+    """Output variables produced by the distributed table's lookups."""
+    local_vars = program.current_block().vars
+    outputs = []
+    for op in program.global_block().ops:
+        if op.type == LOOKUP_TABLE_TYPE and _table_of(op) == table_name:
+            outputs.extend(local_vars[name] for name in op.output("Out"))
+    return outputs

@@ -1,7 +1,7 @@
 """DyGraph (eager) mode — reference ``python/paddle/fluid/dygraph/``."""
 
 from . import (backward_strategy, base, checkpoint, container, jit, layers,
-               learning_rate_scheduler, nn, parallel)
+               learning_rate_scheduler, nn, parallel, profiler)
 from .backward_strategy import BackwardStrategy  # noqa: F401
 from .container import Sequential  # noqa: F401
 from .parallel import DataParallel, ParallelEnv, prepare_context  # noqa: F401
@@ -24,5 +24,6 @@ from .base import (  # noqa: F401
     to_variable,
 )
 from .checkpoint import load_dygraph, save_dygraph  # noqa: F401
+from .jit import TracedLayer  # noqa: F401
 from .layers import Layer  # noqa: F401
 from .nn import *  # noqa: F401,F403

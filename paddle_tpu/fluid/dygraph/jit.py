@@ -81,6 +81,20 @@ class TracedLayer:
         self._fetch_names = fetch_names
         self._scope = None
 
+    @staticmethod
+    def trace(layer, inputs):
+        """Reference ``TracedLayer.trace`` (``dygraph/jit.py:48``):
+        returns (outputs, TracedLayer). Same as the module-level
+        ``trace``."""
+        return trace(layer, inputs)
+
+    def set_strategy(self, build_strategy=None, exec_strategy=None):
+        """Accepted for API parity (reference ``jit.py:91``); the traced
+        Program executes through the whole-block XLA jit, which owns the
+        scheduling these strategies tuned."""
+        self._build_strategy = build_strategy
+        self._exec_strategy = exec_strategy
+
     def _materialize_scope(self):
         from ..executor import Scope
 

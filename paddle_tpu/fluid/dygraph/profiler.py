@@ -1,0 +1,34 @@
+"""Dygraph-mode profiler hooks.
+
+Parity: reference ``dygraph/profiler.py`` (``start_gperf_profiler:25`` /
+``stop_gperf_profiler:29``), which gperf-profiles the imperative C++
+engine. Here the eager engine IS the XLA runtime, so the equivalent
+signal is a jax.profiler trace of the eager op dispatches: the trace
+lands in ``PADDLE_TPU_GPERF_DIR`` (default ``./dygraph_profile``) and is
+viewable in TensorBoard / Perfetto, alongside the host-span profiler in
+``fluid/profiler.py``.
+"""
+
+import os
+
+__all__ = ["start_gperf_profiler", "stop_gperf_profiler"]
+
+_active = [False]
+
+
+def start_gperf_profiler():
+    import jax
+
+    if _active[0]:  # symmetric with stop(): re-entry is a no-op
+        return
+    logdir = os.environ.get("PADDLE_TPU_GPERF_DIR", "./dygraph_profile")
+    jax.profiler.start_trace(logdir)
+    _active[0] = True
+
+
+def stop_gperf_profiler():
+    import jax
+
+    if _active[0]:
+        jax.profiler.stop_trace()
+        _active[0] = False

@@ -23,6 +23,8 @@ Switch behavior). ``aux_loss`` is the load-balancing term
 (E * sum_e fraction_e * prob_mass_e) from the Switch paper.
 """
 
+import math
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -45,8 +47,6 @@ class MoEConfig:
 
     def capacity(self, n_tokens):
         # ceil(k * tokens / E * factor), at least 1, static
-        import math
-
         return max(1, int(math.ceil(
             self.k * n_tokens / self.n_experts * self.capacity_factor)))
 
@@ -66,14 +66,14 @@ def init_moe_params(cfg, seed=0, dtype=jnp.float32):
     }
 
 
-def moe_param_specs():
-    """PartitionSpecs: experts sharded over ``ep``; the router replicated."""
+def moe_param_specs(ep_axis="ep"):
+    """PartitionSpecs: experts sharded over ``ep_axis``; router replicated."""
     return {
         "wg": P(),
-        "w1": P("ep", None, None),
-        "b1": P("ep", None),
-        "w2": P("ep", None, None),
-        "b2": P("ep", None),
+        "w1": P(ep_axis, None, None),
+        "b1": P(ep_axis, None),
+        "w2": P(ep_axis, None, None),
+        "b2": P(ep_axis, None),
     }
 
 
@@ -100,7 +100,12 @@ def _assign(gates, capacity, mask=None, slot_offset=None):
     pos_1h = jax.nn.one_hot(pos, capacity, dtype=gates.dtype)  # [T, C]
     dispatch = (choice_1h[:, :, None] * pos_1h[:, None, :] *
                 keep[:, None, None].astype(gates.dtype))  # [T, E, C]
-    gate_val = jnp.sum(gates * choice_1h, axis=-1) * keep.astype(gates.dtype)
+    # RAW router probability of the chosen expert (capacity drops are
+    # already zeroed in `dispatch`; keeping the gate raw lets top-2
+    # normalize BEFORE the drop, per GShard — a token whose first choice
+    # overflowed keeps weight g2/(g1+g2), the dropped share riding the
+    # residual, not an amplified 1.0)
+    gate_val = jnp.sum(gates * choice_1h, axis=-1)
     return dispatch, gate_val, choice_1h
 
 
@@ -171,7 +176,7 @@ def make_moe_train_step(cfg, mesh, lr=0.1, aux_weight=0.01,
     """Jitted GSPMD train step over a (dp, ep) mesh: regression of the
     MoE FFN output against targets + load-balance aux. Tokens are
     dp-sharded; experts ep-sharded; GSPMD derives the all-to-alls."""
-    specs = moe_param_specs()
+    specs = moe_param_specs(ep_axis)
 
     def loss_fn(params, x, target):
         y, aux = moe_ffn(params, x, cfg, mesh=mesh, ep_axis=ep_axis)
@@ -194,8 +199,8 @@ def make_moe_train_step(cfg, mesh, lr=0.1, aux_weight=0.01,
     )
 
 
-def shard_moe_params(params, mesh):
+def shard_moe_params(params, mesh, ep_axis="ep"):
     """Place initialized params onto the mesh per moe_param_specs."""
-    specs = moe_param_specs()
+    specs = moe_param_specs(ep_axis)
     return {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
             for k, v in params.items()}

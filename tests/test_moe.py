@@ -151,3 +151,41 @@ def test_sharded_train_step_matches_single_device():
 def test_param_specs_cover_params():
     cfg = MoEConfig()
     assert set(moe_param_specs()) == set(init_moe_params(cfg))
+
+
+def test_top2_overflow_keeps_gshard_weight():
+    """When a token's FIRST choice overflows capacity, its second-choice
+    output keeps weight g2/(g1+g2) — normalized BEFORE the drop (GShard),
+    never amplified to 1.0.
+
+    Construction (E=3, capacity=1, 2 tokens): both tokens pick e0 first;
+    t0 wins the slot, t1's first pick drops. Second round: t0 -> e1,
+    t1 -> e2 (distinct experts, both slots free), so t1's surviving
+    output is EXACTLY its second choice at the normalized share."""
+    cfg = MoEConfig(hidden=16, ffn=32, n_experts=3, k=2,
+                    capacity_factor=0.01)
+    assert cfg.capacity(2) == 1
+    p = init_moe_params(cfg, seed=7)
+    for k in ("w1", "b1", "w2", "b2"):
+        p[k] = jnp.broadcast_to(p[k][:1], p[k].shape)
+    wg = np.zeros((16, 3), np.float32)
+    wg[0, 0] = wg[1, 1] = wg[2, 2] = 1.0  # logits = x[:, :3]
+    p["wg"] = jnp.asarray(wg)
+    x = np.zeros((2, 16), np.float32)
+    x[0, :3] = [3.0, 2.0, 0.0]   # t0: e0 then e1
+    x[1, :3] = [3.0, 0.0, 2.0]   # t1: e0 then e2
+    x[:, 3:] = np.random.RandomState(0).rand(2, 13)
+    x = jnp.asarray(x)
+    y, _ = moe_ffn(p, x, cfg)
+    dense = _dense_ffn(x, p["w1"][0], p["b1"][0], p["w2"][0], p["b2"][0])
+    gates = np.asarray(jax.nn.softmax((x @ p["wg"]).astype(jnp.float32), -1))
+    # t0 kept both choices: weights sum to 1 -> dense exactly
+    np.testing.assert_allclose(np.asarray(y[0]), np.asarray(dense[0]),
+                               rtol=2e-3, atol=2e-3)
+    # t1: first choice dropped; second survives at g2/(g1+g2) ~ 0.27,
+    # clearly distinguishable from the buggy amplified 1.0
+    w2nd = gates[1, 2] / (gates[1, 0] + gates[1, 2] + 1e-9)
+    assert 0.1 < w2nd < 0.5
+    np.testing.assert_allclose(np.asarray(y[1]),
+                               np.asarray(dense[1]) * w2nd,
+                               rtol=2e-3, atol=2e-3)

@@ -202,3 +202,44 @@ def test_input_module_and_module_paths():
     assert fluid.lod_tensor.create_lod_tensor is fluid.create_lod_tensor
     assert hasattr(fluid.communicator, "Communicator")
     assert hasattr(fluid.dygraph_grad_clip, "GradClipByGlobalNorm")
+
+
+def test_distribute_lookup_table_helpers():
+    from paddle_tpu.fluid import distribute_lookup_table as dlt
+
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        ids = layers.data("dlt_ids", shape=[2], dtype="int64")
+        layers.embedding(ids, size=[8, 4], is_distributed=True,
+                         param_attr=fluid.ParamAttr(name="dlt_t"))
+    assert dlt.find_distributed_lookup_table(main) == "dlt_t"
+    ins = dlt.find_distributed_lookup_table_inputs(main, "dlt_t")
+    outs = dlt.find_distributed_lookup_table_outputs(main, "dlt_t")
+    assert [v.name for v in ins] == ["dlt_ids"]
+    assert len(outs) == 1
+    # no distributed table -> None
+    empty = fluid.Program()
+    with fluid.program_guard(empty, fluid.Program()):
+        x = layers.data("dlt_x", shape=[2], dtype="int64")
+        layers.embedding(x, size=[8, 4])
+    assert dlt.find_distributed_lookup_table(empty) is None
+
+
+def test_dygraph_traced_layer_exported():
+    assert dygraph.TracedLayer is fluid.dygraph.jit.TracedLayer
+
+
+def test_dygraph_gperf_profiler_smoke(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_GPERF_DIR", str(tmp_path / "prof"))
+    from paddle_tpu.fluid.dygraph import profiler as dyprof
+
+    dyprof.start_gperf_profiler()
+    with dygraph.guard():
+        v = to_variable(np.ones((2, 2), np.float32))
+        (v * v).numpy()
+    dyprof.stop_gperf_profiler()
+    import os
+
+    assert os.path.isdir(str(tmp_path / "prof"))
+    # idempotent stop
+    dyprof.stop_gperf_profiler()
