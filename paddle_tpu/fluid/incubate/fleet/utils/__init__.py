@@ -1,0 +1,4 @@
+"""Fleet utilities (reference ``incubate/fleet/utils/``)."""
+
+from . import fleet_barrier_util, fleet_util, hdfs  # noqa: F401
+from .fleet_util import FleetUtil  # noqa: F401

@@ -1,0 +1,60 @@
+"""Filesystem-rendezvous trainer barrier.
+
+Parity: reference ``fleet_barrier_util.py:20`` ``check_all_trainers_ready``
+— each trainer uploads a ``ready.<run>.<epoch>.<rank>.done`` marker to a
+shared directory and polls until every rank's marker for (run, epoch) is
+present. The reference hardcodes HDFS; here any fs client with the
+``is_dir/makedirs/upload/ls`` surface works (``paddle_tpu.fs.LocalFS``
+for single-host / NFS jobs, ``HDFSClient`` for hadoop).
+
+Two reference flaws are fixed rather than reproduced: the poll counts
+markers of THIS (run, epoch) only (the reference's ``% trainer_num``
+check aliases consecutive epochs), and the run id — from ``run_id`` or
+``PADDLE_BARRIER_RUN_ID``, default the launch timestamp of rank 0's
+env (``PADDLE_JOB_ID``) or ``"0"`` — keeps a RESTARTED job from
+sailing through on the previous run's leftover markers. Jobs that
+restart with the same run id must clear ``ready_path`` first.
+"""
+
+import os
+import tempfile
+import time
+
+__all__ = ["check_all_trainers_ready"]
+
+
+def check_all_trainers_ready(ready_path, epoch, fleet=None, fs_client=None,
+                             run_id=None, timeout=600.0, interval=1.0):
+    from ..collective import fleet as collective_fleet
+    from .....fs import LocalFS
+
+    fleet = fleet or collective_fleet
+    client = fs_client or LocalFS()
+    n, rank = fleet.worker_num(), fleet.worker_index()
+    if run_id is None:
+        run_id = os.environ.get("PADDLE_BARRIER_RUN_ID",
+                                os.environ.get("PADDLE_JOB_ID", "0"))
+
+    marker = "ready.%s.%s.%s.done" % (run_id, epoch, rank)
+    fd, local = tempfile.mkstemp(prefix="barrier_marker_")
+    os.close(fd)
+    try:
+        if not client.is_dir(ready_path):
+            client.makedirs(ready_path)
+        client.upload(local, os.path.join(ready_path, marker),
+                      overwrite=True)
+    finally:
+        os.unlink(local)
+
+    prefix = "ready.%s.%s." % (run_id, epoch)
+    deadline = time.monotonic() + timeout
+    while True:
+        names = [os.path.basename(str(p)) for p in client.ls(ready_path)]
+        ready = len([x for x in names if x.startswith(prefix)])
+        if ready >= n:
+            return
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                "barrier %r run %s epoch %s: %d/%d trainers ready after "
+                "%.0fs" % (ready_path, run_id, epoch, ready, n, timeout))
+        time.sleep(interval)

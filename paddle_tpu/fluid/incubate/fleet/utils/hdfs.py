@@ -1,0 +1,7 @@
+"""Module-path parity for the reference's ``incubate/fleet/utils/hdfs.py``:
+the hadoop-shell client lives in ``paddle_tpu.fs`` (one implementation for
+the fluid and fleet entry points)."""
+
+from .....fs import HDFSClient, LocalFS  # noqa: F401
+
+__all__ = ["HDFSClient"]

@@ -36,6 +36,9 @@ class LocalFS:
             return []
         return sorted(os.listdir(path))
 
+    # uniform listing name across fs clients (HDFSClient.ls)
+    ls = ls_dir
+
     def is_exist(self, path):
         return os.path.exists(path)
 
@@ -141,6 +144,9 @@ class HDFSClient:
         out = self._cmd(["-ls", path]).decode()
         return [ln.split()[-1] for ln in out.splitlines()
                 if ln and not ln.startswith("Found")]
+
+    # uniform listing name across fs clients (LocalFS.ls_dir)
+    ls_dir = ls
 
     def is_exist(self, path):
         return self._test("-e", path)

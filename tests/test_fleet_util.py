@@ -1,0 +1,179 @@
+"""FleetUtil / fleet_barrier_util (reference incubate/fleet/utils/).
+
+Pins: global AUC from real auc-op stat buckets against sklearn-free
+numpy AUC, set_zero, day/pass model save/load round trip with donefile
+tracking, online-pass scheduling, and the filesystem barrier with epoch
+isolation.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.incubate.fleet.utils import FleetUtil
+from paddle_tpu.fluid.incubate.fleet.utils.fleet_barrier_util import (
+    check_all_trainers_ready)
+
+
+def _auc_numpy(scores, labels):
+    """Exact pairwise AUC (ties at 0.5)."""
+    pos = scores[labels == 1]
+    neg = scores[labels == 0]
+    wins = (pos[:, None] > neg[None, :]).sum()
+    ties = (pos[:, None] == neg[None, :]).sum()
+    return (wins + 0.5 * ties) / (len(pos) * len(neg))
+
+
+def test_global_auc_matches_pairwise():
+    rng = np.random.RandomState(0)
+    scores = rng.rand(512).astype(np.float32)
+    labels = (rng.rand(512) < scores).astype(np.int64)  # informative
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        p = layers.data("p", shape=[1])
+        l = layers.data("l", shape=[1], dtype="int64")
+        pred2 = layers.concat([1.0 - p, p], axis=1)
+        auc_out, stats = layers.auc(pred2, l, num_thresholds=2**12 - 1)
+    exe = fluid.Executor()
+    util = FleetUtil()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed={"p": scores.reshape(-1, 1),
+                            "l": labels.reshape(-1, 1)},
+                fetch_list=[auc_out])
+        got = util.get_global_auc(scope, stats[0].name, stats[1].name)
+        expect = _auc_numpy(scores, labels)
+        assert abs(got - expect) < 2e-3
+        printed = util.print_global_auc(scope, stats[0].name, stats[1].name,
+                                        print_prefix="[test]")
+        assert printed == got
+        # a reducer that doubles the buckets must not change the AUC
+        same = util.get_global_auc(scope, stats[0].name, stats[1].name,
+                                   reducer=lambda a: a * 2)
+        assert abs(same - got) < 1e-9
+        # set_zero resets the buckets -> degenerate AUC 0.5
+        util.set_zero(stats[0].name, scope, param_type="float32")
+        util.set_zero(stats[1].name, scope, param_type="float32")
+        assert util.get_global_auc(scope, stats[0].name,
+                                   stats[1].name) == 0.5
+    # absent buckets -> None
+    assert util.get_global_auc(fluid.Scope(), "nope_pos", "nope_neg") is None
+
+
+def test_day_pass_model_roundtrip(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        y = layers.fc(x, size=3)
+    exe = fluid.Executor()
+    util = FleetUtil()
+    out = str(tmp_path / "models")
+    os.makedirs(out)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        w0 = np.asarray(exe.run(main, feed={"x": np.ones((1, 4), np.float32)},
+                                fetch_list=[y])[0])
+        d = util.save_model(out, "20260731", 3, exe, main)
+        assert d.endswith(os.path.join("20260731", "delta-3"))
+    day, pass_id, model_dir = util.get_last_save_model(out)
+    assert (day, pass_id) == ("20260731", "3") and model_dir == d
+    # fresh scope: load restores the exact params
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        util.load_model(out, "20260731", 3, exe, main)
+        w1 = np.asarray(exe.run(main, feed={"x": np.ones((1, 4), np.float32)},
+                                fetch_list=[y])[0])
+    np.testing.assert_allclose(w1, w0, rtol=1e-6)
+    # base dir for pass -1
+    assert util._model_dir(out, "d", -1).endswith(os.path.join("d", "base"))
+    # empty output path -> (None, None, None)
+    assert util.get_last_save_model(str(tmp_path / "empty")) == (None, None,
+                                                                 None)
+
+
+def test_online_pass_interval():
+    util = FleetUtil()
+    iv = util.get_online_pass_interval("{20190720..20190729}", "{0..23}",
+                                       split_interval=30, split_per_pass=2,
+                                       is_data_hourly_placed=False)
+    assert len(iv) == 24  # 48 half-hour splits, 2 per pass
+    assert iv[0] == ["0000", "0030"]
+    assert iv[-1] == ["2300", "2330"]
+    # hourly placement + restricted hours
+    iv2 = util.get_online_pass_interval(["d"], ["08", "09"], 60, 1, True)
+    assert iv2 == [["08"], ["09"]]
+
+
+def test_rank0_logging(capsys):
+    class _F:
+        def worker_index(self):
+            return 1
+
+    FleetUtil(fleet=_F()).rank0_print("must not appear")
+
+    class _F0:
+        def worker_index(self):
+            return 0
+
+    FleetUtil(fleet=_F0()).rank0_print("must appear")
+    outerr = capsys.readouterr()
+    assert "must appear" in outerr.out
+    assert "must not appear" not in outerr.out
+
+
+def test_barrier_epoch_isolation(tmp_path):
+    class _Fleet:
+        def __init__(self, rank, n):
+            self._r, self._n = rank, n
+
+        def worker_index(self):
+            return self._r
+
+        def worker_num(self):
+            return self._n
+
+    ready = str(tmp_path / "ready")
+    # 2 trainers, epoch 0: first rank alone times out
+    with pytest.raises(TimeoutError):
+        check_all_trainers_ready(ready, 0, fleet=_Fleet(0, 2),
+                                 timeout=1.0, interval=0.2)
+    # second rank arrives -> both markers present, returns
+    check_all_trainers_ready(ready, 0, fleet=_Fleet(1, 2), timeout=5.0,
+                             interval=0.1)
+    # a NEW epoch must not count epoch-0 markers (the reference's
+    # modulo check would have aliased here)
+    with pytest.raises(TimeoutError):
+        check_all_trainers_ready(ready, 1, fleet=_Fleet(0, 2),
+                                 timeout=1.0, interval=0.2)
+
+
+def test_barrier_run_isolation(tmp_path):
+    """A restarted job with a NEW run id never counts the old run's
+    markers (review: stale-marker passthrough)."""
+    class _Fleet:
+        def __init__(self, rank, n):
+            self._r, self._n = rank, n
+
+        def worker_index(self):
+            return self._r
+
+        def worker_num(self):
+            return self._n
+
+    ready = str(tmp_path / "ready")
+    # rank 0 uploads its runA marker, then times out alone
+    with pytest.raises(TimeoutError):
+        check_all_trainers_ready(ready, 0, fleet=_Fleet(0, 2), run_id="runA",
+                                 timeout=1.0, interval=0.2)
+    # rank 1 arrives: both runA markers present -> returns
+    check_all_trainers_ready(ready, 0, fleet=_Fleet(1, 2), run_id="runA",
+                             timeout=5.0, interval=0.1)
+    # restart as runB: runA's two markers must NOT satisfy the barrier
+    with pytest.raises(TimeoutError):
+        check_all_trainers_ready(ready, 0, fleet=_Fleet(0, 2), run_id="runB",
+                                 timeout=1.0, interval=0.2)
