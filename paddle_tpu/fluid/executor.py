@@ -70,6 +70,17 @@ class Scope:
     def local_var_names(self):
         return list(self.vars)
 
+    def var_names(self):
+        """All visible names: this scope plus ancestors (find_var order;
+        shadowed ancestor names appear once)."""
+        seen, s = [], self
+        while s is not None:
+            for n in s.vars:
+                if n not in seen:
+                    seen.append(n)
+            s = s.parent
+        return seen
+
 
 _global_scope = Scope()
 _scope_stack = [_global_scope]

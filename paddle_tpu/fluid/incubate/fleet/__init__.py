@@ -1,1 +1,1 @@
-from . import base, collective, parameter_server  # noqa: F401
+from . import base, collective, parameter_server, utils  # noqa: F401

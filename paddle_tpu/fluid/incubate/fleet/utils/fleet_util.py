@@ -63,10 +63,13 @@ class FleetUtil(object):
                       np.zeros(np.asarray(cur).shape, dtype=param_type))
 
     # -- global AUC from the auc op's stat buckets (reference :172) ----------
-    def get_global_auc(self, scope=None, stat_pos="auc.stat_pos",
-                       stat_neg="auc.stat_neg", reducer=None):
+    def get_global_auc(self, scope=None, stat_pos=None, stat_neg=None,
+                       reducer=None):
         """AUC from the accumulated pos/neg threshold buckets.
 
+        With no bucket names given, the scope is searched for the single
+        ``*.stat_pos``/``*.stat_neg`` pair ``layers.auc`` generates
+        (programs with several auc ops must name the pair explicitly).
         Under the GSPMD collective modes the buckets in the scope are
         already global; in a per-process deployment pass ``reducer``
         (array -> summed array across workers) to aggregate first.
@@ -75,6 +78,14 @@ class FleetUtil(object):
         import paddle_tpu.fluid as fluid
 
         scope = scope or fluid.global_scope()
+        if stat_pos is None or stat_neg is None:
+            pos_names = [n for n in scope.var_names()
+                         if n.endswith(".stat_pos")]
+            if len(pos_names) != 1:
+                self.rank0_print("not found auc bucket")
+                return None
+            stat_pos = pos_names[0]
+            stat_neg = stat_pos[:-len(".stat_pos")] + ".stat_neg"
         pos_v = scope.find_var(stat_pos)
         neg_v = scope.find_var(stat_neg)
         if pos_v is None or neg_v is None:
@@ -96,9 +107,10 @@ class FleetUtil(object):
             return 0.5
         return float(area / (tot_pos * tot_neg))
 
-    def print_global_auc(self, scope=None, stat_pos="auc.stat_pos",
-                         stat_neg="auc.stat_neg", print_prefix=""):
-        auc = self.get_global_auc(scope, stat_pos, stat_neg)
+    def print_global_auc(self, scope=None, stat_pos=None, stat_neg=None,
+                         print_prefix="", reducer=None):
+        auc = self.get_global_auc(scope, stat_pos, stat_neg,
+                                  reducer=reducer)
         self.rank0_print("%s global auc = %s" % (print_prefix, auc))
         return auc
 
@@ -112,14 +124,21 @@ class FleetUtil(object):
 
     def save_model(self, output_path, day, pass_id, executor, program,
                    feeded_var_names=None, target_vars=None):
-        """Persist the program's persistables under the reference's
+        """Persist the program under the reference's
         ``<output>/<day>/delta-<pass>`` layout (``base`` for pass -1) and
-        stamp the donefile rank-0-only."""
+        stamp the donefile rank-0-only. With ``feeded_var_names`` +
+        ``target_vars`` the save is an inference-model export (pruned to
+        the targets, reference save_paddle_inference_model:862);
+        otherwise the full training persistables are written."""
         import paddle_tpu.fluid as fluid
 
         d = self._model_dir(output_path, day, pass_id)
         os.makedirs(d, exist_ok=True)
-        fluid.io.save_persistables(executor, d, program)
+        if feeded_var_names is not None and target_vars is not None:
+            fluid.io.save_inference_model(d, feeded_var_names, target_vars,
+                                          executor, main_program=program)
+        else:
+            fluid.io.save_persistables(executor, d, program)
         if self._is_rank0():
             self.write_model_donefile(output_path, day, pass_id, d)
         return d

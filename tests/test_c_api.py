@@ -227,3 +227,44 @@ def test_c_trainer_trains_and_checkpoints(tmp_path):
         (l2,) = exe.run(main, feed={"x": xv, "label": lv},
                         fetch_list=["loss"])
     assert float(np.asarray(l2).ravel()[0]) <= last * 1.05 + 1e-3
+
+
+def test_c_program_graph_driver(tmp_path):
+    """A pure-C driver (tests/c_program_main.c) parses, lints, prunes,
+    and round-trips a REAL serialized program through the prg_* ABI —
+    the reference exercises its desc/prune tier from native tests the
+    same way (framework/prune_test.cc)."""
+    import shutil
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    if native.load_program_graph() is None:
+        pytest.skip("no toolchain")
+
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = layers.data("x", shape=[4])
+        h = layers.fc(x, size=3, act="relu")
+        out = layers.mean(h)
+        layers.reduce_sum(h)  # prunable tail
+    bytes_path = tmp_path / "prog.bin"
+    bytes_path.write_bytes(main.serialize_to_string())
+
+    drv_src = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "c_program_main.c")
+    so = os.path.join(_DIR, "libprogram_graph.so")
+    drv = str(tmp_path / "c_program")
+    subprocess.run(
+        ["g++", "-x", "c", drv_src, "-x", "none", "-o", drv, so,
+         "-Wl,-rpath," + _DIR],
+        check=True, capture_output=True)
+    r = subprocess.run([drv, str(bytes_path), out.name],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
+    assert "C_PROGRAM_OK" in r.stdout
+    # the C-side prune agrees with the Python prune it mirrors
+    py_pruned = len(main._prune([out]).global_block().ops)
+    assert ("pruned_ops=%d" % py_pruned) in r.stdout

@@ -177,3 +177,50 @@ def test_barrier_run_isolation(tmp_path):
     with pytest.raises(TimeoutError):
         check_all_trainers_ready(ready, 0, fleet=_Fleet(0, 2), run_id="runB",
                                  timeout=1.0, interval=0.2)
+
+
+def test_global_auc_zero_config_discovery():
+    """With no bucket names, the single layers.auc pair in the scope is
+    found automatically (review: the previous defaults could never
+    match generated names)."""
+    rng = np.random.RandomState(1)
+    scores = rng.rand(128).astype(np.float32)
+    labels = (rng.rand(128) < scores).astype(np.int64)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        p = layers.data("p", shape=[1])
+        l = layers.data("l", shape=[1], dtype="int64")
+        pred2 = layers.concat([1.0 - p, p], axis=1)
+        auc_out, stats = layers.auc(pred2, l)
+    exe = fluid.Executor()
+    util = FleetUtil()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed={"p": scores.reshape(-1, 1),
+                            "l": labels.reshape(-1, 1)},
+                fetch_list=[auc_out])
+        auto = util.get_global_auc(scope)
+        named = util.get_global_auc(scope, stats[0].name, stats[1].name)
+        assert auto == named is not None
+        # print_global_auc forwards the reducer
+        doubled = util.print_global_auc(scope, reducer=lambda a: a * 2)
+        assert abs(doubled - named) < 1e-9
+
+
+def test_save_model_inference_mode(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        y = layers.fc(x, size=2)
+    exe = fluid.Executor()
+    util = FleetUtil()
+    out = str(tmp_path / "m")
+    os.makedirs(out)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        d = util.save_model(out, "20260731", -1, exe, main,
+                            feeded_var_names=["x"], target_vars=[y])
+        assert os.path.exists(os.path.join(d, "__model__"))
+        prog, feeds, fetches = fluid.io.load_inference_model(d, exe)
+        assert feeds == ["x"]
