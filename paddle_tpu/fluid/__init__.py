@@ -39,6 +39,7 @@ from . import (  # noqa: F401
     unique_name,
 )
 from .backward import append_backward, calc_gradient, gradients  # noqa: F401
+from .transpiler import memory_optimize, release_memory  # noqa: F401
 from .lod_tensor import create_random_int_lodtensor  # noqa: F401
 from .compiler import BuildStrategy, CompiledProgram, ExecutionStrategy  # noqa: F401
 from .parallel_executor import ParallelExecutor  # noqa: F401
@@ -138,15 +139,6 @@ import contextlib as _contextlib  # noqa: E402
 def device_guard(device=None):
     """Reference op-placement hint; placement is XLA's here — no-op."""
     yield
-
-
-def memory_optimize(*args, **kwargs):
-    """Deprecated in the reference 1.6 (a no-op there too); XLA owns
-    buffer lifetime (see compiler.BuildStrategy.enable_inplace)."""
-
-
-def release_memory(*args, **kwargs):
-    """Deprecated reference API; XLA owns buffer lifetime."""
 
 
 def load_op_library(lib_path):

@@ -1,0 +1,6 @@
+"""Optimizer class transforms (reference ``contrib/extend_optimizer/``)."""
+
+from .extend_optimizer_with_weight_decay import (  # noqa: F401
+    extend_with_decoupled_weight_decay)
+
+__all__ = ["extend_with_decoupled_weight_decay"]

@@ -1,0 +1,97 @@
+"""Decoupled weight decay as an optimizer class transform.
+
+Parity: reference
+``contrib/extend_optimizer/extend_optimizer_with_weight_decay.py:102``
+``extend_with_decoupled_weight_decay`` — returns a subclass of the given
+optimizer whose ``minimize`` subtracts ``param * coeff`` directly from
+each parameter (decoupled from the gradient path, AdamW-style), before
+the base optimizer applies the raw-gradient update. ``coeff`` is a
+float; ``apply_decay_param_fun(name) -> bool`` filters which parameters
+decay.
+"""
+
+from ... import optimizer as _optimizer
+from ...framework import in_dygraph_mode
+
+__all__ = ["extend_with_decoupled_weight_decay"]
+
+
+class DecoupledWeightDecay(object):
+    def __init__(self, coeff=0.0, apply_decay_param_fun=None, **kwargs):
+        if not isinstance(coeff, float):
+            raise TypeError("coeff should be float, got %r" % (coeff,))
+        self._coeff = coeff
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._decayed_names = set()
+        super(DecoupledWeightDecay, self).__init__(**kwargs)
+
+    def _append_decay_ops(self, params_grads):
+        from ... import layers
+
+        for param, grad in params_grads:
+            if grad is None or self._coeff == 0.0:
+                continue
+            if self._apply_decay_param_fun is not None and \
+                    not self._apply_decay_param_fun(param.name):
+                continue
+            self._decayed_names.add(param.name)
+            scaled = layers.scale(param, scale=self._coeff)
+            layers.assign(layers.elementwise_sub(param, scaled), param)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, grad_clip=None):
+        if in_dygraph_mode():
+            # eager path, same order as static: decay the parameter
+            # arrays first, then the base optimizer applies the raw
+            # grads. Run the pending backward up front (exactly what the
+            # base minimize would do) so grads exist for the filter.
+            from ...framework import _dygraph_tracer
+
+            tracer = _dygraph_tracer()
+            if tracer is not None and tracer._tape:
+                loss.backward()
+            if self._coeff and parameter_list:
+                for p in parameter_list:
+                    if p is None or p._grad is None or p.stop_gradient:
+                        continue
+                    if self._apply_decay_param_fun is not None and \
+                            not self._apply_decay_param_fun(p.name):
+                        continue
+                    self._decayed_names.add(p.name)
+                    p._ivar = p._ivar * (1.0 - self._coeff)
+            return super(DecoupledWeightDecay, self).minimize(
+                loss, startup_program, parameter_list, no_grad_set,
+                grad_clip=grad_clip)
+        params_grads = self.backward(loss, startup_program, parameter_list,
+                                     no_grad_set)
+        # the decay ops run in program order before the optimizer ops —
+        # the reference appends them between backward and apply
+        self._append_decay_ops(params_grads)
+        optimize_ops = self.apply_optimize(loss, startup_program,
+                                           params_grads)
+        return optimize_ops, params_grads
+
+    def __str__(self):
+        return " ".join(["Weight Decay, params:",
+                         ",".join(sorted(self._decayed_names))])
+
+
+def extend_with_decoupled_weight_decay(base_optimizer):
+    """Returns class ``OptimizerWithDecoupledWeightDecay`` deriving from
+    ``base_optimizer``; construct it with ``weight_decay=`` (coeff) and
+    optionally ``apply_decay_param_fun=`` plus the base optimizer's own
+    arguments."""
+    if not issubclass(base_optimizer, _optimizer.Optimizer):
+        raise TypeError(
+            "The input(base_optimizer) should be a derived class of "
+            "Optimizer, got %r" % (base_optimizer,))
+
+    class OptimizerWithDecoupledWeightDecay(DecoupledWeightDecay,
+                                            base_optimizer):
+        def __init__(self, weight_decay=0.0, apply_decay_param_fun=None,
+                     **kwargs):
+            super(OptimizerWithDecoupledWeightDecay, self).__init__(
+                coeff=weight_decay,
+                apply_decay_param_fun=apply_decay_param_fun, **kwargs)
+
+    return OptimizerWithDecoupledWeightDecay

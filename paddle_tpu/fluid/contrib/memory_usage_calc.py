@@ -1,0 +1,56 @@
+"""Estimate a Program's activation/parameter memory.
+
+Parity: reference ``contrib/memory_usage_calc.py:46`` ``memory_usage`` —
+sum the bytes of every LoD-tensor var the global block's ops write, with
+batch-relative (-1) dims resolved by ``batch_size``, returned as a
+(lower, upper, unit) estimate. Useful here for sizing HBM before a run;
+the actual residency is decided by XLA's buffer assignment (donation +
+reuse), so the reference's 5-10% overhead band is kept as-is.
+"""
+
+from ..framework import Program, convert_dtype
+import numpy as np
+
+__all__ = ["memory_usage"]
+
+
+def memory_usage(program, batch_size):
+    """Returns (min_total, max_total, unit_str) for ``program`` at
+    ``batch_size`` (unit auto-scales B -> KB -> MB)."""
+    if not isinstance(program, Program):
+        raise TypeError(
+            "Calculating Memory Usage requires Program as its Parameter."
+            "But you passed in %s" % (type(program)))
+    if batch_size <= 0:
+        raise ValueError("The batch size need to be positive.")
+
+    total = 0.0
+    seen = set()
+    blk = program.global_block()
+    for op in blk.ops:
+        for name in op.output_arg_names():
+            if name in seen:
+                continue
+            seen.add(name)
+            var = blk.vars.get(name)
+            if var is None or var.shape is None:
+                continue
+            count = 1
+            neg = 0
+            for x in var.shape:
+                if x < 0:
+                    neg += 1
+                    if neg > 1:
+                        raise ValueError(
+                            "Var %s has more than one negtive dim." % name)
+                    count *= batch_size * (-x)
+                else:
+                    count *= x
+            total += count * np.dtype(convert_dtype(var.dtype)).itemsize
+
+    unit = "B"
+    if total > 1024:
+        total, unit = total / 1024, "KB"
+        if total > 1024:
+            total, unit = total / 1024, "MB"
+    return total * 1.05, total * 1.1, unit

@@ -1,0 +1,104 @@
+"""Model PARAMs / FLOPs summary.
+
+Parity: reference ``contrib/model_stat.py:40`` ``summary`` — walk every
+block's ops, count parameters and forward FLOPs for the common layer
+ops (conv, fc/mul/matmul, pool, activations, batch/layer norm), print a
+table, and return the totals. Shapes with a batch (-1) leading dim
+count per-example, like the reference.
+"""
+
+from collections import OrderedDict
+
+__all__ = ["summary"]
+
+
+def _numel(shape, skip_batch=True):
+    n = 1
+    for i, d in enumerate(shape):
+        if d < 0:
+            if skip_batch and i == 0:
+                continue
+            d = 1
+        n *= d
+    return n
+
+
+def _summary_model(block_vars, op):
+    if op.type in ("conv2d", "depthwise_conv2d"):
+        k = block_vars[op.input("Filter")[0]].shape
+        in_shape = block_vars[op.input("Input")[0]].shape
+        out_shape = block_vars[op.output("Output")[0]].shape
+        # filter shape is [c_out, c_in // groups, kh, kw] — the group
+        # division is already baked into the stored shape
+        c_out, c_in_per_group, k_h, k_w = k
+        h_out, w_out = out_shape[-2], out_shape[-1]
+        kernel_ops = k_h * k_w * c_in_per_group
+        params = c_out * kernel_ops
+        flops = 2 * h_out * w_out * c_out * kernel_ops
+    elif op.type in ("mul", "matmul"):
+        from ..framework import Parameter
+
+        y = block_vars.get(op.input("Y")[0])
+        if y is None or not isinstance(y, Parameter):
+            return None
+        in_shape = block_vars[op.input("X")[0]].shape
+        out_shape = block_vars[op.output("Out")[0]].shape
+        k_in, k_out = y.shape[-2], y.shape[-1]
+        params = k_in * k_out
+        flops = 2 * k_in * k_out * max(_numel(in_shape) // max(k_in, 1), 1)
+    elif op.type == "pool2d":
+        in_shape = block_vars[op.input("X")[0]].shape
+        out_shape = block_vars[op.output("Out")[0]].shape
+        ks = op.attr("ksize", [1, 1])
+        params = 0
+        flops = _numel(out_shape) * ks[0] * ks[1]
+    elif op.type in ("sigmoid", "tanh", "relu", "leaky_relu", "prelu",
+                     "gelu"):
+        in_shape = block_vars[op.input("X")[0]].shape
+        out_shape = block_vars[op.output("Out")[0]].shape
+        params = 1 if op.type == "prelu" else 0
+        flops = _numel(in_shape)
+    elif op.type in ("batch_norm", "layer_norm"):
+        xname = op.input("X")[0]
+        in_shape = block_vars[xname].shape
+        out_key = "Y" if op.output("Y") else "Out"
+        out_shape = block_vars[op.output(out_key)[0]].shape
+        c = in_shape[1] if len(in_shape) > 1 else in_shape[-1]
+        params = c * 2
+        flops = _numel(in_shape) * 2
+    else:
+        return None
+    return in_shape, out_shape, params, flops
+
+
+def summary(main_prog, print_table=True):
+    """Collects per-op PARAMs/FLOPs; prints the table (reference prints
+    on the terminal) and returns (rows, total_params, total_flops)."""
+    rows = []
+    total_params = 0
+    total_flops = 0
+    for blk in main_prog.blocks:
+        for op in blk.ops:
+            res = _summary_model(blk.vars, op)
+            if res is None:
+                continue
+            info = OrderedDict()
+            info["type"] = op.type
+            info["input_shape"] = tuple(res[0][1:])
+            info["out_shape"] = tuple(res[1][1:])
+            info["PARAMs"] = int(res[2])
+            info["FLOPs"] = int(res[3])
+            rows.append(info)
+            total_params += info["PARAMs"]
+            total_flops += info["FLOPs"]
+    if print_table:
+        fmt = "%-18s %-22s %-22s %14s %16s"
+        print(fmt % ("type", "input_shape", "out_shape", "PARAMs", "FLOPs"))
+        for r in rows:
+            print(fmt % (r["type"], r["input_shape"], r["out_shape"],
+                         "{:,}".format(r["PARAMs"]),
+                         "{:,}".format(r["FLOPs"])))
+        print("Total PARAMs: %s (%.4fM)  Total FLOPs: %s (%.2fG)"
+              % ("{:,}".format(total_params), total_params / 1e6,
+                 "{:,}".format(total_flops), total_flops / 1e9))
+    return rows, total_params, total_flops

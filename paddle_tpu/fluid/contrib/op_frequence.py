@@ -1,0 +1,40 @@
+"""Op-frequency statistics over a Program.
+
+Parity: reference ``contrib/op_frequence.py:23`` ``op_freq_statistic`` —
+single-op counts plus adjacent (producer -> consumer) pair counts over
+the global block, ordered most-frequent first. The pair statistic is
+what the reference's fusion-pass authors mined for candidates; here it
+doubles as a fusion sanity view on what XLA will see.
+"""
+
+from collections import OrderedDict
+
+from ..framework import Program
+
+__all__ = ["op_freq_statistic"]
+
+
+def op_freq_statistic(program):
+    """Returns (uni_op_freq, adj_2_op_freq): OrderedDicts of op-type and
+    "producer,consumer" pair counts, sorted descending."""
+    if not isinstance(program, Program):
+        raise TypeError("The input type should be Porgram."
+                        "But you passed in %s" % (type(program)))
+
+    uni = OrderedDict()
+    adj = OrderedDict()
+    producer = {}  # var name -> op type of its most recent writer
+
+    for op in program.global_block().ops:
+        uni[op.type] = uni.get(op.type, 0) + 1
+        for name in op.input_arg_names():
+            src = producer.get(name)
+            if src is not None:
+                key = "%s,%s" % (src, op.type)
+                adj[key] = adj.get(key, 0) + 1
+        for name in op.output_arg_names():
+            producer[name] = op.type
+
+    uni = OrderedDict(sorted(uni.items(), key=lambda kv: -kv[1]))
+    adj = OrderedDict(sorted(adj.items(), key=lambda kv: -kv[1]))
+    return uni, adj

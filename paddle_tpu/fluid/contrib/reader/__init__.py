@@ -1,0 +1,5 @@
+"""Contrib readers (reference ``contrib/reader/``)."""
+
+from .distributed_reader import distributed_batch_reader  # noqa: F401
+
+__all__ = ["distributed_batch_reader"]

@@ -1,0 +1,28 @@
+"""Shard a batch reader across trainers.
+
+Parity: reference ``contrib/reader/distributed_reader.py:21``
+``distributed_batch_reader`` — each trainer yields every
+``PADDLE_TRAINERS_NUM``-th batch starting at its ``PADDLE_TRAINER_ID``,
+so multi-process data parallelism consumes disjoint batches from one
+source reader without a central dispatcher.
+"""
+
+import os
+
+__all__ = ["distributed_batch_reader"]
+
+
+def distributed_batch_reader(batch_reader):
+    trainers_num = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    if not trainer_id < trainers_num:
+        raise AssertionError(
+            "PADDLE_TRAINER_ID %d must be < PADDLE_TRAINERS_NUM %d"
+            % (trainer_id, trainers_num))
+
+    def decorated():
+        for batch_id, data in enumerate(batch_reader()):
+            if batch_id % trainers_num == trainer_id:
+                yield data
+
+    return decorated

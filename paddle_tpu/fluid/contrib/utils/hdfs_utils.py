@@ -1,0 +1,52 @@
+"""HDFS helpers (reference ``contrib/utils/hdfs_utils.py:29``).
+
+The client itself is ``paddle_tpu.fs.HDFSClient`` (one hadoop-shell
+implementation serves the fluid, fleet, and contrib entry points);
+``multi_download``/``multi_upload`` are the reference's trainer-sharded
+transfer helpers: trainer ``i`` of ``n`` moves every n-th file, so a
+fleet job fans directory transfers across its workers.
+"""
+
+import os
+
+from ....fs import HDFSClient, LocalFS  # noqa: F401
+
+__all__ = ["HDFSClient", "multi_download", "multi_upload"]
+
+
+def multi_download(client, hdfs_path, local_path, trainer_id, trainers,
+                   multi_processes=None):
+    """Download this trainer's shard of the files under ``hdfs_path``
+    into ``local_path``; returns the local file list.
+    ``multi_processes`` is accepted for API parity (transfers run
+    sequentially here — the hadoop shell is the bottleneck either way).
+    """
+    # HDFSClient.ls returns full paths, LocalFS.ls bare names — normalize
+    files = sorted(str(f) for f in client.ls(hdfs_path))
+    files = [f if os.path.dirname(f) else os.path.join(hdfs_path, f)
+             for f in files]
+    mine = [f for i, f in enumerate(files) if i % trainers == trainer_id]
+    os.makedirs(local_path, exist_ok=True)
+    out = []
+    for f in mine:
+        dst = os.path.join(local_path, os.path.basename(f))
+        client.download(f, dst, overwrite=True)
+        out.append(dst)
+    return out
+
+
+def multi_upload(client, hdfs_path, local_path, multi_processes=None,
+                 overwrite=False):
+    """Upload every file under ``local_path`` (recursively) to
+    ``hdfs_path``; returns the uploaded count."""
+    if not client.is_dir(hdfs_path):
+        client.makedirs(hdfs_path)
+    count = 0
+    for root, _, names in os.walk(local_path):
+        for name in names:
+            src = os.path.join(root, name)
+            rel = os.path.relpath(src, local_path)
+            client.upload(src, os.path.join(hdfs_path, rel),
+                          overwrite=overwrite)
+            count += 1
+    return count

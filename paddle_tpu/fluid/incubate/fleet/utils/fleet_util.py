@@ -78,6 +78,12 @@ class FleetUtil(object):
         import paddle_tpu.fluid as fluid
 
         scope = scope or fluid.global_scope()
+        if stat_pos is not None and stat_neg is None and \
+                stat_pos.endswith(".stat_pos"):
+            stat_neg = stat_pos[:-len(".stat_pos")] + ".stat_neg"
+        elif stat_neg is not None and stat_pos is None and \
+                stat_neg.endswith(".stat_neg"):
+            stat_pos = stat_neg[:-len(".stat_neg")] + ".stat_pos"
         if stat_pos is None or stat_neg is None:
             pos_names = [n for n in scope.var_names()
                          if n.endswith(".stat_pos")]

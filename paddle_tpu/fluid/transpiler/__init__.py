@@ -1,9 +1,15 @@
 """Program transpilers (reference ``python/paddle/fluid/transpiler/``)."""
 
-from . import collective, ps_dispatcher  # noqa: F401
+from . import (collective, geo_sgd_transpiler,  # noqa: F401
+               memory_optimization_transpiler, ps_dispatcher)
 from .collective import Collective, GradAllReduce, LocalSGD  # noqa: F401
 from .distribute_transpiler import (  # noqa: F401
     DistributeTranspiler,
     DistributeTranspilerConfig,
+)
+from .geo_sgd_transpiler import GeoSgdTranspiler  # noqa: F401
+from .memory_optimization_transpiler import (  # noqa: F401
+    memory_optimize,
+    release_memory,
 )
 from .ps_dispatcher import HashName, RoundRobin  # noqa: F401

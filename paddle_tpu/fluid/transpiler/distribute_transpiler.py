@@ -36,6 +36,8 @@ class DistributeTranspilerConfig:
         self.split_method = None  # default: modulo row sharding
         self.min_block_size = 8192
         self.sync_mode = True
+        # GeoSgdTranspiler cadence: deltas ship every this many pushes
+        self.geo_sgd_need_push_nums = 100
 
 
 class DistributeTranspiler:

@@ -1,0 +1,60 @@
+"""Geo-SGD distributed transpiler.
+
+Parity: reference ``transpiler/geo_sgd_transpiler.py:48``
+``GeoSgdTranspiler`` — parameter-server training where workers train
+against a LOCAL parameter copy and ship accumulated DELTAS every
+``geo_sgd_need_push_nums`` updates, instead of per-step push/pull.
+
+Built over this repo's tiers: the pserver side is identical to
+``DistributeTranspiler`` (the delta arrives as a gradient with lr = -1,
+an additive apply); the trainer side interposes the geo table proxy
+(``fluid/communicator.py`` ``_GeoTableProxy``) in front of every
+distributed table, so program pulls serve the local mirror and pushes
+update it, with ``GeoCommunicator`` shipping/rebasing on cadence.
+"""
+
+from .distribute_transpiler import (DistributeTranspiler,
+                                    DistributeTranspilerConfig)
+
+__all__ = ["GeoSgdTranspiler"]
+
+
+class GeoSgdTranspiler(DistributeTranspiler):
+    def __init__(self, config=None):
+        if config is None:
+            config = DistributeTranspilerConfig()
+        super(GeoSgdTranspiler, self).__init__(config)
+        self._geo_k = int(getattr(config, "geo_sgd_need_push_nums", 100))
+        self._geo_comms = {}
+
+    def transpile(self, trainer_id, program=None, pservers="", trainers=1,
+                  sync_mode=False, startup_program=None):
+        # geo is an async mode by definition
+        super(GeoSgdTranspiler, self).transpile(
+            trainer_id, program, pservers, trainers, sync_mode=False,
+            startup_program=startup_program)
+
+    def get_trainer_program(self, wait_port=True, push_init=True):
+        from ...distributed import ps
+        from ..communicator import _GeoTableProxy
+
+        program = super(GeoSgdTranspiler, self).get_trainer_program(
+            wait_port=wait_port, push_init=push_init)
+        # swap the remote proxies for geo views: local-mirror training,
+        # delta push every _geo_k updates. Idempotent: a second
+        # get_trainer_program call must not wrap the proxy in another
+        # proxy (the delta would land in the first mirror, never the PS)
+        for name in self._tables:
+            if name in self._geo_comms:
+                continue
+            remote = ps.get_table(name)
+            comm = ps.GeoCommunicator(remote, k_steps=self._geo_k)
+            self._geo_comms[name] = comm
+            ps.register_table(name, _GeoTableProxy(remote, comm))
+        return program
+
+    def sync(self):
+        """Force-ship all pending deltas (end-of-pass; the reference's
+        final geo push on barrier)."""
+        for comm in self._geo_comms.values():
+            comm.maybe_sync(force=True)

@@ -1,0 +1,48 @@
+"""Legacy memory-optimization entry points.
+
+Parity: reference ``transpiler/memory_optimization_transpiler.py:18`` —
+since 1.6 these are deprecation warnings, not rewrites (the runtime's
+default strategies replaced them). The same is true here, more so: XLA's
+buffer assignment plus donation (``enable_inplace``) owns reuse, and the
+eager-deletion analysis survives as the native last-use plan
+(``native/program_graph.cc``), which ``memory_optimize`` reports when
+available so callers still get the visibility the old pass printed.
+"""
+
+import logging
+
+__all__ = ["memory_optimize", "release_memory"]
+
+
+def memory_optimize(input_program, skip_opt_set=None, print_log=False,
+                    level=0, skip_grads=True):
+    """Deprecated no-op (reference behavior since 1.6). Logs where the
+    equivalent machinery lives now; with ``print_log`` also reports the
+    native last-use (eager-deletion) plan size for the program."""
+    logging.warning(
+        "paddle.fluid.memory_optimize() is deprecated and takes no "
+        "effect: XLA buffer assignment + donation "
+        "(build_strategy.enable_inplace, on by default) own buffer "
+        "reuse on this backend.")
+    if print_log:
+        try:
+            from ..native_program import NativeProgram
+
+            np_ = NativeProgram.from_program(input_program)
+            if np_ is not None:
+                plan = np_.last_use(0)
+                logging.warning(
+                    "last-use plan: %d vars become dead across %d ops "
+                    "(advisory; XLA already frees at these points)",
+                    sum(len(v) for v in plan.values()), len(plan))
+        except Exception:
+            pass
+    return None
+
+
+def release_memory(input_program, skip_opt_set=None):
+    """Deprecated no-op (reference behavior since 1.6)."""
+    logging.warning(
+        "paddle.fluid.release_memory() is deprecated and takes no "
+        "effect on this backend.")
+    return None
