@@ -1,8 +1,8 @@
 """contrib: AMP, slim (quant), extensions — reference ``python/paddle/fluid/contrib/``."""
 
-from . import (extend_optimizer, memory_usage_calc,  # noqa: F401
-               mixed_precision, model_stat, op_frequence, reader, slim,
-               utils)
+from . import (extend_optimizer, layers, memory_usage_calc,  # noqa: F401
+               mixed_precision, model_stat, op_frequence, quantize, reader,
+               slim, utils)
 from .extend_optimizer import extend_with_decoupled_weight_decay  # noqa: F401
 from .memory_usage_calc import memory_usage  # noqa: F401
 from .op_frequence import op_freq_statistic  # noqa: F401

@@ -1,0 +1,13 @@
+"""Contrib layers (reference ``contrib/layers/``)."""
+
+from . import metric_op, rnn_impl  # noqa: F401
+from .metric_op import ctr_metric_bundle  # noqa: F401
+from .rnn_impl import (  # noqa: F401
+    BasicGRUUnit,
+    BasicLSTMUnit,
+    basic_gru,
+    basic_lstm,
+)
+
+__all__ = ["BasicGRUUnit", "basic_gru", "BasicLSTMUnit", "basic_lstm",
+           "ctr_metric_bundle"]

@@ -1,0 +1,57 @@
+"""CTR metric bundle.
+
+Parity: reference ``contrib/layers/metric_op.py:30`` ``ctr_metric_bundle``
+— running accumulators for the CTR job dashboard: squared error, abs
+error, predicted-probability mass, q value (sigmoid mass), positive
+count, and instance count. Finalize as MAE = abserr/ins,
+RMSE = sqrt(sqrerr/ins), predicted_ctr = prob/ins, q = q/ins;
+distributed jobs reduce the six accumulators first (e.g. through
+``FleetUtil``'s reducer hook).
+"""
+
+from ... import layers
+
+from ...initializer import Constant
+from ...layer_helper import LayerHelper
+
+__all__ = ["ctr_metric_bundle"]
+
+
+def ctr_metric_bundle(input, label):
+    """input: [B, 1] probabilities; label: [B, 1]. Returns the six
+    persistable accumulators (sqrerr, abserr, prob, q, pos_num,
+    ins_num), updated in place every run."""
+    if tuple(input.shape) != tuple(label.shape):
+        raise ValueError("input/label shapes differ: %s vs %s"
+                         % (input.shape, label.shape))
+    helper = LayerHelper("ctr_metric_bundle")
+
+    def accum(name):
+        var = helper.main_program.global_block().create_var(
+            name="%s.%s" % (helper.name_prefix, name), shape=(1,),
+            dtype="float32", persistable=True, stop_gradient=True)
+        sb = helper.startup_program.global_block()
+        sv = sb.create_var(name=var.name, shape=var.shape, dtype="float32",
+                           persistable=True)
+        Constant(0.0)(sv, sb)
+        return var
+
+    acc = {n: accum(n) for n in ("sqrerr", "abserr", "prob", "q",
+                                 "pos_num", "ins_num")}
+    labelf = layers.cast(label, "float32")
+    diff = layers.elementwise_sub(input, labelf)
+    batches = {
+        "sqrerr": layers.reduce_sum(layers.square(diff)),
+        "abserr": layers.reduce_sum(layers.abs(diff)),
+        "prob": layers.reduce_sum(input),
+        "q": layers.reduce_sum(layers.sigmoid(input)),
+        "pos_num": layers.reduce_sum(labelf),
+        "ins_num": layers.reduce_sum(layers.fill_constant_batch_size_like(
+            label, [-1, 1], "float32", 1.0)),
+    }
+    for name, batch in batches.items():
+        layers.assign(
+            layers.elementwise_add(layers.reshape(batch, [1]), acc[name]),
+            acc[name])
+    return (acc["sqrerr"], acc["abserr"], acc["prob"], acc["q"],
+            acc["pos_num"], acc["ins_num"])

@@ -1,0 +1,211 @@
+"""Multi-layer / bidirectional RNN builders.
+
+Parity: reference ``contrib/layers/rnn_impl.py:19``
+(``BasicGRUUnit:22`` / ``basic_gru:139`` / ``BasicLSTMUnit:632`` /
+``basic_lstm:358``). The reference composes its units with StaticRNN;
+here the stacks build on ``layers.rnn`` + the shared GRU/LSTM cells
+(``layers/rnn.py``) — one unrolled program XLA re-rolls — with the same
+surface: ``[num_layers * direc, B, H]`` init/last hidden packing,
+inter-layer dropout, ``bidirectional`` concat, ``batch_first``.
+
+The Basic*Unit classes are single-step dygraph Layers over the same
+gate math (used eagerly or inside custom loops).
+"""
+
+from ... import layers
+from ...dygraph import Layer
+from ...dygraph import nn as dynn
+
+__all__ = ["BasicGRUUnit", "basic_gru", "BasicLSTMUnit", "basic_lstm"]
+
+
+def _trace(op_type, inputs, attrs=None):
+    from ...framework import _dygraph_tracer
+
+    (out,) = _dygraph_tracer().trace_op(op_type, inputs, ["Out"],
+                                        attrs or {})
+    return out
+
+
+def _concat(vs):
+    return _trace("concat", {"X": list(vs)}, {"axis": -1})
+
+
+def _act(name, v):
+    return _trace(name, {"X": [v]})
+
+
+class BasicGRUUnit(Layer):
+    """One GRU step: ``forward(input, pre_hidden) -> new_hidden``. The
+    whole step is traced on the autograd tape, so grads flow to the
+    gate parameters like any dygraph Layer. ``gate_activation`` /
+    ``activation`` are op names (default sigmoid / tanh)."""
+
+    def __init__(self, name_scope=None, hidden_size=None,
+                 param_attr=None, bias_attr=None,
+                 gate_activation=None, activation=None, dtype="float32"):
+        super().__init__()
+        if hidden_size is None:  # reference positional order
+            hidden_size = name_scope
+        self._hidden_size = int(hidden_size)
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._gate_act = gate_activation or "sigmoid"
+        self._cand_act = activation or "tanh"
+        # lazy: input size is known at the first forward (attribute
+        # assignment registers the sublayers — no add_sublayer needed)
+        self._fc_r = self._fc_u = self._fc_c = None
+
+    def forward(self, input, pre_hidden):
+        h = self._hidden_size
+        if self._fc_r is None:
+            in_dim = int(input.shape[-1])
+            self._fc_r = dynn.Linear(in_dim + h, h, self._param_attr,
+                                     self._bias_attr)
+            self._fc_u = dynn.Linear(in_dim + h, h, self._param_attr,
+                                     self._bias_attr)
+            self._fc_c = dynn.Linear(in_dim + h, h, self._param_attr,
+                                     self._bias_attr)
+        xh = _concat([input, pre_hidden])
+        r = _act(self._gate_act, self._fc_r(xh))
+        u = _act(self._gate_act, self._fc_u(xh))
+        c = _act(self._cand_act,
+                 self._fc_c(_concat([input, r * pre_hidden])))
+        one_minus_u = _trace("scale", {"X": [u]},
+                             {"scale": -1.0, "bias": 1.0})
+        return u * pre_hidden + one_minus_u * c
+
+
+class BasicLSTMUnit(Layer):
+    """One LSTM step: ``forward(input, pre_hidden, pre_cell) ->
+    (new_hidden, new_cell)``; ``forget_bias`` added to the forget gate
+    pre-activation like the reference. Fully traced — see
+    BasicGRUUnit."""
+
+    def __init__(self, name_scope=None, hidden_size=None,
+                 param_attr=None, bias_attr=None, gate_activation=None,
+                 activation=None, forget_bias=1.0, dtype="float32"):
+        super().__init__()
+        if hidden_size is None:
+            hidden_size = name_scope
+        self._hidden_size = int(hidden_size)
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._gate_act = gate_activation or "sigmoid"
+        self._cell_act = activation or "tanh"
+        self._forget_bias = float(forget_bias)
+        self._fc_i = self._fc_j = self._fc_f = self._fc_o = None
+
+    def forward(self, input, pre_hidden, pre_cell):
+        h = self._hidden_size
+        if self._fc_i is None:
+            in_dim = int(input.shape[-1])
+            for gate in ("i", "j", "f", "o"):
+                setattr(self, "_fc_" + gate,
+                        dynn.Linear(in_dim + h, h, self._param_attr,
+                                    self._bias_attr))
+        xh = _concat([input, pre_hidden])
+        i = _act(self._gate_act, self._fc_i(xh))
+        j = _act(self._cell_act, self._fc_j(xh))
+        f = _act(self._gate_act,
+                 _trace("scale", {"X": [self._fc_f(xh)]},
+                        {"scale": 1.0, "bias": self._forget_bias}))
+        o = _act(self._gate_act, self._fc_o(xh))
+        new_c = pre_cell * f + i * j
+        new_h = _act(self._cell_act, new_c) * o
+        return new_h, new_c
+
+
+def _stack_rnn(make_cell, n_states, input, init_hidden, init_cell,
+               hidden_size, num_layers, sequence_length, dropout_prob,
+               bidirectional, batch_first, name):
+    direc = 2 if bidirectional else 1
+    # internal layout is batch-major [B, T, ...]
+    x = input if batch_first else layers.transpose(input, [1, 0, 2])
+
+    def init_state(pack, layer_idx, d_idx):
+        if pack is None:
+            return None
+        # [num_layers*direc, B, H] -> one [B, H] slice
+        idx = layer_idx * direc + d_idx
+        return layers.squeeze(
+            layers.slice(pack, [0], [idx], [idx + 1]), [0])
+
+    last_h, last_c = [], []
+    for layer_idx in range(num_layers):
+        outs = []
+        for d_idx, rev in enumerate([False, True][:direc]):
+            cell = make_cell("%s_l%d_d%d" % (name or "basic", layer_idx,
+                                             d_idx))
+            init = None
+            if init_hidden is not None:
+                h0 = init_state(init_hidden, layer_idx, d_idx)
+                if n_states == 2:
+                    c0 = init_state(init_cell, layer_idx, d_idx)
+                    init = (h0, c0)
+                else:
+                    init = h0
+            out, st = layers.rnn(cell, x, initial_states=init,
+                                 sequence_length=sequence_length,
+                                 is_reverse=rev)
+            outs.append(out)
+            if n_states == 2:
+                last_h.append(st[0])
+                last_c.append(st[1])
+            else:
+                last_h.append(st)
+        x = outs[0] if direc == 1 else layers.concat(outs, axis=-1)
+        if dropout_prob and layer_idx < num_layers - 1:
+            x = layers.dropout(
+                x, dropout_prob,
+                dropout_implementation="upscale_in_train")
+
+    out = x if batch_first else layers.transpose(x, [1, 0, 2])
+    pack_h = layers.stack(last_h, axis=0)  # [num_layers*direc, B, H]
+    if n_states == 2:
+        return out, pack_h, layers.stack(last_c, axis=0)
+    return out, pack_h
+
+
+def basic_gru(input, init_hidden, hidden_size, num_layers=1,
+              sequence_length=None, dropout_prob=0.0, bidirectional=False,
+              batch_first=True, param_attr=None, bias_attr=None,
+              gate_activation=None, activation=None, dtype="float32",
+              name="basic_gru"):
+    """Returns (rnn_out, last_hidden): out [B, T, H*direc] (batch_first)
+    and last_hidden [num_layers*direc, B, H]."""
+    def make_cell(cell_name):
+        kw = {}
+        if gate_activation:
+            kw["gate_activation"] = gate_activation
+        if activation:
+            kw["activation"] = activation
+        return layers.GRUCell(hidden_size, param_attr=param_attr,
+                              bias_attr=bias_attr, name=cell_name, **kw)
+
+    return _stack_rnn(make_cell, 1, input, init_hidden, None, hidden_size,
+                      num_layers, sequence_length, dropout_prob,
+                      bidirectional, batch_first, name)
+
+
+def basic_lstm(input, init_hidden, init_cell, hidden_size, num_layers=1,
+               sequence_length=None, dropout_prob=0.0, bidirectional=False,
+               batch_first=True, param_attr=None, bias_attr=None,
+               gate_activation=None, activation=None, forget_bias=1.0,
+               dtype="float32", name="basic_lstm"):
+    """Returns (rnn_out, last_hidden, last_cell) with the same packing
+    as ``basic_gru``."""
+    def make_cell(cell_name):
+        kw = {}
+        if gate_activation:
+            kw["gate_activation"] = gate_activation
+        if activation:
+            kw["activation"] = activation
+        return layers.LSTMCell(hidden_size, param_attr=param_attr,
+                               bias_attr=bias_attr,
+                               forget_bias=forget_bias, name=cell_name,
+                               **kw)
+
+    return _stack_rnn(make_cell, 2, input, init_hidden, init_cell,
+                      hidden_size, num_layers, sequence_length,
+                      dropout_prob, bidirectional, batch_first, name)

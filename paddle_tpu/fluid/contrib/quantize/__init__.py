@@ -1,0 +1,5 @@
+"""Legacy quantization API (reference ``contrib/quantize/``)."""
+
+from .quantize_transpiler import QuantizeTranspiler  # noqa: F401
+
+__all__ = ["QuantizeTranspiler"]

@@ -1,0 +1,72 @@
+"""Legacy transpiler-style quantization entry point.
+
+Parity: reference ``contrib/quantize/quantize_transpiler.py:80``
+``QuantizeTranspiler`` — the pre-slim API whose three phases
+(``training_transpile`` / ``freeze_program`` / ``convert_to_int8``) map
+one-to-one onto the slim passes this build implements
+(``slim/quantization/quantization_pass.py``): fake-quant insertion for
+QAT, scale harvesting + integer weights at freeze, int8 storage last.
+This class is the thin compatibility veneer the reference itself later
+replaced with those passes; new code should use them directly.
+"""
+
+from ..slim.quantization.quantization_pass import (ConvertToInt8Pass,
+                                                   QuantizationFreezePass,
+                                                   QuantizationTransformPass)
+from ...executor import global_scope
+
+__all__ = ["QuantizeTranspiler"]
+
+
+class QuantizeTranspiler(object):
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 activation_quantize_type="abs_max",
+                 weight_quantize_type="abs_max", window_size=10000,
+                 moving_rate=0.9,
+                 quantizable_op_type=("conv2d", "depthwise_conv2d", "mul")):
+        self._weight_bits = weight_bits
+        self._activation_bits = activation_bits
+        self._act_type = activation_quantize_type
+        self._weight_type = weight_quantize_type
+        self._window_size = window_size
+        self._moving_rate = moving_rate
+        self._types = tuple(quantizable_op_type)
+        self._freeze_pass = None
+
+    def training_transpile(self, program=None, startup_program=None,
+                           scope=None):
+        """Insert fake quant/dequant for QAT (reference :146). Call
+        BEFORE optimizer.minimize, like the transform pass."""
+        from ...framework import default_main_program
+
+        program = program or default_main_program()
+        QuantizationTransformPass(
+            scope=scope or global_scope(),
+            weight_bits=self._weight_bits,
+            activation_bits=self._activation_bits,
+            activation_quantize_type=self._act_type,
+            weight_quantize_type=self._weight_type,
+            window_size=self._window_size,
+            moving_rate=self._moving_rate,
+            quantizable_op_type=self._types).apply(program)
+        return program
+
+    def freeze_program(self, program, place=None, scope=None):
+        """Freeze a trained QAT program for inference (reference :223):
+        strip activation fakes, put weights on the integer grid, append
+        dequants."""
+        self._freeze_pass = QuantizationFreezePass(
+            scope=scope or global_scope(),
+            weight_bits=self._weight_bits,
+            activation_bits=self._activation_bits,
+            weight_quantize_type=self._weight_type,
+            quantizable_op_type=self._types)
+        self._freeze_pass.apply(program)
+        return program
+
+    def convert_to_int8(self, program, place=None, scope=None):
+        """Store the frozen integer weights as int8 (reference :349).
+        Must follow ``freeze_program``."""
+        ConvertToInt8Pass(scope=scope or global_scope(),
+                          quantizable_op_type=self._types).apply(program)
+        return program

@@ -219,3 +219,79 @@ def test_model_stat_depthwise_conv():
     rows, total_params, _ = model_stat.summary(main, print_table=False)
     conv = next(r for r in rows if r["type"] == "conv2d")
     assert conv["PARAMs"] == 4 * 1 * 3 * 3  # 36, not 0
+
+
+def test_ctr_metric_bundle():
+    from paddle_tpu.fluid.contrib.layers import ctr_metric_bundle
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        p = layers.data("p", shape=[1])
+        lbl = layers.data("lbl", shape=[1])
+        bundle = ctr_metric_bundle(p, lbl)
+    exe = fluid.Executor()
+    rng = np.random.RandomState(0)
+    pv = rng.rand(8, 1).astype(np.float32)
+    lv = (rng.rand(8, 1) < 0.5).astype(np.float32)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for _ in range(2):  # accumulates across runs
+            vals = exe.run(main, feed={"p": pv, "lbl": lv},
+                           fetch_list=list(bundle))
+        sqrerr, abserr, prob, q, pos, ins = [np.asarray(v).item() for v in vals]
+    np.testing.assert_allclose(sqrerr, 2 * np.square(pv - lv).sum(), rtol=1e-5)
+    np.testing.assert_allclose(abserr, 2 * np.abs(pv - lv).sum(), rtol=1e-5)
+    np.testing.assert_allclose(prob, 2 * pv.sum(), rtol=1e-5)
+    np.testing.assert_allclose(q, 2 * (1 / (1 + np.exp(-pv))).sum(), rtol=1e-5)
+    np.testing.assert_allclose(pos, 2 * lv.sum(), rtol=1e-5)
+    np.testing.assert_allclose(ins, 16.0, rtol=1e-6)
+
+
+def test_legacy_quantize_transpiler_e2e():
+    """The pre-slim QuantizeTranspilerthree-phase flow end-to-end: QAT
+    trains, freeze integerizes weights, int8 storage keeps outputs."""
+    from paddle_tpu.fluid.contrib.quantize import QuantizeTranspiler
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(32, 8).astype(np.float32)
+    Y = (X @ rng.rand(8, 1)).astype(np.float32)
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 9
+    with fluid.program_guard(main, startup):
+        x = layers.data("qx", shape=[8])
+        yl = layers.data("qy", shape=[1])
+        h = layers.fc(x, 16, act="relu")
+        pred = layers.fc(h, 1)
+        loss = layers.mean(layers.square_error_cost(pred, yl))
+    scope = fluid.Scope()
+    qt = QuantizeTranspiler(
+        activation_quantize_type="moving_average_abs_max",
+        quantizable_op_type=("mul",))
+    with fluid.scope_guard(scope):
+        qt.training_transpile(main, startup, scope=scope)
+        with fluid.program_guard(main, startup):
+            optimizer.SGD(learning_rate=0.05).minimize(loss)
+        types = [op.type for op in main.global_block().ops]
+        assert any(t.startswith("fake_quantize") for t in types)
+        exe = fluid.Executor()
+        exe.run(startup)
+        losses = []
+        for _ in range(12):
+            (lv,) = exe.run(main, feed={"qx": X, "qy": Y},
+                            fetch_list=[loss])
+            losses.append(float(np.asarray(lv)))
+        assert losses[-1] < losses[0]
+        infer = main._prune([pred])
+        qt.freeze_program(infer, scope=scope)
+        types = [op.type for op in infer.global_block().ops]
+        assert not any(t.startswith("fake_quantize") for t in types)
+        (frozen,) = exe.run(infer, feed={"qx": X}, fetch_list=[pred])
+        wname = next(iter(qt._freeze_pass._weight_scales))
+        w = np.asarray(scope.find_var(wname))
+        np.testing.assert_allclose(w, np.round(w), atol=1e-5)
+        qt.convert_to_int8(infer, scope=scope)
+        assert np.asarray(scope.find_var(wname)).dtype == np.int8
+        (int8_out,) = exe.run(infer, feed={"qx": X}, fetch_list=[pred])
+        np.testing.assert_allclose(np.asarray(int8_out),
+                                   np.asarray(frozen), rtol=1e-4,
+                                   atol=1e-5)
