@@ -1,8 +1,17 @@
 """contrib: AMP, slim (quant), extensions — reference ``python/paddle/fluid/contrib/``."""
 
-from . import (extend_optimizer, layers, memory_usage_calc,  # noqa: F401
-               mixed_precision, model_stat, op_frequence, quantize, reader,
-               slim, utils)
+from . import (extend_optimizer, inferencer, layers,  # noqa: F401
+               memory_usage_calc, mixed_precision, model_stat, op_frequence,
+               quantize, reader, slim, trainer, utils)
+from .inferencer import Inferencer  # noqa: F401
+from .trainer import (  # noqa: F401
+    BeginEpochEvent,
+    BeginStepEvent,
+    CheckpointConfig,
+    EndEpochEvent,
+    EndStepEvent,
+    Trainer,
+)
 from .extend_optimizer import extend_with_decoupled_weight_decay  # noqa: F401
 from .memory_usage_calc import memory_usage  # noqa: F401
 from .op_frequence import op_freq_statistic  # noqa: F401

@@ -1,0 +1,49 @@
+"""High-level Inferencer API.
+
+Parity: reference ``contrib/inferencer.py:31`` (the old
+``fluid.Inferencer``): ``infer_func`` rebuilds the inference graph,
+``param_path`` supplies the trained parameters (a ``Trainer.save_params``
+directory), ``infer({name: array})`` serves. The served program is the
+``for_test`` clone, compile-cached by the Executor like any program.
+"""
+
+from . import trainer as _trainer_mod  # noqa: F401  (shared module family)
+from .. import io as fluid_io
+from ..executor import Executor, Scope, scope_guard
+from ..framework import Program, program_guard
+from .. import unique_name
+
+__all__ = ["Inferencer"]
+
+
+class Inferencer(object):
+    def __init__(self, infer_func, param_path, place=None, parallel=False):
+        self.param_path = param_path
+        self.scope = Scope()
+
+        self.inference_program = Program()
+        startup = Program()
+        with program_guard(self.inference_program, startup):
+            with unique_name.guard():
+                self.predict_var = infer_func()
+        self.exe = Executor(place)
+        with scope_guard(self.scope):
+            # init then overwrite with the trained params: vars the
+            # checkpoint lacks keep their initializer values
+            self.exe.run(startup)
+            fluid_io.load_persistables(self.exe, param_path,
+                                       self.inference_program)
+        self.inference_program = self.inference_program.clone(for_test=True)
+
+    def infer(self, inputs, return_numpy=True):
+        if not isinstance(inputs, dict):
+            raise ValueError(
+                "inputs should be a map of {'input_name': input_var}")
+        import numpy as np
+
+        with scope_guard(self.scope):
+            results = self.exe.run(self.inference_program, feed=inputs,
+                                   fetch_list=[self.predict_var])
+        if return_numpy:
+            results = [np.asarray(r) for r in results]
+        return results

@@ -12,9 +12,16 @@ The Basic*Unit classes are single-step dygraph Layers over the same
 gate math (used eagerly or inside custom loops).
 """
 
-from ... import layers
+from ... import layers, unique_name
 from ...dygraph import Layer
 from ...dygraph import nn as dynn
+
+
+def _check_dtype(dtype):
+    if dtype not in (None, "float32"):
+        raise NotImplementedError(
+            "rnn_impl computes in float32 (AMP governs mixed precision); "
+            "got dtype=%r" % (dtype,))
 
 __all__ = ["BasicGRUUnit", "basic_gru", "BasicLSTMUnit", "basic_lstm"]
 
@@ -47,6 +54,7 @@ class BasicGRUUnit(Layer):
         super().__init__()
         if hidden_size is None:  # reference positional order
             hidden_size = name_scope
+        _check_dtype(dtype)
         self._hidden_size = int(hidden_size)
         self._param_attr = param_attr
         self._bias_attr = bias_attr
@@ -88,6 +96,7 @@ class BasicLSTMUnit(Layer):
         super().__init__()
         if hidden_size is None:
             hidden_size = name_scope
+        _check_dtype(dtype)
         self._hidden_size = int(hidden_size)
         self._param_attr = param_attr
         self._bias_attr = bias_attr
@@ -125,7 +134,9 @@ def _stack_rnn(make_cell, n_states, input, init_hidden, init_cell,
 
     def init_state(pack, layer_idx, d_idx):
         if pack is None:
-            return None
+            # zero state for the missing half of an (h, c) pair
+            return layers.fill_constant_batch_size_like(
+                x, [-1, hidden_size], "float32", 0.0)
         # [num_layers*direc, B, H] -> one [B, H] slice
         idx = layer_idx * direc + d_idx
         return layers.squeeze(
@@ -135,10 +146,10 @@ def _stack_rnn(make_cell, n_states, input, init_hidden, init_cell,
     for layer_idx in range(num_layers):
         outs = []
         for d_idx, rev in enumerate([False, True][:direc]):
-            cell = make_cell("%s_l%d_d%d" % (name or "basic", layer_idx,
-                                             d_idx))
+            cell = make_cell("%s_l%d_d%d" % (name, layer_idx, d_idx))
             init = None
-            if init_hidden is not None:
+            if init_hidden is not None or \
+                    (n_states == 2 and init_cell is not None):
                 h0 = init_state(init_hidden, layer_idx, d_idx)
                 if n_states == 2:
                     c0 = init_state(init_cell, layer_idx, d_idx)
@@ -171,9 +182,14 @@ def basic_gru(input, init_hidden, hidden_size, num_layers=1,
               sequence_length=None, dropout_prob=0.0, bidirectional=False,
               batch_first=True, param_attr=None, bias_attr=None,
               gate_activation=None, activation=None, dtype="float32",
-              name="basic_gru"):
+              name=None):
     """Returns (rnn_out, last_hidden): out [B, T, H*direc] (batch_first)
-    and last_hidden [num_layers*direc, B, H]."""
+    and last_hidden [num_layers*direc, B, H]. Each call gets a UNIQUE
+    default name — two stacks never alias parameters unless the caller
+    names them identically on purpose."""
+    _check_dtype(dtype)
+    name = name or unique_name.generate("basic_gru")
+
     def make_cell(cell_name):
         kw = {}
         if gate_activation:
@@ -192,9 +208,12 @@ def basic_lstm(input, init_hidden, init_cell, hidden_size, num_layers=1,
                sequence_length=None, dropout_prob=0.0, bidirectional=False,
                batch_first=True, param_attr=None, bias_attr=None,
                gate_activation=None, activation=None, forget_bias=1.0,
-               dtype="float32", name="basic_lstm"):
+               dtype="float32", name=None):
     """Returns (rnn_out, last_hidden, last_cell) with the same packing
-    as ``basic_gru``."""
+    as ``basic_gru``; see its naming note."""
+    _check_dtype(dtype)
+    name = name or unique_name.generate("basic_lstm")
+
     def make_cell(cell_name):
         kw = {}
         if gate_activation:

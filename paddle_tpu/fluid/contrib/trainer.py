@@ -1,0 +1,218 @@
+"""High-level Trainer API.
+
+Parity: reference ``contrib/trainer.py`` (``Trainer:169`` — the old
+``fluid.Trainer`` moved into contrib): program construction from a
+``train_func``, an event-driven epoch/step loop
+(Begin/EndEpochEvent, Begin/EndStepEvent), test over the for_test
+clone, save_params / save_inference_model, and serial-numbered
+checkpoint dirs with auto-resume (``CheckpointConfig:100``). The
+reference's NCCL2/PS transpile hooks map to this build's fleet tier
+and are not re-exposed here (fleet is the supported multi-process
+path).
+"""
+
+import os
+
+from .. import io as fluid_io
+from ..data_feeder import DataFeeder
+from ..executor import Executor, Scope, scope_guard
+from ..framework import Program, program_guard
+from .. import optimizer as opt_module
+
+__all__ = [
+    "BeginEpochEvent", "EndEpochEvent", "BeginStepEvent", "EndStepEvent",
+    "CheckpointConfig", "Trainer",
+]
+
+
+class BeginEpochEvent(object):
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class EndEpochEvent(object):
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class BeginStepEvent(object):
+    def __init__(self, epoch_id, step_id):
+        self.epoch = epoch_id
+        self.step = step_id
+        #: set False in the handler to skip this step's metric fetch
+        self.fetch_metrics = True
+
+
+class EndStepEvent(object):
+    def __init__(self, epoch_id, step_id, metrics):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.metrics = metrics
+
+
+class CheckpointConfig(object):
+    """Serial-numbered checkpoints under ``checkpoint_dir`` every
+    ``epoch_interval`` epochs / ``step_interval`` steps; the newest
+    serial is auto-loaded at Trainer construction."""
+
+    def __init__(self, checkpoint_dir=None, max_num_checkpoints=3,
+                 epoch_interval=1, step_interval=10):
+        self.checkpoint_dir = checkpoint_dir or os.getcwd()
+        self.max_num_checkpoints = int(max_num_checkpoints)
+        self.epoch_interval = max(1, int(epoch_interval))
+        self.step_interval = max(1, int(step_interval))
+        self.load_serial = None
+
+    def _serial_dir(self, serial):
+        return os.path.join(self.checkpoint_dir, "checkpoint_%d" % serial)
+
+    def _latest_serial(self):
+        best = -1
+        if os.path.isdir(self.checkpoint_dir):
+            for name in os.listdir(self.checkpoint_dir):
+                if name.startswith("checkpoint_"):
+                    try:
+                        best = max(best, int(name.split("_")[-1]))
+                    except ValueError:
+                        pass
+        return best
+
+
+class Trainer(object):
+    """``train_func() -> loss`` (or [loss, metric...]) builds the graph;
+    ``optimizer_func() -> Optimizer`` supplies the optimizer. ``train``
+    drives reader batches through the program firing the event handler
+    around every epoch and step."""
+
+    def __init__(self, train_func, optimizer_func, param_path=None,
+                 place=None, parallel=False, checkpoint_config=None):
+        self.__stop = False
+        self.trainer_id = 0
+        self.checkpoint_cfg = checkpoint_config
+        if self.checkpoint_cfg is not None:
+            if not isinstance(self.checkpoint_cfg, CheckpointConfig):
+                raise TypeError("checkpoint_config must be a "
+                                "CheckpointConfig")
+            serial = self.checkpoint_cfg._latest_serial()
+            self.checkpoint_cfg.load_serial = serial if serial >= 0 else None
+        self._next_serial = 0
+
+        self.scope = Scope()
+        self.startup_program = Program()
+        self.train_program = Program()
+        with program_guard(self.train_program, self.startup_program):
+            outs = train_func()
+            self.train_func_outputs = outs if isinstance(outs, list) \
+                else [outs]
+            self.test_program = self.train_program.clone(for_test=True)
+            loss = self.train_func_outputs[0]
+            opt = optimizer_func()
+            if not isinstance(opt, opt_module.Optimizer):
+                raise TypeError(
+                    "The optimizer should be an instance of Optimizer")
+            opt.minimize(loss)
+        self.place = place
+        self.exe = Executor(place)
+
+        with self._prog_and_scope_guard():
+            self.exe.run(self.startup_program)
+            if self.checkpoint_cfg and \
+                    self.checkpoint_cfg.load_serial is not None:
+                d = self.checkpoint_cfg._serial_dir(
+                    self.checkpoint_cfg.load_serial)
+                fluid_io.load_persistables(self.exe, d, self.train_program)
+                self._next_serial = self.checkpoint_cfg.load_serial + 1
+            elif param_path and os.path.isdir(param_path):
+                fluid_io.load_persistables(self.exe, param_path,
+                                           self.train_program)
+
+    def _prog_and_scope_guard(self):
+        return scope_guard(self.scope)
+
+    def stop(self):
+        """Stop the loop after the current step completes."""
+        self.__stop = True
+
+    def _feeder(self, feed_order, program):
+        blk = program.global_block()
+        feed_vars = [blk.var(n) for n in feed_order]
+        return DataFeeder(feed_list=feed_vars)
+
+    def train(self, num_epochs, event_handler, reader=None, feed_order=None):
+        if reader is None or feed_order is None:
+            raise ValueError("train() needs reader and feed_order")
+        self.__stop = False  # a stop() only covers the loop it interrupted
+        feeder = self._feeder(feed_order, self.train_program)
+        fetch = [v.name for v in self.train_func_outputs]
+        with self._prog_and_scope_guard():
+            for epoch_id in range(num_epochs):
+                event_handler(BeginEpochEvent(epoch_id))
+                for step_id, data in enumerate(reader()):
+                    if self.__stop:
+                        if self.checkpoint_cfg:
+                            self._save_checkpoint()
+                        return
+                    begin = BeginStepEvent(epoch_id, step_id)
+                    event_handler(begin)
+                    metrics = self.exe.run(
+                        self.train_program, feed=feeder.feed(data),
+                        fetch_list=fetch if begin.fetch_metrics else [])
+                    event_handler(EndStepEvent(epoch_id, step_id, metrics))
+                    if self.checkpoint_cfg and \
+                            (step_id + 1) % \
+                            self.checkpoint_cfg.step_interval == 0:
+                        self._save_checkpoint()
+                event_handler(EndEpochEvent(epoch_id))
+                if self.checkpoint_cfg and \
+                        (epoch_id + 1) % \
+                        self.checkpoint_cfg.epoch_interval == 0:
+                    self._save_checkpoint()
+
+    def test(self, reader, feed_order):
+        """Mean of each train_func output over the test reader, on the
+        for_test clone."""
+        import numpy as np
+
+        feeder = self._feeder(feed_order, self.test_program)
+        fetch = [v.name for v in self.train_func_outputs]
+        sums, count = None, 0
+        with self._prog_and_scope_guard():
+            for data in reader():
+                vals = self.exe.run(self.test_program,
+                                    feed=feeder.feed(data),
+                                    fetch_list=fetch)
+                vals = [np.mean(np.asarray(v)) for v in vals]
+                sums = vals if sums is None else [
+                    a + b for a, b in zip(sums, vals)]
+                count += 1
+        return [s / max(count, 1) for s in (sums or [])]
+
+    def save_params(self, param_path):
+        with self._prog_and_scope_guard():
+            fluid_io.save_persistables(self.exe, param_path,
+                                       self.train_program)
+
+    def save_inference_model(self, param_path, feeded_var_names,
+                             target_var_indexes):
+        targets = [self.train_func_outputs[i] for i in target_var_indexes]
+        with self._prog_and_scope_guard():
+            fluid_io.save_inference_model(param_path, feeded_var_names,
+                                          targets, self.exe,
+                                          main_program=self.test_program)
+
+    def _save_checkpoint(self):
+        cfg = self.checkpoint_cfg
+        d = cfg._serial_dir(self._next_serial)
+        os.makedirs(d, exist_ok=True)
+        fluid_io.save_persistables(self.exe, d, self.train_program)
+        self._next_serial += 1
+        # retire old serials beyond max_num_checkpoints
+        import shutil
+
+        serials = sorted(
+            int(n.split("_")[-1])
+            for n in os.listdir(cfg.checkpoint_dir)
+            if n.startswith("checkpoint_") and
+            n.split("_")[-1].isdigit())
+        for old in serials[:-cfg.max_num_checkpoints]:
+            shutil.rmtree(cfg._serial_dir(old), ignore_errors=True)

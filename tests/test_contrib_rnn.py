@@ -127,3 +127,44 @@ def test_basic_units_grads_flow_and_unique_params():
         l2.backward()
         # o/f/i gates and their biases all receive gradient
         assert sum(p._grad is not None for p in lparams) >= 6
+
+
+def test_basic_lstm_init_cell_only_and_unique_names():
+    """init_cell without init_hidden must seed the cell state (review:
+    it was silently dropped); two default-named stacks never alias
+    parameters."""
+    B, T, D, H = 2, 3, 4, 4
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 3
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[T, D])
+        c0 = layers.fill_constant([1, B, H], "float32", 5.0)
+        out_c, lh_c, lc_c = basic_lstm(x, None, c0, hidden_size=H)
+        out_0, lh_0, lc_0 = basic_lstm(x, None, None, hidden_size=H)
+    # the two stacks created DISTINCT parameter sets
+    pnames = [p.name for p in main.all_parameters()]
+    assert len(pnames) == len(set(pnames)) == 4  # 2 stacks x (w, b)
+    exe = fluid.Executor()
+    rng = np.random.RandomState(2)
+    feed = {"x": rng.rand(B, T, D).astype(np.float32)}
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        oc, o0 = [np.asarray(v) for v in exe.run(
+            main, feed=feed, fetch_list=[out_c, out_0])]
+    # different init cell -> different trajectories (params differ too,
+    # so compare against the same stack re-run with zero cell)
+    main2, startup2 = fluid.Program(), fluid.Program()
+    main2.random_seed = 3
+    with fluid.program_guard(main2, startup2):
+        x2 = layers.data("x", shape=[T, D])
+        c02 = layers.fill_constant([1, B, H], "float32", 0.0)
+        out_z, _, _ = basic_lstm(x2, None, c02, hidden_size=H)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup2)
+        oz = np.asarray(exe.run(main2, feed=feed, fetch_list=[out_z])[0])
+    assert np.abs(oc - oz).max() > 1e-4  # the 5.0 cell seed mattered
+
+    import pytest
+
+    with pytest.raises(NotImplementedError):
+        basic_gru(None, None, 4, dtype="float64")

@@ -1,0 +1,123 @@
+"""contrib Trainer/Inferencer (reference contrib/trainer.py:169,
+inferencer.py:31): event-driven train loop, test clone, param save,
+checkpoint serials with auto-resume, and the infer round trip.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers, optimizer
+from paddle_tpu.fluid.contrib import (BeginEpochEvent, BeginStepEvent,
+                                      CheckpointConfig, EndEpochEvent,
+                                      EndStepEvent, Inferencer, Trainer)
+
+W_TRUE = np.array([[1.0], [2.0], [-1.0], [0.5]], np.float32)
+
+
+def _train_func():
+    x = layers.data("x", shape=[4])
+    y = layers.data("y", shape=[1])
+    pred = layers.fc(x, size=1, param_attr=fluid.ParamAttr(name="tr_w"),
+                     bias_attr=False, name="pred")
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    return [loss]
+
+
+def _infer_func():
+    x = layers.data("x", shape=[4])
+    return layers.fc(x, size=1, param_attr=fluid.ParamAttr(name="tr_w"),
+                     bias_attr=False, name="pred")
+
+
+def _reader():
+    rng = np.random.RandomState(0)
+    for _ in range(8):
+        xs = rng.rand(16, 4).astype(np.float32)
+        ys = xs @ W_TRUE
+        yield list(zip(xs, ys))
+
+
+def test_trainer_events_train_test_infer(tmp_path):
+    events = []
+
+    def handler(ev):
+        events.append(type(ev).__name__)
+        if isinstance(ev, EndStepEvent):
+            assert ev.metrics and np.isfinite(
+                np.asarray(ev.metrics[0]).item())
+
+    trainer = Trainer(train_func=_train_func,
+                      optimizer_func=lambda: optimizer.SGD(0.5))
+    trainer.train(num_epochs=6, event_handler=handler, reader=_reader,
+                  feed_order=["x", "y"])
+    assert events[0] == "BeginEpochEvent" and events[-1] == "EndEpochEvent"
+    assert events.count("BeginEpochEvent") == 6
+    assert events.count("EndStepEvent") == 48
+
+    # test(): the mean loss after training is small
+    (mean_loss,) = trainer.test(reader=_reader, feed_order=["x", "y"])
+    assert mean_loss < 0.05, mean_loss
+
+    params_dir = str(tmp_path / "params")
+    trainer.save_params(params_dir)
+    inf = Inferencer(_infer_func, params_dir)
+    xs = np.eye(4, dtype=np.float32)
+    (got,) = inf.infer({"x": xs})
+    np.testing.assert_allclose(got, W_TRUE, atol=0.2)
+    with pytest.raises(ValueError):
+        inf.infer([1, 2])
+
+    # save_inference_model exports the served subgraph
+    model_dir = str(tmp_path / "inf_model")
+    trainer.save_inference_model(model_dir, ["x"], [0])
+    assert os.path.exists(os.path.join(model_dir, "__model__"))
+
+
+def test_trainer_stop_and_fetch_gate():
+    seen = []
+
+    def handler(ev):
+        if isinstance(ev, BeginStepEvent):
+            ev.fetch_metrics = False  # skip fetches entirely
+        if isinstance(ev, EndStepEvent):
+            seen.append(ev.metrics)
+            trainer.stop()  # stop after the first step
+
+    trainer = Trainer(train_func=_train_func,
+                      optimizer_func=lambda: optimizer.SGD(0.1))
+    trainer.train(num_epochs=5, event_handler=handler, reader=_reader,
+                  feed_order=["x", "y"])
+    assert len(seen) == 1 and seen[0] == []
+
+
+def test_trainer_checkpoint_resume(tmp_path):
+    ckpt_dir = str(tmp_path / "ckpt")
+    cfg = CheckpointConfig(checkpoint_dir=ckpt_dir, max_num_checkpoints=2,
+                           epoch_interval=1, step_interval=1000)
+    t1 = Trainer(train_func=_train_func,
+                 optimizer_func=lambda: optimizer.SGD(0.1),
+                 checkpoint_config=cfg)
+    t1.train(num_epochs=2, event_handler=lambda ev: None, reader=_reader,
+             feed_order=["x", "y"])
+    serials = sorted(os.listdir(ckpt_dir))
+    assert serials == ["checkpoint_0", "checkpoint_1"]
+    with fluid.scope_guard(t1.scope):
+        w_trained = np.asarray(t1.scope.find_var("tr_w")).copy()
+
+    # a new trainer with the same config resumes from serial 1
+    cfg2 = CheckpointConfig(checkpoint_dir=ckpt_dir, max_num_checkpoints=2)
+    t2 = Trainer(train_func=_train_func,
+                 optimizer_func=lambda: optimizer.SGD(0.1),
+                 checkpoint_config=cfg2)
+    assert cfg2.load_serial == 1
+    with fluid.scope_guard(t2.scope):
+        w_resumed = np.asarray(t2.scope.find_var("tr_w"))
+    np.testing.assert_allclose(w_resumed, w_trained, rtol=1e-6)
+    # retirement: another epoch pushes serial 2, serial 0 retires
+    t2.train(num_epochs=1, event_handler=lambda ev: None, reader=_reader,
+             feed_order=["x", "y"])
+    serials = sorted(os.listdir(ckpt_dir))
+    assert serials == ["checkpoint_1", "checkpoint_2"]
