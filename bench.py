@@ -465,6 +465,25 @@ def bench_transformer(batch_size=32, seq_len=64, warmup=3, iters=10):
             "transformer_big_seq_len": seq_len}
 
 
+def monitor_summary():
+    """Framework-counter sub-dict for the JSON line (fluid/monitor.py):
+    the same counters a production scrape would see, so BENCH_r0x.json
+    captures executor/compile-cache behavior alongside throughput."""
+    from paddle_tpu.fluid import monitor
+
+    hits = monitor.counter("executor_compile_cache_hit_total").value
+    misses = monitor.counter("executor_compile_cache_miss_total").value
+    run_hist = monitor.get_metric("executor_run_seconds")
+    return {
+        "executor_run_count": monitor.counter("executor_run_total").value,
+        "compile_cache_hits": hits,
+        "compile_cache_misses": misses,
+        "compile_cache_hit_ratio": round(hits / max(1, hits + misses), 4),
+        "executor_run_seconds_sum": round(run_hist.sum, 3)
+        if run_hist is not None else 0.0,
+    }
+
+
 if __name__ == "__main__":
     r = bench_bert()
     assert r["mfu"] <= 1.0, (
@@ -491,4 +510,5 @@ if __name__ == "__main__":
                                  prefix="longseq4k"))
         out.update(bench_longseq(batch_size=2, seq_len=8192,
                                  prefix="longseq8k"))
+    out["monitor"] = monitor_summary()
     print(json.dumps(out))

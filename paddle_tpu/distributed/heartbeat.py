@@ -16,9 +16,19 @@ import os
 import threading
 import time
 
+from ..fluid import monitor as _monitor
+
 __all__ = ["Heartbeat", "Watchdog", "current_heartbeat_dir"]
 
 ENV_DIR = "PADDLE_HEARTBEAT_DIR"
+
+_M_BEATS = _monitor.counter(
+    "heartbeat_beats_total", help="liveness stamps written by this worker")
+_M_STEP = _monitor.gauge(
+    "heartbeat_last_step", help="step counter in the last stamp written")
+_M_STALE = _monitor.counter(
+    "watchdog_stale_detections_total",
+    help="workers the watchdog flagged stale (per poll that found any)")
 
 
 def current_heartbeat_dir():
@@ -53,7 +63,9 @@ class Heartbeat:
         except OSError:
             # the launcher owns the dir; if it tore it down (gang kill in
             # flight) do NOT recreate it — just stop stamping
-            pass
+            return
+        _M_BEATS.inc()
+        _M_STEP.set(self._step)
 
     def start(self):
         if self._dir is None:
@@ -124,4 +136,6 @@ class Watchdog:
                     out.append(r)
             elif now - last > self._timeout:
                 out.append(r)
+        if out:
+            _M_STALE.inc(len(out))
         return out

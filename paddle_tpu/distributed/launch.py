@@ -20,7 +20,20 @@ import sys
 import tempfile
 import time
 
+from ..fluid import monitor as _monitor
+
 __all__ = ["launch", "main"]
+
+_M_SPAWNED = _monitor.counter(
+    "launch_workers_spawned_total", help="trainer processes spawned")
+_M_RESTARTS = _monitor.counter(
+    "launch_gang_restarts_total",
+    help="whole-gang restarts after a crash or stale heartbeat")
+_M_FAILED = _monitor.counter(
+    "launch_gang_failures_total",
+    help="gang attempts that ended in a crash or hang (incl. the last)")
+_M_ALIVE = _monitor.gauge(
+    "launch_workers_alive", help="live trainer processes in this gang")
 
 
 def _free_port():
@@ -58,6 +71,8 @@ def _spawn_gang(nproc, cmd, node_ip, base, env, backend, log_dir,
                                           stderr=subprocess.STDOUT))
         else:
             procs.append(subprocess.Popen(cmd, env=child_env))
+    _M_SPAWNED.inc(nproc)
+    _M_ALIVE.set(nproc)
     return procs, logs
 
 
@@ -98,6 +113,7 @@ def launch(nproc, cmd, node_ip="127.0.0.1", started_port=None, env=None,
         try:
             while True:
                 codes = [p.poll() for p in procs]
+                _M_ALIVE.set(sum(1 for c in codes if c is None))
                 if all(c is not None for c in codes):
                     break
                 if any(c not in (None, 0) for c in codes):
@@ -129,9 +145,12 @@ def launch(nproc, cmd, node_ip="127.0.0.1", started_port=None, env=None,
                 f.close()
             if hb_dir:
                 shutil.rmtree(hb_dir, ignore_errors=True)
+        _M_ALIVE.set(0)
         if not failed and all(c == 0 for c in codes):
             return codes
+        _M_FAILED.inc()
         if attempt < max_restarts:
+            _M_RESTARTS.inc()
             sys.stderr.write(
                 "launch: gang failed (codes %r), restart %d/%d\n"
                 % (codes, attempt + 1, max_restarts))

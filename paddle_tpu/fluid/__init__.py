@@ -28,6 +28,7 @@ from . import (  # noqa: F401
     layers,
     lod_tensor,
     metrics,
+    monitor,
     net_drawer,
     nets,
     optimizer,
@@ -47,7 +48,14 @@ from . import core  # noqa: F401  (fluid.core.EOFException etc.)
 from .data_feeder import DataFeeder  # noqa: F401
 from .dataset import DatasetFactory  # noqa: F401
 from .reader import DataLoader, PyReader  # noqa: F401
-from .executor import Executor, Scope, global_scope, scope_guard  # noqa: F401
+from .executor import (  # noqa: F401
+    Executor,
+    Scope,
+    global_scope,
+    register_run_hook,
+    scope_guard,
+    unregister_run_hook,
+)
 from .flags import get_flags, set_flags  # noqa: F401
 from .lod import LoDTensor, LoDTensorArray, create_lod_tensor  # noqa: F401
 from .data_feed_desc import DataFeedDesc  # noqa: F401

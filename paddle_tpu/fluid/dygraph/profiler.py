@@ -2,33 +2,40 @@
 
 Parity: reference ``dygraph/profiler.py`` (``start_gperf_profiler:25`` /
 ``stop_gperf_profiler:29``), which gperf-profiles the imperative C++
-engine. Here the eager engine IS the XLA runtime, so the equivalent
-signal is a jax.profiler trace of the eager op dispatches: the trace
-lands in ``PADDLE_TPU_GPERF_DIR`` (default ``./dygraph_profile``) and is
-viewable in TensorBoard / Perfetto, alongside the host-span profiler in
-``fluid/profiler.py``.
+engine. Here the eager engine IS the XLA runtime, so start/stop route
+through the SHARED ``fluid/profiler.py`` machinery: host RecordEvent
+spans are collected (visible in ``profiler.summary()`` and as monitor
+histograms) and a jax.profiler device trace lands in
+``PADDLE_TPU_GPERF_DIR`` (default ``./dygraph_profile``), viewable in
+TensorBoard / Perfetto. The stop side is silent — gperf never printed a
+table — but the collected spans stay queryable until the next
+``reset_profiler()``.
 """
 
 import os
+
+from .. import monitor as _monitor
+from .. import profiler as _profiler
 
 __all__ = ["start_gperf_profiler", "stop_gperf_profiler"]
 
 _active = [False]
 
+_M_SESSIONS = _monitor.counter(
+    "dygraph_profiler_sessions_total",
+    help="start_gperf_profiler/stop_gperf_profiler cycles")
+
 
 def start_gperf_profiler():
-    import jax
-
     if _active[0]:  # symmetric with stop(): re-entry is a no-op
         return
     logdir = os.environ.get("PADDLE_TPU_GPERF_DIR", "./dygraph_profile")
-    jax.profiler.start_trace(logdir)
+    _profiler.start_profiler(state="All", trace_dir=logdir)
     _active[0] = True
 
 
 def stop_gperf_profiler():
-    import jax
-
     if _active[0]:
-        jax.profiler.stop_trace()
+        _profiler.stop_profiler(silent=True)
         _active[0] = False
+        _M_SESSIONS.inc()

@@ -27,11 +27,62 @@ import os
 import numpy as np
 
 from . import framework
+from . import monitor as _monitor
 from . import rng as _rng
 from .framework import Program, Variable, convert_dtype
 from .registry import LowerCtx, lower_block
 
-__all__ = ["Executor", "Scope", "global_scope", "scope_guard"]
+__all__ = ["Executor", "Scope", "global_scope", "scope_guard",
+           "register_run_hook", "unregister_run_hook"]
+
+# -- monitor series (process-wide; see fluid/monitor.py) ----------------------
+_M_RUN_SECONDS = _monitor.histogram(
+    "executor_run_seconds",
+    help="Executor.run wall time (feed normalization + compile-cache "
+         "lookup + dispatch; includes device sync only while profiling)")
+_M_RUNS = _monitor.counter(
+    "executor_run_total", help="completed Executor.run calls")
+_M_CACHE_HIT = _monitor.counter(
+    "executor_compile_cache_hit_total",
+    help="Executor.run served by an already-jitted step")
+_M_CACHE_MISS = _monitor.counter(
+    "executor_compile_cache_miss_total",
+    help="Executor.run that traced+jitted a new step "
+         "(program/feed-signature/fetch-list/sharding change)")
+
+# -- run hooks ----------------------------------------------------------------
+_RUN_HOOKS = []
+
+
+def register_run_hook(fn):
+    """Register ``fn(record)`` to fire once after every completed
+    ``Executor.run`` (the compiled-step path; server loops and EOF'd
+    py_reader runs never complete a step). ``record`` keys:
+    ``program_id`` (Program._uid), ``fetch_names``, ``wall_time``
+    (seconds), ``cache_hit``, ``profiler_enabled``. Hook exceptions are
+    logged and swallowed — observability must not fail training.
+    Returns ``fn`` so it composes as a decorator."""
+    _RUN_HOOKS.append(fn)
+    return fn
+
+
+def unregister_run_hook(fn):
+    """Remove a previously registered run hook (no-op if absent)."""
+    try:
+        _RUN_HOOKS.remove(fn)
+    except ValueError:
+        pass
+
+
+def _fire_run_hooks(record):
+    for fn in list(_RUN_HOOKS):
+        try:
+            fn(record)
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "executor run hook %r failed", fn)
 
 
 class Scope:
@@ -156,8 +207,11 @@ class Executor:
         scope=None,
         return_numpy=True,
     ):
+        import time as _time
+
         import jax
 
+        _t_run0 = _time.perf_counter()
         scope = scope or global_scope()
         feed = dict(feed or {})
         fetch_list = list(fetch_list or [])
@@ -310,6 +364,8 @@ class Executor:
         from . import flags as _flags
 
         step = self._cache.get(key)
+        cache_hit = step is not None
+        (_M_CACHE_HIT if cache_hit else _M_CACHE_MISS).inc()
         if step is None:
             if _flags.check_program_enabled():
                 # debug mode (reference multi_devices_check_pass): validate
@@ -336,8 +392,11 @@ class Executor:
         fetches, new_state, new_rng = step.fn(state, feed, rng)
         if profiling:
             jax.block_until_ready(fetches)
-            _prof._record("executor_run[%s]" % ",".join(fetch_names[:3]),
-                          _prof.now() - t0)
+            # the #p<uid> suffix keeps distinct programs with the same
+            # leading fetches from colliding in the summary table
+            _prof._record("executor_run[%s#p%d]" % (
+                ",".join(fetch_names[:3]), program._uid),
+                _prof.now() - t0)
         scope.set_var(RNG_STATE_VAR, new_rng)
         for n, v in new_state.items():
             scope.set_var(n, v)
@@ -383,6 +442,18 @@ class Executor:
                         raise FloatingPointError(
                             "FLAGS_check_nan_inf: non-finite values in "
                             "%s var %r after running program" % (label, n))
+
+        wall = _time.perf_counter() - _t_run0
+        _M_RUN_SECONDS.observe(wall)
+        _M_RUNS.inc()
+        if _RUN_HOOKS:
+            _fire_run_hooks({
+                "program_id": program._uid,
+                "fetch_names": list(fetch_names),
+                "wall_time": wall,
+                "cache_hit": cache_hit,
+                "profiler_enabled": profiling,
+            })
 
         if return_numpy:
             return [_fetch_numpy(x) for x in fetches]

@@ -16,13 +16,24 @@ API — this exists so reference py_reader training loops run unchanged.
 """
 
 import logging
+import time as _time
 import weakref
 
 import numpy as np
 
+from .. import monitor as _monitor
 from ..layer_helper import LayerHelper
 
 _LOG = logging.getLogger(__name__)
+
+_M_BATCHES = _monitor.counter(
+    "py_reader_batches_total",
+    help="batches the executor pulled from py_reader queues")
+_M_EOF = _monitor.counter(
+    "py_reader_eof_total", help="end-of-pass events (EOFException raised)")
+_M_FEED_SECONDS = _monitor.histogram(
+    "py_reader_feed_seconds",
+    help="host time to pull + normalize one py_reader batch")
 
 __all__ = ["py_reader", "create_py_reader_by_data", "read_file",
            "double_buffer"]
@@ -105,12 +116,17 @@ class _PyReader:
         core.EOFException without running anything)."""
         if self._it is None:
             raise RuntimeError("py_reader: call start() before exe.run()")
+        t0 = _time.perf_counter()
         try:
             # _to_arrays raises StopIteration itself on a partial final
             # batch (drop_last semantics)
-            return self._to_arrays(next(self._it))
+            out = self._to_arrays(next(self._it))
         except StopIteration:
+            _M_EOF.inc()
             return None
+        _M_FEED_SECONDS.observe(_time.perf_counter() - t0)
+        _M_BATCHES.inc()
+        return out
 
 
 def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,

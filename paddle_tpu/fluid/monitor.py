@@ -1,0 +1,380 @@
+"""Process-wide metrics registry — reference
+``paddle/fluid/platform/monitor.h`` (``StatRegistry`` + the
+``STAT_ADD``/``STAT_GET`` macros) grown into the counter/gauge/histogram
+triple a serving fleet actually scrapes.
+
+The profiler (``fluid/profiler.py``) answers "where did THIS run spend
+its time"; the monitor answers "what has this PROCESS done since it
+started" — compile-cache hit ratios, reader throughput, watchdog
+detections, predictor latency — and survives across profiler
+enable/disable cycles. Everything is lock-protected, label-aware, and
+``reset()``-able so tests can assert exact deltas.
+
+Exposition:
+  * ``dump_json()``           -> plain dict (bench.py embeds this)
+  * ``dump_prometheus(dst)``  -> Prometheus text format 0.0.4
+  * ``PADDLE_MONITOR_DUMP=/path`` dumps at interpreter exit
+    (``*.json`` -> JSON, anything else -> Prometheus text).
+
+No jax / framework imports here: the registry must be importable from
+every layer (executor, reader, launcher, predictor) without cycles.
+"""
+
+import atexit
+import bisect
+import json
+import os
+import re
+import threading
+from collections import OrderedDict
+
+__all__ = ["Counter", "Gauge", "Histogram", "counter", "gauge",
+           "histogram", "get_metric", "all_metrics", "reset",
+           "dump_json", "dump_prometheus", "default_buckets"]
+
+ENV_DUMP = "PADDLE_MONITOR_DUMP"
+
+_LOCK = threading.Lock()          # registry structure
+_REGISTRY = OrderedDict()         # (name, labels_tuple) -> metric
+_KINDS = {}                       # name -> (kind, help)
+
+
+def default_buckets(start=1e-6, factor=4.0, count=14):
+    """Fixed log-scale bucket upper bounds: ``start * factor**i``.
+
+    The default spans 1us .. ~67s — wide enough for a single XLA op
+    dispatch and a cold first-step compile in the same histogram."""
+    return tuple(start * factor ** i for i in range(count))
+
+
+def _labels_key(labels):
+    if not labels:
+        return ()
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class _Metric:
+    kind = None
+
+    def __init__(self, name, labels=()):
+        self.name = name
+        self.labels = OrderedDict(labels)
+        self._lock = threading.Lock()
+
+    def to_dict(self):
+        raise NotImplementedError
+
+
+class Counter(_Metric):
+    """Monotonic count (reference ``STAT_ADD``)."""
+
+    kind = "counter"
+
+    def __init__(self, name, labels=()):
+        _Metric.__init__(self, name, labels)
+        self._value = 0
+
+    def inc(self, n=1):
+        if n < 0:
+            raise ValueError("Counter.inc(%r): counters only go up — "
+                             "use a Gauge" % (n,))
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self):
+        return self._value
+
+    def _reset_value(self):
+        with self._lock:
+            self._value = 0
+
+    def to_dict(self):
+        return {"kind": self.kind, "value": self._value}
+
+
+class Gauge(_Metric):
+    """Point-in-time value (reference ``STAT_RESET`` on a stat)."""
+
+    kind = "gauge"
+
+    def __init__(self, name, labels=()):
+        _Metric.__init__(self, name, labels)
+        self._value = 0
+
+    def set(self, v):
+        with self._lock:
+            self._value = v
+
+    def inc(self, n=1):
+        with self._lock:
+            self._value += n
+
+    def dec(self, n=1):
+        with self._lock:
+            self._value -= n
+
+    @property
+    def value(self):
+        return self._value
+
+    def _reset_value(self):
+        with self._lock:
+            self._value = 0
+
+    def to_dict(self):
+        return {"kind": self.kind, "value": self._value}
+
+
+class Histogram(_Metric):
+    """Fixed log-scale buckets + sum/count/min/max. ``observe()`` is a
+    bisect + two adds under the metric lock — cheap enough for the
+    executor hot path."""
+
+    kind = "histogram"
+
+    def __init__(self, name, labels=(), buckets=None):
+        _Metric.__init__(self, name, labels)
+        self.buckets = tuple(sorted(buckets if buckets is not None
+                                    else default_buckets()))
+        if not self.buckets:
+            raise ValueError("histogram needs at least one bucket bound")
+        self._counts = [0] * (len(self.buckets) + 1)  # last = +Inf
+        self._sum = 0.0
+        self._count = 0
+        self._min = None
+        self._max = None
+
+    def observe(self, v):
+        v = float(v)
+        i = bisect.bisect_left(self.buckets, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+            if self._min is None or v < self._min:
+                self._min = v
+            if self._max is None or v > self._max:
+                self._max = v
+
+    def time(self):
+        """Context manager observing the elapsed seconds of its body."""
+        return _HistogramTimer(self)
+
+    @property
+    def count(self):
+        return self._count
+
+    @property
+    def sum(self):
+        return self._sum
+
+    def cumulative_buckets(self):
+        """[(upper_bound, cumulative_count), ...] ending with +Inf —
+        the Prometheus histogram series shape."""
+        out, acc = [], 0
+        with self._lock:
+            counts = list(self._counts)
+        for le, c in zip(self.buckets, counts):
+            acc += c
+            out.append((le, acc))
+        out.append((float("inf"), acc + counts[-1]))
+        return out
+
+    def _reset_value(self):
+        with self._lock:
+            self._counts = [0] * (len(self.buckets) + 1)
+            self._sum = 0.0
+            self._count = 0
+            self._min = None
+            self._max = None
+
+    def to_dict(self):
+        return {"kind": self.kind, "count": self._count,
+                "sum": self._sum, "min": self._min, "max": self._max,
+                "buckets": [[le, c] for le, c
+                            in self.cumulative_buckets()]}
+
+
+class _HistogramTimer:
+    def __init__(self, hist):
+        self._hist = hist
+
+    def __enter__(self):
+        import time
+
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        import time
+
+        self._hist.observe(time.perf_counter() - self._t0)
+        return False
+
+
+def _get_or_create(cls, name, help, labels, **kw):
+    key = (name, _labels_key(labels))
+    with _LOCK:
+        m = _REGISTRY.get(key)
+        if m is not None:
+            if m.kind != cls.kind:
+                raise ValueError(
+                    "metric %r already registered as a %s (wanted %s)"
+                    % (name, m.kind, cls.kind))
+            return m
+        known = _KINDS.get(name)
+        if known is not None and known[0] != cls.kind:
+            raise ValueError(
+                "metric %r already registered as a %s (wanted %s)"
+                % (name, known[0], cls.kind))
+        m = cls(name, labels=_labels_key(labels), **kw)
+        _REGISTRY[key] = m
+        if known is None or (help and not known[1]):
+            _KINDS[name] = (cls.kind, help or (known[1] if known else ""))
+        return m
+
+
+def counter(name, help="", labels=None):
+    """Get-or-create the Counter for (name, labels)."""
+    return _get_or_create(Counter, name, help, labels)
+
+
+def gauge(name, help="", labels=None):
+    """Get-or-create the Gauge for (name, labels)."""
+    return _get_or_create(Gauge, name, help, labels)
+
+
+def histogram(name, help="", labels=None, buckets=None):
+    """Get-or-create the Histogram for (name, labels). ``buckets`` is
+    honored on first creation only (series of one name share bounds)."""
+    return _get_or_create(Histogram, name, help, labels, buckets=buckets)
+
+
+def get_metric(name, labels=None):
+    """The registered metric, or None."""
+    return _REGISTRY.get((name, _labels_key(labels)))
+
+
+def all_metrics():
+    """Snapshot list of registered metrics (registration order)."""
+    with _LOCK:
+        return list(_REGISTRY.values())
+
+
+def reset():
+    """Zero every metric's VALUE in place. Instances stay registered, so
+    module-level references held by the executor/reader keep working —
+    this is the test-isolation hook."""
+    for m in all_metrics():
+        m._reset_value()
+
+
+# -- exposition ---------------------------------------------------------------
+
+def dump_json():
+    """{name: [{"labels": {...}, <metric fields>}, ...]} — the bench.py
+    embedding format."""
+    out = OrderedDict()
+    for m in all_metrics():
+        d = m.to_dict()
+        d["labels"] = dict(m.labels)
+        out.setdefault(m.name, []).append(d)
+    return out
+
+
+_NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+
+def _prom_name(name):
+    if _NAME_OK.match(name):
+        return name
+    return re.sub(r"[^a-zA-Z0-9_:]", "_",
+                  name if not name[:1].isdigit() else "_" + name)
+
+
+def _prom_labels(labels, extra=()):
+    items = list(labels.items()) + list(extra)
+    if not items:
+        return ""
+    def esc(v):
+        return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+            .replace("\n", "\\n")
+    return "{%s}" % ",".join('%s="%s"' % (_prom_name(k), esc(v))
+                             for k, v in items)
+
+
+def _prom_num(v):
+    if v is None:
+        return "NaN"
+    if v == float("inf"):
+        return "+Inf"
+    if v == float("-inf"):
+        return "-Inf"
+    return repr(float(v)) if isinstance(v, float) else str(v)
+
+
+def dump_prometheus(dst=None):
+    """Render every metric in Prometheus text exposition format 0.0.4
+    and return the text. ``dst``: None, a path string, or a writable
+    stream. Series are grouped per name under one HELP/TYPE header,
+    sorted for deterministic output (golden-testable)."""
+    by_name = OrderedDict()
+    for m in all_metrics():
+        by_name.setdefault(m.name, []).append(m)
+    lines = []
+    for name in sorted(by_name):
+        pname = _prom_name(name)
+        kind, help = _KINDS.get(name, (by_name[name][0].kind, ""))
+        if help:
+            lines.append("# HELP %s %s"
+                         % (pname, help.replace("\\", "\\\\")
+                            .replace("\n", "\\n")))
+        lines.append("# TYPE %s %s" % (pname, kind))
+        for m in sorted(by_name[name], key=lambda m: tuple(m.labels.items())):
+            if isinstance(m, Histogram):
+                for le, c in m.cumulative_buckets():
+                    lines.append("%s_bucket%s %d" % (
+                        pname,
+                        _prom_labels(m.labels, [("le", _prom_num(le))]), c))
+                lines.append("%s_sum%s %s" % (pname,
+                                              _prom_labels(m.labels),
+                                              _prom_num(m._sum)))
+                lines.append("%s_count%s %d" % (pname,
+                                                _prom_labels(m.labels),
+                                                m._count))
+            else:
+                lines.append("%s%s %s" % (pname, _prom_labels(m.labels),
+                                          _prom_num(m.value)))
+    text = "\n".join(lines) + ("\n" if lines else "")
+    if dst is not None:
+        if hasattr(dst, "write"):
+            dst.write(text)
+        else:
+            with open(dst, "w") as f:
+                f.write(text)
+    return text
+
+
+# -- atexit dump --------------------------------------------------------------
+
+def _dump_to_path(path):
+    if path.endswith(".json"):
+        with open(path, "w") as f:
+            json.dump(dump_json(), f, indent=1)
+    else:
+        dump_prometheus(path)
+    return path
+
+
+def _atexit_dump():
+    path = os.environ.get(ENV_DUMP)
+    if not path:
+        return
+    try:
+        _dump_to_path(path)
+    except OSError:
+        pass  # interpreter teardown: never raise
+
+
+atexit.register(_atexit_dump)

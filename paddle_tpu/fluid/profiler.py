@@ -14,8 +14,10 @@ import contextlib
 import time
 from collections import OrderedDict
 
+from . import monitor as _monitor
+
 __all__ = ["profiler", "start_profiler", "stop_profiler", "reset_profiler",
-           "export_chrome_tracing",
+           "export_chrome_tracing", "dropped_span_count",
            "RecordEvent", "cuda_profiler", "npu_profiler"]
 
 _enabled = False
@@ -23,13 +25,37 @@ _events = OrderedDict()  # name -> [calls, total, min, max]
 _trace_dir = None
 _spans = []              # (name, t_end, dur) — for the chrome timeline
 _MAX_SPANS = 200_000
+_dropped = [0]           # spans lost past _MAX_SPANS (satellite #1)
 # perf_counter has an arbitrary epoch; anchor it to unix time once so
 # host spans land on the same clock as device XPlane timestamps
 _EPOCH_ANCHOR = (time.perf_counter(), time.time())
 
+_M_DROPPED = _monitor.counter(
+    "profiler_dropped_spans_total",
+    help="host spans not recorded because the span buffer was full")
+# one monitor histogram series per event name, cached so the per-record
+# cost is a dict hit rather than a registry lookup
+_mon_hists = {}
+
+
+def _mon_hist(name):
+    h = _mon_hists.get(name)
+    if h is None:
+        h = _monitor.histogram(
+            "profiler_event_seconds",
+            help="host RecordEvent/Executor span durations",
+            labels={"event": name})
+        _mon_hists[name] = h
+    return h
+
 
 def now():
     return time.perf_counter()
+
+
+def dropped_span_count():
+    """Spans lost since the last reset_profiler() (buffer overflow)."""
+    return _dropped[0]
 
 
 def _record(name, seconds):
@@ -43,8 +69,12 @@ def _record(name, seconds):
         e[1] += seconds
         e[2] = min(e[2], seconds)
         e[3] = max(e[3], seconds)
+    _mon_hist(name).observe(seconds)
     if len(_spans) < _MAX_SPANS:
         _spans.append((name, time.perf_counter(), seconds))
+    else:
+        _dropped[0] += 1
+        _M_DROPPED.inc()
 
 
 class RecordEvent:
@@ -82,12 +112,14 @@ def start_profiler(state="All", tracer_option="Default", trace_dir=None):
         jax.profiler.start_trace(trace_dir)
 
 
-def stop_profiler(sorted_key=None, profile_path=None, timeline_path=None):
-    """Disable collection, print the summary table, optionally write it
-    to ``profile_path``, stop the device trace if one is running, and —
-    with ``timeline_path`` — export a chrome://tracing JSON (the
-    reference's ``tools/timeline.py`` output, host events + any captured
-    device ops)."""
+def stop_profiler(sorted_key=None, profile_path=None, timeline_path=None,
+                  silent=False):
+    """Disable collection, print the summary table (suppressed with
+    ``silent`` — the dygraph gperf route wants collection without the
+    stdout table), optionally write it to ``profile_path``, stop the
+    device trace if one is running, and — with ``timeline_path`` —
+    export a chrome://tracing JSON (the reference's ``tools/timeline.py``
+    output, host events + any captured device ops)."""
     global _enabled, _trace_dir
     _enabled = False
     trace_dir = _trace_dir
@@ -97,7 +129,8 @@ def stop_profiler(sorted_key=None, profile_path=None, timeline_path=None):
         jax.profiler.stop_trace()
         _trace_dir = None
     report = summary(sorted_key)
-    print(report)
+    if not silent:
+        print(report)
     if profile_path:
         with open(profile_path, "w") as f:
             f.write(report)
@@ -155,7 +188,11 @@ def export_chrome_tracing(path, trace_dir=None):
     meta = [{"name": "process_name", "ph": "M", "pid": 0,
              "args": {"name": "host"}},
             {"name": "process_name", "ph": "M", "pid": 1,
-             "args": {"name": "device (XLA ops)"}}]
+             "args": {"name": "device (XLA ops)"}},
+            # how many host spans the buffer dropped — a trace that hit
+            # _MAX_SPANS is TRUNCATED and must say so
+            {"name": "dropped_spans", "ph": "M", "pid": 0,
+             "args": {"count": _dropped[0]}}]
     with open(path, "w") as f:
         json.dump({"traceEvents": meta + events,
                    "displayTimeUnit": "ms"}, f)
@@ -165,6 +202,7 @@ def export_chrome_tracing(path, trace_dir=None):
 def reset_profiler():
     _events.clear()
     del _spans[:]
+    _dropped[0] = 0
 
 
 def summary(sorted_key=None):

@@ -12,13 +12,27 @@ staged ``jax.Array`` feeds untouched.
 
 import queue as _queue
 import threading
+import time as _time
 
 import numpy as np
 
+from . import monitor as _monitor
 from .framework import Variable
 
 __all__ = ["DataLoader", "PyReader", "GeneratorLoader", "WorkerInfo",
            "get_worker_info"]
+
+# -- monitor series (process-wide; see fluid/monitor.py) ----------------------
+_M_BATCHES = _monitor.counter(
+    "reader_batches_total",
+    help="batches produced by DataLoader/GeneratorLoader")
+_M_STALLS = _monitor.counter(
+    "reader_queue_full_total",
+    help="producer stalls: the prefetch queue was full when a batch "
+         "was ready (consumer is the bottleneck)")
+_M_FEED_SECONDS = _monitor.histogram(
+    "reader_feed_seconds",
+    help="batch assembly + device staging time (_to_feed)")
 
 
 class WorkerInfo:
@@ -93,6 +107,7 @@ class GeneratorLoader:
 
     # -- iteration -------------------------------------------------------
     def _to_feed(self, batch):
+        t0 = _time.perf_counter()
         items = ([batch[n] for n in self._feed_names]
                  if isinstance(batch, dict) else list(batch))
         arrays = []
@@ -109,6 +124,8 @@ class GeneratorLoader:
                 # async H2D: stages ahead while the step runs
                 a = jax.device_put(a)
             arrays.append(a)
+        _M_FEED_SECONDS.observe(_time.perf_counter() - t0)
+        _M_BATCHES.inc()
         return dict(zip(self._feed_names, arrays))
 
     def _iter_threaded(self):
@@ -118,7 +135,13 @@ class GeneratorLoader:
         def produce():
             try:
                 for batch in self._gen():
-                    q.put(self._to_feed(batch))
+                    item = self._to_feed(batch)
+                    try:
+                        q.put_nowait(item)
+                    except _queue.Full:
+                        # consumer-bound: count the stall, then block
+                        _M_STALLS.inc()
+                        q.put(item)
             finally:
                 q.put(end)
 

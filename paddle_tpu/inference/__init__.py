@@ -11,11 +11,25 @@ compile-cached jit of the whole pruned program — one fused executable
 instead of an op interpreter.
 """
 
+import time as _time
+
 import numpy as np
 
 from .. import fluid
+from ..fluid import monitor as _monitor
 
 __all__ = ["Config", "Predictor", "create_predictor", "PredictorPool"]
+
+_M_RUNS = _monitor.counter(
+    "predictor_runs_total", help="Predictor.run calls served")
+_M_LATENCY = _monitor.histogram(
+    "predictor_run_seconds",
+    help="Predictor.run wall time (host->host, numpy materialized)")
+_M_RECOMPILES = _monitor.counter(
+    "predictor_shape_recompile_total",
+    help="Predictor.run calls whose input shapes/dtypes differed from "
+         "every signature this predictor served before (each costs an "
+         "XLA recompile — pad/bucket inputs to avoid)")
 
 
 class Config:
@@ -79,6 +93,7 @@ class Predictor:
             self._fetch_vars = fetches
         self._exe = exe
         self._input_data = {}
+        self._seen_sigs = set()
 
     def _cast_params_bf16(self, scope):
         import jax.numpy as jnp
@@ -110,9 +125,19 @@ class Predictor:
         missing = [n for n in self._feed_names if n not in feed]
         if missing:
             raise ValueError("missing inference feeds: %r" % missing)
+        sig = tuple(sorted(
+            (n, tuple(np.shape(v)), str(getattr(v, "dtype", "")))
+            for n, v in feed.items()))
+        if sig not in self._seen_sigs:
+            if self._seen_sigs:  # first signature is the initial compile
+                _M_RECOMPILES.inc()
+            self._seen_sigs.add(sig)
+        t0 = _time.perf_counter()
         with fluid.scope_guard(self._scope):
             outs = self._exe.run(self._program, feed=feed,
                                  fetch_list=self._fetch_vars)
+        _M_LATENCY.observe(_time.perf_counter() - t0)
+        _M_RUNS.inc()
         self._outputs = outs
         return outs
 

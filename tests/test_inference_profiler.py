@@ -219,6 +219,126 @@ def test_chrome_timeline_export(tmp_path):
     assert all(e["dur"] >= 0 and e["ts"] >= 0 for e in evts)
 
 
+def test_profiler_counts_dropped_spans(tmp_path, monkeypatch):
+    """Past _MAX_SPANS the span buffer stops recording — the drop count
+    must be surfaced (monitor counter + chrome-trace meta event), not
+    silently truncated."""
+    import json
+
+    from paddle_tpu.fluid import monitor
+
+    monkeypatch.setattr(profiler, "_MAX_SPANS", 2)
+    monitor.reset()
+    profiler.reset_profiler()
+    profiler.start_profiler()
+    for i in range(5):
+        with profiler.RecordEvent("burst"):
+            pass
+    profiler.stop_profiler(silent=True)
+    assert profiler.dropped_span_count() == 3
+    assert monitor.counter("profiler_dropped_spans_total").value == 3
+    path = str(tmp_path / "trunc.json")
+    profiler.export_chrome_tracing(path)
+    doc = json.load(open(path))
+    (meta,) = [e for e in doc["traceEvents"]
+               if e.get("name") == "dropped_spans"]
+    assert meta["args"]["count"] == 3
+    # the summary still aggregates ALL 5 calls (only the timeline drops)
+    assert profiler._events["burst"][0] == 5
+    profiler.reset_profiler()
+    assert profiler.dropped_span_count() == 0
+
+
+def test_record_events_visible_as_monitor_histograms():
+    """RecordEvent totals are unified into the monitor registry: one
+    profiler_event_seconds series per event name."""
+    from paddle_tpu.fluid import monitor
+
+    monitor.reset()
+    profiler.reset_profiler()
+    profiler.start_profiler()
+    for _ in range(4):
+        with profiler.RecordEvent("mon_unified"):
+            pass
+    profiler.stop_profiler(silent=True)
+    h = monitor.get_metric("profiler_event_seconds",
+                           labels={"event": "mon_unified"})
+    assert h is not None and h.count == 4
+    assert 'event="mon_unified"' in monitor.dump_prometheus()
+
+
+def test_run_event_names_distinguish_programs():
+    """Two programs with IDENTICAL fetch names must not collide in the
+    profiler table (the event name carries a #p<uid> suffix)."""
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            x = layers.data("px", shape=[4], dtype="float32")
+            y = layers.mean(layers.scale(x, scale=2.0))
+        return main, startup, y
+
+    main_a, startup_a, ya = build()
+    main_b, startup_b, yb = build()
+    assert ya.name == yb.name  # the old fetch_names[:3] key collided
+    exe = fluid.Executor()
+    feed = {"px": np.ones((2, 4), np.float32)}
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup_a)
+        exe.run(startup_b)
+        profiler.reset_profiler()
+        profiler.start_profiler()
+        exe.run(main_a, feed=feed, fetch_list=[ya])
+        exe.run(main_b, feed=feed, fetch_list=[yb])
+        profiler.stop_profiler(silent=True)
+    run_events = [n for n in profiler._events if n.startswith("executor_run")]
+    assert len(run_events) == 2
+    assert all("#p" in n for n in run_events)
+    profiler.reset_profiler()
+
+
+def test_predictor_monitor_latency_and_shape_recompiles(tmp_path):
+    """Every Predictor.run lands in the latency histogram; a NEW input
+    shape signature counts as a recompile."""
+    from paddle_tpu.fluid import monitor
+
+    try:
+        _, xv = _train_and_save(tmp_path, seed=11)
+        p = inference.create_predictor(inference.Config(str(tmp_path)))
+    except OSError as e:  # pre-existing: native tensor_io .so unloadable
+        pytest.skip("native lib unavailable: %s" % e)
+    monitor.reset()
+    p.run({"x": xv})
+    p.run({"x": xv})            # same signature: no recompile
+    p.run({"x": xv[:4]})        # new batch shape: recompile
+    assert monitor.counter("predictor_runs_total").value == 3
+    assert monitor.get_metric("predictor_run_seconds").count == 3
+    assert monitor.counter("predictor_shape_recompile_total").value == 1
+
+
+def test_dygraph_gperf_routes_through_shared_profiler(tmp_path,
+                                                      monkeypatch):
+    """dygraph start/stop_gperf_profiler is no longer a stub: it drives
+    the shared fluid profiler (host spans + monitor counters)."""
+    from paddle_tpu.fluid import monitor
+    from paddle_tpu.fluid.dygraph import profiler as dyprof
+
+    monkeypatch.setenv("PADDLE_TPU_GPERF_DIR", str(tmp_path / "gp"))
+    monitor.reset()
+    profiler.reset_profiler()
+    dyprof.start_gperf_profiler()
+    assert profiler.is_profiler_enabled()
+    with profiler.RecordEvent("dy_section"):
+        pass
+    dyprof.stop_gperf_profiler()
+    assert not profiler.is_profiler_enabled()
+    assert "dy_section" in profiler._events
+    assert monitor.counter("dygraph_profiler_sessions_total").value == 1
+    dyprof.stop_gperf_profiler()  # idempotent
+    assert monitor.counter("dygraph_profiler_sessions_total").value == 1
+    profiler.reset_profiler()
+
+
 def test_dropout_inference_scales_by_exact_keep():
     """downgrade_in_infer inference multiplies by EXACT 1-p (reference
     checkpoint parity) while training folds the realized-keep correction

@@ -1,0 +1,333 @@
+"""Framework-wide metrics registry — reference
+``paddle/fluid/platform/monitor.h`` (StatRegistry / STAT macros), grown
+into counter/gauge/histogram series with Prometheus + JSON exposition
+(``fluid/monitor.py``) and the executor run-hook API.
+"""
+
+import json
+import re
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers, monitor
+
+
+@pytest.fixture(autouse=True)
+def _fresh_values():
+    """Zero every process-wide series so each test asserts exact deltas."""
+    monitor.reset()
+    yield
+    monitor.reset()
+
+
+# -- metric semantics ---------------------------------------------------------
+
+def test_counter_semantics():
+    c = monitor.counter("t_requests_total", help="test counter")
+    assert c.value == 0
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    with pytest.raises(ValueError, match="only go up"):
+        c.inc(-1)
+    # get-or-create returns the SAME instance
+    assert monitor.counter("t_requests_total") is c
+
+
+def test_gauge_semantics():
+    g = monitor.gauge("t_inflight")
+    g.set(10)
+    g.inc(2)
+    g.dec(5)
+    assert g.value == 7
+
+
+def test_histogram_buckets_are_log_scale_and_cumulative():
+    h = monitor.histogram("t_latency", buckets=(0.001, 0.01, 0.1, 1.0))
+    for v in (0.0005, 0.005, 0.005, 0.5, 50.0):
+        h.observe(v)
+    assert h.count == 5
+    assert abs(h.sum - 50.5105) < 1e-9
+    cum = h.cumulative_buckets()
+    assert [c for _, c in cum] == [1, 3, 3, 4, 5]
+    assert cum[-1][0] == float("inf")
+    # cumulative counts are monotone
+    counts = [c for _, c in cum]
+    assert counts == sorted(counts)
+    d = h.to_dict()
+    assert d["min"] == 0.0005 and d["max"] == 50.0
+
+
+def test_histogram_default_buckets_log_scale():
+    b = monitor.default_buckets()
+    ratios = {round(b[i + 1] / b[i], 6) for i in range(len(b) - 1)}
+    assert ratios == {4.0}  # fixed log-scale factor
+    assert b[0] <= 1e-6 and b[-1] > 10  # spans us..tens of seconds
+
+
+def test_histogram_observe_on_bound_is_inclusive():
+    h = monitor.histogram("t_edge", buckets=(1.0, 10.0))
+    h.observe(1.0)  # le="1.0" is inclusive (Prometheus semantics)
+    assert h.cumulative_buckets()[0] == (1.0, 1)
+
+
+def test_histogram_timer():
+    h = monitor.histogram("t_timed")
+    with h.time():
+        time.sleep(0.01)
+    assert h.count == 1 and h.sum >= 0.005
+
+
+def test_labels_make_separate_series():
+    a = monitor.counter("t_labeled", labels={"method": "get"})
+    b = monitor.counter("t_labeled", labels={"method": "put"})
+    assert a is not b
+    a.inc(2)
+    assert b.value == 0
+    # label order is irrelevant to identity
+    c = monitor.counter("t_two", labels={"x": 1, "y": 2})
+    assert monitor.counter("t_two", labels={"y": 2, "x": 1}) is c
+
+
+def test_kind_conflict_raises():
+    monitor.counter("t_conflict")
+    with pytest.raises(ValueError, match="already registered"):
+        monitor.gauge("t_conflict")
+    with pytest.raises(ValueError, match="already registered"):
+        monitor.histogram("t_conflict", labels={"a": "b"})
+
+
+def test_reset_zeroes_in_place():
+    c = monitor.counter("t_reset_me")
+    h = monitor.histogram("t_reset_hist")
+    c.inc(3)
+    h.observe(1.0)
+    monitor.reset()
+    assert c.value == 0 and h.count == 0 and h.sum == 0.0
+    assert monitor.counter("t_reset_me") is c  # instance survives
+    c.inc()
+    assert c.value == 1
+
+
+# -- exposition ---------------------------------------------------------------
+
+def test_dump_json_shape():
+    monitor.counter("t_json_c", labels={"k": "v"}).inc(2)
+    monitor.histogram("t_json_h", buckets=(1.0,)).observe(0.5)
+    d = monitor.dump_json()
+    json.dumps(d)  # must be JSON-serializable as-is
+    assert d["t_json_c"] == [{"kind": "counter", "value": 2,
+                              "labels": {"k": "v"}}]
+    (h,) = d["t_json_h"]
+    assert h["kind"] == "histogram" and h["count"] == 1
+    assert h["buckets"] == [[1.0, 1], [float("inf"), 1]]
+
+
+def test_prometheus_golden():
+    """Exact text for a known set of series (format 0.0.4)."""
+    monitor.counter("zz_golden_total", help="served requests",
+                    labels={"method": "get"}).inc(3)
+    monitor.counter("zz_golden_total", labels={"method": "put"}).inc(1)
+    monitor.gauge("zz_golden_inflight").set(2)
+    h = monitor.histogram("zz_golden_seconds", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = monitor.dump_prometheus()
+    block = "\n".join(l for l in text.splitlines() if "zz_golden" in l)
+    assert block == "\n".join([
+        '# TYPE zz_golden_inflight gauge',
+        'zz_golden_inflight 2',
+        '# TYPE zz_golden_seconds histogram',
+        'zz_golden_seconds_bucket{le="0.1"} 1',
+        'zz_golden_seconds_bucket{le="1.0"} 2',
+        'zz_golden_seconds_bucket{le="+Inf"} 3',
+        'zz_golden_seconds_sum 5.55',
+        'zz_golden_seconds_count 3',
+        '# HELP zz_golden_total served requests',
+        '# TYPE zz_golden_total counter',
+        'zz_golden_total{method="get"} 3',
+        'zz_golden_total{method="put"} 1',
+    ])
+
+
+_PROM_LINE = re.compile(
+    r'^(# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]*( .*)?'
+    r'|[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? (NaN|[+-]Inf|-?[0-9.eE+-]+))$')
+
+
+def test_prometheus_full_output_parses():
+    """EVERY line of the full process dump must be valid exposition
+    text — this sweeps the names the framework modules registered at
+    import (executor, reader, heartbeat, predictor...)."""
+    monitor.histogram("t_parse_h", labels={"event": 'odd"name\nx'}) \
+        .observe(0.1)
+    text = monitor.dump_prometheus()
+    assert text.endswith("\n")
+    for line in text.splitlines():
+        assert _PROM_LINE.match(line), "bad exposition line: %r" % line
+
+
+def test_dump_prometheus_to_path_and_stream(tmp_path):
+    import io
+
+    monitor.counter("t_dst").inc()
+    p = str(tmp_path / "m.prom")
+    text = monitor.dump_prometheus(p)
+    assert open(p).read() == text
+    buf = io.StringIO()
+    monitor.dump_prometheus(buf)
+    assert buf.getvalue() == text
+
+
+def test_env_dump_at_exit(tmp_path, monkeypatch):
+    monitor.counter("t_atexit").inc(7)
+    # JSON by extension
+    jpath = str(tmp_path / "dump.json")
+    monkeypatch.setenv(monitor.ENV_DUMP, jpath)
+    monitor._atexit_dump()
+    assert json.load(open(jpath))["t_atexit"][0]["value"] == 7
+    # Prometheus otherwise
+    ppath = str(tmp_path / "dump.prom")
+    monkeypatch.setenv(monitor.ENV_DUMP, ppath)
+    monitor._atexit_dump()
+    assert "t_atexit 7" in open(ppath).read()
+    monkeypatch.delenv(monitor.ENV_DUMP)
+    monitor._atexit_dump()  # unset env: no-op
+
+
+# -- executor wiring ----------------------------------------------------------
+
+def _tiny_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("mx", shape=[4], dtype="float32")
+        y = layers.mean(layers.fc(x, size=2))
+    return main, startup, y
+
+
+def test_executor_run_histogram_and_cache_counters():
+    main, startup, y = _tiny_program()
+    exe = fluid.Executor()
+    feed = {"mx": np.ones((2, 4), np.float32)}
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for _ in range(3):
+            exe.run(main, feed=feed, fetch_list=[y])
+    d = monitor.dump_json()
+    (h,) = d["executor_run_seconds"]
+    assert h["count"] == 4 and h["sum"] > 0
+    assert monitor.counter("executor_run_total").value == 4
+    # startup + first main run compile; runs 2-3 hit the cache
+    assert monitor.counter("executor_compile_cache_miss_total").value == 2
+    assert monitor.counter("executor_compile_cache_hit_total").value == 2
+    # prometheus exposition of the histogram is non-zero
+    text = monitor.dump_prometheus()
+    assert "executor_run_seconds_count 4" in text
+
+
+def test_run_hooks_fire_exactly_once_per_run():
+    main, startup, y = _tiny_program()
+    exe = fluid.Executor()
+    feed = {"mx": np.ones((2, 4), np.float32)}
+    records = []
+    fluid.register_run_hook(records.append)
+    try:
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            exe.run(main, feed=feed, fetch_list=[y])
+            exe.run(main, feed=feed, fetch_list=[y])
+    finally:
+        fluid.unregister_run_hook(records.append)
+    assert len(records) == 3
+    rec = records[-1]
+    assert rec["program_id"] == main._uid
+    assert rec["fetch_names"] == [y.name]
+    assert rec["wall_time"] > 0
+    assert rec["cache_hit"] is True and records[1]["cache_hit"] is False
+    assert rec["profiler_enabled"] is False
+    # unregistered: no further firing
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+    assert len(records) == 3
+    fluid.unregister_run_hook(records.append)  # absent: no-op
+
+
+def test_run_hook_errors_are_swallowed():
+    main, startup, y = _tiny_program()
+    exe = fluid.Executor()
+
+    def bad_hook(record):
+        raise RuntimeError("observability must not fail training")
+
+    fluid.register_run_hook(bad_hook)
+    try:
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)  # must not raise
+    finally:
+        fluid.unregister_run_hook(bad_hook)
+
+
+# -- reader wiring ------------------------------------------------------------
+
+def test_reader_batch_and_feed_latency_counters():
+    from paddle_tpu.fluid.reader import DataLoader
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("rx", shape=[2], dtype="float32")
+    loader = DataLoader.from_generator(feed_list=[x], capacity=2,
+                                       stage_on_device=False)
+
+    def gen():
+        for i in range(5):
+            yield [np.full((3, 2), i, np.float32)]
+
+    loader.set_batch_generator(gen)
+    n = sum(1 for _ in loader)
+    assert n == 5
+    assert monitor.counter("reader_batches_total").value == 5
+    assert monitor.get_metric("reader_feed_seconds").count == 5
+
+
+def test_reader_queue_full_stall_counter():
+    from paddle_tpu.fluid.reader import DataLoader
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("sx", shape=[1], dtype="float32")
+    loader = DataLoader.from_generator(feed_list=[x], capacity=1,
+                                       stage_on_device=False)
+
+    def gen():
+        for i in range(6):
+            yield [np.zeros((1, 1), np.float32)]
+
+    loader.set_batch_generator(gen)
+    for _ in loader:
+        time.sleep(0.02)  # slow consumer: the producer must stall
+    assert monitor.counter("reader_queue_full_total").value > 0
+
+
+# -- heartbeat / watchdog wiring ---------------------------------------------
+
+def test_heartbeat_and_watchdog_counters(tmp_path):
+    from paddle_tpu.distributed.heartbeat import Heartbeat, Watchdog
+
+    hb = Heartbeat(rank=0, dirname=str(tmp_path), interval=60)
+    hb.beat(step=5)
+    hb.beat(step=9)
+    assert monitor.counter("heartbeat_beats_total").value == 2
+    assert monitor.gauge("heartbeat_last_step").value == 9
+
+    # rank 0 stamped just now; rank 1 never did and grace has passed
+    wd = Watchdog(str(tmp_path), nproc=2, timeout=60.0, startup_grace=0.0)
+    time.sleep(0.01)
+    assert wd.stale_workers() == [1]
+    assert monitor.counter("watchdog_stale_detections_total").value == 1
+    # a detached (no-dir) heartbeat never stamps or counts
+    Heartbeat(rank=7, dirname=None).beat(step=1)
+    assert monitor.counter("heartbeat_beats_total").value == 2
