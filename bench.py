@@ -66,7 +66,26 @@ def bert_train_flops_per_step(cfg, batch, seq, n_pred=None):
     return 3 * (L * per_layer + head)
 
 
-def _timed_run(exe, main, batch, loss, iters, jax):
+def _timed_run(exe, main, batch, loss, iters, jax, use_iters=False):
+    if use_iters:
+        # step-batched window (exe.run(..., iters=k)): ONE dispatch drives
+        # all k steps device-side (lax.scan with donated state), so the
+        # window measures compute, not k Python+PJRT round trips — this is
+        # what stabilized the host-overhead-bound configs (LeNet swung
+        # ±40% run-to-run, DeepFM lost 20% under host contention). The
+        # feed is loop-invariant (per-step shape, reused each iteration);
+        # the untimed first call compiles the k-step executable (k is part
+        # of the compile-cache key).
+        (traj,) = exe.run(main, feed=batch, fetch_list=[loss],
+                          iters=iters, return_numpy=False)
+        jax.block_until_ready(traj)
+        t0 = time.perf_counter()
+        (traj,) = exe.run(main, feed=batch, fetch_list=[loss],
+                          iters=iters, return_numpy=False)
+        jax.block_until_ready(traj)
+        elapsed = time.perf_counter() - t0
+        assert np.isfinite(np.asarray(traj)).all()
+        return elapsed
     # drain in-flight work so the window times exactly `iters` steps —
     # with millisecond-scale steps any carried-over dispatch shows up as a
     # fixed cost that fakes better scaling at higher iters
@@ -86,13 +105,14 @@ def _timed_run(exe, main, batch, loss, iters, jax):
 
 
 def _stable_throughput(exe, main, feed, loss, iters, jax, units_per_step,
-                       what):
+                       what, use_iters=False):
     """Measurement-validation protocol shared by every bench: time `iters`
     then `2*iters` steps; the rates must agree within [0.7, 1.43) or the
     harness is measuring less than it claims. Returns (rate at 2*iters,
-    rate at iters, step seconds from the longer run)."""
-    elapsed = _timed_run(exe, main, feed, loss, iters, jax)
-    elapsed2 = _timed_run(exe, main, feed, loss, 2 * iters, jax)
+    rate at iters, step seconds from the longer run). ``use_iters`` runs
+    each window as one step-batched dispatch (``exe.run(..., iters=k)``)."""
+    elapsed = _timed_run(exe, main, feed, loss, iters, jax, use_iters)
+    elapsed2 = _timed_run(exe, main, feed, loss, 2 * iters, jax, use_iters)
     r1 = units_per_step * iters / elapsed
     r2 = units_per_step * 2 * iters / elapsed2
     assert 0.7 < r2 / r1 < 1.43, (
@@ -288,9 +308,11 @@ def bench_resnet(batch_size=256, image_size=224, warmup=3, iters=10):
 
 def bench_lenet(batch_size=1024, warmup=10, iters=100):
     """BASELINE config 1 (MNIST LeNet images/sec/chip, the first e2e
-    milestone); opt-in via BENCH_LENET=1. Steps are host-overhead bound
-    (~10 ms), so the windows are long to ride out tunnel jitter; note the
-    first-step XLA conv compile can take minutes on a tunneled chip."""
+    milestone); opt-in via BENCH_LENET=1. Steps were host-overhead bound
+    (~10 ms, ±40% run-to-run under tunnel jitter — PROFILE_r05 §3), so
+    the timed windows run step-batched (exe.run(..., iters=k): one
+    dispatch, k device-side steps) and measure compute; the first-step
+    XLA conv compile can still take minutes on a tunneled chip."""
     import paddle_tpu.fluid as fluid
     from paddle_tpu.models import lenet
 
@@ -310,7 +332,7 @@ def bench_lenet(batch_size=1024, warmup=10, iters=100):
             assert np.isfinite(np.asarray(lv)).all()
         ips, _, step_s = _stable_throughput(
             exe, main, feed, loss, iters, jax, batch_size,
-            "lenet images/sec")
+            "lenet images/sec", use_iters=True)
     return {"lenet_images_per_sec": round(ips, 1),
             "lenet_step_time_ms": round(step_s * 1e3, 3),
             "lenet_batch_size": batch_size}
@@ -363,7 +385,9 @@ def bench_deepfm(batch_size=4096, warmup=20, iters=2000):
     is examples/sec, not MFU. Steps are ~3.8 ms, so the window is LONG
     (2000 iters ≈ 7.5 s x2): 40-iter windows swung 0.48-0.86M ex/s
     run-to-run; at 2000+ iters repeated runs agree within 0.1%
-    (1.0865M vs 1.0854M, r5)."""
+    (1.0865M vs 1.0854M, r5). The windows run step-batched
+    (exe.run(..., iters=k)) so host CPU contention — which cost 20% at
+    one dispatch per step (PROFILE_r05 §5) — stays out of the number."""
     import paddle_tpu.fluid as fluid
     from paddle_tpu.models import deepfm
 
@@ -381,7 +405,7 @@ def bench_deepfm(batch_size=4096, warmup=20, iters=2000):
             assert np.isfinite(np.asarray(lv)).all()
         eps, _, step_s = _stable_throughput(
             exe, main, feed, loss, iters, jax, batch_size,
-            "deepfm examples/sec")
+            "deepfm examples/sec", use_iters=True)
     return {"deepfm_examples_per_sec": round(eps, 1),
             "deepfm_step_time_ms": round(step_s * 1e3, 3),
             "deepfm_batch_size": batch_size,
@@ -481,6 +505,10 @@ def monitor_summary():
         "compile_cache_hit_ratio": round(hits / max(1, hits + misses), 4),
         "executor_run_seconds_sum": round(run_hist.sum, 3)
         if run_hist is not None else 0.0,
+        "batched_run_count":
+            monitor.counter("executor_batched_run_total").value,
+        "batched_iters_total":
+            monitor.counter("executor_batched_iters_total").value,
     }
 
 

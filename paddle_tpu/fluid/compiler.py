@@ -446,6 +446,28 @@ class CompiledProgram:
 
         return fn
 
+    def _state_sharding(self, block, name, mesh, repl):
+        """Param layout: ``ParamAttr(shard=...)`` specs over the mesh,
+        everything else replicated (shared by the single-step and
+        step-batched GSPMD wrappers)."""
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        var = block._find_var_recursive(name) if block is not None \
+            else None
+        spec = getattr(var, "shard_spec", None) if var is not None \
+            else None
+        if spec is None:
+            return repl
+        missing = [a for a in spec if a is not None
+                   and a not in mesh.shape]
+        if missing:
+            raise ValueError(
+                "param %r shard spec %r names mesh axes %r absent from "
+                "the mesh %r" % (name, spec, missing,
+                                 dict(mesh.shape)))
+        return NamedSharding(mesh, P(*spec))
+
     def _wrap_step_gspmd(self, step, block, feed, fetch_names, state_names):
         """jit the lowered step under the mesh: batch over 'dp', params
         laid out by their ``shard_spec`` (TP), everything else replicated.
@@ -466,24 +488,9 @@ class CompiledProgram:
                 return NamedSharding(mesh, P("dp", *([None] * (ndim - 1))))
             return repl
 
-        def state_sharding(name):
-            var = block._find_var_recursive(name) if block is not None \
-                else None
-            spec = getattr(var, "shard_spec", None) if var is not None \
-                else None
-            if spec is None:
-                return repl
-            missing = [a for a in spec if a is not None
-                       and a not in mesh.shape]
-            if missing:
-                raise ValueError(
-                    "param %r shard spec %r names mesh axes %r absent from "
-                    "the mesh %r" % (name, spec, missing,
-                                     dict(mesh.shape)))
-            return NamedSharding(mesh, P(*spec))
-
         feed_shardings = {n: feed_sharding(n) for n in feed}
-        state_shardings = {n: state_sharding(n) for n in state_names}
+        state_shardings = {n: self._state_sharding(block, n, mesh, repl)
+                           for n in state_names}
         in_shardings = (
             state_shardings,
             feed_shardings,
@@ -508,5 +515,64 @@ class CompiledProgram:
             }
             rng = jax.device_put(rng, repl)
             return jfn(state, feed_vals, rng)
+
+        return fn
+
+    def wrap_batched_step(self, batched, block, stacked_feed,
+                          invariant_feed, fetch_names, state_names):
+        """Step-batched (``iters=k``) execution under this strategy.
+        GSPMD only: stacked feeds shard their SECOND axis over 'dp' (the
+        leading axis is the iteration index the device-side scan slices),
+        invariant feeds shard their leading axis like single-step feeds,
+        params follow their ``shard_spec``. shard_map and pipeline modes
+        already schedule their own device-side loops, so a scan around
+        them is refused rather than half-supported."""
+        mode = getattr(self, "_mode", "gspmd")
+        if mode != "gspmd":
+            raise RuntimeError(
+                "iters>1 supports plain programs and GSPMD data/hybrid "
+                "parallelism (with_data_parallel); %r mode schedules its "
+                "own device-side loop — drive steps from the host "
+                "instead" % mode)
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        mesh = self.mesh
+        repl = NamedSharding(mesh, P())
+
+        def data_sharding(arr, bdim):
+            ndim = np.ndim(arr)
+            if "dp" in mesh.shape and ndim > bdim and \
+                    np.shape(arr)[bdim] % mesh.shape["dp"] == 0:
+                spec = [None] * ndim
+                spec[bdim] = "dp"
+                return NamedSharding(mesh, P(*spec))
+            return repl
+
+        state_shardings = {n: self._state_sharding(block, n, mesh, repl)
+                           for n in state_names}
+        stacked_shardings = {n: data_sharding(stacked_feed[n], 1)
+                             for n in stacked_feed}
+        invariant_shardings = {n: data_sharding(invariant_feed[n], 0)
+                               for n in invariant_feed}
+        donate = (0,) if self._build_strategy.enable_inplace else ()
+        jfn = jax.jit(
+            batched,
+            in_shardings=(state_shardings, stacked_shardings,
+                          invariant_shardings, repl),
+            out_shardings=([repl for _ in fetch_names], None, repl),
+            donate_argnums=donate,
+        )
+
+        def fn(state, stacked_vals, invariant_vals, rng):
+            state = {k: jax.device_put(v, state_shardings.get(k, repl))
+                     for k, v in state.items()}
+            stacked_vals = {k: jax.device_put(v, stacked_shardings[k])
+                            for k, v in stacked_vals.items()}
+            invariant_vals = {k: jax.device_put(v, invariant_shardings[k])
+                              for k, v in invariant_vals.items()}
+            rng = jax.device_put(rng, repl)
+            return jfn(state, stacked_vals, invariant_vals, rng)
 
         return fn

@@ -59,6 +59,10 @@ class CheckpointConfig(object):
                  epoch_interval=1, step_interval=10):
         self.checkpoint_dir = checkpoint_dir or os.getcwd()
         self.max_num_checkpoints = int(max_num_checkpoints)
+        if self.max_num_checkpoints < 1:
+            raise ValueError(
+                "max_num_checkpoints must be >= 1 (every save would "
+                "otherwise retire itself), got %r" % (max_num_checkpoints,))
         self.epoch_interval = max(1, int(epoch_interval))
         self.step_interval = max(1, int(step_interval))
         self.load_serial = None
@@ -214,5 +218,7 @@ class Trainer(object):
             for n in os.listdir(cfg.checkpoint_dir)
             if n.startswith("checkpoint_") and
             n.split("_")[-1].isdigit())
-        for old in serials[:-cfg.max_num_checkpoints]:
+        # explicit bound: a plain serials[:-N] slice silently retires the
+        # WRONG end (or nothing) for degenerate N values
+        for old in serials[:max(0, len(serials) - cfg.max_num_checkpoints)]:
             shutil.rmtree(cfg._serial_dir(old), ignore_errors=True)

@@ -49,6 +49,14 @@ _M_CACHE_MISS = _monitor.counter(
     "executor_compile_cache_miss_total",
     help="Executor.run that traced+jitted a new step "
          "(program/feed-signature/fetch-list/sharding change)")
+_M_BATCHED_RUNS = _monitor.counter(
+    "executor_batched_run_total",
+    help="Executor.run calls that lowered iters>1 steps into one "
+         "device-side loop (lax.scan) dispatch")
+_M_BATCHED_ITERS = _monitor.counter(
+    "executor_batched_iters_total",
+    help="device-side training steps executed inside batched runs "
+         "(sum of iters over executor_batched_run_total)")
 
 # -- run hooks ----------------------------------------------------------------
 _RUN_HOOKS = []
@@ -59,7 +67,11 @@ def register_run_hook(fn):
     ``Executor.run`` (the compiled-step path; server loops and EOF'd
     py_reader runs never complete a step). ``record`` keys:
     ``program_id`` (Program._uid), ``fetch_names``, ``wall_time``
-    (seconds), ``cache_hit``, ``profiler_enabled``. Hook exceptions are
+    (seconds), ``cache_hit``, ``profiler_enabled``. A step-batched run
+    (``Executor.run(..., iters=k)`` with k >= 2) still fires the hook
+    ONCE for the whole device-side loop and adds an ``iters`` key
+    (``record["iters"] == k``); single-step runs carry no ``iters`` key
+    (read ``record.get("iters", 1)``). Hook exceptions are
     logged and swallowed — observability must not fail training.
     Returns ``fn`` so it composes as a decorator."""
     _RUN_HOOKS.append(fn)
@@ -167,6 +179,50 @@ def _feed_signature(feed, block):
     return tuple(sig)
 
 
+def _split_batched_feed(feed, block, iters):
+    """Classify each ``iters=k`` feed as per-iteration STACKED
+    (``[k, ...]``, sliced by the device-side loop) or loop-INVARIANT
+    (the per-step shape, reused every iteration).
+
+    Vars with a fully static declared shape are validated exactly;
+    when the declared shape has dynamic (-1) batch dims, the leading
+    axis decides: ``shape[0] == k`` means one slice per iteration.
+    Ambiguity (a per-step shape whose own leading dim equals k)
+    resolves to the declared/per-step reading for static vars and the
+    stacked reading for dynamic ones — stack explicitly to be safe."""
+    stacked, invariant = {}, {}
+    for name, arr in feed.items():
+        shape = tuple(np.shape(arr))
+        var = block._find_var_recursive(name)
+        declared = tuple(int(d) for d in var.shape) \
+            if var is not None and var.shape is not None else None
+        static = declared is not None and all(d >= 0 for d in declared)
+        if static:
+            if shape == declared:
+                invariant[name] = arr
+            elif shape == (iters,) + declared:
+                stacked[name] = arr
+            elif shape[:1] == (iters,):
+                raise ValueError(
+                    "iters=%d: stacked feed %r has per-step shape %s "
+                    "but var %r declares shape %s"
+                    % (iters, name, list(shape[1:]), name,
+                       list(declared)))
+            else:
+                raise ValueError(
+                    "iters=%d: feed %r has shape %s — pass either the "
+                    "per-step shape %s (reused every iteration) or a "
+                    "leading-axis stack %s (one slice per iteration)"
+                    % (iters, name, list(shape), list(declared),
+                       [iters] + list(declared)))
+        else:
+            if shape[:1] == (iters,):
+                stacked[name] = arr
+            else:
+                invariant[name] = arr
+    return stacked, invariant
+
+
 def _fetch_numpy(x):
     """np.asarray, multiprocess-safe: a replicated global array is not
     fully addressable — read the local replica. A SHARDED global fetch has
@@ -206,7 +262,28 @@ class Executor:
         fetch_list=None,
         scope=None,
         return_numpy=True,
+        iters=1,
     ):
+        """``iters=1`` (default): one feed/fetch step, the legacy path.
+
+        ``iters=k`` (k >= 2): step-batched execution — the program's step
+        function is compiled ONCE and ``k`` steps run inside a single
+        jitted dispatch (``jax.lax.scan`` carrying ``(state, rng)`` with
+        buffer donation), amortizing the per-step Python + PJRT round
+        trip the way the reference's C++ hot loop (``executor.cc:445``)
+        amortizes op dispatch. Feed contract: each feed is either a
+        leading-axis stack ``[k, ...]`` (one slice per iteration) or the
+        plain per-step shape (loop-invariant, reused every iteration);
+        py_reader-fed programs instead drain exactly ``k`` batches up
+        front. Each fetch returns the per-iteration trajectory, stacked
+        ``[k, ...]``. See ``_run_batched`` and README "Step-batched
+        execution"."""
+        iters = int(iters)
+        if iters < 1:
+            raise ValueError("iters must be >= 1, got %d" % iters)
+        if iters > 1:
+            return self._run_batched(program, feed, fetch_list, scope,
+                                     return_numpy, iters)
         import time as _time
 
         import jax
@@ -497,6 +574,285 @@ class Executor:
             )
 
         jfn = jax.jit(step, donate_argnums=(0,))
+        return _CompiledStep(jfn, state_names, fetch_names)
+
+    # -- step-batched execution (iters=k) ------------------------------
+    def _run_batched(self, program, feed, fetch_list, scope, return_numpy,
+                     iters):
+        """``Executor.run(..., iters=k)`` for k >= 2: one compiled
+        executable drives k steps device-side. Kept separate from the
+        single-step ``run`` body so ``iters=1`` stays byte-for-byte the
+        legacy path (semantics, hook payloads, profiler events)."""
+        import time as _time
+
+        import jax
+
+        _t_run0 = _time.perf_counter()
+        scope = scope or global_scope()
+        feed = dict(feed or {})
+        fetch_list = list(fetch_list or [])
+        fetch_names = [v.name if isinstance(v, Variable) else str(v)
+                       for v in fetch_list]
+
+        from . import compiler
+
+        strategy = None
+        if isinstance(program, compiler.CompiledProgram):
+            strategy = program
+            program = strategy._program
+        if program is None:
+            program = framework.default_main_program()
+        block = program.global_block()
+
+        py_readers = []
+        for op in block.ops:
+            if op.type in ("listen_and_serv", "fl_listen_and_serv"):
+                raise RuntimeError(
+                    "iters>1 cannot drive a server program (%s op): the "
+                    "serving loop runs on the host — call exe.run "
+                    "without iters" % op.type)
+            if op.type == "py_reader_dequeue":
+                from .layers.py_reader import _READERS
+
+                r = _READERS.get(int(op.attr("reader_id")))
+                if r is None:
+                    raise RuntimeError(
+                        "the py_reader feeding this program was "
+                        "garbage-collected — keep the object returned "
+                        "by layers.py_reader() alive and start() it")
+                py_readers.append(r)
+        save_ops = [(op.input("X")[0], op.attr("file_path"))
+                    for op in block.ops if op.type == "save"]
+        for blk in program.blocks:
+            if blk is not block and any(op.type == "save"
+                                        for op in blk.ops):
+                raise RuntimeError(
+                    "a save op inside a control-flow sub-block is not "
+                    "supported: the compiled step cannot conditionally "
+                    "write host files — move the save op to the global "
+                    "block or checkpoint from the host loop "
+                    "(fluid.io.save)")
+
+        if py_readers:
+            # drain exactly `iters` batches per reader up front and stack
+            # them [k, ...]; EOF before k batches ends the pass like the
+            # single-step path (readers reset, EOFException, no step ran —
+            # already-pulled batches of this window are discarded, so size
+            # the pass to a multiple of k to lose nothing)
+            pulled = {r: [] for r in py_readers}
+            for i in range(iters):
+                step_vals = [(r, r._next()) for r in py_readers]
+                if any(v is None for _, v in step_vals):
+                    from . import core as _core
+
+                    if i or any(v is not None for _, v in step_vals):
+                        import logging
+
+                        logging.getLogger(__name__).warning(
+                            "py_reader EOF during a batched run: "
+                            "discarding %d already-pulled batch(es) of a "
+                            "requested window of %d", i, iters)
+                    for r in py_readers:
+                        r.reset()
+                    raise _core.EOFException(
+                        "py_reader queue exhausted before %d batches — "
+                        "reader.reset() and re-start() for the next pass"
+                        % iters)
+                for r, vals in step_vals:
+                    pulled[r].append(vals)
+            for r, items in pulled.items():
+                for j, name in enumerate(r.names):
+                    feed[name] = np.stack([vals[j] for vals in items])
+
+        from .lod import LoDTensor
+
+        for name in list(feed):
+            if isinstance(feed[name], LoDTensor):
+                raise ValueError(
+                    "iters>1 does not take LoDTensor feeds — feed dense "
+                    "arrays (plus explicit length arrays) stacked "
+                    "[k, ...], or loop exe.run from the host")
+            if isinstance(feed[name], jax.Array):
+                continue
+            var = block._find_var_recursive(name)
+            arr = np.asarray(feed[name])
+            if var is not None and arr.dtype != var.dtype:
+                arr = arr.astype(var.dtype)
+            feed[name] = arr
+
+        stacked, invariant = _split_batched_feed(feed, block, iters)
+
+        state_names = sorted(
+            v.name
+            for v in program.list_vars()
+            if v.persistable and scope.has_var(v.name)
+        )
+
+        # iters joins the key: a k-step executable is a different
+        # program than a single step (7-tuple — never collides with the
+        # single-step path's 6-tuple keys in the same cache)
+        key = (
+            program._uid,
+            program._mutation,
+            _feed_signature(feed, block),
+            tuple(fetch_names),
+            tuple(state_names),
+            strategy._uid if strategy is not None else 0,
+            iters,
+        )
+        from . import flags as _flags
+
+        step = self._cache.get(key)
+        cache_hit = step is not None
+        (_M_CACHE_HIT if cache_hit else _M_CACHE_MISS).inc()
+        if step is None:
+            if _flags.check_program_enabled():
+                from .passes import apply_pass
+
+                apply_pass(program, "program_check",
+                           feed_names=list(feed))
+            step = self._build_batched(program, block, stacked, invariant,
+                                       fetch_names, state_names, strategy,
+                                       iters)
+            self._cache[key] = step
+
+        rng = scope.find_var(RNG_STATE_VAR)
+        if rng is None:
+            seed = program.random_seed or 0
+            rng = _rng.key_data(_rng.root_key(seed))
+            scope.set_var(RNG_STATE_VAR, rng)
+
+        state = {n: scope.find_var(n) for n in state_names}
+        from . import profiler as _prof
+
+        profiling = _prof.is_profiler_enabled()
+        t0 = _prof.now() if profiling else None
+        fetches, new_state, new_rng = step.fn(state, stacked, invariant,
+                                              rng)
+        if profiling:
+            jax.block_until_ready(fetches)
+            _prof._record("executor_batched_run[%s#p%d;k=%d]" % (
+                ",".join(fetch_names[:3]), program._uid, iters),
+                _prof.now() - t0)
+        scope.set_var(RNG_STATE_VAR, new_rng)
+        for n, v in new_state.items():
+            scope.set_var(n, v)
+
+        if save_ops:
+            # same contract as the single-step path, applied to the whole
+            # window: ONE write per save op, recording the value committed
+            # after step k (running k single-step runs against the same
+            # file path leaves exactly this value too)
+            from .core import tensor_io
+
+            for name, path in save_ops:
+                val = scope.find_var(name)
+                if val is None:
+                    raise RuntimeError(
+                        "save op: var %r is not in the scope — only "
+                        "PERSISTABLE vars can be saved (the step "
+                        "commits those; intermediates are fused away "
+                        "by XLA). fetch_list the value instead." % name)
+                os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+                tensor_io.save_combine(path, {name: _fetch_numpy(val)})
+
+        if _flags.check_nan_inf_enabled():
+            def _local_view(x):
+                if hasattr(x, "is_fully_addressable") and \
+                        not x.is_fully_addressable:
+                    return np.asarray(x.addressable_shards[0].data)
+                return np.asarray(x)
+
+            for label, vals in (("fetch", zip(fetch_names, fetches)),
+                                ("state", new_state.items())):
+                for n, v in vals:
+                    arr = _local_view(v)
+                    if np.issubdtype(arr.dtype, np.floating) and \
+                            not np.isfinite(arr).all():
+                        raise FloatingPointError(
+                            "FLAGS_check_nan_inf: non-finite values in "
+                            "%s var %r after running program" % (label, n))
+
+        wall = _time.perf_counter() - _t_run0
+        _M_RUN_SECONDS.observe(wall)
+        _M_RUNS.inc()
+        _M_BATCHED_RUNS.inc()
+        _M_BATCHED_ITERS.inc(iters)
+        if _RUN_HOOKS:
+            _fire_run_hooks({
+                "program_id": program._uid,
+                "fetch_names": list(fetch_names),
+                "wall_time": wall,
+                "cache_hit": cache_hit,
+                "profiler_enabled": profiling,
+                "iters": iters,
+            })
+
+        if return_numpy:
+            return [_fetch_numpy(x) for x in fetches]
+        return list(fetches)
+
+    def _build_batched(self, program, block, stacked, invariant,
+                       fetch_names, state_names, strategy, iters):
+        """Trace the block once into ``step`` and wrap it in a
+        ``lax.scan`` over the iteration axis: stacked feeds are sliced
+        per step, invariant feeds close over the loop, ``(state, rng)``
+        is the carry, and the initial state is donated — the whole
+        k-step window is allocation-free on device."""
+        import jax
+
+        mesh = strategy.mesh if strategy is not None else None
+
+        def step(state, feed_vals, rng_key):
+            env = {}
+            env.update(state)
+            env.update(feed_vals)
+            ctx = LowerCtx(block, env, _rng.wrap_key_data(rng_key),
+                           mesh=mesh)
+            if strategy is not None:
+                strategy._on_trace_begin(ctx)
+            lower_block(ctx, block)
+            fetches = [ctx.get(n) for n in fetch_names]
+            new_state = {n: env[n] for n in state if n in env}
+            # a scan carry has a FIXED structure: a program that creates
+            # new persistables mid-step (startup-style init) cannot be
+            # step-batched — fail with the remedy, not a tracer error
+            grown = sorted(
+                set(n for n in ctx.written
+                    if n in env and n not in new_state) |
+                set(name for name, var in block.vars.items()
+                    if var.persistable and name in env
+                    and name not in state))
+            if grown:
+                raise RuntimeError(
+                    "iters>1 needs loop-invariant state, but this "
+                    "program creates new persistable vars %s during "
+                    "the step — run the startup program (iters=1) "
+                    "first so they exist in the scope" % (grown,))
+            return fetches, new_state, _rng.key_data(ctx.rng_key)
+
+        def batched(state, stacked_feeds, invariant_feeds, rng_key):
+            def body(carry, feed_i):
+                st, rk = carry
+                fv = dict(invariant_feeds)
+                fv.update(feed_i)
+                fetches, new_st, new_rk = step(st, fv, rk)
+                return (new_st, new_rk), fetches
+
+            (final_state, final_rng), traj = jax.lax.scan(
+                body, (state, rng_key), stacked_feeds, length=iters)
+            return traj, final_state, final_rng
+
+        if strategy is not None and mesh is not None:
+            return _CompiledStep(
+                strategy.wrap_batched_step(batched, block, stacked,
+                                           invariant, fetch_names,
+                                           state_names),
+                state_names,
+                fetch_names,
+            )
+
+        jfn = jax.jit(batched, donate_argnums=(0,))
         return _CompiledStep(jfn, state_names, fetch_names)
 
     # convenience ------------------------------------------------------

@@ -121,7 +121,10 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     if export_for_deployment:
         pruned = main_program._prune(target_vars)
     else:
-        pruned = main_program.clone(for_test=True)
+        # keep the program EXACTLY as built — no for_test flip — so a
+        # reloaded program still trains (dropout active, batch-norm
+        # updating running stats); only deployment exports go eval-mode
+        pruned = main_program.clone(for_test=False)
     pruned._feed_names = list(feeded_var_names)
     pruned._fetch_names = [
         v.name if isinstance(v, Variable) else v for v in target_vars

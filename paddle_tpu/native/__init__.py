@@ -15,10 +15,10 @@ import subprocess
 _DIR = os.path.dirname(os.path.abspath(__file__))
 
 
-def _build(name, srcs, extra_flags=(), timeout=120):
+def _build(name, srcs, extra_flags=(), timeout=120, force=False):
     so = os.path.join(_DIR, name + ".so")
     src_paths = [os.path.join(_DIR, s) for s in srcs]
-    if os.path.exists(so) and all(
+    if not force and os.path.exists(so) and all(
             os.path.getmtime(so) >= os.path.getmtime(s) for s in src_paths):
         return so
     # extra_flags go AFTER the sources: -l libraries only record a
@@ -33,15 +33,35 @@ def _build(name, srcs, extra_flags=(), timeout=120):
     return so
 
 
+def _dlopen(name, srcs, extra_flags=(), timeout=120):
+    """Build-if-needed then dlopen. A cached .so that fails to load
+    (e.g. built against another machine's libstdc++/glibc) is rebuilt
+    from source once — binaries are never shipped, only sources are."""
+    import ctypes
+
+    so = _build(name, srcs, extra_flags, timeout)
+    if so is None:
+        return None
+    try:
+        return ctypes.CDLL(so)
+    except OSError:
+        so = _build(name, srcs, extra_flags, timeout, force=True)
+        if so is None:
+            return None
+        try:
+            return ctypes.CDLL(so)
+        except OSError:
+            return None
+
+
 @functools.lru_cache(maxsize=None)
 def load_data_feed():
     """ctypes handle to the multislot text parser, or None."""
     import ctypes
 
-    so = _build("libdata_feed", ["data_feed.cc"])
-    if so is None:
+    lib = _dlopen("libdata_feed", ["data_feed.cc"])
+    if lib is None:
         return None
-    lib = ctypes.CDLL(so)
     i64 = ctypes.c_int64
     lib.dfd_count.restype = i64
     lib.dfd_count.argtypes = [ctypes.c_char_p, i64, ctypes.c_int,
@@ -61,10 +81,9 @@ def load_ps_store():
     """ctypes handle to the embedding-store library, or None."""
     import ctypes
 
-    so = _build("libps_store", ["ps_store.cc"])
-    if so is None:
+    lib = _dlopen("libps_store", ["ps_store.cc"])
+    if lib is None:
         return None
-    lib = ctypes.CDLL(so)
     i64, f32p, i64p = (ctypes.c_int64, ctypes.POINTER(ctypes.c_float),
                        ctypes.POINTER(ctypes.c_int64))
     lib.pts_create.restype = i64
@@ -94,10 +113,9 @@ def load_tensor_io():
     """ctypes handle to the combined-tensor-file serde, or None."""
     import ctypes
 
-    so = _build("libtensor_io", ["tensor_io.cc"])
-    if so is None:
+    lib = _dlopen("libtensor_io", ["tensor_io.cc"])
+    if lib is None:
         return None
-    lib = ctypes.CDLL(so)
     i64 = ctypes.c_int64
     lib.tio_open_write.restype = i64
     lib.tio_open_write.argtypes = [ctypes.c_char_p]
@@ -127,10 +145,9 @@ def load_channel():
     """ctypes handle to the bounded MPMC channel, or None."""
     import ctypes
 
-    so = _build("libchannel", ["channel.cc"])
-    if so is None:
+    lib = _dlopen("libchannel", ["channel.cc"])
+    if lib is None:
         return None
-    lib = ctypes.CDLL(so)
     i64 = ctypes.c_int64
     lib.chn_create.restype = i64
     lib.chn_create.argtypes = [i64]
@@ -222,10 +239,9 @@ def load_program_graph():
     or None when no toolchain is available."""
     import ctypes
 
-    so = _build("libprogram_graph", ["program_graph.cc"])
-    if so is None:
+    lib = _dlopen("libprogram_graph", ["program_graph.cc"])
+    if lib is None:
         return None
-    lib = ctypes.CDLL(so)
     i64 = ctypes.c_int64
     # Out-buffers are POINTER(c_char) (NOT c_char_p): serialized wire
     # bytes contain NULs, callers read them with ctypes.string_at(p, n)

@@ -39,6 +39,10 @@ class MoEConfig:
         if k not in (1, 2):
             raise ValueError("k must be 1 (Switch) or 2 (GShard), got %r"
                              % (k,))
+        if k > n_experts:
+            raise ValueError(
+                "top-k k=%d exceeds n_experts=%d — top_k would dispatch "
+                "a token to the same expert more than once" % (k, n_experts))
         self.hidden = hidden
         self.ffn = ffn
         self.n_experts = n_experts

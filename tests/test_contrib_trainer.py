@@ -93,6 +93,27 @@ def test_trainer_stop_and_fetch_gate():
     assert len(seen) == 1 and seen[0] == []
 
 
+def test_checkpoint_config_rejects_degenerate_max(tmp_path):
+    """max_num_checkpoints < 1 would make every save retire itself (or
+    mis-slice the retire list) — refused up front."""
+    import pytest
+
+    for bad in (0, -1):
+        with pytest.raises(ValueError, match="max_num_checkpoints"):
+            CheckpointConfig(checkpoint_dir=str(tmp_path),
+                             max_num_checkpoints=bad)
+    # the boundary value keeps exactly the newest serial
+    ckpt_dir = str(tmp_path / "one")
+    cfg = CheckpointConfig(checkpoint_dir=ckpt_dir, max_num_checkpoints=1,
+                           epoch_interval=1, step_interval=1000)
+    t = Trainer(train_func=_train_func,
+                optimizer_func=lambda: optimizer.SGD(0.1),
+                checkpoint_config=cfg)
+    t.train(num_epochs=3, event_handler=lambda ev: None, reader=_reader,
+            feed_order=["x", "y"])
+    assert sorted(os.listdir(ckpt_dir)) == ["checkpoint_2"]
+
+
 def test_trainer_checkpoint_resume(tmp_path):
     ckpt_dir = str(tmp_path / "ckpt")
     cfg = CheckpointConfig(checkpoint_dir=ckpt_dir, max_num_checkpoints=2,

@@ -173,6 +173,195 @@ def test_py_reader_loop_reference_shape():
     assert first_losses[1] < first_losses[0], first_losses
 
 
+# -- step-batched execution: exe.run(..., iters=k) ---------------------------
+
+def _sgd_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        label = layers.data(name="label", shape=[1], dtype="float32")
+        pred = layers.fc(x, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, label))
+        optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def test_iters_trajectory_matches_sequential_runs():
+    """iters=k with stacked [k, ...] feeds: the per-step loss trajectory
+    and final weights match k sequential exe.run calls at 1e-6."""
+    main, startup, loss = _sgd_program()
+    exe = fluid.Executor()
+    rng = np.random.RandomState(7)
+    k = 6
+    xs = rng.rand(k, 8, 4).astype(np.float32)
+    ys = rng.rand(k, 8, 1).astype(np.float32)
+    wname = main.all_parameters()[0].name
+
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        seq = [float(np.asarray(exe.run(
+            main, feed={"x": xs[i], "label": ys[i]},
+            fetch_list=[loss])[0]).ravel()[0]) for i in range(k)]
+        w_seq = np.asarray(fluid.global_scope().find_var(wname))
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (traj,) = exe.run(main, feed={"x": xs, "label": ys},
+                          fetch_list=[loss], iters=k)
+        w_bat = np.asarray(fluid.global_scope().find_var(wname))
+    traj = np.asarray(traj).ravel()
+    assert traj.shape == (k,)
+    np.testing.assert_allclose(traj, seq, atol=1e-6, rtol=1e-6)
+    np.testing.assert_allclose(w_bat, w_seq, atol=1e-6, rtol=1e-6)
+
+
+def test_iters_invariant_feed_and_single_compile():
+    """A per-step-shaped feed is loop-invariant (reused each iteration),
+    and a k>1 window compiles exactly ONE executable: the first batched
+    run is the only compile-cache miss, repeats are hits."""
+    from paddle_tpu.fluid import monitor
+
+    main, startup, loss = _sgd_program()
+    exe = fluid.Executor()
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(8, 4).astype(np.float32),
+            "label": rng.rand(8, 1).astype(np.float32)}
+    hits = monitor.counter("executor_compile_cache_hit_total")
+    misses = monitor.counter("executor_compile_cache_miss_total")
+    batched = monitor.counter("executor_batched_run_total")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        m0, h0, b0 = misses.value, hits.value, batched.value
+        (t1,) = exe.run(main, feed=feed, fetch_list=[loss], iters=4)
+        assert (misses.value - m0, hits.value - h0) == (1, 0)
+        (t2,) = exe.run(main, feed=feed, fetch_list=[loss], iters=4)
+        assert (misses.value - m0, hits.value - h0) == (1, 1)
+        assert batched.value - b0 == 2
+    t1 = np.asarray(t1).ravel()
+    assert t1.shape == (4,)
+    # training on the same batch: the trajectory decreases
+    assert t1[-1] < t1[0]
+    # the second window starts where the first committed
+    assert np.asarray(t2).ravel()[0] < t1[-1]
+
+
+def test_iters_one_is_the_legacy_path():
+    """iters=1 routes through the single-step path byte-for-byte: same
+    cache entry as a plain run, and the hook payload is unchanged (no
+    'iters' key); batched runs add iters to the record."""
+    main, startup, loss = _sgd_program()
+    exe = fluid.Executor()
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(8, 4).astype(np.float32),
+            "label": rng.rand(8, 1).astype(np.float32)}
+    records = []
+    fluid.register_run_hook(records.append)
+    try:
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            n_entries = len(exe._cache)
+            exe.run(main, feed=feed, fetch_list=[loss])
+            assert len(exe._cache) == n_entries + 1
+            exe.run(main, feed=feed, fetch_list=[loss], iters=1)
+            # same cache entry — no new compile
+            assert len(exe._cache) == n_entries + 1
+            assert records[-1]["cache_hit"] is True
+            assert set(records[-1]) == {"program_id", "fetch_names",
+                                        "wall_time", "cache_hit",
+                                        "profiler_enabled"}
+            exe.run(main, feed=feed, fetch_list=[loss], iters=3)
+            assert records[-1]["iters"] == 3
+            assert records[-1]["cache_hit"] is False
+    finally:
+        fluid.unregister_run_hook(records.append)
+    # one hook firing per run call, batched or not
+    assert len(records) == 4
+
+
+def test_iters_stacked_feed_shape_validation():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        y = layers.data(name="y", shape=[3, 2], dtype="float32",
+                        append_batch_size=False)
+        out = layers.reduce_sum(y)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        with pytest.raises(ValueError, match="per-step shape \\[5, 2\\]"):
+            exe.run(main, feed={"y": np.zeros((2, 5, 2), np.float32)},
+                    fetch_list=[out], iters=2)
+        with pytest.raises(ValueError, match="pass either the per-step "
+                                             "shape"):
+            exe.run(main, feed={"y": np.zeros((7, 2), np.float32)},
+                    fetch_list=[out], iters=2)
+        with pytest.raises(ValueError, match="iters must be >= 1"):
+            exe.run(main, feed={"y": np.zeros((3, 2), np.float32)},
+                    fetch_list=[out], iters=0)
+
+
+def test_iters_requires_committed_state():
+    """A program that creates new persistables mid-step (startup-style)
+    cannot keep a fixed scan carry — refused with the remedy."""
+    main, startup, loss = _sgd_program()
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        with pytest.raises(RuntimeError, match="loop-invariant state"):
+            exe.run(startup, iters=2)
+
+
+def test_iters_py_reader_drains_exactly_k_batches():
+    """py_reader-fed batched runs pull exactly k batches up front (in
+    order), and a window the pass cannot fill raises EOF with nothing
+    committed."""
+    B, D = 4, 3
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        reader = layers.py_reader(capacity=8, shapes=[[B, D]],
+                                  dtypes=["float32"])
+        x = layers.read_file(reader)
+        m = layers.reduce_mean(x)
+    batches = [(np.full((B, D), i, np.float32),) for i in range(5)]
+    reader.decorate_tensor_provider(lambda: iter(batches))
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        reader.start()
+        (t1,) = exe.run(main, fetch_list=[m], iters=2)
+        (t2,) = exe.run(main, fetch_list=[m], iters=2)
+        np.testing.assert_allclose(np.asarray(t1).ravel(), [0.0, 1.0])
+        np.testing.assert_allclose(np.asarray(t2).ravel(), [2.0, 3.0])
+        # one batch left < k=2: EOF, pass over
+        with pytest.raises(fluid.core.EOFException):
+            exe.run(main, fetch_list=[m], iters=2)
+        # reset/start re-arms, same contract as the single-step path
+        reader.start()
+        (t3,) = exe.run(main, fetch_list=[m], iters=2)
+        np.testing.assert_allclose(np.asarray(t3).ravel(), [0.0, 1.0])
+
+
+def test_iters_gspmd_matches_sequential():
+    """iters=k composes with with_data_parallel (GSPMD): trajectory
+    matches the sequential CompiledProgram runs."""
+    from paddle_tpu.fluid import compiler
+
+    main, startup, loss = _sgd_program()
+    exe = fluid.Executor()
+    rng = np.random.RandomState(3)
+    k = 3
+    xs = rng.rand(k, 8, 4).astype(np.float32)
+    ys = rng.rand(k, 8, 1).astype(np.float32)
+    cp = compiler.CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        seq = [float(np.asarray(exe.run(
+            cp, feed={"x": xs[i], "label": ys[i]},
+            fetch_list=[loss])[0]).ravel()[0]) for i in range(k)]
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (traj,) = exe.run(cp, feed={"x": xs, "label": ys},
+                          fetch_list=[loss], iters=k)
+    np.testing.assert_allclose(np.asarray(traj).ravel(), seq, atol=1e-6)
+
+
 def test_save_load_ops_roundtrip(tmp_path):
     """The save/load op pair (reference save_op.cc / load_op.cc): a
     program's save op writes the POST-step value after commit; a second

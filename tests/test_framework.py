@@ -143,6 +143,44 @@ def test_prune_keeps_subblock_dependencies(tmp_path):
                                rtol=1e-5)
 
 
+def test_save_inference_model_keeps_train_mode_when_not_deploying(tmp_path):
+    """export_for_deployment=False saves the program AS BUILT: a
+    reloaded program keeps dropout/batch-norm in training mode (no
+    clone(for_test=True) flip) so it can resume training."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[100], dtype="float32")
+        d = layers.dropout(x, dropout_prob=0.5,
+                           dropout_implementation="upscale_in_train")
+        out = layers.reduce_sum(d, keep_dim=True)
+    exe = fluid.Executor()
+    model_dir = str(tmp_path / "train_mode_model")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.io.save_inference_model(model_dir, ["x"], [out], exe,
+                                      main_program=main,
+                                      export_for_deployment=False)
+        prog, feeds, fetches = fluid.io.load_inference_model(model_dir, exe)
+    drops = [op for op in prog.global_block().ops if op.type == "dropout"]
+    assert drops and drops[0].attrs.get("is_test") is False
+    # and it behaves like training mode: some activations are zeroed
+    xv = np.ones((2, 100), np.float32)
+    with fluid.scope_guard(fluid.Scope()):
+        (got,) = exe.run(prog, feed={"x": xv},
+                         fetch_list=[d.name])
+    assert (np.asarray(got) == 0).any()
+    # the deployment export of the same program IS eval-mode
+    deploy_dir = str(tmp_path / "deploy_model")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.io.save_inference_model(deploy_dir, ["x"], [out], exe,
+                                      main_program=main)
+        prog2, _, _ = fluid.io.load_inference_model(deploy_dir, exe)
+    drops2 = [op for op in prog2.global_block().ops
+              if op.type == "dropout"]
+    assert drops2 and drops2[0].attrs.get("is_test") is True
+
+
 def test_protobuf_roundtrip():
     main, _, loss = _build_program()
     data = main.serialize_to_string()

@@ -49,6 +49,9 @@ def test_identical_experts_match_dense():
 def test_topk_validation():
     with pytest.raises(ValueError, match="k must be"):
         MoEConfig(k=3)
+    # k > n_experts would dispatch a token to one expert twice
+    with pytest.raises(ValueError, match="exceeds n_experts"):
+        MoEConfig(n_experts=1, k=2)
 
 
 def test_capacity_drops_pass_zero():
