@@ -75,16 +75,19 @@ def _timed_run(exe, main, batch, loss, iters, jax, use_iters=False):
         # ±40% run-to-run, DeepFM lost 20% under host contention). The
         # feed is loop-invariant (per-step shape, reused each iteration);
         # the untimed first call compiles the k-step executable (k is part
-        # of the compile-cache key).
-        (traj,) = exe.run(main, feed=batch, fetch_list=[loss],
-                          iters=iters, return_numpy=False)
-        jax.block_until_ready(traj)
+        # of the compile-cache key). fetch_mode="async" keeps the loss
+        # trajectory as a FetchHandle — run() issues no host sync, the
+        # window closes on block_until_ready (device done, no transfer),
+        # and the finiteness check syncs AFTER timing.
+        (h,) = exe.run(main, feed=batch, fetch_list=[loss],
+                       iters=iters, fetch_mode="async")
+        h.block_until_ready()
         t0 = time.perf_counter()
-        (traj,) = exe.run(main, feed=batch, fetch_list=[loss],
-                          iters=iters, return_numpy=False)
-        jax.block_until_ready(traj)
+        (h,) = exe.run(main, feed=batch, fetch_list=[loss],
+                       iters=iters, fetch_mode="async")
+        h.block_until_ready()
         elapsed = time.perf_counter() - t0
-        assert np.isfinite(np.asarray(traj)).all()
+        assert np.isfinite(h.numpy()).all()
         return elapsed
     # drain in-flight work so the window times exactly `iters` steps —
     # with millisecond-scale steps any carried-over dispatch shows up as a
@@ -498,6 +501,7 @@ def monitor_summary():
     hits = monitor.counter("executor_compile_cache_hit_total").value
     misses = monitor.counter("executor_compile_cache_miss_total").value
     run_hist = monitor.get_metric("executor_run_seconds")
+    fetch_hist = monitor.get_metric("executor_fetch_sync_seconds")
     return {
         "executor_run_count": monitor.counter("executor_run_total").value,
         "compile_cache_hits": hits,
@@ -509,10 +513,77 @@ def monitor_summary():
             monitor.counter("executor_batched_run_total").value,
         "batched_iters_total":
             monitor.counter("executor_batched_iters_total").value,
+        "fetch_sync_count": fetch_hist.count
+        if fetch_hist is not None else 0,
+        "fetch_sync_seconds_sum": round(fetch_hist.sum, 3)
+        if fetch_hist is not None else 0.0,
+        "window_overlap_hits":
+            monitor.counter("executor_window_overlap_hit_total").value,
+        "window_overlap_misses":
+            monitor.counter("executor_window_overlap_miss_total").value,
+    }
+
+
+def bench_smoke():
+    """``bench.py --smoke``: two tiny step-batched windows through the
+    FULL async pipeline — py_reader feeds, background window prefetch,
+    async fetch handles — on CPU in seconds, no TPU needed. Asserts the
+    pipeline invariants (second window is an overlap hit, zero fetch
+    syncs before ``.numpy()``, finite decoupled losses) and prints the
+    same one-line JSON shape as the real bench."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers, monitor
+
+    monitor.reset()
+    B, D, K = 8, 4, 2
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        reader = layers.py_reader(capacity=8, shapes=[[B, D], [B, 1]],
+                                  dtypes=["float32", "float32"])
+        x, y = layers.read_file(reader)
+        pred = layers.fc(x, 1, name="smoke_fc")
+        loss = layers.reduce_mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    rng = np.random.RandomState(0)
+    batches = [(rng.rand(B, D).astype(np.float32),
+                rng.rand(B, 1).astype(np.float32)) for _ in range(2 * K)]
+    reader.decorate_tensor_provider(lambda: iter(batches))
+    exe = fluid.Executor()
+    t0 = time.perf_counter()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        reader.start()
+        handles = []
+        for _ in range(2):
+            (h,) = exe.run(main, fetch_list=[loss], iters=K,
+                           fetch_mode="async", prefetch=True)
+            handles.append(h)
+        syncs_before = monitor.get_metric(
+            "executor_fetch_sync_seconds").count
+        losses = [h.numpy().ravel().tolist() for h in handles]
+    exe.close()
+    assert syncs_before == 0, (
+        "async windows synced %d time(s) before .numpy()" % syncs_before)
+    assert all(np.isfinite(np.asarray(l)).all() for l in losses), losses
+    hits = monitor.counter("executor_window_overlap_hit_total").value
+    assert hits >= 1, "window 2 did not consume the prefetched window"
+    return {
+        "metric": "smoke_async_pipeline_seconds",
+        "value": round(time.perf_counter() - t0, 3),
+        "unit": "seconds",
+        "vs_baseline": None,
+        "windows": 2,
+        "iters_per_window": K,
+        "window_losses": losses,
+        "monitor": monitor_summary(),
     }
 
 
 if __name__ == "__main__":
+    if "--smoke" in sys.argv:
+        print(json.dumps(bench_smoke()))
+        sys.exit(0)
     r = bench_bert()
     assert r["mfu"] <= 1.0, (
         "MFU %.3f > 1: either the peak table is wrong for this chip or the "

@@ -405,14 +405,7 @@ class CompiledProgram:
         axis = mesh.axis_names[0]
         repl = NamedSharding(mesh, P())
 
-        def feed_spec(name):
-            arr = feed[name]
-            ndim = np.ndim(arr)
-            if ndim >= 1 and np.shape(arr)[0] % mesh.shape[axis] == 0:
-                return P(axis, *([None] * (ndim - 1)))
-            return P()
-
-        feed_specs = {n: feed_spec(n) for n in feed}
+        feed_specs = {n: self.feed_sharding(feed[n]).spec for n in feed}
 
         def inner(state, feed_vals, rng):
             fetches, new_state, new_rng = step(state, feed_vals, rng)
@@ -445,6 +438,39 @@ class CompiledProgram:
             return jfn(state, feed_vals, rng)
 
         return fn
+
+    def feed_sharding(self, value, batch_dim=0):
+        """The ``NamedSharding`` this strategy lays a feed array out
+        with — the single source of truth the step wrappers AND the
+        ahead-of-time stagers (``fluid.reader.DeviceStager``,
+        ``Executor.train_from_dataset``, the ``iters=k`` window
+        prefetch) share, so prefetched batches land pre-sharded across
+        the mesh instead of funneling through device 0.
+
+        ``batch_dim`` is the axis carrying the batch (1 for an
+        ``iters=k`` stacked ``[k, batch, ...]`` feed whose leading axis
+        is the iteration index). Returns the batch-sharded layout when
+        the strategy shards feeds ('dp' under GSPMD, the first mesh
+        axis under shard_map) and the batch dim divides evenly,
+        replicated otherwise; ``None`` when the strategy stages feeds
+        itself (pipeline mode) or no mesh is attached."""
+        if not self._is_data_parallel:
+            return None
+        mode = getattr(self, "_mode", "gspmd")
+        if mode == "pipeline":
+            return None
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        mesh = self.mesh
+        axis = "dp" if mode == "gspmd" else mesh.axis_names[0]
+        ndim = np.ndim(value)
+        if axis in mesh.shape and ndim > batch_dim and \
+                np.shape(value)[batch_dim] % mesh.shape[axis] == 0:
+            spec = [None] * ndim
+            spec[batch_dim] = axis
+            return NamedSharding(mesh, P(*spec))
+        return NamedSharding(mesh, P())
 
     def _state_sharding(self, block, name, mesh, repl):
         """Param layout: ``ParamAttr(shard=...)`` specs over the mesh,
@@ -480,15 +506,7 @@ class CompiledProgram:
         mesh = self.mesh
         repl = NamedSharding(mesh, P())
 
-        def feed_sharding(name):
-            arr = feed[name]
-            ndim = np.ndim(arr)
-            if "dp" in mesh.shape and ndim >= 1 and \
-                    np.shape(arr)[0] % mesh.shape["dp"] == 0:
-                return NamedSharding(mesh, P("dp", *([None] * (ndim - 1))))
-            return repl
-
-        feed_shardings = {n: feed_sharding(n) for n in feed}
+        feed_shardings = {n: self.feed_sharding(feed[n]) for n in feed}
         state_shardings = {n: self._state_sharding(block, n, mesh, repl)
                            for n in state_names}
         in_shardings = (
@@ -541,20 +559,12 @@ class CompiledProgram:
         mesh = self.mesh
         repl = NamedSharding(mesh, P())
 
-        def data_sharding(arr, bdim):
-            ndim = np.ndim(arr)
-            if "dp" in mesh.shape and ndim > bdim and \
-                    np.shape(arr)[bdim] % mesh.shape["dp"] == 0:
-                spec = [None] * ndim
-                spec[bdim] = "dp"
-                return NamedSharding(mesh, P(*spec))
-            return repl
-
         state_shardings = {n: self._state_sharding(block, n, mesh, repl)
                            for n in state_names}
-        stacked_shardings = {n: data_sharding(stacked_feed[n], 1)
+        stacked_shardings = {n: self.feed_sharding(stacked_feed[n],
+                                                   batch_dim=1)
                              for n in stacked_feed}
-        invariant_shardings = {n: data_sharding(invariant_feed[n], 0)
+        invariant_shardings = {n: self.feed_sharding(invariant_feed[n])
                                for n in invariant_feed}
         donate = (0,) if self._build_strategy.enable_inplace else ()
         jfn = jax.jit(

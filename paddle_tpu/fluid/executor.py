@@ -57,6 +57,29 @@ _M_BATCHED_ITERS = _monitor.counter(
     "executor_batched_iters_total",
     help="device-side training steps executed inside batched runs "
          "(sum of iters over executor_batched_run_total)")
+_M_FETCH_SYNC = _monitor.histogram(
+    "executor_fetch_sync_seconds",
+    help="device->host fetch materialization (the blocking sync): "
+         "return_numpy=True observes once per fetch at run time, "
+         "fetch_mode='async' only when FetchHandle.numpy()/indexing "
+         "forces the value — zero samples means no host sync happened")
+_M_WINDOW_STALL = _monitor.histogram(
+    "executor_window_stall_seconds",
+    help="host wait for a prefetched iters=k window to finish its "
+         "drain+stack+stage (0 when the window was already staged — "
+         "the prefetch fully hid the host-side feed work)")
+_M_OVERLAP_HIT = _monitor.counter(
+    "executor_window_overlap_hit_total",
+    help="batched runs served by an already-prefetched window "
+         "(drain/stack/stage overlapped the previous window's compute)")
+_M_OVERLAP_MISS = _monitor.counter(
+    "executor_window_overlap_miss_total",
+    help="prefetch-requested batched runs that drained inline "
+         "(first window of a pass, or the pass just restarted after EOF)")
+_M_PREFETCH_INFLIGHT = _monitor.gauge(
+    "executor_window_prefetch_inflight",
+    help="window prefetches currently draining/staging in the "
+         "background (0 or 1 per Executor)")
 
 # -- run hooks ----------------------------------------------------------------
 _RUN_HOOKS = []
@@ -223,18 +246,91 @@ def _split_batched_feed(feed, block, iters):
     return stacked, invariant
 
 
-def _fetch_numpy(x):
-    """np.asarray, multiprocess-safe: a replicated global array is not
-    fully addressable — read the local replica. A SHARDED global fetch has
-    no complete local value; fail loudly rather than return a slice."""
+def _local_view(x):
+    """Host-readable numpy view of a possibly multi-process array: a
+    non-fully-addressable array (replicated or sharded across processes)
+    is read through its first LOCAL shard — the shard-local view every
+    SPMD process can materialize without a cross-host gather. The one
+    conversion helper shared by the sync fetch path, the async
+    ``FetchHandle``, save ops, and the nan/inf debug checks."""
     if hasattr(x, "is_fully_addressable") and not x.is_fully_addressable:
-        if getattr(x.sharding, "is_fully_replicated", False):
-            return np.asarray(x.addressable_shards[0].data)
+        return np.asarray(x.addressable_shards[0].data)
+    return np.asarray(x)
+
+
+def _fetch_numpy(x):
+    """Materialize one fetch on the host (THE blocking device sync —
+    observed by ``executor_fetch_sync_seconds``), multiprocess-safe: a
+    replicated global array reads its local replica; a SHARDED global
+    fetch has no complete local value, so fail loudly rather than
+    return a slice."""
+    if hasattr(x, "is_fully_addressable") and not x.is_fully_addressable \
+            and not getattr(x.sharding, "is_fully_replicated", False):
         raise ValueError(
             "fetch is sharded across processes (%s); fetch with "
             "return_numpy=False and gather explicitly (e.g. "
             "multihost_utils.process_allgather)" % (x.sharding,))
-    return np.asarray(x)
+    with _M_FETCH_SYNC.time():
+        return _local_view(x)
+
+
+class FetchHandle:
+    """A fetch result still in flight on the device
+    (``Executor.run(..., fetch_mode="async")``).
+
+    JAX dispatch is asynchronous: ``run`` returns as soon as the step is
+    enqueued, and the handle wraps the resulting ``jax.Array`` WITHOUT
+    forcing a device->host sync — back-to-back windows keep the device
+    busy. The sync happens exactly when you ask for host data:
+    ``.numpy()``, indexing, ``np.asarray(handle)``, or ``float(handle)``
+    (each observes ``executor_fetch_sync_seconds``). ``.value`` exposes
+    the raw in-flight array and ``shape``/``dtype``/``repr`` never
+    sync."""
+
+    __slots__ = ("_value", "name")
+
+    def __init__(self, value, name=None):
+        self._value = value
+        self.name = name
+
+    @property
+    def value(self):
+        """The underlying (possibly in-flight) array — no sync."""
+        return self._value
+
+    @property
+    def shape(self):
+        return tuple(np.shape(self._value))
+
+    @property
+    def dtype(self):
+        return getattr(self._value, "dtype", None)
+
+    def block_until_ready(self):
+        """Wait for the device computation, keep data on device (no
+        transfer). Returns self for chaining."""
+        import jax
+
+        jax.block_until_ready(self._value)
+        return self
+
+    def numpy(self):
+        """Materialize on the host (blocking sync)."""
+        return _fetch_numpy(self._value)
+
+    def __getitem__(self, idx):
+        return self.numpy()[idx]
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __float__(self):
+        return float(self.numpy())
+
+    def __repr__(self):
+        return "FetchHandle(name=%r, shape=%s, dtype=%s)" % (
+            self.name, self.shape, self.dtype)
 
 
 class _CompiledStep:
@@ -246,6 +342,86 @@ class _CompiledStep:
         self.fetch_names = fetch_names
 
 
+class _WindowPrefetch:
+    """One in-flight background drain+stack+stage of the NEXT ``iters=k``
+    py_reader window (``Executor.run(..., iters=k, prefetch=True)``).
+
+    While the device executes window i, this thread pulls the k batches
+    of window i+1 from the py_reader queues, stacks them ``[k, ...]``
+    (``_to_arrays`` already normalized dtype/shape to the declared
+    slots), and ``jax.device_put``s the stacks with the program's GSPMD
+    feed sharding (``CompiledProgram.feed_sharding`` at ``batch_dim=1``
+    — axis 0 is the iteration axis) — so when window i's dispatch
+    returns, window i+1's feeds are already device-resident and
+    pre-sharded. EOF is detected here but ACTED ON at consume time: the
+    consuming run resets the readers and raises ``EOFException`` before
+    any step executes, preserving the inline path's
+    EOF-before-step contract.
+
+    The thread is NON-daemon (tests/conftest.py fails tests that leak
+    one); ``consume()``/``discard()`` join it. ``_next()`` only blocks
+    as long as the user's generator takes to yield, so the join is
+    bounded by one window of host feed work."""
+
+    def __init__(self, py_readers, iters, sharding_fn=None):
+        import threading
+
+        self.key = (tuple(id(r) for r in py_readers), iters)
+        self.readers = list(py_readers)
+        self.iters = iters
+        self._sharding_fn = sharding_fn
+        self._result = ("error", RuntimeError("prefetch never ran"))
+        self._thread = threading.Thread(
+            target=self._drain, name="paddle-window-prefetch",
+            daemon=False)
+        self._thread.start()
+
+    def _drain(self):
+        import jax
+
+        try:
+            with _M_PREFETCH_INFLIGHT.track():
+                pulled = {r: [] for r in self.readers}
+                for i in range(self.iters):
+                    step_vals = [(r, r._next()) for r in self.readers]
+                    if any(v is None for _, v in step_vals):
+                        partial = bool(i) or any(v is not None
+                                                 for _, v in step_vals)
+                        self._result = ("eof", i, partial)
+                        return
+                    for r, vals in step_vals:
+                        pulled[r].append(vals)
+                feed = {}
+                for r, items in pulled.items():
+                    for j, name in enumerate(r.names):
+                        arr = np.stack([vals[j] for vals in items])
+                        s = self._sharding_fn(name, arr) \
+                            if self._sharding_fn is not None else None
+                        feed[name] = jax.device_put(arr, s) \
+                            if s is not None else jax.device_put(arr)
+                self._result = ("ok", feed)
+        except BaseException as e:
+            self._result = ("error", e)
+
+    def consume(self):
+        """Join the drain and return ``("ok", feed)``, ``("eof",
+        n_pulled, partial)`` or ``("error", exc)``. The join time IS
+        the window stall — 0 when the prefetch finished during the
+        previous window's compute."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        self._thread.join()
+        _M_WINDOW_STALL.observe(_time.perf_counter() - t0)
+        return self._result
+
+    def discard(self):
+        """Join and drop the result (Executor.close / abandoned loop).
+        Already-pulled batches are lost, like any abandoned pass."""
+        self._thread.join()
+        self._result = ("error", RuntimeError("prefetch discarded"))
+
+
 class Executor:
     """Reference ``executor.py:418``. ``place`` is advisory — JAX device
     placement is controlled by the default backend / shardings."""
@@ -253,6 +429,9 @@ class Executor:
     def __init__(self, place=None):
         self.place = place
         self._cache = {}
+        # (reader ids, iters) -> in-flight _WindowPrefetch; one entry
+        # per distinct prefetching batched loop (close() reaps them all)
+        self._window_prefetch = {}
 
     # ------------------------------------------------------------------
     def run(
@@ -263,6 +442,8 @@ class Executor:
         scope=None,
         return_numpy=True,
         iters=1,
+        fetch_mode=None,
+        prefetch=False,
     ):
         """``iters=1`` (default): one feed/fetch step, the legacy path.
 
@@ -277,13 +458,39 @@ class Executor:
         py_reader-fed programs instead drain exactly ``k`` batches up
         front. Each fetch returns the per-iteration trajectory, stacked
         ``[k, ...]``. See ``_run_batched`` and README "Step-batched
+        execution".
+
+        ``fetch_mode="async"``: return ``FetchHandle`` objects instead
+        of numpy — the step is dispatched but run() never blocks on a
+        device->host sync; each handle syncs only when ``.numpy()`` /
+        indexing forces it. ``fetch_mode="sync"`` (or None) is the
+        legacy behavior, where ``return_numpy`` decides between numpy
+        (blocking per fetch) and raw in-flight ``jax.Array``s.
+
+        ``prefetch=True`` (needs ``iters=k`` and a py_reader-fed
+        program): after dispatching this window, a background thread
+        drains + stacks + device-stages window i+1's batches while the
+        device executes window i, so the next ``run`` finds its feeds
+        already staged (``executor_window_overlap_hit_total``).
+        EOF-before-step semantics are preserved. See README "Async
         execution"."""
+        if fetch_mode not in (None, "sync", "async"):
+            raise ValueError(
+                "fetch_mode must be None, 'sync' or 'async', got %r"
+                % (fetch_mode,))
         iters = int(iters)
         if iters < 1:
             raise ValueError("iters must be >= 1, got %d" % iters)
+        if prefetch and iters == 1:
+            raise ValueError(
+                "prefetch=True needs iters>=2: window prefetch overlaps "
+                "the NEXT step-batched window with this one's compute — "
+                "single steps already overlap via async dispatch "
+                "(fetch_mode='async')")
         if iters > 1:
             return self._run_batched(program, feed, fetch_list, scope,
-                                     return_numpy, iters)
+                                     return_numpy, iters, fetch_mode,
+                                     prefetch)
         import time as _time
 
         import jax
@@ -375,6 +582,16 @@ class Executor:
                         "garbage-collected — keep the object returned "
                         "by layers.py_reader() alive and start() it")
                 py_readers.append(r)
+        if py_readers:
+            rids = {id(r) for r in py_readers}
+            for pf in self._window_prefetch.values():
+                if set(pf.key[0]) & rids:
+                    raise RuntimeError(
+                        "a prefetched iters=%d window is pending on "
+                        "this program's py_reader(s) — a single-step "
+                        "run would race it for batches. Finish the "
+                        "batched loop (run with iters=%d until EOF) or "
+                        "exe.close() first." % (pf.iters, pf.iters))
         if py_readers:
             # pull every reader's batch on the host BEFORE dispatch and
             # ride the normal feed path (works under any sharding
@@ -504,12 +721,6 @@ class Executor:
             # name the first offender — costs a sync per step by design.
             # Multi-process arrays are checked shard-locally (every SPMD
             # process runs this, so together they cover the array).
-            def _local_view(x):
-                if hasattr(x, "is_fully_addressable") and \
-                        not x.is_fully_addressable:
-                    return np.asarray(x.addressable_shards[0].data)
-                return np.asarray(x)
-
             for label, vals in (("fetch", zip(fetch_names, fetches)),
                                 ("state", new_state.items())):
                 for n, v in vals:
@@ -524,14 +735,22 @@ class Executor:
         _M_RUN_SECONDS.observe(wall)
         _M_RUNS.inc()
         if _RUN_HOOKS:
-            _fire_run_hooks({
+            record = {
                 "program_id": program._uid,
                 "fetch_names": list(fetch_names),
                 "wall_time": wall,
                 "cache_hit": cache_hit,
                 "profiler_enabled": profiling,
-            })
+            }
+            if fetch_mode == "async":
+                # omit-when-default, like iters: legacy records keep
+                # their exact key set (read record.get("async", False))
+                record["async"] = True
+            _fire_run_hooks(record)
 
+        if fetch_mode == "async":
+            return [FetchHandle(x, name=n)
+                    for n, x in zip(fetch_names, fetches)]
         if return_numpy:
             return [_fetch_numpy(x) for x in fetches]
         return list(fetches)
@@ -578,11 +797,16 @@ class Executor:
 
     # -- step-batched execution (iters=k) ------------------------------
     def _run_batched(self, program, feed, fetch_list, scope, return_numpy,
-                     iters):
+                     iters, fetch_mode=None, prefetch=False):
         """``Executor.run(..., iters=k)`` for k >= 2: one compiled
         executable drives k steps device-side. Kept separate from the
         single-step ``run`` body so ``iters=1`` stays byte-for-byte the
-        legacy path (semantics, hook payloads, profiler events)."""
+        legacy path (semantics, hook payloads, profiler events).
+        ``prefetch=True`` overlaps the NEXT window's py_reader
+        drain+stack+stage with this window's device compute
+        (``_WindowPrefetch``); ``fetch_mode="async"`` returns
+        ``FetchHandle``s, so a prefetching loop issues no host sync at
+        all between windows."""
         import time as _time
 
         import jax
@@ -633,7 +857,55 @@ class Executor:
                     "block or checkpoint from the host loop "
                     "(fluid.io.save)")
 
-        if py_readers:
+        if prefetch and not py_readers:
+            raise ValueError(
+                "prefetch=True needs a py_reader-fed program — explicit "
+                "feeds are the caller's to stage ahead of time "
+                "(DataLoader use_double_buffer / fluid.reader.stage_feed)")
+
+        rkey = (tuple(id(r) for r in py_readers), iters)
+        pending = self._window_prefetch.get(rkey) if py_readers else None
+        for k in list(self._window_prefetch):
+            if k != rkey and set(k[0]) & set(rkey[0]):
+                pf = self._window_prefetch[k]
+                raise RuntimeError(
+                    "a prefetched window (iters=%d) is pending on "
+                    "py_reader(s) this run (iters=%d) also reads — the "
+                    "prefetched batches would be mis-windowed. Keep a "
+                    "prefetching batched loop's iters uniform, or "
+                    "exe.close() between loops." % (pf.iters, iters))
+        if pending is not None:
+            # overlap hit: the window was drained+stacked+staged in the
+            # background while the previous window computed
+            del self._window_prefetch[rkey]
+            status = pending.consume()
+            if status[0] == "error":
+                raise status[1]
+            if status[0] == "eof":
+                # EOF-before-step, exactly like the inline drain: reset,
+                # raise, no step ran, partial pulls discarded (logged)
+                from . import core as _core
+
+                if status[2]:
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "py_reader EOF during a prefetched batched run: "
+                        "discarding %d already-pulled batch(es) of a "
+                        "requested window of %d", status[1], iters)
+                for r in py_readers:
+                    r.reset()
+                raise _core.EOFException(
+                    "py_reader queue exhausted before %d batches — "
+                    "reader.reset() and re-start() for the next pass"
+                    % iters)
+            _M_OVERLAP_HIT.inc()
+            feed.update(status[1])
+        elif py_readers:
+            if prefetch:
+                # first window of a pass (or the pass just restarted
+                # after EOF): nothing staged yet, drain inline
+                _M_OVERLAP_MISS.inc()
             # drain exactly `iters` batches per reader up front and stack
             # them [k, ...]; EOF before k batches ends the pass like the
             # single-step path (readers reset, EOFException, no step ran —
@@ -734,6 +1006,18 @@ class Executor:
             _prof._record("executor_batched_run[%s#p%d;k=%d]" % (
                 ",".join(fetch_names[:3]), program._uid, iters),
                 _prof.now() - t0)
+        if prefetch:
+            # dispatch is asynchronous — window i is still executing on
+            # device; start draining + staging window i+1 right now so
+            # the next run finds it ready (overlap hit). Pre-shard with
+            # the program's GSPMD feed sharding (iteration axis is 0,
+            # so the dp'd batch axis sits at 1).
+            sharding_fn = None
+            if strategy is not None and strategy.mesh is not None:
+                sharding_fn = (lambda name, v:
+                               strategy.feed_sharding(v, batch_dim=1))
+            self._window_prefetch[rkey] = _WindowPrefetch(
+                py_readers, iters, sharding_fn)
         scope.set_var(RNG_STATE_VAR, new_rng)
         for n, v in new_state.items():
             scope.set_var(n, v)
@@ -757,12 +1041,6 @@ class Executor:
                 tensor_io.save_combine(path, {name: _fetch_numpy(val)})
 
         if _flags.check_nan_inf_enabled():
-            def _local_view(x):
-                if hasattr(x, "is_fully_addressable") and \
-                        not x.is_fully_addressable:
-                    return np.asarray(x.addressable_shards[0].data)
-                return np.asarray(x)
-
             for label, vals in (("fetch", zip(fetch_names, fetches)),
                                 ("state", new_state.items())):
                 for n, v in vals:
@@ -779,15 +1057,21 @@ class Executor:
         _M_BATCHED_RUNS.inc()
         _M_BATCHED_ITERS.inc(iters)
         if _RUN_HOOKS:
-            _fire_run_hooks({
+            record = {
                 "program_id": program._uid,
                 "fetch_names": list(fetch_names),
                 "wall_time": wall,
                 "cache_hit": cache_hit,
                 "profiler_enabled": profiling,
                 "iters": iters,
-            })
+            }
+            if fetch_mode == "async":
+                record["async"] = True
+            _fire_run_hooks(record)
 
+        if fetch_mode == "async":
+            return [FetchHandle(x, name=n)
+                    for n, x in zip(fetch_names, fetches)]
         if return_numpy:
             return [_fetch_numpy(x) for x in fetches]
         return list(fetches)
@@ -865,8 +1149,6 @@ class Executor:
         same compile-cached XLA step ``run()`` uses — thread-level
         parallelism lives in the dataset's parsing/prefetch side, device
         parallelism in the compiled step's shardings."""
-        import jax
-
         if dataset is None:
             raise ValueError("dataset is required")
         if thread:
@@ -878,32 +1160,39 @@ class Executor:
         # double-buffer ahead-dispatch (the fluid/reader.py staging trick;
         # reference buffered_reader.h ReadAsync semantics): step i is
         # dispatched asynchronously (return_numpy=False keeps it
-        # in-flight), then batch i+1 parses on host and stages H2D while
-        # the device executes — host prep and device step overlap.
+        # in-flight), then a background DeviceStager parses batch i+1 on
+        # host and stages it H2D while the device executes — host prep
+        # and device step overlap. A CompiledProgram's GSPMD feed
+        # sharding is applied AT the stage, so data-parallel feeds land
+        # pre-sharded across the mesh instead of funneling through
+        # device 0.
         import numpy as _np
 
-        def _stage(feed):
-            # LoDTensor (and other non-array) feeds ride through raw —
-            # run() decomposes them into data + @LOD with dtype
-            # normalization; only plain arrays pre-stage on device
-            return {k: jax.device_put(v)
-                    if isinstance(v, (_np.ndarray, jax.Array)) else v
-                    for k, v in feed.items()}
+        from . import compiler as _compiler
+        from .reader import DeviceStager, _as_sharding_fn, stage_feed
 
-        it = iter(dataset.batch_reader()())
-        nxt = next(it, None)
-        staged = _stage(nxt) if nxt is not None else None
-        while staged is not None:
-            res = self.run(program, feed=staged, fetch_list=fetch_list,
-                           scope=scope, return_numpy=False)
-            nxt = next(it, None)
-            staged = _stage(nxt) if nxt is not None else None
-            n_batches += 1
-            if debug and fetch_list and n_batches % print_period == 0:
-                msg = ", ".join(
-                    "%s=%s" % (info, _np.asarray(val).ravel()[:4])
-                    for info, val in zip(fetch_info, res))
-                print("batch %d: %s" % (n_batches, msg))
+        sharding_fn = None
+        if isinstance(program, _compiler.CompiledProgram) and \
+                program.mesh is not None:
+            sharding_fn = _as_sharding_fn(program)
+
+        stager = DeviceStager(
+            dataset.batch_reader()(),
+            transform=lambda feed: stage_feed(feed, sharding_fn),
+            capacity=2, name="dataset")
+        try:
+            for staged in stager:
+                res = self.run(program, feed=staged,
+                               fetch_list=fetch_list, scope=scope,
+                               return_numpy=False)
+                n_batches += 1
+                if debug and fetch_list and n_batches % print_period == 0:
+                    msg = ", ".join(
+                        "%s=%s" % (info, _np.asarray(val).ravel()[:4])
+                        for info, val in zip(fetch_info, res))
+                    print("batch %d: %s" % (n_batches, msg))
+        finally:
+            stager.close()
         return n_batches
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,
@@ -948,6 +1237,13 @@ class Executor:
         return step, (state, dict(feed_specs), rng)
 
     def close(self):
+        """Release compiled steps and reap any in-flight window
+        prefetch (joining its non-daemon thread; already-pulled batches
+        of an abandoned pass are dropped)."""
+        pending = list(self._window_prefetch.values())
+        self._window_prefetch.clear()
+        for pf in pending:
+            pf.discard()
         self._cache.clear()
 
 

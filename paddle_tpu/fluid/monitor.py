@@ -114,6 +114,13 @@ class Gauge(_Metric):
         with self._lock:
             self._value -= n
 
+    def track(self, n=1):
+        """Context manager: ``inc(n)`` on entry, ``dec(n)`` on exit —
+        the in-flight/occupancy idiom (e.g. a prefetch thread holds the
+        gauge at 1 while its pull is outstanding). Exception-safe, so a
+        crashed worker never leaves the gauge pinned high."""
+        return _GaugeTracker(self, n)
+
     @property
     def value(self):
         return self._value
@@ -124,6 +131,20 @@ class Gauge(_Metric):
 
     def to_dict(self):
         return {"kind": self.kind, "value": self._value}
+
+
+class _GaugeTracker:
+    def __init__(self, gauge, n):
+        self._gauge = gauge
+        self._n = n
+
+    def __enter__(self):
+        self._gauge.inc(self._n)
+        return self._gauge
+
+    def __exit__(self, *exc):
+        self._gauge.dec(self._n)
+        return False
 
 
 class Histogram(_Metric):

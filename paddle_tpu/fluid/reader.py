@@ -3,11 +3,18 @@
 Parity: reference ``python/paddle/fluid/reader.py`` (``DataLoader:73``
 ``from_generator``, ``GeneratorLoader:298``, ``PyReader:583``) backed by
 C++ ``LoDTensorBlockingQueue`` + ``buffered_reader`` (pre-H2D transfer on a
-CUDA stream). TPU-native: a background thread assembles numpy batches and
-stages them on device with ``jax.device_put`` ahead of consumption — the
-double-buffer H2D overlap matters even more here because the chip can sit
-behind a high-latency host link (see bench.py); the executor accepts the
-staged ``jax.Array`` feeds untouched.
+CUDA stream). TPU-native: a background ``DeviceStager`` thread assembles
+numpy batches and stages them on device with ``jax.device_put`` ahead of
+consumption — the double-buffer H2D overlap matters even more here because
+the chip can sit behind a high-latency host link (see bench.py); the
+executor accepts the staged ``jax.Array`` feeds untouched.
+
+Staging is SHARDING-AWARE: pass ``sharding=`` (a ``CompiledProgram``, a
+{name: Sharding} dict, or a ``fn(name, value) -> Sharding|None``) and each
+feed lands pre-laid-out with the program's GSPMD feed ``NamedSharding``
+(``CompiledProgram.feed_sharding``) instead of funneling through device 0 —
+a data-parallel program then consumes the prefetched batch with zero
+resharding copies.
 """
 
 import queue as _queue
@@ -19,8 +26,8 @@ import numpy as np
 from . import monitor as _monitor
 from .framework import Variable
 
-__all__ = ["DataLoader", "PyReader", "GeneratorLoader", "WorkerInfo",
-           "get_worker_info"]
+__all__ = ["DataLoader", "PyReader", "GeneratorLoader", "DeviceStager",
+           "stage_feed", "WorkerInfo", "get_worker_info"]
 
 # -- monitor series (process-wide; see fluid/monitor.py) ----------------------
 _M_BATCHES = _monitor.counter(
@@ -33,6 +40,144 @@ _M_STALLS = _monitor.counter(
 _M_FEED_SECONDS = _monitor.histogram(
     "reader_feed_seconds",
     help="batch assembly + device staging time (_to_feed)")
+_M_PREFETCH_DEPTH = _monitor.gauge(
+    "reader_prefetch_depth",
+    help="staged batches queued ahead of the consumer (DeviceStager "
+         "queue occupancy; capacity-bounded)")
+_M_PREFETCH_STALL = _monitor.histogram(
+    "reader_prefetch_stall_seconds",
+    help="consumer wait on the DeviceStager queue (0 when the next "
+         "staged batch was already waiting — the prefetch kept up)")
+
+
+def _as_sharding_fn(sharding):
+    """Normalize the ``sharding=`` surface to ``fn(name, value) ->
+    Sharding|None``: None passes through, a ``CompiledProgram`` resolves
+    via its ``feed_sharding``, a dict looks names up, a callable is used
+    as-is."""
+    if sharding is None:
+        return None
+    if hasattr(sharding, "feed_sharding"):  # CompiledProgram strategy
+        return lambda name, value: sharding.feed_sharding(value)
+    if isinstance(sharding, dict):
+        return lambda name, value: sharding.get(name)
+    if callable(sharding):
+        return sharding
+    raise TypeError(
+        "sharding must be None, a CompiledProgram, a {name: Sharding} "
+        "dict, or fn(name, value) -> Sharding; got %r" % (sharding,))
+
+
+def stage_feed(feed, sharding_fn=None):
+    """Sharding-aware H2D staging of one feed dict: every ndarray /
+    jax.Array value is ``jax.device_put`` with the sharding
+    ``sharding_fn(name, value)`` resolves (plain single-device put when
+    the fn is absent or returns None); non-array values (LoDTensor etc.)
+    pass through raw — the executor decomposes those itself."""
+    import jax
+
+    out = {}
+    for name, value in feed.items():
+        if isinstance(value, (np.ndarray, jax.Array)):
+            s = sharding_fn(name, value) if sharding_fn is not None else None
+            value = jax.device_put(value, s) if s is not None \
+                else jax.device_put(value)
+        out[name] = value
+    return out
+
+
+class DeviceStager:
+    """Bounded ahead-of-time staging pipeline: a producer thread pulls
+    items from ``source``, runs ``transform`` (batch assembly and/or the
+    sharding-aware ``jax.device_put``), and hands results over a bounded
+    queue — H2D transfer for batch i+1 overlaps the device's step i, and
+    ``reader_prefetch_depth`` reports how far ahead it is running.
+
+    The thread is deliberately NON-daemon: a stager that outlives its
+    pipeline is a bug (tests/conftest.py fails any test that leaks one).
+    Iterate to exhaustion or call ``close()`` — close() is idempotent,
+    unblocks a producer stalled on a full queue, and joins the thread.
+    Producer exceptions re-raise in the consumer."""
+
+    _END = object()
+
+    def __init__(self, source, transform=None, capacity=2, name="stager"):
+        self._q = _queue.Queue(maxsize=max(1, int(capacity)))
+        self._stop = threading.Event()
+        self._done = False
+        self._transform = transform
+        self._source = iter(source)
+        self._thread = threading.Thread(
+            target=self._produce, name="paddle-device-stager[%s]" % name,
+            daemon=False)
+        self._thread.start()
+
+    # -- producer side --------------------------------------------------
+    def _put(self, item):
+        # consumer-bound: count the stall once per batch — checked up
+        # front because the blocking put below can absorb a short stall
+        # inside its timeout without ever raising Full
+        if self._q.full():
+            _M_STALLS.inc()
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.05)
+                _M_PREFETCH_DEPTH.set(self._q.qsize())
+                return True
+            except _queue.Full:
+                pass
+        return False
+
+    def _produce(self):
+        try:
+            for item in self._source:
+                if self._stop.is_set():
+                    return
+                if self._transform is not None:
+                    item = self._transform(item)
+                if not self._put(item):
+                    return
+        except BaseException as e:  # re-raised on the consumer side
+            self._put(("__stager_error__", e))
+        finally:
+            self._put(self._END)
+
+    # -- consumer side --------------------------------------------------
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._done:
+            raise StopIteration
+        t0 = _time.perf_counter()
+        item = self._q.get()
+        _M_PREFETCH_STALL.observe(_time.perf_counter() - t0)
+        _M_PREFETCH_DEPTH.set(self._q.qsize())
+        if item is self._END:
+            self.close()
+            raise StopIteration
+        if isinstance(item, tuple) and len(item) == 2 and \
+                item[0] == "__stager_error__":
+            self.close()
+            raise item[1]
+        return item
+
+    def close(self):
+        """Stop the producer and join its thread. Items still queued are
+        dropped (an abandoned prefetch is by definition ahead of what
+        the consumer wanted)."""
+        if self._done and not self._thread.is_alive():
+            return
+        self._done = True
+        self._stop.set()
+        # drain so a producer blocked on a full queue can observe _stop
+        while True:
+            try:
+                self._q.get_nowait()
+            except _queue.Empty:
+                break
+        self._thread.join()
+        _M_PREFETCH_DEPTH.set(0)
 
 
 class WorkerInfo:
@@ -60,15 +205,21 @@ def get_worker_info():
 
 class GeneratorLoader:
     """Iterable loader: wraps a sample/batch generator into prefetched,
-    device-staged feed dicts."""
+    device-staged feed dicts. ``use_double_buffer=False`` turns BOTH the
+    prefetch thread and the ahead-of-time device staging off — every
+    batch assembles synchronously in the consumer and reaches the
+    executor as host arrays (staged at dispatch)."""
 
     def __init__(self, feed_list, capacity=4, stage_on_device=True,
-                 use_multiprocess=False, num_workers=2):
+                 use_multiprocess=False, num_workers=2,
+                 use_double_buffer=True, sharding=None):
         self._feed_names = [v.name if isinstance(v, Variable) else str(v)
                             for v in feed_list]
         self._feed_vars = feed_list
         self._capacity = capacity
         self._stage = stage_on_device
+        self._double_buffer = bool(use_double_buffer)
+        self._sharding_fn = _as_sharding_fn(sharding)
         self._gen = None
         self._kind = None
         self._use_multiprocess = use_multiprocess
@@ -111,47 +262,43 @@ class GeneratorLoader:
         items = ([batch[n] for n in self._feed_names]
                  if isinstance(batch, dict) else list(batch))
         arrays = []
-        for a in items:
+        for name, a in zip(self._feed_names, items):
             # LoDTensors pass through whole; the executor decomposes them
             # into data + @LOD lengths itself
             if hasattr(a, "recursive_sequence_lengths"):
                 arrays.append(a)
                 continue
             a = np.asarray(a)
-            if self._stage:
+            if self._stage and self._double_buffer:
                 import jax
 
-                # async H2D: stages ahead while the step runs
-                a = jax.device_put(a)
+                # async H2D with the program's feed sharding: stages
+                # ahead (and pre-shards) while the step runs
+                s = self._sharding_fn(name, a) \
+                    if self._sharding_fn is not None else None
+                a = jax.device_put(a, s) if s is not None \
+                    else jax.device_put(a)
             arrays.append(a)
         _M_FEED_SECONDS.observe(_time.perf_counter() - t0)
         _M_BATCHES.inc()
         return dict(zip(self._feed_names, arrays))
 
     def _iter_threaded(self):
-        end = object()
-        q = _queue.Queue(maxsize=self._capacity)
+        stager = DeviceStager(self._gen(), transform=self._to_feed,
+                              capacity=self._capacity, name="loader")
+        try:
+            for item in stager:
+                yield item
+        finally:
+            # abandoning the loop (break / GC of the generator) must not
+            # leak the non-daemon producer thread
+            stager.close()
 
-        def produce():
-            try:
-                for batch in self._gen():
-                    item = self._to_feed(batch)
-                    try:
-                        q.put_nowait(item)
-                    except _queue.Full:
-                        # consumer-bound: count the stall, then block
-                        _M_STALLS.inc()
-                        q.put(item)
-            finally:
-                q.put(end)
-
-        t = threading.Thread(target=produce, daemon=True)
-        t.start()
-        while True:
-            item = q.get()
-            if item is end:
-                break
-            yield item
+    def _iter_sync(self):
+        """use_double_buffer=False: no thread, no queue, no device
+        staging — each batch assembles on demand in the consumer."""
+        for batch in self._gen():
+            yield self._to_feed(batch)
 
     def _iter_multiprocess(self):
         """Worker processes run the generator and ship numpy batches over
@@ -232,6 +379,8 @@ class GeneratorLoader:
                                "set_sample_generator / set_sample_list_generator)")
         if self._use_multiprocess:
             return self._iter_multiprocess()
+        if not self._double_buffer:
+            return self._iter_sync()
         return self._iter_threaded()
 
 
@@ -243,14 +392,25 @@ class DataLoader:
     def from_generator(feed_list=None, capacity=4, use_double_buffer=True,
                        iterable=True, return_list=False,
                        stage_on_device=True, use_multiprocess=False,
-                       num_workers=2):
+                       num_workers=2, sharding=None):
+        """``use_double_buffer=True`` (default): a background
+        ``DeviceStager`` thread prefetches up to ``capacity`` batches,
+        each already assembled and — with ``stage_on_device=True`` —
+        ``jax.device_put`` ahead of time (pass ``sharding=`` a
+        ``CompiledProgram`` / dict / fn to pre-shard for GSPMD).
+        ``use_double_buffer=False``: fully synchronous — no prefetch
+        thread AND no ahead-of-time device staging (feeds reach the
+        executor as host arrays and stage at dispatch); use it when
+        batches are produced by something that must not run on a
+        side thread, or to take H2D off the measurement."""
         if not feed_list:
             raise ValueError("feed_list is required")
-        cap = capacity if use_double_buffer else 1
-        return GeneratorLoader(feed_list, capacity=cap,
+        return GeneratorLoader(feed_list, capacity=capacity,
                                stage_on_device=stage_on_device,
                                use_multiprocess=use_multiprocess,
-                               num_workers=num_workers)
+                               num_workers=num_workers,
+                               use_double_buffer=use_double_buffer,
+                               sharding=sharding)
 
     @staticmethod
     def from_dataset(dataset, places=None, drop_last=True):
@@ -266,8 +426,10 @@ class PyReader:
     machinery; ``start()``/``reset()`` are no-ops in iterable mode."""
 
     def __init__(self, feed_list=None, capacity=4, use_double_buffer=True,
-                 iterable=True, return_list=False):
-        self._loader = GeneratorLoader(feed_list, capacity)
+                 iterable=True, return_list=False, sharding=None):
+        self._loader = GeneratorLoader(feed_list, capacity,
+                                       use_double_buffer=use_double_buffer,
+                                       sharding=sharding)
         self._iterable = iterable
 
     def decorate_sample_generator(self, sample_generator, batch_size,

@@ -23,6 +23,33 @@ assert all(d.platform == "cpu" for d in jax.devices())
 assert len(jax.devices()) == 8, "expected 8 virtual CPU devices for mesh tests"
 
 
+import threading  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_nondaemon_threads():
+    """Fail any test that leaves NEW non-daemon threads alive — a hung
+    DeviceStager / window-prefetch thread would otherwise hang the whole
+    suite at interpreter exit. Pre-existing threads (dataset channel
+    workers from earlier tests, jax internals) are exempt via the
+    before-snapshot; a short grace join absorbs threads that are mid-
+    shutdown when the test body returns."""
+    before = set(threading.enumerate())
+    yield
+    leaked = [t for t in threading.enumerate()
+              if t not in before and t.is_alive() and not t.daemon]
+    deadline = 2.0
+    for t in leaked:
+        t.join(timeout=deadline)
+    leaked = [t for t in leaked if t.is_alive()]
+    if leaked:
+        pytest.fail(
+            "test leaked non-daemon thread(s): %s — close() your "
+            "DeviceStager/Executor/loader" % [t.name for t in leaked])
+
+
 def pytest_sessionfinish(session, exitstatus):
     """Dump the executed-op-type set so the execution-coverage gate's
     EXEMPT list can be audited: tests/.executed_op_types.txt. Only

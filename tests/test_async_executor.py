@@ -1,0 +1,493 @@
+"""Async executor pipeline: non-blocking FetchHandles, sharding-aware
+device prefetch, overlapped step-batched windows (README "Async
+execution").
+
+The contract under test: fetch_mode="async" returns handles that sync
+ONLY on .numpy()/indexing (executor_fetch_sync_seconds stays at zero
+until then), window prefetch overlaps window i+1's drain+stack+stage
+with window i's device compute while preserving EOF-before-step
+semantics bit-for-bit, and every background thread is reaped by
+close()/exhaustion (the conftest fixture fails leaks suite-wide)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers, monitor, optimizer
+from paddle_tpu.fluid.executor import FetchHandle
+from paddle_tpu.fluid.reader import DeviceStager, stage_feed
+
+
+def _sgd_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        label = layers.data(name="label", shape=[1], dtype="float32")
+        pred = layers.fc(x, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, label))
+        optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def _pyreader_program(B=4, D=3):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        reader = layers.py_reader(capacity=8, shapes=[[B, D], [B, 1]],
+                                  dtypes=["float32", "float32"])
+        x, y = layers.read_file(reader)
+        pred = layers.fc(x, 1, name="async_fc")
+        loss = layers.reduce_mean(layers.square_error_cost(pred, y))
+        optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, reader, loss
+
+
+def _batches(n, B=4, D=3, seed=0):
+    rng = np.random.RandomState(seed)
+    return [(rng.rand(B, D).astype(np.float32),
+             rng.rand(B, 1).astype(np.float32)) for _ in range(n)]
+
+
+# -- FetchHandle semantics ----------------------------------------------------
+
+def test_async_single_step_bit_identical_to_sync():
+    main, startup, loss = _sgd_program()
+    exe = fluid.Executor()
+    rng = np.random.RandomState(0)
+    feeds = [{"x": rng.rand(8, 4).astype(np.float32),
+              "label": rng.rand(8, 1).astype(np.float32)}
+             for _ in range(3)]
+
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        ref = [exe.run(main, feed=f, fetch_list=[loss])[0] for f in feeds]
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        handles = [exe.run(main, feed=f, fetch_list=[loss],
+                           fetch_mode="async")[0] for f in feeds]
+    for r, h in zip(ref, handles):
+        assert isinstance(h, FetchHandle)
+        np.testing.assert_array_equal(np.asarray(r), h.numpy())
+
+
+def test_async_batched_bit_identical_to_sync():
+    main, startup, loss = _sgd_program()
+    exe = fluid.Executor()
+    rng = np.random.RandomState(1)
+    k = 3
+    xs = rng.rand(k, 8, 4).astype(np.float32)
+    ys = rng.rand(k, 8, 1).astype(np.float32)
+
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (ref,) = exe.run(main, feed={"x": xs, "label": ys},
+                         fetch_list=[loss], iters=k)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (h,) = exe.run(main, feed={"x": xs, "label": ys},
+                       fetch_list=[loss], iters=k, fetch_mode="async")
+    np.testing.assert_array_equal(np.asarray(ref), h.numpy())
+
+
+def test_fetch_handle_api_and_sync_gating():
+    """shape/dtype/repr/block_until_ready never sync; numpy/indexing/
+    __array__/__float__ do, each recording in the fetch-sync
+    histogram."""
+    main, startup, loss = _sgd_program()
+    exe = fluid.Executor()
+    feed = {"x": np.ones((8, 4), np.float32),
+            "label": np.ones((8, 1), np.float32)}
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        monitor.reset()
+        (h,) = exe.run(main, feed=feed, fetch_list=[loss],
+                       fetch_mode="async")
+        hist = monitor.get_metric("executor_fetch_sync_seconds")
+        assert h.shape == () or h.shape == (1,)
+        assert h.dtype is not None
+        assert "FetchHandle" in repr(h)
+        assert h.block_until_ready() is h
+        assert hist.count == 0, "metadata access must not sync"
+        v = h.numpy()
+        assert hist.count == 1
+        assert np.isfinite(v).all()
+        np.testing.assert_array_equal(np.asarray(h), v)
+        assert float(h) == float(v.ravel()[0])
+        assert hist.count >= 3  # each host materialization recorded
+
+
+def test_run_hook_async_field():
+    """Async runs add async=True to hook records; legacy records keep
+    their exact key set (omit-when-default, like iters)."""
+    main, startup, loss = _sgd_program()
+    exe = fluid.Executor()
+    feed = {"x": np.ones((8, 4), np.float32),
+            "label": np.ones((8, 1), np.float32)}
+    records = []
+    fluid.register_run_hook(records.append)
+    try:
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            exe.run(main, feed=feed, fetch_list=[loss])
+            exe.run(main, feed=feed, fetch_list=[loss],
+                    fetch_mode="async")
+    finally:
+        fluid.unregister_run_hook(records.append)
+    sync_rec, async_rec = records[-2], records[-1]
+    assert "async" not in sync_rec
+    assert async_rec["async"] is True
+
+
+def test_fetch_mode_validation():
+    exe = fluid.Executor()
+    with pytest.raises(ValueError):
+        exe.run(fluid.Program(), fetch_mode="banana")
+    with pytest.raises(ValueError):
+        exe.run(fluid.Program(), prefetch=True)  # iters=1
+    main, startup, loss = _sgd_program()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        with pytest.raises(ValueError):
+            # prefetch needs a py_reader-fed program
+            exe.run(main, feed={"x": np.ones((2, 8, 4), np.float32),
+                                "label": np.ones((2, 8, 1), np.float32)},
+                    fetch_list=[loss], iters=2, prefetch=True)
+
+
+# -- window prefetch ----------------------------------------------------------
+
+def test_prefetch_trajectories_match_inline_across_epochs():
+    """A prefetching loop produces the SAME losses, EOF points, and
+    restart behavior as the inline (prefetch=False) loop — two full
+    epochs, bit-identical."""
+    def run_epochs(prefetch):
+        main, startup, reader, loss = _pyreader_program()
+        reader.decorate_tensor_provider(lambda: iter(_batches(6)))
+        exe = fluid.Executor()
+        out = []
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            for _ in range(2):
+                reader.start()
+                while True:
+                    try:
+                        (h,) = exe.run(main, fetch_list=[loss], iters=2,
+                                       fetch_mode="async",
+                                       prefetch=prefetch)
+                    except fluid.core.EOFException:
+                        reader.reset()
+                        break
+                    out.append(h.numpy().ravel())
+        exe.close()
+        return np.concatenate(out)
+
+    np.testing.assert_array_equal(run_epochs(False), run_epochs(True))
+
+
+def test_prefetch_eof_before_step_and_state_untouched():
+    """5 batches, windows of k=2: the third window's prefetch underfills
+    (1 batch left) — EOF must raise BEFORE any step runs, leaving the
+    weights exactly as window 2 committed them."""
+    main, startup, reader, loss = _pyreader_program()
+    reader.decorate_tensor_provider(lambda: iter(_batches(5)))
+    exe = fluid.Executor()
+    wname = [v.name for v in main.list_vars()
+             if v.persistable and ".w_" in v.name][0]
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        reader.start()
+        for _ in range(2):
+            exe.run(main, fetch_list=[loss], iters=2, prefetch=True,
+                    fetch_mode="async")
+        w_before = np.asarray(scope.find_var(wname)).copy()
+        with pytest.raises(fluid.core.EOFException):
+            exe.run(main, fetch_list=[loss], iters=2, prefetch=True,
+                    fetch_mode="async")
+        np.testing.assert_array_equal(
+            w_before, np.asarray(scope.find_var(wname)))
+        # pass restarts deterministically after reset
+        reader.start()
+        (h,) = exe.run(main, fetch_list=[loss], iters=2, prefetch=True,
+                       fetch_mode="async")
+        assert np.isfinite(h.numpy()).all()
+    exe.close()
+
+
+def test_overlap_hit_miss_counters():
+    main, startup, reader, loss = _pyreader_program()
+    reader.decorate_tensor_provider(lambda: iter(_batches(6)))
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        monitor.reset()
+        reader.start()
+        for _ in range(3):
+            exe.run(main, fetch_list=[loss], iters=2, prefetch=True)
+    exe.close()
+    assert monitor.counter(
+        "executor_window_overlap_miss_total").value == 1
+    assert monitor.counter(
+        "executor_window_overlap_hit_total").value == 2
+    assert monitor.get_metric("executor_window_stall_seconds").count == 2
+
+
+def test_window_prefetch_conflicts():
+    """A pending prefetched window guards its readers: a single-step run
+    or a different-iters batched run on the same readers is refused
+    rather than silently mis-windowing batches; close() clears it."""
+    main, startup, reader, loss = _pyreader_program()
+    reader.decorate_tensor_provider(lambda: iter(_batches(10)))
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        reader.start()
+        exe.run(main, fetch_list=[loss], iters=2, prefetch=True)
+        with pytest.raises(RuntimeError, match="prefetched"):
+            exe.run(main, fetch_list=[loss])
+        with pytest.raises(RuntimeError, match="mis-windowed"):
+            exe.run(main, fetch_list=[loss], iters=3)
+        exe.close()  # discards the pending window
+        # single-step works again (prefetch state cleared)
+        (lv,) = exe.run(main, fetch_list=[loss])
+        assert np.isfinite(np.asarray(lv)).all()
+    exe.close()
+
+
+def test_no_leaked_threads_after_close():
+    """close() must join the in-flight window prefetch thread even when
+    the batched loop is abandoned mid-pass."""
+    main, startup, reader, loss = _pyreader_program()
+    reader.decorate_tensor_provider(lambda: iter(_batches(8)))
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        reader.start()
+        exe.run(main, fetch_list=[loss], iters=2, prefetch=True)
+    exe.close()
+    alive = [t.name for t in threading.enumerate()
+             if t.is_alive() and t.name.startswith("paddle-window-prefetch")]
+    assert not alive, alive
+
+
+# -- sharding-aware staging ---------------------------------------------------
+
+def test_feed_sharding_resolution():
+    """CompiledProgram.feed_sharding: batch axis shards over 'dp' when
+    divisible, replicates otherwise — the single source of truth the
+    step wrappers and the stagers share."""
+    import jax
+
+    main, startup, loss = _sgd_program()
+    from paddle_tpu.fluid import compiler
+
+    cp = compiler.CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name, places=jax.devices()[:2])
+    s = cp.feed_sharding(np.zeros((8, 3), np.float32))
+    assert s.spec[0] == "dp"
+    s = cp.feed_sharding(np.zeros((7, 3), np.float32))
+    assert s.is_fully_replicated
+    s = cp.feed_sharding(np.zeros((4, 8, 3), np.float32), batch_dim=1)
+    assert s.spec[1] == "dp"
+    plain = compiler.CompiledProgram(main)
+    assert plain.feed_sharding(np.zeros((8, 3))) is None
+
+
+def test_sharded_window_prefetch_places_shards():
+    """Under a 2-device mesh, the background window prefetch stages
+    stacked [k, B, ...] feeds pre-sharded over 'dp' on the batch axis
+    (axis 1) — and the batched run consumes them bit-identically to the
+    single-device trajectory."""
+    import jax
+
+    from paddle_tpu.fluid import compiler
+
+    B, D = 8, 3
+    main, startup, reader, loss = _pyreader_program(B, D)
+    cp = compiler.CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name, places=jax.devices()[:2])
+    reader.decorate_tensor_provider(lambda: iter(_batches(6, B, D)))
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        reader.start()
+        exe.run(cp, fetch_list=[loss], iters=2, prefetch=True,
+                fetch_mode="async")
+        # inspect the in-flight prefetch of window 2 before consuming it
+        (pf,) = exe._window_prefetch.values()
+        pf._thread.join()
+        status, feed = pf._result
+        assert status == "ok"
+        for v in feed.values():
+            assert isinstance(v, jax.Array)
+            assert len(v.sharding.device_set) == 2
+            assert v.sharding.spec[1] == "dp"
+        (h,) = exe.run(cp, fetch_list=[loss], iters=2, prefetch=True,
+                       fetch_mode="async")
+        assert np.isfinite(h.numpy()).all()
+    exe.close()
+
+
+def test_loader_sharding_aware_staging():
+    """GeneratorLoader(sharding=CompiledProgram) stages every batch with
+    the program's feed sharding — 2 devices hold the shards before the
+    executor ever sees the feed."""
+    import jax
+
+    from paddle_tpu.fluid import compiler
+    from paddle_tpu.fluid.reader import DataLoader
+
+    main, startup, loss = _sgd_program()
+    cp = compiler.CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name, places=jax.devices()[:2])
+    x = [v for v in main.list_vars() if v.name == "x"][0]
+    loader = DataLoader.from_generator(feed_list=[x], capacity=2,
+                                       sharding=cp)
+
+    def gen():
+        for i in range(3):
+            yield [np.full((8, 4), i, np.float32)]
+
+    loader.set_batch_generator(gen)
+    feeds = list(loader)
+    assert len(feeds) == 3
+    for f in feeds:
+        a = f["x"]
+        assert isinstance(a, jax.Array)
+        assert len(a.sharding.device_set) == 2
+
+
+def test_use_double_buffer_false_disables_staging_and_thread():
+    """use_double_buffer=False is a real switch now: no prefetch thread
+    is spawned and feeds stay host-side numpy (staged at dispatch), not
+    pre-put jax Arrays."""
+    import jax
+
+    from paddle_tpu.fluid.reader import DataLoader
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("dbx", shape=[4], dtype="float32")
+    loader = DataLoader.from_generator(feed_list=[x], capacity=4,
+                                       use_double_buffer=False)
+
+    seen_threads = []
+
+    def gen():
+        for i in range(3):
+            seen_threads.append(threading.current_thread())
+            yield [np.full((2, 4), i, np.float32)]
+
+    loader.set_batch_generator(gen)
+    feeds = list(loader)
+    assert len(feeds) == 3
+    assert all(t is threading.main_thread() for t in seen_threads), \
+        "use_double_buffer=False must not run the generator on a thread"
+    for f in feeds:
+        assert isinstance(f["dbx"], np.ndarray)
+        assert not isinstance(f["dbx"], jax.Array)
+
+
+def test_device_stager_error_propagates_and_joins():
+    def gen():
+        yield {"a": np.zeros(2, np.float32)}
+        raise RuntimeError("boom in producer")
+
+    stager = DeviceStager(gen(), capacity=2)
+    assert "a" in next(stager)
+    with pytest.raises(RuntimeError, match="boom in producer"):
+        next(stager)
+    assert not stager._thread.is_alive()
+
+
+def test_stage_feed_passthrough_and_put():
+    import jax
+
+    out = stage_feed({"a": np.ones((2, 2), np.float32), "b": "raw"})
+    assert isinstance(out["a"], jax.Array)
+    assert out["b"] == "raw"
+
+
+# -- acceptance: no host sync between windows --------------------------------
+
+def test_async_prefetch_overlaps_windows():
+    """The acceptance criterion: with fetch_mode="async" + prefetch, N
+    back-to-back iters=k windows run in less wall-clock than N x
+    (window compute + per-window feed work), because window i+1's feed
+    work (reader sleep, calibrated to the window's own compute time)
+    happens WHILE window i computes — and the executor records zero
+    fetch syncs until .numpy()."""
+    import jax
+
+    n, m, k, N = 256, 10, 2, 5
+    B = 256
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        reader = layers.py_reader(capacity=8, shapes=[[B, n]],
+                                  dtypes=["float32"])
+        x = layers.read_file(reader)
+        w = layers.create_parameter([n, n], "float32", name="ov_w")
+        h = x
+        for _ in range(m):
+            h = layers.matmul(h, w)
+            h = h * 0.01  # keep magnitudes bounded over the chain
+        loss = layers.reduce_mean(h)
+        optimizer.SGD(learning_rate=1e-4).minimize(loss)
+
+    delay = {"s": 0.0}  # set after calibration; the generator reads it
+    data = np.random.RandomState(0).rand(B, n).astype(np.float32)
+
+    def gen():
+        while True:
+            time.sleep(delay["s"])
+            yield (data,)
+
+    reader.decorate_tensor_provider(gen)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        reader.start()
+        # untimed compile window, then calibrate the window compute time
+        exe.run(main, fetch_list=[loss], iters=k)
+        t0 = time.perf_counter()
+        (h,) = exe.run(main, fetch_list=[loss], iters=k,
+                       fetch_mode="async")
+        h.block_until_ready()
+        t_c = time.perf_counter() - t0
+        if t_c < 0.02:
+            pytest.skip("window compute too fast to measure overlap "
+                        "reliably on this host (%.4fs)" % t_c)
+
+        # per-window feed work == window compute: a serial loop costs
+        # ~2*t_c per window, an overlapped one ~t_c
+        delay["s"] = t_c / k
+        reader.reset()
+        reader.start()
+        monitor.reset()
+        handles = []
+        t0 = time.perf_counter()
+        for _ in range(N):
+            (h,) = exe.run(main, fetch_list=[loss], iters=k,
+                           fetch_mode="async", prefetch=True)
+            handles.append(h)
+        handles[-1].block_until_ready()
+        wall = time.perf_counter() - t0
+
+        hist = monitor.get_metric("executor_fetch_sync_seconds")
+        assert hist.count == 0, (
+            "async windows must not sync before .numpy() (%d syncs)"
+            % hist.count)
+        assert monitor.counter(
+            "executor_window_overlap_hit_total").value >= N - 1
+        for h in handles:
+            assert np.isfinite(h.numpy()).all()
+        assert hist.count == len(handles)
+
+        serial_estimate = N * (t_c + k * delay["s"])
+        assert wall < 0.9 * serial_estimate, (
+            "no overlap: N=%d windows took %.3fs, serial estimate %.3fs "
+            "(window compute %.3fs, feed work %.3fs/window)"
+            % (N, wall, serial_estimate, t_c, k * delay["s"]))
+    exe.close()
