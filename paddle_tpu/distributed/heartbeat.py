@@ -29,6 +29,10 @@ _M_STEP = _monitor.gauge(
 _M_STALE = _monitor.counter(
     "watchdog_stale_detections_total",
     help="workers the watchdog flagged stale (per poll that found any)")
+_M_STOP_WEDGED = _monitor.counter(
+    "heartbeat_stop_wedged_total",
+    help="Heartbeat.stop calls whose stamper thread failed to join "
+         "within the timeout (wedged on I/O; stop still returns)")
 
 
 def current_heartbeat_dir():
@@ -87,9 +91,34 @@ class Heartbeat:
             self._stamp()
 
     def stop(self):
+        """Idempotent clean shutdown: joins the stamper thread, removes
+        the stamp, and leaves an ``hb.<rank>.exit`` marker so the
+        Watchdog knows this rank stopped ON PURPOSE — without the marker
+        a clean stop would read as a hang once the timeout passed (the
+        launcher's ``skip=`` workaround existed for exactly that). A
+        thread that fails to join within 2x the interval (wedged on I/O)
+        is counted and warned about, but stop() still returns — shutdown
+        must not hang on a hung stamper."""
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=self._interval * 2)
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=self._interval * 2)
+            if t.is_alive():
+                _M_STOP_WEDGED.inc()
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "Heartbeat.stop: stamper thread did not exit within "
+                    "%.1fs (wedged on I/O?); continuing shutdown",
+                    self._interval * 2)
+                return  # a wedged stamper may still write; keep the stamp
+        if self._dir is not None:
+            try:
+                with open(self.path + ".exit", "w") as f:
+                    f.write("clean")
+                os.remove(self.path)
+            except OSError:
+                pass  # launcher already tore the dir down
 
 
 class Watchdog:
@@ -130,6 +159,9 @@ class Watchdog:
         for r in range(self._nproc):
             if r in skip:
                 continue
+            if os.path.exists(os.path.join(self._dir,
+                                           "hb.%d.exit" % r)):
+                continue  # stopped on purpose (Heartbeat.stop marker)
             last = self._last_stamp(r)
             if last is None:
                 if now - self._started > self._grace:

@@ -21,6 +21,7 @@ import tempfile
 import time
 
 from ..fluid import monitor as _monitor
+from ..fluid import resilience as _resilience
 
 __all__ = ["launch", "main"]
 
@@ -34,6 +35,13 @@ _M_FAILED = _monitor.counter(
     help="gang attempts that ended in a crash or hang (incl. the last)")
 _M_ALIVE = _monitor.gauge(
     "launch_workers_alive", help="live trainer processes in this gang")
+_M_PORT_RETRIES = _monitor.counter(
+    "launch_port_retries_total",
+    help="gang attempts redone with a fresh base port after a bind "
+         "failure (the _free_port TOCTOU race)")
+_M_RESTART_BACKOFF = _monitor.histogram(
+    "launch_restart_backoff_seconds",
+    help="sleep before each gang restart (exponential backoff)")
 
 
 def _free_port():
@@ -42,6 +50,51 @@ def _free_port():
     port = s.getsockname()[1]
     s.close()
     return port
+
+
+def _reserve_port_range(nproc, tries=10):
+    """A base port such that base..base+nproc-1 are ALL bindable right
+    now. _free_port only probes one port, so a consecutive range starting
+    there can still collide with a live listener; verify the whole range
+    (and retry with a fresh base) before handing it to a gang. The race
+    window between this check and the workers binding remains — the
+    launcher additionally retries a gang that dies on 'Address already
+    in use' without burning a restart (see launch())."""
+    for _ in range(tries):
+        base = _free_port()
+        socks = []
+        try:
+            for i in range(1, nproc):
+                s = socket.socket()
+                s.bind(("127.0.0.1", base + i))
+                socks.append(s)
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    return _free_port()  # contended host: fall back to the single probe
+
+
+def _bind_failure(log_dir, nproc):
+    """True when a worker log of the just-failed attempt shows a port
+    bind failure — the one gang failure that is the LAUNCHER's fault
+    (port TOCTOU), so it gets a fresh base port instead of consuming
+    the caller's restart budget."""
+    if not log_dir:
+        return False
+    for rank in range(nproc):
+        path = os.path.join(log_dir, "worker.%d.log" % rank)
+        try:
+            with open(path, "rb") as f:
+                f.seek(max(0, os.path.getsize(path) - 65536))
+                tail = f.read().decode("utf-8", "replace")
+        except OSError:
+            continue
+        if "Address already in use" in tail or "EADDRINUSE" in tail:
+            return True
+    return False
 
 
 def _spawn_gang(nproc, cmd, node_ip, base, env, backend, log_dir,
@@ -91,19 +144,36 @@ def _kill_gang(procs):
 
 def launch(nproc, cmd, node_ip="127.0.0.1", started_port=None, env=None,
            backend=None, log_dir=None, max_restarts=0,
-           heartbeat_timeout=None):
+           heartbeat_timeout=None, restart_backoff=0.5, port_retries=3,
+           checkpoint_dir=None):
     """Spawn ``nproc`` copies of ``cmd`` (argv list) with the trainer env;
     returns the list of exit codes of the final attempt.
 
     Failure detection (SURVEY §5.3): a worker crashing (nonzero exit) or
     hanging (stale heartbeat, when ``heartbeat_timeout`` is set and the
     training script runs a ``distributed.Heartbeat``) kills the whole
-    gang; with ``max_restarts`` > 0 the gang is relaunched — training
-    scripts resume from their own checkpoints."""
+    gang; with ``max_restarts`` > 0 the gang is relaunched after an
+    exponential backoff (``restart_backoff`` base seconds — an immediate
+    respawn against a still-broken dependency just burns the budget).
+    Restarted workers see ``PADDLE_RESTART_ATTEMPT`` > 0 and, when
+    ``checkpoint_dir`` is set, ``PADDLE_CHECKPOINT_DIR`` — the pair
+    ``fluid.io.CheckpointManager.restore_on_restart`` reads to
+    auto-resume from the last intact checkpoint.
+
+    A gang that dies to a port bind failure ('Address already in use' in
+    a worker log — the ``_free_port`` TOCTOU race, launcher's fault) is
+    redone with a fresh base port up to ``port_retries`` times WITHOUT
+    consuming ``max_restarts`` or backing off."""
     from .heartbeat import Watchdog
 
-    for attempt in range(max_restarts + 1):
-        base = _free_port() if started_port is None else int(started_port)
+    if checkpoint_dir:
+        env = dict(os.environ if env is None else env)
+        env["PADDLE_CHECKPOINT_DIR"] = checkpoint_dir
+    attempt = 0
+    port_retry = 0
+    while True:
+        base = _reserve_port_range(nproc) if started_port is None \
+            else int(started_port)
         hb_dir = tempfile.mkdtemp(prefix="paddle_tpu_hb_")             if heartbeat_timeout else None
         procs, logs = _spawn_gang(nproc, cmd, node_ip, base, env, backend,
                                   log_dir, hb_dir, attempt)
@@ -149,12 +219,26 @@ def launch(nproc, cmd, node_ip="127.0.0.1", started_port=None, env=None,
         if not failed and all(c == 0 for c in codes):
             return codes
         _M_FAILED.inc()
-        if attempt < max_restarts:
-            _M_RESTARTS.inc()
+        if started_port is None and port_retry < port_retries and \
+                _bind_failure(log_dir, nproc):
+            port_retry += 1
+            _M_PORT_RETRIES.inc()
             sys.stderr.write(
-                "launch: gang failed (codes %r), restart %d/%d\n"
-                % (codes, attempt + 1, max_restarts))
-    return codes
+                "launch: gang lost the port race (base %d), retrying "
+                "with a fresh port range %d/%d (restart budget "
+                "untouched)\n" % (base, port_retry, port_retries))
+            continue
+        if attempt >= max_restarts:
+            return codes
+        _M_RESTARTS.inc()
+        delay = _resilience.backoff_delay(
+            attempt, base=restart_backoff, max_delay=30.0, jitter=0.25)
+        _M_RESTART_BACKOFF.observe(delay)
+        sys.stderr.write(
+            "launch: gang failed (codes %r), restart %d/%d in %.1fs\n"
+            % (codes, attempt + 1, max_restarts, delay))
+        time.sleep(delay)
+        attempt += 1
 
 
 def main(argv=None):
@@ -172,6 +256,14 @@ def main(argv=None):
     parser.add_argument("--heartbeat_timeout", type=float, default=None,
                         help="kill+restart when a worker's heartbeat "
                              "goes stale (script must run a Heartbeat)")
+    parser.add_argument("--checkpoint_dir", default=None,
+                        help="exported to workers as "
+                             "PADDLE_CHECKPOINT_DIR; pair with "
+                             "CheckpointManager.restore_on_restart for "
+                             "auto-resume across gang restarts")
+    parser.add_argument("--restart_backoff", type=float, default=0.5,
+                        help="base seconds of the exponential backoff "
+                             "before each gang restart")
     parser.add_argument("training_script")
     parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
@@ -180,7 +272,9 @@ def main(argv=None):
     codes = launch(args.nproc_per_node, cmd, node_ip=args.node_ip,
                    started_port=args.started_port, backend=args.backend,
                    log_dir=args.log_dir, max_restarts=args.max_restarts,
-                   heartbeat_timeout=args.heartbeat_timeout)
+                   heartbeat_timeout=args.heartbeat_timeout,
+                   restart_backoff=args.restart_backoff,
+                   checkpoint_dir=args.checkpoint_dir)
     bad = [(i, c) for i, c in enumerate(codes) if c != 0]
     if bad:
         sys.exit("workers failed: %r" % bad)

@@ -23,6 +23,7 @@ import threading
 import numpy as np
 
 from .. import native
+from ..fluid import resilience as _resilience
 
 _lib = None
 _lib_tried = False
@@ -169,6 +170,13 @@ class AsyncPusher:
         self._q = queue.Queue(maxsize=max_queue)
         self._stop = threading.Event()
         self._exc = None
+        # transient push failures (a RemoteTable behind a flaky link)
+        # retry in the worker before being recorded as a deferred error;
+        # programming errors (IndexError etc.) surface immediately
+        self._retry = _resilience.Retry(
+            max_attempts=3, base_delay=0.05, max_delay=1.0,
+            retryable=(_resilience.TransientError, ConnectionError),
+            name="ps.push")
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
         _registry_add(_pushers, self)
@@ -186,8 +194,8 @@ class AsyncPusher:
             # deadlock on q.join(); the error is recorded and re-raised from
             # the caller's next push()/flush().
             try:
-                self.table.push(*item[0], **item[1])
-            except BaseException as e:  # noqa: B036 — worker must survive
+                self._retry.call(self.table.push, *item[0], **item[1])
+            except BaseException as e:  # noqa: B036 — worker must survive; recorded, re-raised from push()/flush()
                 if self._exc is None:
                     self._exc = e
             finally:

@@ -31,6 +31,8 @@ import logging
 
 import numpy as np
 
+from ..fluid import resilience as _resilience
+
 _LOG = logging.getLogger(__name__)
 
 __all__ = ["TableServer", "RemoteTable", "ShardedRemoteTable",
@@ -373,7 +375,11 @@ class _Conn:
     token handshake, and reconnect-with-backoff. Requests are retried
     across reconnects — safe for every opcode because pushes carry a
     (client, seq) pair the server dedupes (at-most-once apply), and the
-    rest are idempotent reads/overwrites."""
+    rest are idempotent reads/overwrites.
+
+    The retry policy is the shared ``fluid.resilience.Retry`` (site
+    ``ps.rpc`` in monitor) instead of a hand-rolled loop — same attempt
+    budget and doubling backoff as before (5 attempts, 0.2s base)."""
 
     RETRIES = 4
     BACKOFF = 0.2  # seconds, doubled per attempt
@@ -384,6 +390,12 @@ class _Conn:
         self._token = _default_token() if token is None else str(token)
         self._mu = threading.Lock()
         self._sock = None
+        self._retry = _resilience.Retry(
+            max_attempts=self.RETRIES + 1, base_delay=self.BACKOFF,
+            factor=2.0, max_delay=30.0, jitter=0.0,
+            retryable=(OSError, ConnectionError,
+                       _resilience.TransientError),
+            name="ps.rpc")
         self._connect()
 
     def _connect(self):
@@ -401,38 +413,36 @@ class _Conn:
             raise
         self._sock = sock
 
+    def _round_trip(self, payload):
+        """One attempt: (re)connect if needed, send, read the response.
+        A failure mid-stream leaves the framing desynchronized, so the
+        socket is dropped before the error propagates to the Retry —
+        the next attempt starts on a fresh connection (push dedup makes
+        the re-send safe)."""
+        from ..fluid import faults as _faults
+
+        if self._sock is None:
+            self._connect()
+        try:
+            _faults.check("ps.rpc")
+            _send_all(self._sock, _frame(payload))
+            return _read_frame(self._sock)
+        except (OSError, ConnectionError, _resilience.TransientError):
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+            raise
+
     def request(self, payload):
         with self._mu:
-            last_err = None
-            for attempt in range(self.RETRIES + 1):
-                if self._sock is None:
-                    try:
-                        self._connect()
-                    except (OSError, ConnectionError) as e:
-                        last_err = e
-                        if attempt < self.RETRIES:
-                            time.sleep(self.BACKOFF * (2 ** attempt))
-                        continue
-                try:
-                    _send_all(self._sock, _frame(payload))
-                    resp = _read_frame(self._sock)
-                    break
-                except (OSError, ConnectionError) as e:
-                    # a timeout/short read leaves the stream
-                    # desynchronized — drop the socket and retry on a
-                    # fresh connection (push dedup makes this safe)
-                    last_err = e
-                    try:
-                        self._sock.close()
-                    except OSError:
-                        pass
-                    self._sock = None
-                    if attempt < self.RETRIES:
-                        time.sleep(self.BACKOFF * (2 ** attempt))
-            else:
+            try:
+                resp = self._retry.call(self._round_trip, payload)
+            except (OSError, ConnectionError) as e:
                 raise ConnectionError(
                     "pserver %s:%d unreachable after %d attempts: %r"
-                    % (self._addr + (self.RETRIES + 1, last_err)))
+                    % (self._addr + (self.RETRIES + 1, e)))
         if not resp or resp[0] != 0:
             raise RuntimeError("pserver error: %s"
                                % resp[1:].decode("utf-8", "replace"))

@@ -19,6 +19,7 @@ from . import (  # noqa: F401
     dygraph_grad_clip,
     evaluator,
     executor,
+    faults,
     flags,
     framework,
     initializer,
@@ -36,6 +37,7 @@ from . import (  # noqa: F401
     passes,
     profiler,
     regularizer,
+    resilience,
     transpiler,
     unique_name,
 )

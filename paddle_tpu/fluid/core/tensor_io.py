@@ -4,6 +4,7 @@
 when a toolchain exists; the struct-based fallback writes byte-identical
 files, so the two interchange."""
 
+import os
 import struct
 
 import numpy as np
@@ -46,9 +47,17 @@ def _native():
     return native.load_tensor_io()  # memoized by the native package
 
 
-def save_combine(path, arrays):
+def save_combine(path, arrays, atomic=True):
     """Write named arrays (dict or (name, array) iterable) to one file.
-    Format limit: ndim <= 16 (enforced symmetrically at save time)."""
+    Format limit: ndim <= 16 (enforced symmetrically at save time).
+
+    ``atomic=True`` (default): the bytes land in ``<path>.tmp-<pid>``,
+    are fsync'd, and only then renamed over ``path`` — a crash at ANY
+    instant leaves either the old intact file or the new intact file,
+    never a torn one (the reference's save ops write in place, so a
+    killed worker could leave a half-checkpoint that load half-applies).
+    ``atomic=False`` restores the in-place write for callers that own
+    their own staging (the tmp-dir checkpoint writer)."""
     items = list(arrays.items()) if isinstance(arrays, dict) else list(arrays)
     items = [(n, np.ascontiguousarray(a)) for n, a in items]
     for n, a in items:
@@ -56,10 +65,37 @@ def save_combine(path, arrays):
             raise ValueError("PTC1 stores at most 16 dims; %r has %d"
                              % (n, a.ndim))
     lib = _native()
-    if lib is not None:
-        _save_native(lib, path, items)
-    else:
-        _save_py(path, items)
+    if not atomic:
+        if lib is not None:
+            _save_native(lib, path, items)
+        else:
+            _save_py(path, items)
+        return
+    tmp = "%s.tmp-%d" % (path, os.getpid())
+    try:
+        if lib is not None:
+            _save_native(lib, tmp, items)
+            _fsync_path(tmp)
+        else:
+            _save_py(tmp, items)
+        from .. import faults as _faults
+
+        _faults.check("io.write")  # simulated crash: tmp written, dest untouched
+        os.replace(tmp, path)
+    except BaseException:  # crash-consistency: never leave tmp behind on a surfaced error
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def _fsync_path(path):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
 
 
 def _save_native(lib, path, items):
@@ -94,6 +130,8 @@ def _save_py(path, items):
                 f.write(struct.pack("<Q", d))
             f.write(struct.pack("<Q", a.nbytes))
             f.write(a.tobytes())
+        f.flush()
+        os.fsync(f.fileno())
 
 
 def load_combine(path):

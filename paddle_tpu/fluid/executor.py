@@ -80,6 +80,18 @@ _M_PREFETCH_INFLIGHT = _monitor.gauge(
     "executor_window_prefetch_inflight",
     help="window prefetches currently draining/staging in the "
          "background (0 or 1 per Executor)")
+_M_ANOMALY = _monitor.counter(
+    "executor_anomaly_nonfinite_total",
+    help="steps whose fetches/updated state contained non-finite values "
+         "(or an injected step.nonfinite fault)")
+_M_ANOMALY_SKIPPED = _monitor.counter(
+    "executor_anomaly_skipped_steps_total",
+    help="training steps discarded (state not committed) by the "
+         "skip_step anomaly policy")
+_M_ANOMALY_ROLLBACKS = _monitor.counter(
+    "executor_anomaly_rollbacks_total",
+    help="rollback-policy restores to the last intact checkpoint after "
+         "a non-finite step")
 
 # -- run hooks ----------------------------------------------------------------
 _RUN_HOOKS = []
@@ -400,7 +412,7 @@ class _WindowPrefetch:
                         feed[name] = jax.device_put(arr, s) \
                             if s is not None else jax.device_put(arr)
                 self._result = ("ok", feed)
-        except BaseException as e:
+        except BaseException as e:  # background thread: stored and re-raised on the consuming run
             self._result = ("error", e)
 
     def consume(self):
@@ -432,6 +444,103 @@ class Executor:
         # (reader ids, iters) -> in-flight _WindowPrefetch; one entry
         # per distinct prefetching batched loop (close() reaps them all)
         self._window_prefetch = {}
+        # consecutive steps discarded by the skip_step/rollback anomaly
+        # policy; a clean step resets it, exceeding the budget raises
+        self._anomaly_skips = 0
+
+    # -- anomaly policy (nan/inf) --------------------------------------
+    def _scan_anomaly(self, fetch_names, fetches, new_state):
+        """First non-finite (kind, var name) among fetches and updated
+        state, or None. Runs when FLAGS_check_nan_inf is on, when the
+        anomaly policy is not 'raise', or when a step.nonfinite fault is
+        armed; costs one host sync by design. Shard-local on
+        multi-process arrays (every SPMD process scans its shard)."""
+        from . import faults as _faults
+        from . import flags as _flags
+
+        enabled = (_flags.check_nan_inf_enabled()
+                   or _flags.anomaly_policy() != "raise"
+                   or _faults.is_armed("step.nonfinite"))
+        if not enabled:
+            return None
+        if _faults.take("step.nonfinite"):
+            return ("injected", "step.nonfinite")
+        for label, vals in (("fetch", zip(fetch_names, fetches)),
+                            ("state", new_state.items())):
+            for n, v in vals:
+                arr = _local_view(v)
+                if np.issubdtype(arr.dtype, np.floating) and \
+                        not np.isfinite(arr).all():
+                    return (label, n)
+        return None
+
+    def _handle_anomaly(self, where, program, scope, checkpoint, iters):
+        """Apply the configured anomaly policy to a non-finite step.
+        Returns True when the step (or whole ``iters=k`` window) must be
+        DISCARDED — the caller then commits neither state nor rng.
+
+        ``raise``: legacy behavior, FloatingPointError names the var.
+        ``skip_step``: drop this step's updates, keep training on the
+        previous weights; after ``FLAGS_anomaly_skip_budget`` CONSECUTIVE
+        anomalous steps it raises anyway (a persistently diverged run
+        must not spin forever). ``rollback``: additionally restore the
+        last intact checkpoint (requires ``checkpoint=(manager, n)`` on
+        this run call), rewinding optimizer state and rng with the
+        params. Skip/rollback keep the PRE-step scope arrays live, so
+        they need XLA buffer donation off (the executor builds its plain
+        jit undonated for these policies automatically; sharded runs set
+        ``build_strategy.enable_inplace = False``)."""
+        from . import flags as _flags
+
+        _M_ANOMALY.inc()
+        policy = _flags.anomaly_policy()
+        msg = ("non-finite values in %s var %r after running program"
+               % where)
+        if policy == "raise":
+            raise FloatingPointError("FLAGS_check_nan_inf: " + msg)
+        self._anomaly_skips += 1
+        budget = _flags.anomaly_skip_budget()
+        if self._anomaly_skips > budget:
+            raise FloatingPointError(
+                "anomaly policy %r: %s — %d consecutive anomalous steps "
+                "exceeded FLAGS_anomaly_skip_budget=%d"
+                % (policy, msg, self._anomaly_skips, budget))
+        import logging
+
+        log = logging.getLogger(__name__)
+        if policy == "rollback":
+            if checkpoint is None:
+                raise RuntimeError(
+                    "anomaly policy 'rollback' needs a checkpoint to "
+                    "roll back to — call Executor.run(..., "
+                    "checkpoint=(CheckpointManager, every_n_steps))")
+            step = checkpoint[0].restore(self, program, scope=scope)
+            _M_ANOMALY_ROLLBACKS.inc()
+            log.warning("anomaly policy rollback: %s; restored "
+                        "checkpoint step %d (%d/%d consecutive)",
+                        msg, step, self._anomaly_skips, budget)
+        else:
+            _M_ANOMALY_SKIPPED.inc(iters)
+            log.warning("anomaly policy skip_step: %s; discarding the "
+                        "step's updates (%d/%d consecutive)",
+                        msg, self._anomaly_skips, budget)
+        return True
+
+    @staticmethod
+    def _check_checkpoint_arg(checkpoint):
+        if checkpoint is None:
+            return None
+        try:
+            mgr, every = checkpoint
+        except (TypeError, ValueError):
+            raise ValueError(
+                "checkpoint must be a (CheckpointManager, every_n_steps) "
+                "pair, got %r" % (checkpoint,))
+        if not hasattr(mgr, "step_completed") or int(every) < 1:
+            raise ValueError(
+                "checkpoint must be a (CheckpointManager, every_n_steps "
+                ">= 1) pair, got %r" % (checkpoint,))
+        return mgr, int(every)
 
     # ------------------------------------------------------------------
     def run(
@@ -444,6 +553,7 @@ class Executor:
         iters=1,
         fetch_mode=None,
         prefetch=False,
+        checkpoint=None,
     ):
         """``iters=1`` (default): one feed/fetch step, the legacy path.
 
@@ -473,7 +583,17 @@ class Executor:
         device executes window i, so the next ``run`` finds its feeds
         already staged (``executor_window_overlap_hit_total``).
         EOF-before-step semantics are preserved. See README "Async
-        execution"."""
+        execution".
+
+        ``checkpoint=(manager, every_n_steps)``: after every committed
+        step (``iters=k`` counts k), the ``fluid.io.CheckpointManager``
+        advances its step counter and writes a crash-consistent
+        checkpoint each time it crosses a multiple of ``every_n_steps``
+        — pair with ``manager.restore_on_restart`` for auto-resume under
+        ``distributed.launch(max_restarts=...)``. Also the rollback
+        target for the ``rollback`` anomaly policy (README "Fault
+        tolerance")."""
+        checkpoint = self._check_checkpoint_arg(checkpoint)
         if fetch_mode not in (None, "sync", "async"):
             raise ValueError(
                 "fetch_mode must be None, 'sync' or 'async', got %r"
@@ -490,7 +610,7 @@ class Executor:
         if iters > 1:
             return self._run_batched(program, feed, fetch_list, scope,
                                      return_numpy, iters, fetch_mode,
-                                     prefetch)
+                                     prefetch, checkpoint)
         import time as _time
 
         import jax
@@ -645,8 +765,12 @@ class Executor:
             if v.persistable and scope.has_var(v.name)
         )
 
+        from . import flags as _flags
+
         # program._uid (a monotonic token) rather than id(program): a GC'd
-        # Program's id can be reused, which would serve a stale compiled step
+        # Program's id can be reused, which would serve a stale compiled step.
+        # The anomaly-policy bit joins the key because it flips buffer
+        # donation (skip_step/rollback must keep pre-step buffers alive).
         key = (
             program._uid,
             program._mutation,
@@ -654,8 +778,8 @@ class Executor:
             tuple(fetch_names),
             tuple(state_names),
             strategy._uid if strategy is not None else 0,
+            _flags.anomaly_policy() != "raise",
         )
-        from . import flags as _flags
 
         step = self._cache.get(key)
         cache_hit = step is not None
@@ -691,11 +815,24 @@ class Executor:
             _prof._record("executor_run[%s#p%d]" % (
                 ",".join(fetch_names[:3]), program._uid),
                 _prof.now() - t0)
-        scope.set_var(RNG_STATE_VAR, new_rng)
-        for n, v in new_state.items():
-            scope.set_var(n, v)
+        # nan/inf anomaly scan BEFORE commit (reference
+        # FLAGS_check_nan_inf / nan_inf_utils, grown into a policy): a
+        # non-finite step is handled per FLAGS_anomaly_policy — raise
+        # (legacy, default), skip_step (discard the update), or rollback
+        # (restore the last checkpoint). Discarded steps commit nothing.
+        anomaly = self._scan_anomaly(fetch_names, fetches, new_state)
+        discarded = False
+        if anomaly is not None:
+            discarded = self._handle_anomaly(anomaly, program, scope,
+                                             checkpoint, iters=1)
+        else:
+            self._anomaly_skips = 0
+        if not discarded:
+            scope.set_var(RNG_STATE_VAR, new_rng)
+            for n, v in new_state.items():
+                scope.set_var(n, v)
 
-        if save_ops:
+        if save_ops and not discarded:
             # TPU deviation from save_op.cc (which executes at its
             # program-order position): the whole block runs as ONE
             # compiled step, so saves always record the POST-step
@@ -715,21 +852,8 @@ class Executor:
                 os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
                 tensor_io.save_combine(path, {name: _fetch_numpy(val)})
 
-        if _flags.check_nan_inf_enabled():
-            # debug mode (reference FLAGS_check_nan_inf / nan_inf_utils):
-            # force-materialize every fetch and updated persistable and
-            # name the first offender — costs a sync per step by design.
-            # Multi-process arrays are checked shard-locally (every SPMD
-            # process runs this, so together they cover the array).
-            for label, vals in (("fetch", zip(fetch_names, fetches)),
-                                ("state", new_state.items())):
-                for n, v in vals:
-                    arr = _local_view(v)
-                    if np.issubdtype(arr.dtype, np.floating) and \
-                            not np.isfinite(arr).all():
-                        raise FloatingPointError(
-                            "FLAGS_check_nan_inf: non-finite values in "
-                            "%s var %r after running program" % (label, n))
+        if checkpoint is not None and not discarded:
+            checkpoint[0].step_completed(program, scope, 1, checkpoint[1])
 
         wall = _time.perf_counter() - _t_run0
         _M_RUN_SECONDS.observe(wall)
@@ -792,12 +916,22 @@ class Executor:
                 fetch_names,
             )
 
-        jfn = jax.jit(step, donate_argnums=(0,))
+        # skip_step/rollback re-commit the PRE-step scope arrays after a
+        # discarded step; donation would have handed those buffers to XLA
+        # (a no-op on CPU but fatal on TPU), so those policies compile
+        # undonated. The policy sits in the compile-cache key, so
+        # flipping FLAGS_anomaly_policy recompiles rather than reusing a
+        # mismatched executable.
+        from . import flags as _flags
+
+        donate = (0,) if _flags.anomaly_policy() == "raise" else ()
+        jfn = jax.jit(step, donate_argnums=donate)
         return _CompiledStep(jfn, state_names, fetch_names)
 
     # -- step-batched execution (iters=k) ------------------------------
     def _run_batched(self, program, feed, fetch_list, scope, return_numpy,
-                     iters, fetch_mode=None, prefetch=False):
+                     iters, fetch_mode=None, prefetch=False,
+                     checkpoint=None):
         """``Executor.run(..., iters=k)`` for k >= 2: one compiled
         executable drives k steps device-side. Kept separate from the
         single-step ``run`` body so ``iters=1`` stays byte-for-byte the
@@ -960,9 +1094,13 @@ class Executor:
             if v.persistable and scope.has_var(v.name)
         )
 
+        from . import flags as _flags
+
         # iters joins the key: a k-step executable is a different
-        # program than a single step (7-tuple — never collides with the
-        # single-step path's 6-tuple keys in the same cache)
+        # program than a single step (8-tuple — never collides with the
+        # single-step path's 7-tuple keys in the same cache); the
+        # anomaly-policy bit flips buffer donation, like the single-step
+        # path
         key = (
             program._uid,
             program._mutation,
@@ -971,8 +1109,8 @@ class Executor:
             tuple(state_names),
             strategy._uid if strategy is not None else 0,
             iters,
+            _flags.anomaly_policy() != "raise",
         )
-        from . import flags as _flags
 
         step = self._cache.get(key)
         cache_hit = step is not None
@@ -1018,11 +1156,24 @@ class Executor:
                                strategy.feed_sharding(v, batch_dim=1))
             self._window_prefetch[rkey] = _WindowPrefetch(
                 py_readers, iters, sharding_fn)
-        scope.set_var(RNG_STATE_VAR, new_rng)
-        for n, v in new_state.items():
-            scope.set_var(n, v)
+        # anomaly scan BEFORE commit, same policy as the single-step
+        # path. Granularity is the WINDOW: a non-finite value anywhere in
+        # the k-step trajectory (fetches are stacked [k, ...]) or the
+        # final state discards all k steps — the device-side loop cannot
+        # partially commit.
+        anomaly = self._scan_anomaly(fetch_names, fetches, new_state)
+        discarded = False
+        if anomaly is not None:
+            discarded = self._handle_anomaly(anomaly, program, scope,
+                                             checkpoint, iters=iters)
+        else:
+            self._anomaly_skips = 0
+        if not discarded:
+            scope.set_var(RNG_STATE_VAR, new_rng)
+            for n, v in new_state.items():
+                scope.set_var(n, v)
 
-        if save_ops:
+        if save_ops and not discarded:
             # same contract as the single-step path, applied to the whole
             # window: ONE write per save op, recording the value committed
             # after step k (running k single-step runs against the same
@@ -1040,16 +1191,9 @@ class Executor:
                 os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
                 tensor_io.save_combine(path, {name: _fetch_numpy(val)})
 
-        if _flags.check_nan_inf_enabled():
-            for label, vals in (("fetch", zip(fetch_names, fetches)),
-                                ("state", new_state.items())):
-                for n, v in vals:
-                    arr = _local_view(v)
-                    if np.issubdtype(arr.dtype, np.floating) and \
-                            not np.isfinite(arr).all():
-                        raise FloatingPointError(
-                            "FLAGS_check_nan_inf: non-finite values in "
-                            "%s var %r after running program" % (label, n))
+        if checkpoint is not None and not discarded:
+            checkpoint[0].step_completed(program, scope, iters,
+                                         checkpoint[1])
 
         wall = _time.perf_counter() - _t_run0
         _M_RUN_SECONDS.observe(wall)
@@ -1136,7 +1280,12 @@ class Executor:
                 fetch_names,
             )
 
-        jfn = jax.jit(batched, donate_argnums=(0,))
+        # see _build: donation off under skip_step/rollback so a
+        # discarded window's pre-step state stays valid
+        from . import flags as _flags
+
+        donate = (0,) if _flags.anomaly_policy() == "raise" else ()
+        jfn = jax.jit(batched, donate_argnums=donate)
         return _CompiledStep(jfn, state_names, fetch_names)
 
     # convenience ------------------------------------------------------

@@ -1,0 +1,181 @@
+"""Deterministic fault injection for the fault-tolerance test harness.
+
+Production code is instrumented with NAMED injection points — a
+one-line ``faults.check("io.write")`` at the spot where a real failure
+would bite (between a checkpoint's tmp-file write and its rename, inside
+the reader's staging thread, around a pserver RPC, once per training
+step). Unarmed points cost a dict lookup and are no-ops; armed points
+count their hits and fire deterministically on the Nth one, so a test
+can reproduce "the worker died right after step 6's checkpoint" exactly.
+
+Arming is programmatic (``faults.arm("worker.exit", after_n=5)``) or —
+for subprocesses spawned by ``distributed.launch`` — environmental:
+``PADDLE_FAULTS=point:after_n[:times],point2:after_n`` is parsed at
+import. The injected exception defaults to ``FaultInjected``, a
+``resilience.TransientError`` subclass, so points wrapped in a shared
+``Retry`` demonstrably absorb it; pass ``exc=`` a different class to
+model a non-retryable failure. ``worker.exit`` is special: instead of
+raising it hard-kills the process with ``os._exit(EXIT_CODE)`` — the
+crash the launcher's gang restart exists for.
+
+Every fire is counted in ``monitor`` (``faults_injected_total`` by
+point), so a test can assert the fault actually happened.
+"""
+
+import os
+import threading
+
+from . import monitor as _monitor
+from .resilience import TransientError
+
+__all__ = ["FaultInjected", "POINTS", "EXIT_CODE", "arm", "disarm",
+           "reset", "is_armed", "hits", "check", "take"]
+
+ENV = "PADDLE_FAULTS"
+EXIT_CODE = 43  # distinguishable from python's 1 and signal deaths
+
+# the instrumented sites (arming an unknown point is an error — a typo'd
+# point name silently never firing is the worst failure mode of a fault
+# harness)
+POINTS = (
+    "io.write",        # fluid/core/tensor_io.save_combine: after the tmp
+                       #   file is written, BEFORE the atomic rename
+    "reader.stage",    # fluid/reader.stage_feed: inside the DeviceStager
+                       #   producer thread, before the device_put
+    "ps.rpc",          # distributed/ps_server._Conn: before each framed
+                       #   request round-trip
+    "worker.exit",     # training scripts call check() once per step;
+                       #   fires os._exit(EXIT_CODE) — a hard crash
+    "step.nonfinite",  # executor anomaly check: the step's results are
+                       #   treated as non-finite (policy path exercised
+                       #   without building a diverging model)
+)
+
+
+class FaultInjected(TransientError):
+    """Default injected failure — transient, so retry layers absorb it."""
+
+
+class _Fault:
+    __slots__ = ("after_n", "times", "exc", "hits", "fired")
+
+    def __init__(self, after_n, times, exc):
+        self.after_n = int(after_n)
+        self.times = int(times)
+        self.exc = exc
+        self.hits = 0
+        self.fired = 0
+
+
+_LOCK = threading.Lock()
+_ARMED = {}
+
+_M_INJECTED = {}
+
+
+def _m_injected(point):
+    m = _M_INJECTED.get(point)
+    if m is None:
+        m = _M_INJECTED[point] = _monitor.counter(
+            "faults_injected_total",
+            help="injected faults fired, by injection point",
+            labels={"point": point})
+    return m
+
+
+def arm(point, after_n=0, times=1, exc=FaultInjected):
+    """Arm ``point``: the first ``after_n`` hits pass through, then the
+    next ``times`` hits fire (raise ``exc``, or ``os._exit`` for
+    ``worker.exit``); later hits pass through again. Re-arming replaces
+    the previous setting and resets counters."""
+    if point not in POINTS:
+        raise ValueError("unknown fault point %r; known: %s"
+                         % (point, ", ".join(POINTS)))
+    with _LOCK:
+        _ARMED[point] = _Fault(after_n, times, exc)
+
+
+def disarm(point):
+    with _LOCK:
+        _ARMED.pop(point, None)
+
+
+def reset():
+    """Disarm everything (test teardown)."""
+    with _LOCK:
+        _ARMED.clear()
+
+
+def is_armed(point):
+    return point in _ARMED
+
+
+def hits(point):
+    """Hit count since arming (0 if not armed)."""
+    with _LOCK:
+        f = _ARMED.get(point)
+        return f.hits if f is not None else 0
+
+
+def _fire(point):
+    """Count a hit; True if this hit should fail."""
+    with _LOCK:
+        f = _ARMED.get(point)
+        if f is None:
+            return None
+        f.hits += 1
+        if f.hits > f.after_n and f.fired < f.times:
+            f.fired += 1
+            _m_injected(point).inc()
+            return f.exc
+    return None
+
+
+def check(point):
+    """The injection point: no-op unless armed and due. ``worker.exit``
+    hard-exits the process; every other point raises the armed
+    exception class (constructed with a descriptive message)."""
+    exc = _fire(point)
+    if exc is None:
+        return
+    if point == "worker.exit":
+        os._exit(EXIT_CODE)
+    raise exc("injected fault at %r" % point)
+
+
+def take(point):
+    """Like ``check`` but RETURNS True instead of raising — for sites
+    that inject a condition rather than an exception (the executor's
+    ``step.nonfinite`` pretends the step produced NaNs)."""
+    return _fire(point) is not None
+
+
+def _parse_env(spec):
+    """``point:after_n[:times]`` comma-separated; bad entries raise (a
+    silently ignored fault spec would invalidate the test using it)."""
+    out = []
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.split(":")
+        if len(parts) not in (2, 3):
+            raise ValueError(
+                "%s entry %r: want point:after_n[:times]" % (ENV, entry))
+        point, after_n = parts[0], int(parts[1])
+        times = int(parts[2]) if len(parts) == 3 else 1
+        out.append((point, after_n, times))
+    return out
+
+
+def arm_from_env(environ=None):
+    """Arm points from ``PADDLE_FAULTS`` (called at import; exposed so
+    tests can re-parse after monkeypatching the environment)."""
+    spec = (environ if environ is not None else os.environ).get(ENV)
+    if not spec:
+        return
+    for point, after_n, times in _parse_env(spec):
+        arm(point, after_n=after_n, times=times)
+
+
+arm_from_env()

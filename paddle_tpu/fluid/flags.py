@@ -15,6 +15,15 @@ Live flags:
                            before first compiling it
   FLAGS_cudnn_deterministic  accepted (XLA is deterministic by default)
   FLAGS_eager_delete_tensor_gb  accepted (XLA buffer lifetime)
+  FLAGS_anomaly_policy     what a non-finite training step does:
+                           "raise" (default, legacy FloatingPointError),
+                           "skip_step" (discard the update, keep going),
+                           "rollback" (restore the last checkpoint —
+                           needs Executor.run(checkpoint=...)). Env:
+                           PADDLE_ANOMALY_POLICY.
+  FLAGS_anomaly_skip_budget  consecutive anomalous steps skip_step /
+                           rollback tolerate before raising anyway
+                           (default 3). Env: PADDLE_ANOMALY_SKIP_BUDGET.
 """
 
 import os
@@ -30,7 +39,13 @@ _FLAGS = {
     "FLAGS_eager_delete_tensor_gb": 0.0,
     "FLAGS_fraction_of_gpu_memory_to_use": 0.92,
     "FLAGS_paddle_num_threads": 1,
+    "FLAGS_anomaly_policy": os.environ.get("PADDLE_ANOMALY_POLICY",
+                                           "raise"),
+    "FLAGS_anomaly_skip_budget": int(
+        os.environ.get("PADDLE_ANOMALY_SKIP_BUDGET", "3")),
 }
+
+_ANOMALY_POLICIES = ("raise", "skip_step", "rollback")
 
 
 def set_flags(flags):
@@ -52,3 +67,23 @@ def check_nan_inf_enabled():
 
 def check_program_enabled():
     return bool(_FLAGS.get("FLAGS_check_program"))
+
+
+def anomaly_policy():
+    """Validated FLAGS_anomaly_policy value (raise|skip_step|rollback).
+    Validation happens at READ time so a bad env var / set_flags value
+    fails the first run loudly rather than silently acting as raise."""
+    p = _FLAGS.get("FLAGS_anomaly_policy", "raise")
+    if p not in _ANOMALY_POLICIES:
+        raise ValueError(
+            "FLAGS_anomaly_policy must be one of %s, got %r"
+            % ("|".join(_ANOMALY_POLICIES), p))
+    return p
+
+
+def anomaly_skip_budget():
+    b = int(_FLAGS.get("FLAGS_anomaly_skip_budget", 3))
+    if b < 0:
+        raise ValueError(
+            "FLAGS_anomaly_skip_budget must be >= 0, got %d" % b)
+    return b

@@ -48,6 +48,11 @@ class _PyReader:
         self.dtypes = [np.dtype(d) for d in dtypes]
         self._provider = None
         self._it = None
+        # batches consumed since start() — checkpointed by
+        # fluid.io.CheckpointManager so a resumed run can fast-forward
+        # the provider to the batch after the checkpoint
+        self._pos = 0
+        self._resume_to = 0
 
     # -- decoration (reference py_reader surface) -------------------------
     def decorate_paddle_reader(self, reader, places=None):
@@ -72,9 +77,39 @@ class _PyReader:
                 "py_reader.start(): decorate a reader first "
                 "(decorate_paddle_reader / decorate_tensor_provider)")
         self._it = iter(self._provider())
+        self._pos = 0
+        if self._resume_to:
+            # checkpoint resume: burn the batches the checkpointed run
+            # already consumed this pass, so training continues with the
+            # batch AFTER the checkpoint (requires a deterministic
+            # provider, which resumable pipelines need anyway)
+            skip, self._resume_to = self._resume_to, 0
+            for _ in range(skip):
+                if self._next() is None:
+                    break
 
     def reset(self):
         self._it = None
+        self._pos = 0
+        self._resume_to = 0
+
+    @property
+    def position(self):
+        """Batches consumed since start() (the checkpointed cursor)."""
+        return self._pos
+
+    def resume_at(self, n):
+        """Arm a fast-forward: the next start() skips the first ``n``
+        batches. Applied immediately when the pass is already live."""
+        n = int(n)
+        if n < 0:
+            raise ValueError("resume_at: n must be >= 0, got %d" % n)
+        if self._it is not None:
+            while self._pos < n:
+                if self._next() is None:
+                    break
+        else:
+            self._resume_to = n
 
     def _to_arrays(self, item):
         if isinstance(item, dict):
@@ -126,6 +161,7 @@ class _PyReader:
             return None
         _M_FEED_SECONDS.observe(_time.perf_counter() - t0)
         _M_BATCHES.inc()
+        self._pos += 1
         return out
 
 

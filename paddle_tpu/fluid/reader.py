@@ -17,6 +17,7 @@ a data-parallel program then consumes the prefetched batch with zero
 resharding copies.
 """
 
+import os as _os
 import queue as _queue
 import threading
 import time as _time
@@ -24,6 +25,7 @@ import time as _time
 import numpy as np
 
 from . import monitor as _monitor
+from . import resilience as _resilience
 from .framework import Variable
 
 __all__ = ["DataLoader", "PyReader", "GeneratorLoader", "DeviceStager",
@@ -48,6 +50,16 @@ _M_PREFETCH_STALL = _monitor.histogram(
     "reader_prefetch_stall_seconds",
     help="consumer wait on the DeviceStager queue (0 when the next "
          "staged batch was already waiting — the prefetch kept up)")
+
+# transient staging failures (a device_put hiccup on a flaky host link,
+# an injected reader.stage fault) are retried with backoff inside the
+# producer thread instead of killing the whole input pipeline; attempts
+# are tunable via PADDLE_STAGE_RETRIES (>=1), and every retry/exhaustion
+# is counted under site="reader.stage" in monitor
+_STAGE_RETRY = _resilience.Retry(
+    max_attempts=max(1, int(_os.environ.get("PADDLE_STAGE_RETRIES", "3"))),
+    base_delay=0.05, max_delay=1.0,
+    retryable=_resilience.TransientError, name="reader.stage")
 
 
 def _as_sharding_fn(sharding):
@@ -76,6 +88,9 @@ def stage_feed(feed, sharding_fn=None):
     pass through raw — the executor decomposes those itself."""
     import jax
 
+    from . import faults as _faults
+
+    _faults.check("reader.stage")
     out = {}
     for name, value in feed.items():
         if isinstance(value, (np.ndarray, jax.Array)):
@@ -134,10 +149,13 @@ class DeviceStager:
                 if self._stop.is_set():
                     return
                 if self._transform is not None:
-                    item = self._transform(item)
+                    # transient staging failures retry with backoff here,
+                    # on the producer thread, so a device_put hiccup
+                    # doesn't tear down the whole input pipeline
+                    item = _STAGE_RETRY.call(self._transform, item)
                 if not self._put(item):
                     return
-        except BaseException as e:  # re-raised on the consumer side
+        except BaseException as e:  # background thread: stored and re-raised on the consumer side
             self._put(("__stager_error__", e))
         finally:
             self._put(self._END)
@@ -340,7 +358,7 @@ class GeneratorLoader:
                         items = list(batch)
                     q.put([pack(a) for a in items])
                 q.put(None)
-            except BaseException:
+            except BaseException:  # forked worker: traceback shipped to the parent, re-raised there
                 q.put(("__worker_error__", rank,
                        traceback.format_exc()))
 

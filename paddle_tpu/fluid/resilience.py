@@ -1,0 +1,251 @@
+"""Shared resilience primitives: retry-with-backoff and circuit breaking.
+
+Before this module every layer re-invented its own failure handling —
+the pserver client hand-rolled a reconnect loop (``ps_server._Conn``),
+the launcher respawned a crashed gang immediately, checkpoint writes
+had no retry at all. ``Retry`` and ``CircuitBreaker`` centralize the
+policy (attempt budget, deadline, exponential backoff + jitter, a
+retryable-exception predicate) and the observability (every attempt,
+exhaustion, and breaker trip is counted in ``monitor`` under the
+call-site's name), so "how does this subsystem behave under transient
+failure" has one answer instead of five.
+
+Exception taxonomy: ``TransientError`` marks failures worth retrying by
+default (network blips, queue hiccups, injected faults from
+``fluid/faults.py``); anything else is considered a programming error
+and surfaces immediately unless the call site widens ``retryable``.
+
+No jax / framework imports: like ``monitor``, this must be importable
+from every layer (io, reader, launcher, pserver) without cycles.
+"""
+
+import random
+import threading
+import time
+
+from . import monitor as _monitor
+
+__all__ = ["TransientError", "CircuitOpenError", "Retry",
+           "CircuitBreaker", "backoff_delay"]
+
+def _site_counters(site):
+    return (
+        _monitor.counter(
+            "resilience_retry_attempts_total",
+            help="failed attempts that were retried (per site label)",
+            labels={"site": site}),
+        _monitor.counter(
+            "resilience_retry_exhausted_total",
+            help="Retry.call gave up: attempts/deadline exhausted or "
+                 "non-retryable error",
+            labels={"site": site}),
+    )
+
+
+class TransientError(Exception):
+    """Marker base class: an operation failed in a way that is expected
+    to succeed on retry (connection reset, queue hiccup, injected
+    fault). ``Retry``'s default predicate retries these plus
+    ``OSError``/``ConnectionError``."""
+
+
+class CircuitOpenError(RuntimeError):
+    """The circuit breaker is open: calls are short-circuited without
+    touching the protected resource until the reset timeout elapses."""
+
+
+def backoff_delay(attempt, base=0.1, factor=2.0, max_delay=30.0,
+                  jitter=0.5, rand=random.random):
+    """Exponential backoff with decorrelating jitter: attempt 0 waits
+    ~``base``, each further attempt multiplies by ``factor``, capped at
+    ``max_delay``; ``jitter`` adds up to that fraction of the delay on
+    top (0 disables — deterministic, used by tests)."""
+    d = min(float(max_delay), float(base) * float(factor) ** int(attempt))
+    if jitter:
+        d += d * float(jitter) * rand()
+    return d
+
+
+class Retry:
+    """Bounded retry policy: ``retry.call(fn, *args)`` runs ``fn`` up to
+    ``max_attempts`` times (or until ``deadline`` seconds have elapsed),
+    sleeping ``backoff_delay`` between failures. On exhaustion the LAST
+    exception re-raises unchanged, so callers' ``except`` clauses keep
+    working. Also usable as a decorator: ``@Retry(name="io")``.
+
+    ``retryable`` is an exception class, a tuple of classes, or a
+    predicate ``fn(exc) -> bool``; the default retries
+    ``TransientError`` / ``OSError`` / ``ConnectionError``. A
+    non-retryable exception surfaces immediately (counted as
+    exhaustion, not as an attempt burned).
+
+    Instances are stateless between calls and therefore thread-safe —
+    one module-level Retry can guard every call site of a subsystem.
+    """
+
+    DEFAULT_RETRYABLE = (TransientError, OSError, ConnectionError)
+
+    def __init__(self, max_attempts=3, base_delay=0.1, factor=2.0,
+                 max_delay=30.0, deadline=None, jitter=0.5,
+                 retryable=None, name="retry", sleep=time.sleep,
+                 clock=time.monotonic):
+        if int(max_attempts) < 1:
+            raise ValueError("max_attempts must be >= 1, got %r"
+                             % (max_attempts,))
+        self.max_attempts = int(max_attempts)
+        self.base_delay = float(base_delay)
+        self.factor = float(factor)
+        self.max_delay = float(max_delay)
+        self.deadline = None if deadline is None else float(deadline)
+        self.jitter = float(jitter)
+        self.name = name
+        self._sleep = sleep
+        self._clock = clock
+        if retryable is None:
+            retryable = self.DEFAULT_RETRYABLE
+        if isinstance(retryable, type) and issubclass(retryable,
+                                                      BaseException):
+            retryable = (retryable,)
+        if isinstance(retryable, tuple):
+            classes = retryable
+            self._retryable = lambda e: isinstance(e, classes)
+        elif callable(retryable):
+            self._retryable = retryable
+        else:
+            raise TypeError(
+                "retryable must be an exception class, a tuple of them, "
+                "or a predicate fn(exc) -> bool; got %r" % (retryable,))
+        self._m_attempts, self._m_exhausted = _site_counters(name)
+
+    def delay(self, attempt):
+        """Seconds to sleep after failed attempt number ``attempt``
+        (0-based)."""
+        return backoff_delay(attempt, self.base_delay, self.factor,
+                             self.max_delay, self.jitter)
+
+    def call(self, fn, *args, **kwargs):
+        t0 = self._clock()
+        for attempt in range(self.max_attempts):
+            try:
+                return fn(*args, **kwargs)
+            except BaseException as e:  # classified below: re-raised unless the predicate marks it retryable
+                if not self._retryable(e):
+                    self._m_exhausted.inc()
+                    raise
+                last = attempt == self.max_attempts - 1
+                if not last:
+                    d = self.delay(attempt)
+                    over = (self.deadline is not None and
+                            self._clock() - t0 + d > self.deadline)
+                    last = over
+                if last:
+                    self._m_exhausted.inc()
+                    raise
+                self._m_attempts.inc()
+                self._sleep(d)
+        raise AssertionError("unreachable")  # loop always returns/raises
+
+    def __call__(self, fn):
+        def wrapped(*args, **kwargs):
+            return self.call(fn, *args, **kwargs)
+
+        wrapped.__name__ = getattr(fn, "__name__", "wrapped")
+        wrapped.__doc__ = fn.__doc__
+        wrapped.__wrapped__ = fn
+        return wrapped
+
+
+class CircuitBreaker:
+    """Classic three-state breaker guarding a flaky dependency.
+
+    CLOSED: calls pass through; ``failure_threshold`` CONSECUTIVE
+    failures trip it OPEN. OPEN: calls raise ``CircuitOpenError``
+    immediately (no load on the dependency) until ``reset_timeout``
+    seconds pass. HALF_OPEN: one probe call is let through — success
+    closes the breaker, failure re-opens it for another timeout.
+
+    Use ``breaker.call(fn, ...)`` or the ``allow()`` /
+    ``record_success()`` / ``record_failure()`` trio when the protected
+    operation isn't a single callable. Thread-safe.
+    """
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(self, failure_threshold=5, reset_timeout=30.0,
+                 name="breaker", clock=time.monotonic):
+        if int(failure_threshold) < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        self.failure_threshold = int(failure_threshold)
+        self.reset_timeout = float(reset_timeout)
+        self.name = name
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._failures = 0
+        self._opened_at = None
+        self._probing = False
+        self._m_trips = _monitor.counter(
+            "resilience_breaker_trips_total",
+            help="breaker transitions into the open state",
+            labels={"site": name})
+        self._m_rejected = _monitor.counter(
+            "resilience_breaker_rejected_total",
+            help="calls short-circuited while the breaker was open",
+            labels={"site": name})
+
+    @property
+    def state(self):
+        with self._lock:
+            self._maybe_half_open()
+            return self._state
+
+    def _maybe_half_open(self):
+        # caller holds the lock
+        if self._state == self.OPEN and \
+                self._clock() - self._opened_at >= self.reset_timeout:
+            self._state = self.HALF_OPEN
+
+    def allow(self):
+        """True if a call may proceed (transitions OPEN -> HALF_OPEN
+        after the reset timeout; the HALF_OPEN probe is single-shot —
+        a second concurrent caller is rejected until it resolves)."""
+        with self._lock:
+            self._maybe_half_open()
+            if self._state == self.CLOSED:
+                return True
+            if self._state == self.HALF_OPEN and not self._probing:
+                self._probing = True
+                return True
+            self._m_rejected.inc()
+            return False
+
+    def record_success(self):
+        with self._lock:
+            self._state = self.CLOSED
+            self._failures = 0
+            self._probing = False
+
+    def record_failure(self):
+        with self._lock:
+            self._failures += 1
+            self._probing = False
+            if self._state == self.HALF_OPEN or \
+                    self._failures >= self.failure_threshold:
+                if self._state != self.OPEN:
+                    self._m_trips.inc()
+                self._state = self.OPEN
+                self._opened_at = self._clock()
+
+    def call(self, fn, *args, **kwargs):
+        if not self.allow():
+            raise CircuitOpenError(
+                "circuit %r is open (%d consecutive failures); retrying "
+                "after %.1fs" % (self.name, self._failures,
+                                 self.reset_timeout))
+        try:
+            result = fn(*args, **kwargs)
+        except BaseException:  # any failure counts against the breaker; always re-raised
+            self.record_failure()
+            raise
+        self.record_success()
+        return result

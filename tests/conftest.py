@@ -28,6 +28,19 @@ import threading  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    """Register the suite's markers here (no pytest.ini — an extra
+    config file would change pytest's rootdir resolution for callers
+    that run a subset of the tree)."""
+    config.addinivalue_line(
+        "markers", "slow: long-running test, excluded from tier-1 "
+                   "(-m 'not slow')")
+    config.addinivalue_line(
+        "markers", "faults: exercises the fluid.faults injection "
+                   "harness (kills subprocesses, arms global fault "
+                   "points)")
+
+
 @pytest.fixture(autouse=True)
 def _no_leaked_nondaemon_threads():
     """Fail any test that leaves NEW non-daemon threads alive — a hung

@@ -1,0 +1,96 @@
+"""Kill-resume runner for the fault-tolerance tests: trains a small MLP
+single-process with periodic crash-consistent checkpoints, optionally
+hard-crashing itself (``faults`` ``worker.exit``) partway through the
+FIRST attempt so the parent test can watch ``distributed.launch``
+respawn it and ``CheckpointManager.restore_on_restart`` resume it.
+
+Determinism contract: the feed of step ``i`` is derived from
+``RandomState(1234 + i)`` and the executor rng is checkpointed, so a
+run resumed from any intact checkpoint must reach final weights
+BIT-IDENTICAL to an uninterrupted run.
+
+Env knobs (all set by tests/test_fault_tolerance.py):
+  PADDLE_CHECKPOINT_DIR   exported by launch(checkpoint_dir=...)
+  PADDLE_RESTART_ATTEMPT  set by the launcher (0 first spawn)
+  PADDLE_TEST_TOTAL       total training steps (default 12)
+  PADDLE_TEST_EVERY       checkpoint every n steps (default 3)
+  PADDLE_TEST_KILL_AT     crash after this many completed steps, first
+                          attempt only (unset = run to completion)
+
+Prints ``RESUMED <step>`` and ``WEIGHTS <sha256>`` lines the parent
+parses from the worker log.
+"""
+
+import hashlib
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu.fluid as fluid  # noqa: E402
+from paddle_tpu.fluid import faults, layers, optimizer  # noqa: E402
+
+TOTAL = int(os.environ.get("PADDLE_TEST_TOTAL", "12"))
+EVERY = int(os.environ.get("PADDLE_TEST_EVERY", "3"))
+KILL_AT = os.environ.get("PADDLE_TEST_KILL_AT")
+ATTEMPT = int(os.environ.get("PADDLE_RESTART_ATTEMPT", "0") or 0)
+
+
+def build(seed=29):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[6], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="float32")
+        h = layers.fc(x, size=8, act="relu")
+        pred = layers.fc(h, size=1)
+        loss = layers.reduce_mean(layers.square(pred - y))
+        optimizer.SGD(0.05).minimize(loss)
+    return main, startup, loss
+
+
+def feed_for(step):
+    rs = np.random.RandomState(1234 + step)
+    return {"x": rs.rand(4, 6).astype(np.float32),
+            "y": rs.rand(4, 1).astype(np.float32)}
+
+
+def weight_digest(program, scope):
+    h = hashlib.sha256()
+    for v in sorted(program.list_vars(), key=lambda v: v.name):
+        if not v.persistable:
+            continue
+        val = scope.find_var(v.name)
+        if val is not None:
+            h.update(v.name.encode())
+            h.update(np.ascontiguousarray(np.asarray(val)).tobytes())
+    return h.hexdigest()
+
+
+def main():
+    if KILL_AT is not None and ATTEMPT == 0:
+        # the crash the gang restart exists for: a hard os._exit after
+        # N completed steps (deterministic, counted at the check below)
+        faults.arm("worker.exit", after_n=int(KILL_AT))
+
+    main_p, startup, loss = build()
+    exe = fluid.Executor()
+    exe.run(startup)
+    mgr = fluid.io.CheckpointManager(max_to_keep=2)
+    resumed = mgr.restore_on_restart(exe, main_p)
+    start = resumed if resumed is not None else 0
+    print("RESUMED %s" % (resumed if resumed is not None else -1),
+          flush=True)
+    for step in range(start, TOTAL):
+        exe.run(main_p, feed=feed_for(step), fetch_list=[loss],
+                checkpoint=(mgr, EVERY))
+        faults.check("worker.exit")
+    mgr.wait()
+    print("WEIGHTS %s" % weight_digest(main_p, fluid.global_scope()),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,492 @@
+"""Fault-tolerant training runtime: crash-consistent checkpoints
+(atomic writes, versioned CheckpointManager, torn-write fallback),
+auto-resume under the gang launcher (kill-resume bit-equivalence),
+anomaly policies (skip_step / rollback), pserver RPC retry, heartbeat
+clean-stop, and the launcher's port-race handling."""
+
+import hashlib
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import paddle_tpu.fluid as fluid  # noqa: E402
+from paddle_tpu.fluid import faults, flags, layers, optimizer  # noqa: E402
+from paddle_tpu.fluid.core import tensor_io  # noqa: E402
+from paddle_tpu.fluid.executor import RNG_STATE_VAR  # noqa: E402
+from paddle_tpu.fluid.io import CheckpointManager  # noqa: E402
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults_and_flags():
+    faults.reset()
+    yield
+    faults.reset()
+    flags.set_flags({"FLAGS_anomaly_policy": "raise",
+                     "FLAGS_anomaly_skip_budget": 3})
+
+
+def _mlp(seed=11):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="float32")
+        h = layers.fc(x, size=6, act="relu")
+        pred = layers.fc(h, size=1)
+        loss = layers.reduce_mean(layers.square(pred - y))
+        optimizer.SGD(0.1).minimize(loss)
+    return main, startup, loss
+
+
+def _feed(step):
+    rs = np.random.RandomState(77 + step)
+    return {"x": rs.rand(3, 4).astype(np.float32),
+            "y": rs.rand(3, 1).astype(np.float32)}
+
+
+def _params(program, scope):
+    out = {}
+    for v in program.list_vars():
+        if v.persistable and scope.find_var(v.name) is not None:
+            out[v.name] = np.asarray(scope.find_var(v.name))
+    return out
+
+
+# -- atomic tensor_io writes ------------------------------------------------
+
+def test_save_combine_atomic_survives_injected_crash(tmp_path):
+    path = str(tmp_path / "w.pdparams")
+    old = {"a": np.arange(6, dtype=np.float32).reshape(2, 3)}
+    tensor_io.save_combine(path, old)
+    # crash between the tmp write and the rename: destination untouched
+    faults.arm("io.write")
+    with pytest.raises(faults.FaultInjected):
+        tensor_io.save_combine(path, {"a": np.zeros((2, 3), np.float32)})
+    got = tensor_io.load_combine(path)
+    np.testing.assert_array_equal(got["a"], old["a"])
+    # and no tmp litter left behind
+    assert [n for n in os.listdir(str(tmp_path)) if ".tmp-" in n] == []
+
+
+def test_save_combine_atomic_replaces_on_success(tmp_path):
+    path = str(tmp_path / "w.pdparams")
+    tensor_io.save_combine(path, {"a": np.zeros(3, np.float32)})
+    new = {"a": np.ones(3, np.float32)}
+    tensor_io.save_combine(path, new)
+    np.testing.assert_array_equal(tensor_io.load_combine(path)["a"],
+                                  new["a"])
+
+
+# -- io.load strict (satellite) ---------------------------------------------
+
+def test_io_load_missing_raises_and_strict_false_tolerates(tmp_path):
+    prog, _, _ = _mlp()
+    missing = str(tmp_path / "nope" / "model")
+    with pytest.raises(FileNotFoundError, match="strict=False"):
+        fluid.io.load(prog, missing)
+    assert fluid.io.load(prog, missing, strict=False) is False
+
+
+# -- CheckpointManager ------------------------------------------------------
+
+def test_checkpoint_roundtrip_restores_exact_state(tmp_path):
+    prog, startup, loss = _mlp()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        for i in range(3):
+            exe.run(prog, feed=_feed(i), fetch_list=[loss])
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(prog, step=3)
+        saved = _params(prog, scope)
+        rng_saved = np.asarray(scope.find_var(RNG_STATE_VAR))
+    fresh = fluid.Scope()
+    with fluid.scope_guard(fresh):
+        exe2 = fluid.Executor()
+        exe2.run(startup)
+        mgr2 = CheckpointManager(str(tmp_path))
+        assert mgr2.restore(exe2, prog) == 3
+        got = _params(prog, fresh)
+        for name, arr in saved.items():
+            np.testing.assert_array_equal(got[name], arr)
+        np.testing.assert_array_equal(
+            np.asarray(fresh.find_var(RNG_STATE_VAR)), rng_saved)
+
+
+def test_checkpoint_rotation_keeps_max_to_keep(tmp_path):
+    prog, startup, _ = _mlp()
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor()
+        exe.run(startup)
+        mgr = CheckpointManager(str(tmp_path), max_to_keep=2)
+        for s in (1, 2, 3, 4):
+            mgr.save(prog, step=s)
+        assert mgr.steps() == [3, 4]
+
+
+def test_torn_checkpoint_detected_and_falls_back(tmp_path):
+    prog, startup, loss = _mlp()
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor()
+        exe.run(startup)
+        mgr = CheckpointManager(str(tmp_path), max_to_keep=3)
+        mgr.save(prog, step=5)
+        exe.run(prog, feed=_feed(0), fetch_list=[loss])
+        mgr.save(prog, step=10)
+        # truncate the newest version's params file: checksum mismatch
+        p = os.path.join(mgr._path(10), "params.pdparams")
+        with open(p, "r+b") as f:
+            f.truncate(os.path.getsize(p) // 2)
+        assert mgr.validate(10) is False
+        assert mgr.validate(5) is True
+        assert mgr.latest() == 5  # silent fallback to the intact version
+        assert mgr.restore(exe, prog) == 5
+
+
+def test_crash_during_version_write_leaves_previous_intact(tmp_path):
+    prog, startup, _ = _mlp()
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor()
+        exe.run(startup)
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(prog, step=1)
+        # crash after the data files, before the manifest+rename commit.
+        # times=3 outlasts the io retry's 3 attempts, so the save fails.
+        faults.arm("io.write", times=3)
+        with pytest.raises(faults.FaultInjected):
+            mgr.save(prog, step=2)
+        faults.reset()
+        assert mgr.latest() == 1  # the committed version is untouched
+        assert mgr.steps() == [1]  # no half-written ckpt-2 dir
+
+
+def test_background_save_lands_after_wait(tmp_path):
+    prog, startup, _ = _mlp()
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor()
+        exe.run(startup)
+        mgr = CheckpointManager(str(tmp_path), background=True)
+        mgr.save(prog, step=7)
+        mgr.wait()
+        assert mgr.latest() == 7
+        assert mgr.validate(7)
+
+
+def test_background_save_failure_surfaces_on_wait(tmp_path):
+    prog, startup, _ = _mlp()
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor()
+        exe.run(startup)
+        mgr = CheckpointManager(str(tmp_path), background=True)
+        faults.arm("io.write", times=3)
+        mgr.save(prog, step=1)
+        with pytest.raises(faults.FaultInjected):
+            mgr.wait()
+
+
+def test_restore_on_restart_env_contract(tmp_path, monkeypatch):
+    prog, startup, _ = _mlp()
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor()
+        exe.run(startup)
+        mgr = CheckpointManager(str(tmp_path))
+        monkeypatch.setenv("PADDLE_RESTART_ATTEMPT", "1")
+        # restarted but nothing saved yet: fresh start, not an error
+        assert mgr.restore_on_restart(exe, prog) is None
+        mgr.save(prog, step=4)
+        assert mgr.restore_on_restart(exe, prog) == 4
+        monkeypatch.setenv("PADDLE_RESTART_ATTEMPT", "0")
+        assert mgr.restore_on_restart(exe, prog) is None  # first spawn
+
+
+def test_checkpoint_dir_from_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_CHECKPOINT_DIR", str(tmp_path / "cp"))
+    mgr = CheckpointManager()
+    assert mgr.dirname == str(tmp_path / "cp")
+    monkeypatch.delenv("PADDLE_CHECKPOINT_DIR")
+    with pytest.raises(ValueError, match="PADDLE_CHECKPOINT_DIR"):
+        CheckpointManager()
+
+
+def test_executor_checkpoint_every_n_steps(tmp_path):
+    prog, startup, loss = _mlp()
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor()
+        exe.run(startup)
+        mgr = CheckpointManager(str(tmp_path), max_to_keep=10)
+        for i in range(7):
+            exe.run(prog, feed=_feed(i), fetch_list=[loss],
+                    checkpoint=(mgr, 3))
+        mgr.wait()
+        assert mgr.steps() == [3, 6]
+        # iters=k advances the counter by k and saves on the crossing
+        feed = {"x": np.stack([_feed(7)["x"], _feed(8)["x"]]),
+                "y": np.stack([_feed(7)["y"], _feed(8)["y"]])}
+        exe.run(prog, feed=feed, fetch_list=[loss], iters=2,
+                checkpoint=(mgr, 3))
+        mgr.wait()
+        assert mgr.steps() == [3, 6, 9]
+
+
+def test_executor_checkpoint_arg_validated():
+    exe = fluid.Executor()
+    with pytest.raises(ValueError, match="checkpoint"):
+        exe.run(fluid.Program(), checkpoint=("not a manager",))
+    with pytest.raises(ValueError, match="checkpoint"):
+        exe.run(fluid.Program(), checkpoint=(object(), 0))
+
+
+# -- py_reader position (checkpointed epoch cursor) -------------------------
+
+def test_py_reader_position_and_resume():
+    from paddle_tpu.fluid.layers.py_reader import _PyReader
+
+    r = _PyReader(["s0"], [(2, 2)], ["float32"])
+    batches = [np.full((2, 2), i, np.float32) for i in range(6)]
+    r.decorate_tensor_provider(lambda: iter([(b,) for b in batches]))
+    r.start()
+    r._next(); r._next(); r._next()
+    assert r.position == 3
+    r.reset()
+    r.resume_at(3)
+    r.start()  # fast-forwards past the 3 consumed batches
+    (nxt,) = r._next()
+    np.testing.assert_array_equal(nxt, batches[3])
+    assert r.position == 4
+    r.reset()
+
+
+# -- anomaly policies -------------------------------------------------------
+
+def test_anomaly_skip_step_discards_and_budget_raises(tmp_path):
+    prog, startup, loss = _mlp()
+    with fluid.scope_guard(fluid.Scope()) as _:
+        exe = fluid.Executor()
+        exe.run(startup)
+        flags.set_flags({"FLAGS_anomaly_policy": "skip_step",
+                         "FLAGS_anomaly_skip_budget": 2})
+        before = _params(prog, fluid.global_scope())
+        faults.arm("step.nonfinite", after_n=0, times=1)
+        exe.run(prog, feed=_feed(0), fetch_list=[loss])
+        after = _params(prog, fluid.global_scope())
+        for name in before:  # discarded: nothing committed
+            np.testing.assert_array_equal(after[name], before[name])
+        # a clean step commits and resets the consecutive counter
+        exe.run(prog, feed=_feed(1), fetch_list=[loss])
+        changed = any(not np.array_equal(
+            _params(prog, fluid.global_scope())[n], before[n])
+            for n in before)
+        assert changed
+        # budget: 2 consecutive skips tolerated, the third raises
+        faults.arm("step.nonfinite", after_n=0, times=5)
+        exe.run(prog, feed=_feed(2), fetch_list=[loss])
+        exe.run(prog, feed=_feed(3), fetch_list=[loss])
+        with pytest.raises(FloatingPointError, match="skip_budget"):
+            exe.run(prog, feed=_feed(4), fetch_list=[loss])
+
+
+def test_anomaly_rollback_restores_checkpoint(tmp_path):
+    prog, startup, loss = _mlp()
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor()
+        exe.run(startup)
+        mgr = CheckpointManager(str(tmp_path))
+        for i in range(3):
+            exe.run(prog, feed=_feed(i), fetch_list=[loss],
+                    checkpoint=(mgr, 3))
+        mgr.wait()
+        at_ckpt = _params(prog, fluid.global_scope())
+        exe.run(prog, feed=_feed(3), fetch_list=[loss],
+                checkpoint=(mgr, 3))
+        drifted = _params(prog, fluid.global_scope())
+        assert any(not np.array_equal(at_ckpt[n], drifted[n])
+                   for n in at_ckpt)
+        flags.set_flags({"FLAGS_anomaly_policy": "rollback"})
+        faults.arm("step.nonfinite", after_n=0, times=1)
+        exe.run(prog, feed=_feed(4), fetch_list=[loss],
+                checkpoint=(mgr, 3))
+        rolled = _params(prog, fluid.global_scope())
+        for name in at_ckpt:  # back to the step-3 checkpoint exactly
+            np.testing.assert_array_equal(rolled[name], at_ckpt[name])
+
+
+def test_anomaly_rollback_without_checkpoint_is_an_error():
+    prog, startup, loss = _mlp()
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor()
+        exe.run(startup)
+        flags.set_flags({"FLAGS_anomaly_policy": "rollback"})
+        faults.arm("step.nonfinite", after_n=0, times=1)
+        with pytest.raises(RuntimeError, match="rollback"):
+            exe.run(prog, feed=_feed(0), fetch_list=[loss])
+
+
+def test_real_nonfinite_feed_still_raises_by_default():
+    prog, startup, loss = _mlp()
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor()
+        exe.run(startup)
+        flags.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            bad = _feed(0)
+            bad["x"] = np.full_like(bad["x"], np.nan)
+            with pytest.raises(FloatingPointError, match="check_nan_inf"):
+                exe.run(prog, feed=bad, fetch_list=[loss])
+        finally:
+            flags.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_bad_anomaly_policy_rejected():
+    flags.set_flags({"FLAGS_anomaly_policy": "explode"})
+    with pytest.raises(ValueError, match="anomaly_policy"):
+        flags.anomaly_policy()
+
+
+# -- pserver RPC retry ------------------------------------------------------
+
+def test_ps_rpc_retry_absorbs_injected_fault():
+    from paddle_tpu.distributed import ps
+    from paddle_tpu.distributed.ps_server import RemoteTable, TableServer
+
+    srv = TableServer(tables={"t": ps.EmbeddingTable(
+        vocab=8, dim=2, init_scale=0.0)}).start()
+    try:
+        rt = RemoteTable(srv.endpoint, "t")
+        # next two RPC round-trips blip; the shared Retry absorbs them
+        faults.arm("ps.rpc", after_n=0, times=2)
+        rows = rt.pull(np.array([1, 2], np.int64))
+        assert rows.shape == (2, 2)
+        assert faults.hits("ps.rpc") >= 3
+        rt.close()
+    finally:
+        srv.stop()
+
+
+def test_ps_rpc_retry_exhaustion_surfaces():
+    from paddle_tpu.distributed import ps
+    from paddle_tpu.distributed.ps_server import RemoteTable, TableServer
+
+    srv = TableServer(tables={"t": ps.EmbeddingTable(
+        vocab=8, dim=2, init_scale=0.0)}).start()
+    try:
+        rt = RemoteTable(srv.endpoint, "t")
+        faults.arm("ps.rpc", after_n=0, times=99)  # outlasts the budget
+        with pytest.raises(faults.FaultInjected):
+            rt.pull(np.array([1], np.int64))
+        faults.reset()
+        rows = rt.pull(np.array([1], np.int64))  # recovers afterwards
+        assert rows.shape == (1, 2)
+        rt.close()
+    finally:
+        srv.stop()
+
+
+# -- heartbeat clean stop (satellite) ---------------------------------------
+
+def test_heartbeat_stop_is_clean_and_idempotent(tmp_path):
+    from paddle_tpu.distributed.heartbeat import Heartbeat, Watchdog
+
+    hb = Heartbeat(rank=0, dirname=str(tmp_path), interval=0.1).start()
+    time.sleep(0.05)
+    assert os.path.exists(hb.path)
+    hb.stop()
+    hb.stop()  # idempotent
+    assert not os.path.exists(hb.path)          # stamp removed
+    assert os.path.exists(hb.path + ".exit")    # clean-exit marker
+    # the watchdog no longer needs skip= for cleanly-stopped ranks
+    wd = Watchdog(str(tmp_path), nproc=1, timeout=0.01,
+                  startup_grace=0.01)
+    time.sleep(0.05)
+    assert wd.stale_workers() == []
+
+
+def test_watchdog_still_flags_hung_worker(tmp_path):
+    from paddle_tpu.distributed.heartbeat import Heartbeat, Watchdog
+
+    hb = Heartbeat(rank=0, dirname=str(tmp_path), interval=30).start()
+    try:
+        wd = Watchdog(str(tmp_path), nproc=1, timeout=0.05)
+        time.sleep(0.15)  # stamp goes stale, no exit marker
+        assert wd.stale_workers() == [0]
+    finally:
+        hb.stop()
+
+
+# -- launcher port handling (satellite) -------------------------------------
+
+def test_reserve_port_range_is_fully_bindable():
+    import socket
+
+    from paddle_tpu.distributed.launch import _reserve_port_range
+
+    base = _reserve_port_range(4)
+    for i in range(4):
+        s = socket.socket()
+        s.bind(("127.0.0.1", base + i))
+        s.close()
+
+
+def test_bind_failure_detected_in_worker_logs(tmp_path):
+    from paddle_tpu.distributed.launch import _bind_failure
+
+    log_dir = str(tmp_path)
+    with open(os.path.join(log_dir, "worker.0.log"), "w") as f:
+        f.write("Traceback ...\nOSError: [Errno 98] "
+                "Address already in use\n")
+    assert _bind_failure(log_dir, 1) is True
+    with open(os.path.join(log_dir, "worker.0.log"), "w") as f:
+        f.write("clean run\n")
+    assert _bind_failure(log_dir, 1) is False
+    assert _bind_failure(None, 1) is False
+
+
+# -- kill-resume equivalence (the acceptance test) --------------------------
+
+def _run_gang(tmp_path, tag, extra_env, max_restarts):
+    from paddle_tpu.distributed.launch import launch
+
+    log_dir = str(tmp_path / ("logs_" + tag))
+    env = dict(os.environ)
+    env.pop("PADDLE_FAULTS", None)
+    env.update(extra_env)
+    codes = launch(
+        1, [sys.executable, "-u", os.path.join(HERE, "dist_runner_ckpt.py")],
+        env=env, log_dir=log_dir, max_restarts=max_restarts,
+        restart_backoff=0.05,
+        checkpoint_dir=str(tmp_path / ("ckpt_" + tag)))
+    with open(os.path.join(log_dir, "worker.0.log")) as f:
+        log = f.read()
+    return codes, log
+
+
+@pytest.mark.faults
+def test_kill_resume_matches_uninterrupted_run(tmp_path):
+    """A worker hard-killed mid-run (os._exit via the worker.exit fault)
+    is respawned by launch(max_restarts=1), auto-resumes from the last
+    intact checkpoint, and finishes with weights BIT-IDENTICAL to a
+    run that was never interrupted."""
+    codes, log = _run_gang(tmp_path, "base", {}, max_restarts=0)
+    assert codes == [0], log
+    base_weights = re.findall(r"WEIGHTS (\w+)", log)[-1]
+
+    codes, log = _run_gang(
+        tmp_path, "kill", {"PADDLE_TEST_KILL_AT": "7"}, max_restarts=1)
+    assert codes == [0], log
+    # two attempts wrote the (append-mode) log: fresh start then resume
+    resumed = [int(m) for m in re.findall(r"RESUMED (-?\d+)", log)]
+    assert len(resumed) == 2, log
+    assert resumed[0] == -1        # attempt 0: fresh start
+    assert resumed[-1] == 6        # attempt 1: resumed at the last ckpt
+    kill_weights = re.findall(r"WEIGHTS (\w+)", log)[-1]
+    assert kill_weights == base_weights  # bit-identical final state

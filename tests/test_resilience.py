@@ -1,0 +1,432 @@
+"""Shared resilience primitives (fluid/resilience.py), the fault
+harness (fluid/faults.py), the catch-all lint (tools/check_resilience),
+and the background-thread exception-surfacing contracts of the four
+``except BaseException`` sites (reader stager, mp worker, async pusher,
+window prefetch)."""
+
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.fluid import faults, monitor, resilience  # noqa: E402
+from paddle_tpu.fluid.resilience import (  # noqa: E402
+    CircuitBreaker, CircuitOpenError, Retry, TransientError, backoff_delay)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# -- backoff_delay ----------------------------------------------------------
+
+def test_backoff_grows_exponentially_and_caps():
+    ds = [backoff_delay(a, base=0.1, factor=2.0, max_delay=1.0, jitter=0)
+          for a in range(6)]
+    assert ds[:4] == [pytest.approx(0.1), pytest.approx(0.2),
+                      pytest.approx(0.4), pytest.approx(0.8)]
+    assert ds[4] == ds[5] == pytest.approx(1.0)  # capped
+
+
+def test_backoff_jitter_bounded():
+    d = backoff_delay(0, base=1.0, jitter=0.5, rand=lambda: 1.0)
+    assert d == pytest.approx(1.5)
+    d = backoff_delay(0, base=1.0, jitter=0.5, rand=lambda: 0.0)
+    assert d == pytest.approx(1.0)
+
+
+# -- Retry ------------------------------------------------------------------
+
+def _no_sleep_retry(**kw):
+    kw.setdefault("jitter", 0)
+    return Retry(sleep=lambda s: None, **kw)
+
+
+def test_retry_succeeds_after_transient_failures():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise TransientError("blip")
+        return "ok"
+
+    assert _no_sleep_retry(max_attempts=5).call(flaky) == "ok"
+    assert len(calls) == 3
+
+
+def test_retry_exhaustion_reraises_last_exception():
+    r = _no_sleep_retry(max_attempts=3)
+    calls = []
+
+    def always(n=[0]):
+        calls.append(1)
+        raise TransientError("attempt %d" % len(calls))
+
+    with pytest.raises(TransientError, match="attempt 3"):
+        r.call(always)
+    assert len(calls) == 3
+
+
+def test_retry_nonretryable_surfaces_immediately():
+    calls = []
+
+    def bad():
+        calls.append(1)
+        raise ValueError("programming error")
+
+    with pytest.raises(ValueError):
+        _no_sleep_retry(max_attempts=5).call(bad)
+    assert len(calls) == 1
+
+
+def test_retry_deadline_stops_early():
+    clock = [0.0]
+    sleeps = []
+
+    def fake_sleep(s):
+        sleeps.append(s)
+        clock[0] += s
+
+    r = Retry(max_attempts=100, base_delay=1.0, factor=1.0, jitter=0,
+              deadline=2.5, sleep=fake_sleep, clock=lambda: clock[0])
+    calls = []
+
+    def always():
+        calls.append(1)
+        raise TransientError
+
+    with pytest.raises(TransientError):
+        r.call(always)
+    # attempt 1 (t=0), sleep 1, attempt 2 (t=1), sleep 1, attempt 3
+    # (t=2): next sleep would land past the 2.5s deadline -> give up
+    assert len(calls) == 3
+
+
+def test_retry_custom_predicate_and_decorator():
+    pred = lambda e: isinstance(e, KeyError)  # noqa: E731
+    calls = []
+
+    @Retry(max_attempts=2, jitter=0, retryable=pred,
+           sleep=lambda s: None, name="test.pred")
+    def fn():
+        calls.append(1)
+        raise KeyError("x")
+
+    with pytest.raises(KeyError):
+        fn()
+    assert len(calls) == 2
+
+
+def test_retry_counts_in_monitor():
+    before_a = monitor.counter(
+        "resilience_retry_attempts_total",
+        labels={"site": "test.count"}).value
+    before_e = monitor.counter(
+        "resilience_retry_exhausted_total",
+        labels={"site": "test.count"}).value
+    r = _no_sleep_retry(max_attempts=3, name="test.count")
+    with pytest.raises(TransientError):
+        r.call(lambda: (_ for _ in ()).throw(TransientError()))
+    a = monitor.counter("resilience_retry_attempts_total",
+                        labels={"site": "test.count"}).value
+    e = monitor.counter("resilience_retry_exhausted_total",
+                        labels={"site": "test.count"}).value
+    assert a - before_a == 2  # two retried failures, the third exhausts
+    assert e - before_e == 1
+
+
+def test_retry_validates_args():
+    with pytest.raises(ValueError):
+        Retry(max_attempts=0)
+    with pytest.raises(TypeError):
+        Retry(retryable=42)
+
+
+# -- CircuitBreaker ---------------------------------------------------------
+
+def test_breaker_trips_after_consecutive_failures():
+    clock = [0.0]
+    b = CircuitBreaker(failure_threshold=3, reset_timeout=10.0,
+                       name="test.trip", clock=lambda: clock[0])
+
+    def boom():
+        raise TransientError
+
+    for _ in range(3):
+        with pytest.raises(TransientError):
+            b.call(boom)
+    assert b.state == CircuitBreaker.OPEN
+    with pytest.raises(CircuitOpenError):
+        b.call(lambda: "never runs")
+    # success resets the consecutive count while closed
+    clock[0] += 11.0  # half-open: one probe allowed
+    assert b.state == CircuitBreaker.HALF_OPEN
+    assert b.call(lambda: "probe ok") == "probe ok"
+    assert b.state == CircuitBreaker.CLOSED
+
+
+def test_breaker_halfopen_probe_failure_reopens():
+    clock = [0.0]
+    b = CircuitBreaker(failure_threshold=1, reset_timeout=5.0,
+                       name="test.reopen", clock=lambda: clock[0])
+    with pytest.raises(TransientError):
+        b.call(lambda: (_ for _ in ()).throw(TransientError()))
+    assert b.state == CircuitBreaker.OPEN
+    clock[0] += 6.0
+    with pytest.raises(TransientError):
+        b.call(lambda: (_ for _ in ()).throw(TransientError()))
+    assert b.state == CircuitBreaker.OPEN  # probe failed -> re-open
+
+
+def test_breaker_halfopen_single_probe():
+    clock = [0.0]
+    b = CircuitBreaker(failure_threshold=1, reset_timeout=5.0,
+                       name="test.probe", clock=lambda: clock[0])
+    b.record_failure()
+    clock[0] += 6.0
+    assert b.allow() is True    # the probe
+    assert b.allow() is False   # concurrent second caller rejected
+    b.record_success()
+    assert b.allow() is True    # closed again
+
+
+# -- faults harness ---------------------------------------------------------
+
+def test_faults_arm_check_fire_window():
+    faults.arm("io.write", after_n=2, times=1)
+    faults.check("io.write")        # hit 1: passes
+    faults.check("io.write")        # hit 2: passes
+    with pytest.raises(faults.FaultInjected):
+        faults.check("io.write")    # hit 3: fires
+    faults.check("io.write")        # hit 4: window over, passes again
+    assert faults.hits("io.write") == 4
+
+
+def test_faults_unknown_point_rejected():
+    with pytest.raises(ValueError, match="unknown fault point"):
+        faults.arm("no.such.point")
+
+
+def test_faults_custom_exception_class():
+    faults.arm("reader.stage", exc=RuntimeError)
+    with pytest.raises(RuntimeError):
+        faults.check("reader.stage")
+
+
+def test_faults_take_returns_bool():
+    faults.arm("step.nonfinite", after_n=0, times=1)
+    assert faults.take("step.nonfinite") is True
+    assert faults.take("step.nonfinite") is False
+
+
+def test_faults_env_parsing():
+    assert faults._parse_env("io.write:3,ps.rpc:0:2") == [
+        ("io.write", 3, 1), ("ps.rpc", 0, 2)]
+    with pytest.raises(ValueError):
+        faults._parse_env("io.write")
+    faults.arm_from_env({"PADDLE_FAULTS": "worker.exit:5"})
+    assert faults.is_armed("worker.exit")
+    faults.reset()
+
+
+def test_faults_injected_is_transient():
+    # the default injected class MUST be retryable by default-config
+    # Retry layers, or the absorb tests test nothing
+    assert issubclass(faults.FaultInjected, TransientError)
+
+
+# -- the catch-all lint -----------------------------------------------------
+
+def _repo_root():
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_repo_has_no_unjustified_catchalls():
+    sys.path.insert(0, os.path.join(_repo_root(), "tools"))
+    try:
+        import check_resilience
+    finally:
+        sys.path.pop(0)
+    violations = check_resilience.check_tree(_repo_root())
+    assert violations == [], (
+        "unjustified bare-except/BaseException sites:\n%s"
+        % "\n".join("%s:%d: %s" % v for v in violations))
+
+
+def test_lint_catches_violations(tmp_path):
+    sys.path.insert(0, os.path.join(_repo_root(), "tools"))
+    try:
+        import check_resilience
+    finally:
+        sys.path.pop(0)
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "try:\n    pass\nexcept:\n    pass\n"
+        "try:\n    pass\nexcept BaseException as e:\n    raise\n")
+    assert len(check_resilience.check_file(str(bad))) == 2
+    ok = tmp_path / "ok.py"
+    ok.write_text(
+        "try:\n    pass\n"
+        "except BaseException:  # re-raised on the consumer thread\n"
+        "    raise\n"
+        "try:\n    pass\nexcept ValueError:\n    pass\n")
+    assert check_resilience.check_file(str(ok)) == []
+    # a '#' inside a string is not a justification
+    sneaky = tmp_path / "sneaky.py"
+    sneaky.write_text(
+        "try:\n    pass\nexcept BaseException:\n    x = '# not a comment'\n")
+    assert len(check_resilience.check_file(str(sneaky))) == 1
+
+
+# -- background-exception surfacing contracts -------------------------------
+# The runtime's four long-lived catch-all sites must deliver the
+# ORIGINAL exception to the consumer, not swallow it.
+
+def test_device_stager_surfaces_transform_error():
+    from paddle_tpu.fluid.reader import DeviceStager
+
+    def transform(item):
+        raise ValueError("original message %d" % item)
+
+    stager = DeviceStager(iter([7]), transform=transform, name="t")
+    try:
+        with pytest.raises(ValueError, match="original message 7"):
+            for _ in stager:
+                pass
+    finally:
+        stager.close()
+
+
+def test_device_stager_surfaces_source_error():
+    from paddle_tpu.fluid.reader import DeviceStager
+
+    def gen():
+        yield 1
+        raise KeyError("source died")
+
+    stager = DeviceStager(gen(), name="t")
+    got = []
+    try:
+        with pytest.raises(KeyError, match="source died"):
+            for item in stager:
+                got.append(item)
+    finally:
+        stager.close()
+    assert got == [1]
+
+
+def test_mp_worker_surfaces_error_with_traceback():
+    from paddle_tpu.fluid import reader as fr
+
+    loader = fr.GeneratorLoader(["x"], use_multiprocess=True,
+                                num_workers=1)
+
+    def gen():
+        yield [np.zeros((2, 3), np.float32)]
+        raise RuntimeError("worker exploded here")
+
+    loader.set_batch_generator(gen)
+    with pytest.raises(RuntimeError) as ei:
+        for _ in loader:
+            pass
+    # the original traceback text must ride along for debuggability
+    assert "worker exploded here" in str(ei.value)
+    assert "Traceback" in str(ei.value)
+
+
+def test_async_pusher_surfaces_push_error_on_flush():
+    from paddle_tpu.distributed.ps import AsyncPusher, EmbeddingTable
+
+    table = EmbeddingTable(vocab=8, dim=2)
+    pusher = AsyncPusher(table)
+    try:
+        pusher.push(np.array([999], np.int64),  # out of range
+                    np.ones((1, 2), np.float32))
+        with pytest.raises(Exception) as ei:
+            pusher.flush()
+            # the deferred error re-raises from flush() or the next push
+            pusher.push(np.array([0], np.int64),
+                        np.ones((1, 2), np.float32))
+            pusher.flush()
+        assert ei.value is not None
+    finally:
+        pusher.stop()
+
+
+def test_window_prefetch_surfaces_reader_error():
+    from paddle_tpu.fluid.executor import _WindowPrefetch
+
+    class FakeReader:
+        names = ["slot0"]
+
+        def _next(self):
+            raise OSError("reader pipe broke")
+
+    pf = _WindowPrefetch([FakeReader()], iters=3)
+    status = pf.consume()
+    assert status[0] == "error"
+    assert isinstance(status[1], OSError)
+    assert "reader pipe broke" in str(status[1])
+
+
+# -- retry wiring at the call sites -----------------------------------------
+
+def test_stager_absorbs_transient_stage_fault():
+    from paddle_tpu.fluid.reader import DeviceStager, stage_feed
+
+    faults.arm("reader.stage", after_n=0, times=1)  # first batch blips
+    stager = DeviceStager(
+        iter([{"x": np.ones((2, 2), np.float32)} for _ in range(3)]),
+        transform=lambda feed: stage_feed(feed), name="t")
+    got = list(stager)
+    stager.close()
+    assert len(got) == 3  # the injected fault was retried, not fatal
+    assert faults.hits("reader.stage") >= 2
+
+
+def test_stager_nontransient_stage_error_surfaces():
+    from paddle_tpu.fluid.reader import DeviceStager, stage_feed
+
+    faults.arm("reader.stage", exc=TypeError)  # not retryable
+    stager = DeviceStager(
+        iter([{"x": np.ones((2, 2), np.float32)}]),
+        transform=lambda feed: stage_feed(feed), name="t")
+    try:
+        with pytest.raises(TypeError):
+            list(stager)
+    finally:
+        stager.close()
+
+
+def test_async_pusher_retries_transient_push():
+    from paddle_tpu.distributed.ps import AsyncPusher, EmbeddingTable
+
+    table = EmbeddingTable(vocab=8, dim=2)
+    fails = [2]
+    orig_push = table.push
+
+    def flaky_push(*a, **kw):
+        if fails[0] > 0:
+            fails[0] -= 1
+            raise ConnectionError("push blip")
+        return orig_push(*a, **kw)
+
+    table.push = flaky_push
+    pusher = AsyncPusher(table)
+    try:
+        pusher.push(np.array([1], np.int64),
+                    np.full((1, 2), 2.0, np.float32))
+        pusher.flush()  # transient failures absorbed by the retry
+    finally:
+        pusher.stop()
+    assert fails[0] == 0
+    row = table.pull(np.array([1], np.int64))
+    assert np.any(row != 0)  # the push landed despite the blips
