@@ -35,6 +35,30 @@ def parallel_env():
     return rank, world, eps
 
 
+def trainer_env(rank, endpoints, attempt=0, base_env=None):
+    """The PADDLE_* env block for one trainer process — the single
+    derivation point, shared by ``distributed.launch``'s initial spawn
+    and every elastic reformation (a shrunk gang re-derives
+    ``PADDLE_TRAINERS_NUM``/rank/endpoints here, so the two can never
+    disagree). ``endpoints`` is the FULL gang endpoint list; world size
+    is its length. Returns a fresh dict layered over ``base_env``."""
+    endpoints = list(endpoints)
+    rank = int(rank)
+    if not 0 <= rank < len(endpoints):
+        raise ValueError("rank %d outside the %d-endpoint gang"
+                         % (rank, len(endpoints)))
+    env = dict(base_env) if base_env is not None else {}
+    env.update({
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(len(endpoints)),
+        "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+        "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+        "TRAINING_ROLE": "TRAINER",
+        "PADDLE_RESTART_ATTEMPT": str(int(attempt)),
+    })
+    return env
+
+
 def init_parallel_env(ndev_per_proc=None):
     """Join the job's coordination service (idempotent). Returns
     (rank, world_size). Single-process jobs return immediately."""

@@ -29,6 +29,10 @@ _M_STEP = _monitor.gauge(
 _M_STALE = _monitor.counter(
     "watchdog_stale_detections_total",
     help="workers the watchdog flagged stale (per poll that found any)")
+_M_HUNG = _monitor.counter(
+    "watchdog_hung_steps_total",
+    help="workers flagged by the step-deadline watchdog: heartbeat "
+         "fresh but the step counter frozen past step_deadline")
 _M_STOP_WEDGED = _monitor.counter(
     "heartbeat_stop_wedged_total",
     help="Heartbeat.stop calls whose stamper thread failed to join "
@@ -114,26 +118,48 @@ class Heartbeat:
                 return  # a wedged stamper may still write; keep the stamp
         if self._dir is not None:
             try:
-                with open(self.path + ".exit", "w") as f:
+                # marker FIRST (atomically), stamp removal second: a
+                # worker killed between the two still reads as cleanly
+                # exited — stale_workers checks the marker before mtime
+                tmp = self.path + ".exit.tmp"
+                with open(tmp, "w") as f:
                     f.write("clean")
+                os.replace(tmp, self.path + ".exit")
                 os.remove(self.path)
             except OSError:
                 pass  # launcher already tore the dir down
 
 
 class Watchdog:
-    """Launcher-side staleness detector over the heartbeat files.
+    """Launcher-side liveness detector over the heartbeat files. Two
+    independent checks:
+
+    - ``stale_workers()`` — the stamp's mtime is older than ``timeout``:
+      the process (or its stamper thread) is dead. ``timeout=None``
+      disables this check.
+    - ``hung_workers()`` — the stamp is FRESH but its ``step`` counter
+      has not advanced within ``step_deadline`` seconds: the process is
+      alive yet making no progress (deadlocked collective, wedged I/O,
+      infinite loop). A crashed worker looks stale; a hung one only
+      this check catches. ``step_deadline=None`` (default) disables it.
 
     ``startup_grace`` (default 3x timeout) covers slow worker startup —
     heavy imports / device init before the script reaches
     ``Heartbeat().start()`` must not read as a hang."""
 
-    def __init__(self, dirname, nproc, timeout=30.0, startup_grace=None):
+    def __init__(self, dirname, nproc, timeout=30.0, startup_grace=None,
+                 step_deadline=None):
         self._dir = dirname
         self._nproc = int(nproc)
-        self._timeout = float(timeout)
+        self._timeout = None if timeout is None else float(timeout)
+        base = timeout if timeout is not None else (step_deadline or 30.0)
         self._grace = float(startup_grace if startup_grace is not None
-                            else 3 * timeout)
+                            else 3 * base)
+        self._step_deadline = (None if step_deadline is None
+                               else float(step_deadline))
+        # rank -> (last observed step, time it last changed): the
+        # hung-step detector's progress memory
+        self._progress = {}
         self._started = time.time()
 
     def read(self, rank):
@@ -150,18 +176,30 @@ class Watchdog:
         except OSError:
             return None
 
+    def _exited_on_purpose(self, rank):
+        """True when the rank left a clean-stop or drained-preempt
+        marker. The marker is written BEFORE the stamp is removed, so
+        a worker that dies between the two (the .exit-then-crash race)
+        still reads as cleanly exited, never as stale/hung."""
+        return (os.path.exists(os.path.join(self._dir,
+                                            "hb.%d.exit" % rank))
+                or os.path.exists(os.path.join(
+                    self._dir, "hb.%d.preempted" % rank)))
+
     def stale_workers(self, skip=()):
         """Ranks whose heartbeat is older than ``timeout``; ranks in
         ``skip`` (e.g. already exited cleanly) are ignored. A rank that
-        never stamped is only stale once ``startup_grace`` has passed."""
+        never stamped is only stale once ``startup_grace`` has passed.
+        Empty when ``timeout`` is None (staleness check disabled)."""
+        if self._timeout is None:
+            return []
         now = time.time()
         out = []
         for r in range(self._nproc):
             if r in skip:
                 continue
-            if os.path.exists(os.path.join(self._dir,
-                                           "hb.%d.exit" % r)):
-                continue  # stopped on purpose (Heartbeat.stop marker)
+            if self._exited_on_purpose(r):
+                continue
             last = self._last_stamp(r)
             if last is None:
                 if now - self._started > self._grace:
@@ -170,4 +208,38 @@ class Watchdog:
                 out.append(r)
         if out:
             _M_STALE.inc(len(out))
+        return out
+
+    def hung_workers(self, skip=()):
+        """Ranks whose heartbeat is fresh but whose ``step`` counter has
+        not advanced within ``step_deadline`` seconds — the hung-step
+        deadline watchdog. The first observation of a rank's step only
+        starts its clock; a rank is flagged once the SAME step value has
+        been seen for longer than the deadline while stamps kept
+        arriving (a worker whose stamps also stopped belongs to
+        ``stale_workers``, not here)."""
+        if self._step_deadline is None:
+            return []
+        now = time.time()
+        out = []
+        for r in range(self._nproc):
+            if r in skip or self._exited_on_purpose(r):
+                self._progress.pop(r, None)
+                continue
+            data = self.read(r)
+            if data is None or "step" not in data:
+                continue
+            step = data["step"]
+            seen = self._progress.get(r)
+            if seen is None or seen[0] != step:
+                self._progress[r] = (step, now)
+                continue
+            last = self._last_stamp(r)
+            if last is None or (self._timeout is not None
+                                and now - last > self._timeout):
+                continue  # stale, not hung — the other check's business
+            if now - seen[1] > self._step_deadline:
+                out.append(r)
+        if out:
+            _M_HUNG.inc(len(out))
         return out

@@ -22,6 +22,8 @@ import time
 
 from ..fluid import monitor as _monitor
 from ..fluid import resilience as _resilience
+from . import preemption as _preemption
+from . import rendezvous as _rendezvous
 
 __all__ = ["launch", "main"]
 
@@ -42,6 +44,17 @@ _M_PORT_RETRIES = _monitor.counter(
 _M_RESTART_BACKOFF = _monitor.histogram(
     "launch_restart_backoff_seconds",
     help="sleep before each gang restart (exponential backoff)")
+_M_PREEMPTIONS = _monitor.counter(
+    "launch_preemptions_total",
+    help="workers that exited via a clean preempt drain (.preempted "
+         "marker) — respawned without burning restart budget")
+_M_REFORMATIONS = _monitor.counter(
+    "launch_reformations_total",
+    help="gang size changes: shrink-to-survivors after exhausting "
+         "same-size restarts, or scale-up when a slot returned")
+
+ENV_MIN_WORLD = "PADDLE_MIN_WORLD_SIZE"
+ENV_STEP_DEADLINE = "PADDLE_STEP_DEADLINE"
 
 
 def _free_port():
@@ -99,18 +112,14 @@ def _bind_failure(log_dir, nproc):
 
 def _spawn_gang(nproc, cmd, node_ip, base, env, backend, log_dir,
                 heartbeat_dir, attempt):
-    endpoints = ",".join("%s:%d" % (node_ip, base + i) for i in range(nproc))
+    from .env import trainer_env
+
+    endpoints = ["%s:%d" % (node_ip, base + i) for i in range(nproc)]
     procs, logs = [], []
     for rank in range(nproc):
-        child_env = dict(os.environ if env is None else env)
-        child_env.update({
-            "PADDLE_TRAINER_ID": str(rank),
-            "PADDLE_TRAINERS_NUM": str(nproc),
-            "PADDLE_TRAINER_ENDPOINTS": endpoints,
-            "PADDLE_CURRENT_ENDPOINT": "%s:%d" % (node_ip, base + rank),
-            "TRAINING_ROLE": "TRAINER",
-            "PADDLE_RESTART_ATTEMPT": str(attempt),
-        })
+        child_env = trainer_env(
+            rank, endpoints, attempt=attempt,
+            base_env=os.environ if env is None else env)
         if heartbeat_dir:
             child_env["PADDLE_HEARTBEAT_DIR"] = heartbeat_dir
         if backend:
@@ -144,102 +153,280 @@ def _kill_gang(procs):
 
 def launch(nproc, cmd, node_ip="127.0.0.1", started_port=None, env=None,
            backend=None, log_dir=None, max_restarts=0,
-           heartbeat_timeout=None, restart_backoff=0.5, port_retries=3,
-           checkpoint_dir=None):
+           heartbeat_timeout=None, step_deadline=None,
+           restart_backoff=0.5, backoff_reset_after=60.0,
+           port_retries=3, checkpoint_dir=None,
+           max_restarts_at_size=None, min_world_size=None,
+           rendezvous_dir=None, max_preempt_restarts=8,
+           preempt_drain=True):
     """Spawn ``nproc`` copies of ``cmd`` (argv list) with the trainer env;
     returns the list of exit codes of the final attempt.
 
-    Failure detection (SURVEY §5.3): a worker crashing (nonzero exit) or
-    hanging (stale heartbeat, when ``heartbeat_timeout`` is set and the
-    training script runs a ``distributed.Heartbeat``) kills the whole
-    gang; with ``max_restarts`` > 0 the gang is relaunched after an
-    exponential backoff (``restart_backoff`` base seconds — an immediate
-    respawn against a still-broken dependency just burns the budget).
-    Restarted workers see ``PADDLE_RESTART_ATTEMPT`` > 0 and, when
-    ``checkpoint_dir`` is set, ``PADDLE_CHECKPOINT_DIR`` — the pair
+    Failure detection (SURVEY §5.3): a worker crashing (nonzero exit),
+    hanging dead (stale heartbeat, when ``heartbeat_timeout`` is set and
+    the training script runs a ``distributed.Heartbeat``), or hanging
+    LIVE (heartbeat fresh but the step counter frozen past
+    ``step_deadline`` seconds — the hung-step deadline watchdog, which
+    first sends SIGUSR1 so the worker dumps all thread stacks into its
+    log) kills the whole gang; with ``max_restarts`` > 0 the gang is
+    relaunched after an exponential backoff (``restart_backoff`` base
+    seconds, series reset after a run that stayed healthy for
+    ``backoff_reset_after`` seconds — a crash hours in must not inherit
+    the max backoff accumulated by startup flakes). Restarted workers
+    see ``PADDLE_RESTART_ATTEMPT`` > 0 and, when ``checkpoint_dir`` is
+    set, ``PADDLE_CHECKPOINT_DIR`` — the pair
     ``fluid.io.CheckpointManager.restore_on_restart`` reads to
     auto-resume from the last intact checkpoint.
 
-    A gang that dies to a port bind failure ('Address already in use' in
-    a worker log — the ``_free_port`` TOCTOU race, launcher's fault) is
-    redone with a fresh base port up to ``port_retries`` times WITHOUT
-    consuming ``max_restarts`` or backing off."""
+    Preemption (``preempt_drain``, default on): workers get
+    ``PADDLE_PREEMPT_DRAIN=1``, so ``Executor.run`` installs the
+    SIGTERM drain handlers of ``distributed.preemption`` — on eviction
+    the worker finishes its step, force-checkpoints, leaves an
+    ``hb.<rank>.preempted`` marker and exits 0. A gang whose workers
+    all exited 0 with at least one such marker is respawned WITHOUT
+    burning ``max_restarts`` (capped at ``max_preempt_restarts`` so a
+    preempt storm still terminates); when one rank drains while the
+    rest run, the launcher relays SIGTERM to the rest so the gang
+    drains together. If the LAUNCHER itself is SIGTERMed it forwards
+    the signal and returns the drained codes instead of respawning.
+
+    Elastic reformation: after more than ``max_restarts_at_size``
+    failed attempts at the current size (None disables), the gang is
+    re-formed WITHOUT the ranks that crashed/hung — shrink to the
+    survivors, floored at ``min_world_size`` (default
+    ``$PADDLE_MIN_WORLD_SIZE`` or 1). Workers re-derive world size and
+    rank from the respawned env (``env.trainer_env``), and
+    ``restore_on_restart`` reshards the world-size-N checkpoint into
+    the smaller gang. A recovered slot is offered back by dropping a
+    ``slot.<k>`` file in the rendezvous directory
+    (``rendezvous.Rendezvous.offer_slot``; the dir is exported as
+    ``PADDLE_RENDEZVOUS_DIR``) — the next respawn consumes it and
+    scales back up toward the original size.
+
+    A gang that dies to a port bind failure ('Address already in use'
+    in a worker log — the ``_free_port`` TOCTOU race, launcher's fault)
+    is redone with a fresh base port up to ``port_retries`` times
+    WITHOUT consuming ``max_restarts`` or backing off."""
     from .heartbeat import Watchdog
 
-    if checkpoint_dir:
-        env = dict(os.environ if env is None else env)
-        env["PADDLE_CHECKPOINT_DIR"] = checkpoint_dir
-    attempt = 0
-    port_retry = 0
-    while True:
-        base = _reserve_port_range(nproc) if started_port is None \
-            else int(started_port)
-        hb_dir = tempfile.mkdtemp(prefix="paddle_tpu_hb_")             if heartbeat_timeout else None
-        procs, logs = _spawn_gang(nproc, cmd, node_ip, base, env, backend,
-                                  log_dir, hb_dir, attempt)
-        watchdog = Watchdog(hb_dir, nproc, heartbeat_timeout)             if hb_dir else None
-        failed = False
-        last_check = 0.0
-        try:
-            while True:
-                codes = [p.poll() for p in procs]
-                _M_ALIVE.set(sum(1 for c in codes if c is None))
-                if all(c is not None for c in codes):
-                    break
-                if any(c not in (None, 0) for c in codes):
-                    failed = True  # crash: take down the survivors
-                    _kill_gang(procs)
-                    codes = [p.poll() for p in procs]
-                    break
-                if watchdog is not None and \
-                        time.time() - last_check > 1.0:
-                    last_check = time.time()
-                    # exited-clean ranks stop stamping; that's not a hang
-                    done = {i for i, c in enumerate(codes) if c == 0}
-                    stale = watchdog.stale_workers(skip=done)
-                    if stale:
-                        sys.stderr.write(
-                            "launch: workers %r missed heartbeats for "
-                            ">%ss; killing gang\n"
-                            % (stale, heartbeat_timeout))
-                        failed = True
-                        _kill_gang(procs)
-                        codes = [p.poll() for p in procs]
-                        break
-                time.sleep(0.2)
-        except KeyboardInterrupt:
-            _kill_gang(procs)
-            raise
-        finally:
-            for f in logs:
-                f.close()
-            if hb_dir:
-                shutil.rmtree(hb_dir, ignore_errors=True)
-        _M_ALIVE.set(0)
-        if not failed and all(c == 0 for c in codes):
-            return codes
-        _M_FAILED.inc()
-        if started_port is None and port_retry < port_retries and \
-                _bind_failure(log_dir, nproc):
-            port_retry += 1
-            _M_PORT_RETRIES.inc()
-            sys.stderr.write(
-                "launch: gang lost the port race (base %d), retrying "
-                "with a fresh port range %d/%d (restart budget "
-                "untouched)\n" % (base, port_retry, port_retries))
-            continue
-        if attempt >= max_restarts:
-            return codes
-        _M_RESTARTS.inc()
-        delay = _resilience.backoff_delay(
-            attempt, base=restart_backoff, max_delay=30.0, jitter=0.25)
-        _M_RESTART_BACKOFF.observe(delay)
-        sys.stderr.write(
-            "launch: gang failed (codes %r), restart %d/%d in %.1fs\n"
-            % (codes, attempt + 1, max_restarts, delay))
-        time.sleep(delay)
-        attempt += 1
+    if step_deadline is None:
+        v = os.environ.get(ENV_STEP_DEADLINE)
+        step_deadline = float(v) if v else None
+    if min_world_size is None:
+        v = os.environ.get(ENV_MIN_WORLD)
+        min_world_size = int(v) if v else 1
+    min_world_size = max(1, min(int(min_world_size), int(nproc)))
 
+    rdzv_is_tmp = rendezvous_dir is None
+    rdzv = _rendezvous.Rendezvous(
+        rendezvous_dir or tempfile.mkdtemp(prefix="paddle_tpu_rdzv_"))
+    base_env = dict(os.environ if env is None else env)
+    if checkpoint_dir:
+        base_env["PADDLE_CHECKPOINT_DIR"] = checkpoint_dir
+    base_env[_preemption.ENV_DRAIN] = "1" if preempt_drain else "0"
+    base_env[_rendezvous.ENV_DIR] = rdzv.dirname
+
+    backoff = _resilience.RestartBackoff(
+        base=restart_backoff, max_delay=30.0, jitter=0.25,
+        reset_after=backoff_reset_after)
+    world = orig_world = int(nproc)
+    spawn_no = 0        # -> PADDLE_RESTART_ATTEMPT (any respawn resumes)
+    budget_used = 0     # counts against max_restarts (failures only)
+    at_size_failures = 0
+    preempt_respawns = 0
+    port_retry = 0
+    try:
+        with _preemption.LauncherForward() as fwd:
+            while True:
+                base = _reserve_port_range(world) \
+                    if started_port is None else int(started_port)
+                # the hb dir is unconditional now: the .exit/.preempted
+                # markers live there even when heartbeats are off
+                hb_dir = tempfile.mkdtemp(prefix="paddle_tpu_hb_")
+                procs, logs = _spawn_gang(world, cmd, node_ip, base,
+                                          base_env, backend, log_dir,
+                                          hb_dir, spawn_no)
+                fwd.set_procs(procs)
+                rdzv.clear_members()
+                rdzv.record_world(world, spawn_no)
+                watchdog = Watchdog(
+                    hb_dir, world, timeout=heartbeat_timeout,
+                    step_deadline=step_deadline) \
+                    if (heartbeat_timeout is not None
+                        or step_deadline is not None) else None
+                failed = False
+                bad_ranks = set()
+                preempted = []
+                drain_relayed = False
+                last_check = 0.0
+                spawn_time = time.time()
+                try:
+                    while True:
+                        codes = [p.poll() for p in procs]
+                        _M_ALIVE.set(sum(1 for c in codes if c is None))
+                        if all(c is not None for c in codes):
+                            break
+                        if any(c not in (None, 0) for c in codes):
+                            failed = True  # crash: take down survivors
+                            bad_ranks = {i for i, c in enumerate(codes)
+                                         if c not in (None, 0)}
+                            _kill_gang(procs)
+                            codes = [p.poll() for p in procs]
+                            break
+                        if preempt_drain and not drain_relayed and \
+                                any(c == 0 for c in codes):
+                            # one rank drained on preemption while the
+                            # rest run: relay SIGTERM so the whole gang
+                            # drains together instead of deadlocking on
+                            # a collective with a missing participant
+                            gone = [i for i, c in enumerate(codes)
+                                    if c == 0 and os.path.exists(
+                                        _preemption.preempt_marker_path(
+                                            hb_dir, i))]
+                            if gone:
+                                drain_relayed = True
+                                sys.stderr.write(
+                                    "launch: workers %r drained on "
+                                    "preemption; relaying SIGTERM to "
+                                    "the rest of the gang\n" % (gone,))
+                                for i, c in enumerate(codes):
+                                    if c is None:
+                                        try:
+                                            procs[i].send_signal(
+                                                signal.SIGTERM)
+                                        except OSError:
+                                            pass  # exited under us
+                        if watchdog is not None and \
+                                time.time() - last_check > 1.0:
+                            last_check = time.time()
+                            # exited-clean ranks stop stamping; that's
+                            # not a hang
+                            done = {i for i, c in enumerate(codes)
+                                    if c == 0}
+                            stale = watchdog.stale_workers(skip=done)
+                            if stale:
+                                sys.stderr.write(
+                                    "launch: workers %r missed "
+                                    "heartbeats for >%ss; killing "
+                                    "gang\n" % (stale, heartbeat_timeout))
+                                failed = True
+                                bad_ranks = set(stale)
+                                _kill_gang(procs)
+                                codes = [p.poll() for p in procs]
+                                break
+                            hung = watchdog.hung_workers(skip=done)
+                            if hung:
+                                sys.stderr.write(
+                                    "launch: workers %r alive but step "
+                                    "frozen for >%ss (hung-step "
+                                    "deadline); dumping stacks and "
+                                    "killing gang\n"
+                                    % (hung, step_deadline))
+                                for r in hung:
+                                    if procs[r].poll() is None:
+                                        try:
+                                            procs[r].send_signal(
+                                                signal.SIGUSR1)
+                                        except OSError:
+                                            pass
+                                # give faulthandler a beat to flush the
+                                # stacks into the worker log
+                                time.sleep(1.0)
+                                failed = True
+                                bad_ranks = set(hung)
+                                _kill_gang(procs)
+                                codes = [p.poll() for p in procs]
+                                break
+                        time.sleep(0.2)
+                    preempted = [
+                        r for r in range(world) if os.path.exists(
+                            _preemption.preempt_marker_path(hb_dir, r))]
+                except KeyboardInterrupt:
+                    _kill_gang(procs)
+                    raise
+                finally:
+                    for f in logs:
+                        f.close()
+                    shutil.rmtree(hb_dir, ignore_errors=True)
+                _M_ALIVE.set(0)
+                healthy_secs = time.time() - spawn_time
+
+                if not failed and all(c == 0 for c in codes):
+                    if not (preempted and preempt_drain):
+                        return codes  # clean finish
+                    if fwd.triggered or \
+                            preempt_respawns >= max_preempt_restarts:
+                        # the launcher itself is being evicted (or a
+                        # preempt storm): hand the drained codes back
+                        return codes
+                    preempt_respawns += 1
+                    _M_PREEMPTIONS.inc(len(preempted))
+                    returned = rdzv.consume_slots()
+                    new_world = min(orig_world, world + len(returned)) \
+                        if returned else world
+                    if new_world != world:
+                        _M_REFORMATIONS.inc()
+                        at_size_failures = 0
+                        world = new_world
+                    sys.stderr.write(
+                        "launch: gang drained on preemption (ranks %r); "
+                        "respawning %d workers, restart budget "
+                        "untouched (%d/%d preempt respawns)\n"
+                        % (preempted, world, preempt_respawns,
+                           max_preempt_restarts))
+                    spawn_no += 1
+                    continue
+
+                _M_FAILED.inc()
+                if started_port is None and port_retry < port_retries \
+                        and _bind_failure(log_dir, world):
+                    port_retry += 1
+                    _M_PORT_RETRIES.inc()
+                    sys.stderr.write(
+                        "launch: gang lost the port race (base %d), "
+                        "retrying with a fresh port range %d/%d "
+                        "(restart budget untouched)\n"
+                        % (base, port_retry, port_retries))
+                    continue
+                if budget_used >= max_restarts:
+                    return codes
+                budget_used += 1
+                at_size_failures += 1
+                _M_RESTARTS.inc()
+                if not bad_ranks:
+                    bad_ranks = {i for i, c in enumerate(codes)
+                                 if c != 0}
+                returned = rdzv.consume_slots()
+                new_world = world
+                if max_restarts_at_size is not None and \
+                        at_size_failures > max_restarts_at_size:
+                    new_world = _rendezvous.plan_next_world(
+                        world, bad_ranks, orig_world,
+                        min_world=min_world_size,
+                        returned=len(returned))
+                elif returned and world < orig_world:
+                    new_world = min(orig_world, world + len(returned))
+                if new_world != world:
+                    _M_REFORMATIONS.inc()
+                    sys.stderr.write(
+                        "launch: re-forming gang at world size %d "
+                        "(was %d; ranks %r failed %d attempt(s) at "
+                        "that size)\n"
+                        % (new_world, world, sorted(bad_ranks),
+                           at_size_failures))
+                    world = new_world
+                    at_size_failures = 0
+                delay = backoff.next_delay(healthy_secs)
+                _M_RESTART_BACKOFF.observe(delay)
+                sys.stderr.write(
+                    "launch: gang failed (codes %r), restart %d/%d in "
+                    "%.1fs\n"
+                    % (codes, budget_used, max_restarts, delay))
+                time.sleep(delay)
+                spawn_no += 1
+    finally:
+        if rdzv_is_tmp:
+            shutil.rmtree(rdzv.dirname, ignore_errors=True)
 
 def main(argv=None):
     parser = argparse.ArgumentParser(
@@ -264,6 +451,31 @@ def main(argv=None):
     parser.add_argument("--restart_backoff", type=float, default=0.5,
                         help="base seconds of the exponential backoff "
                              "before each gang restart")
+    parser.add_argument("--backoff_reset_after", type=float, default=60.0,
+                        help="a gang that ran healthy this many seconds "
+                             "resets the backoff series")
+    parser.add_argument("--step_deadline", type=float, default=None,
+                        help="hung-step watchdog: kill+restart when a "
+                             "worker's heartbeat is fresh but its step "
+                             "counter froze this long (also "
+                             "$PADDLE_STEP_DEADLINE)")
+    parser.add_argument("--max_restarts_at_size", type=int, default=None,
+                        help="after this many failed attempts at the "
+                             "current world size, re-form the gang "
+                             "without the failing ranks (elastic "
+                             "shrink-to-survivors)")
+    parser.add_argument("--min_world_size", type=int, default=None,
+                        help="floor for elastic shrink (also "
+                             "$PADDLE_MIN_WORLD_SIZE; default 1)")
+    parser.add_argument("--rendezvous_dir", default=None,
+                        help="gang membership dir (exported as "
+                             "PADDLE_RENDEZVOUS_DIR; default: a temp "
+                             "dir); drop slot.<k> files here to offer "
+                             "recovered capacity back")
+    parser.add_argument("--no_preempt_drain", action="store_true",
+                        help="do not export PADDLE_PREEMPT_DRAIN=1 "
+                             "(workers die on SIGTERM instead of "
+                             "draining through a checkpoint)")
     parser.add_argument("training_script")
     parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
@@ -273,8 +485,14 @@ def main(argv=None):
                    started_port=args.started_port, backend=args.backend,
                    log_dir=args.log_dir, max_restarts=args.max_restarts,
                    heartbeat_timeout=args.heartbeat_timeout,
+                   step_deadline=args.step_deadline,
                    restart_backoff=args.restart_backoff,
-                   checkpoint_dir=args.checkpoint_dir)
+                   backoff_reset_after=args.backoff_reset_after,
+                   checkpoint_dir=args.checkpoint_dir,
+                   max_restarts_at_size=args.max_restarts_at_size,
+                   min_world_size=args.min_world_size,
+                   rendezvous_dir=args.rendezvous_dir,
+                   preempt_drain=not args.no_preempt_drain)
     bad = [(i, c) for i, c in enumerate(codes) if c != 0]
     if bad:
         sys.exit("workers failed: %r" % bad)

@@ -1,0 +1,288 @@
+"""Graceful preemption for training workers — SURVEY §5.3 grown into
+elastic membership (the reference's Fleet stack treats worker churn as
+a first-class event; on preemptible TPU fleets eviction notice arrives
+as SIGTERM and a worker that ignores it is hard-killed seconds later).
+
+Worker side: ``install()`` (or ``PADDLE_PREEMPT_DRAIN=1`` in the
+environment, which ``distributed.launch`` exports by default) registers
+SIGTERM/SIGINT handlers that flip a process-wide *drain flag* — nothing
+else happens in the handler. ``Executor.run`` checks the flag between
+steps (and between ``iters=k`` windows) via ``check_drain``: the
+in-flight step finishes and commits, the active ``CheckpointManager``
+force-saves, a ``hb.<rank>.preempted`` marker lands next to the
+heartbeat's ``.exit`` marker, and the process exits 0. The launcher
+reads the marker to tell a clean preempt from a crash and respawns
+WITHOUT burning restart budget.
+
+``install()`` also registers ``faulthandler`` on SIGUSR1, the signal
+the launcher's hung-step watchdog sends so a wedged worker dumps every
+Python thread's stack into its log before the gang is reformed.
+
+This module is the ONE sanctioned home for raw ``signal.signal`` calls
+(``tools/check_resilience.py`` lints every other site): scattering
+handler registration across the runtime is how drain flags get
+clobbered.
+"""
+
+import faulthandler
+import json
+import logging
+import os
+import signal
+import sys
+import threading
+import time
+
+from ..fluid import monitor as _monitor
+
+__all__ = [
+    "ENV_DRAIN", "install", "uninstall", "installed", "draining",
+    "drain_reason", "request_drain", "check_drain", "drain_exit",
+    "maybe_install_from_env", "preempt_marker_path",
+    "write_preempt_marker", "reset", "LauncherForward",
+]
+
+ENV_DRAIN = "PADDLE_PREEMPT_DRAIN"
+
+DEFAULT_SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+_M_SIGNALS = _monitor.counter(
+    "preempt_signals_total",
+    help="drain requests received (preemption signals + programmatic)")
+_M_DRAIN_EXITS = _monitor.counter(
+    "preempt_drain_exits_total",
+    help="clean drain exits taken (checkpoint forced, marker written, "
+         "exit 0)")
+
+_LOCK = threading.Lock()
+_DRAIN = threading.Event()
+_INSTALLED = False
+_ENV_CHECKED = False
+_PREV = {}
+_STACK_SIGNAL = None
+_REASON = None
+_SINCE = None
+
+log = logging.getLogger(__name__)
+
+
+def _is_main_thread():
+    return threading.current_thread() is threading.main_thread()
+
+
+def draining():
+    """True once a preemption signal (or ``request_drain``) arrived —
+    the cheap flag ``Executor.run`` polls between steps."""
+    return _DRAIN.is_set()
+
+
+def drain_reason():
+    """Why the drain flag was set (``'signal:SIGTERM'``, an API
+    caller's reason string), or None."""
+    return _REASON
+
+
+def request_drain(reason="api"):
+    """Flip the drain flag programmatically (what the signal handler
+    does; also the test hook — no real signal delivery needed)."""
+    global _REASON, _SINCE
+    if not _DRAIN.is_set():
+        _REASON = reason
+        _SINCE = time.time()
+        _DRAIN.set()
+        _M_SIGNALS.inc()
+
+
+def _handler(signum, frame):
+    try:
+        name = signal.Signals(signum).name
+    except ValueError:
+        name = str(signum)
+    request_drain("signal:%s" % name)
+
+
+def install(signals=DEFAULT_SIGNALS, stack_dump_signal=signal.SIGUSR1):
+    """Register the drain handlers (idempotent). Returns True when
+    installed, False when not on the main thread (CPython only allows
+    handler registration there; a worker driving training from a
+    helper thread should call this from its main thread at startup).
+
+    ``stack_dump_signal`` (default SIGUSR1, None disables) is handed to
+    ``faulthandler.register`` so the launcher's hung-step watchdog can
+    make this process dump all thread stacks to stderr — which
+    ``distributed.launch`` redirects into the worker log."""
+    global _INSTALLED, _STACK_SIGNAL
+    with _LOCK:
+        if _INSTALLED:
+            return True
+        if not _is_main_thread():
+            log.warning("preemption.install skipped: not the main "
+                        "thread (signal handlers need it)")
+            return False
+        for s in signals:
+            _PREV[s] = signal.signal(s, _handler)
+        if stack_dump_signal is not None:
+            faulthandler.register(stack_dump_signal, file=sys.stderr,
+                                  all_threads=True)
+            _STACK_SIGNAL = stack_dump_signal
+        _INSTALLED = True
+        return True
+
+
+def uninstall():
+    """Restore the previous signal handlers (test teardown)."""
+    global _INSTALLED, _STACK_SIGNAL
+    with _LOCK:
+        if not _INSTALLED:
+            return
+        for s, prev in _PREV.items():
+            signal.signal(s, prev)
+        _PREV.clear()
+        if _STACK_SIGNAL is not None:
+            faulthandler.unregister(_STACK_SIGNAL)
+            _STACK_SIGNAL = None
+        _INSTALLED = False
+
+
+def installed():
+    return _INSTALLED
+
+
+def reset():
+    """Full teardown for tests: uninstall handlers, clear the drain
+    flag, forget the env check (so a monkeypatched ``PADDLE_PREEMPT_
+    DRAIN`` is re-read)."""
+    global _REASON, _SINCE, _ENV_CHECKED
+    uninstall()
+    _DRAIN.clear()
+    _REASON = None
+    _SINCE = None
+    _ENV_CHECKED = False
+
+
+def maybe_install_from_env(environ=None):
+    """Install the handlers when ``PADDLE_PREEMPT_DRAIN`` is truthy —
+    called by ``Executor.run`` once per process so launched workers
+    need zero script plumbing. The env is read once; ``reset()``
+    forgets the answer."""
+    global _ENV_CHECKED
+    if _INSTALLED or _ENV_CHECKED:
+        return _INSTALLED
+    _ENV_CHECKED = True
+    val = (environ if environ is not None else os.environ).get(
+        ENV_DRAIN, "")
+    if str(val).strip().lower() in ("1", "true", "yes", "on"):
+        return install()
+    return False
+
+
+# -- the .preempted marker (next to heartbeat's .exit) ---------------------
+
+def preempt_marker_path(dirname, rank):
+    """Marker a drained worker leaves so the launcher (and the
+    Watchdog) can tell a clean preempt from a crash — same naming
+    convention as the heartbeat's ``hb.<rank>.exit``."""
+    return os.path.join(dirname, "hb.%d.preempted" % int(rank))
+
+
+def write_preempt_marker(dirname=None, rank=None):
+    """Write the marker atomically; returns its path, or None when no
+    heartbeat dir is configured (not launched — nothing to mark)."""
+    from .heartbeat import current_heartbeat_dir
+
+    dirname = dirname or current_heartbeat_dir()
+    if not dirname:
+        return None
+    if rank is None:
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", 0) or 0)
+    path = preempt_marker_path(dirname, rank)
+    tmp = "%s.tmp-%d" % (path, os.getpid())
+    try:
+        with open(tmp, "w") as f:
+            json.dump({"ts": time.time(), "pid": os.getpid(),
+                       "reason": _REASON}, f)
+        os.replace(tmp, path)
+    except OSError:
+        # launcher tore the dir down already (gang kill in flight)
+        return None
+    return path
+
+
+# -- the drain exit itself --------------------------------------------------
+
+def drain_exit(manager=None, program=None, scope=None):
+    """Finish draining: force-save through the active
+    ``CheckpointManager`` (when the run carried one), write the
+    ``.preempted`` marker, and exit 0. A checkpoint failure here is
+    logged but never blocks the exit — the eviction deadline does not
+    wait for a flaky filesystem, and the previous periodic checkpoint
+    is still intact."""
+    step = None
+    if manager is not None and program is not None:
+        try:
+            manager.save(program, scope, background=False)
+            manager.wait()
+            step = manager._step
+        except Exception:
+            log.exception("preempt drain: final checkpoint failed; "
+                          "exiting on the last periodic one")
+    write_preempt_marker()
+    _M_DRAIN_EXITS.inc()
+    sys.stderr.write(
+        "preemption: drained cleanly at step %s (%s); exiting 0\n"
+        % (step if step is not None else "?", _REASON))
+    sys.stderr.flush()
+    raise SystemExit(0)
+
+
+def check_drain(manager=None, program=None, scope=None):
+    """The between-steps hook ``Executor.run`` calls: no-op until the
+    drain flag is set, then ``drain_exit`` (which does not return)."""
+    if not _DRAIN.is_set():
+        return
+    drain_exit(manager, program, scope)
+
+
+# -- launcher side ----------------------------------------------------------
+
+class LauncherForward:
+    """SIGTERM relay for ``distributed.launch``: when the LAUNCHER is
+    preempted it forwards the signal to the current gang (workers
+    drain) and flags itself as draining so the restart loop returns
+    the drained codes instead of respawning. Context manager; no-op
+    off the main thread. ``set_procs`` retargets the relay at each
+    respawned gang."""
+
+    def __init__(self, signals=(signal.SIGTERM,)):
+        self._signals = tuple(signals)
+        self._procs = []
+        self._prev = {}
+        self._active = False
+        self.triggered = False
+
+    def set_procs(self, procs):
+        self._procs = list(procs)
+
+    def _handler(self, signum, frame):
+        self.triggered = True
+        for p in self._procs:
+            try:
+                if p.poll() is None:
+                    p.send_signal(signum)
+            except OSError:
+                pass  # already reaped
+
+    def __enter__(self):
+        if _is_main_thread():
+            for s in self._signals:
+                self._prev[s] = signal.signal(s, self._handler)
+            self._active = True
+        return self
+
+    def __exit__(self, *exc):
+        if self._active:
+            for s, prev in self._prev.items():
+                signal.signal(s, prev)
+            self._prev.clear()
+            self._active = False
+        return False

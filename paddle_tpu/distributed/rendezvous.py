@@ -1,0 +1,182 @@
+"""File-based gang rendezvous for elastic membership — the analogue of
+the reference Fleet's pserver-mediated worker registry, under the same
+dirname convention as ``heartbeat.py`` (the launcher owns a directory,
+exports it via env, members stamp files into it).
+
+The launcher records each gang *generation* (world size + which of the
+original worker slots are populated) in ``world.json``; workers can
+``announce`` themselves for debugging/inspection; and a recovered
+worker slot is offered back by dropping a ``slot.<k>`` file into the
+directory (``offer_slot`` — in production the node-manager agent does
+this when a preempted VM returns; in tests it is one file write). The
+launcher consumes offered slots at the next reformation and scales the
+gang back up toward its original size.
+
+``plan_next_world`` is the pure sizing decision — shrink to the
+survivors of the failing slots, floor at ``min_world``, grow by
+returned slots, cap at the original size — kept free of I/O so it is
+trivially testable.
+"""
+
+import json
+import os
+import time
+
+__all__ = ["ENV_DIR", "Rendezvous", "current_rendezvous_dir",
+           "plan_next_world"]
+
+ENV_DIR = "PADDLE_RENDEZVOUS_DIR"
+
+_WORLD = "world.json"
+_MEMBER_PREFIX = "member."
+_SLOT_PREFIX = "slot."
+
+
+def current_rendezvous_dir():
+    """The launcher-provided rendezvous directory, or None."""
+    return os.environ.get(ENV_DIR)
+
+
+def plan_next_world(world, failed_slots, orig_world, min_world=1,
+                    returned=0):
+    """Next gang size: drop the failing slots (never below
+    ``min_world``), add back ``returned`` recovered slots, never exceed
+    the original size. ``failed_slots`` may be any iterable of ranks;
+    out-of-range entries are ignored."""
+    world = int(world)
+    failed = {int(r) for r in failed_slots if 0 <= int(r) < world}
+    survivors = max(int(min_world), world - len(failed))
+    return max(1, min(int(orig_world), survivors + int(returned)))
+
+
+class Rendezvous:
+    """One rendezvous directory. All writes are tmp+rename so a reader
+    never sees a torn file; all readers tolerate missing/garbage files
+    (a half-dead member must not take the launcher down with it)."""
+
+    def __init__(self, dirname=None):
+        dirname = dirname or current_rendezvous_dir()
+        if not dirname:
+            raise ValueError(
+                "Rendezvous needs a directory: pass dirname= or set %s "
+                "(distributed.launch exports it to workers)" % ENV_DIR)
+        self.dirname = dirname
+        os.makedirs(dirname, exist_ok=True)
+
+    def _write_json(self, path, payload):
+        tmp = "%s.tmp-%d" % (path, os.getpid())
+        try:
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, path)
+        except OSError:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
+
+    def _read_json(self, path):
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    # -- launcher side -----------------------------------------------------
+    def record_world(self, world_size, generation, slots=None):
+        """Commit the membership of gang ``generation``: ``world_size``
+        workers occupying original ``slots`` (default 0..world-1)."""
+        self._write_json(os.path.join(self.dirname, _WORLD), {
+            "world_size": int(world_size),
+            "generation": int(generation),
+            "slots": [int(s) for s in
+                      (slots if slots is not None
+                       else range(int(world_size)))],
+            "ts": time.time(),
+        })
+
+    def world(self):
+        """The last committed ``world.json`` payload, or None."""
+        return self._read_json(os.path.join(self.dirname, _WORLD))
+
+    def generation(self):
+        w = self.world()
+        return int(w["generation"]) if w and "generation" in w else 0
+
+    # -- returned capacity (scale back up) ---------------------------------
+    def offer_slot(self, slot):
+        """Offer worker slot ``slot`` back to the gang (a preempted
+        VM's slot returning). Consumed at the next reformation."""
+        self._write_json(
+            os.path.join(self.dirname, "%s%d" % (_SLOT_PREFIX,
+                                                 int(slot))),
+            {"slot": int(slot), "ts": time.time()})
+
+    def returned_slots(self):
+        """Offered-and-unconsumed slots, sorted."""
+        out = []
+        try:
+            names = os.listdir(self.dirname)
+        except OSError:
+            return out
+        for n in names:
+            if n.startswith(_SLOT_PREFIX) and ".tmp-" not in n:
+                try:
+                    out.append(int(n[len(_SLOT_PREFIX):]))
+                except ValueError:
+                    pass
+        return sorted(out)
+
+    def consume_slots(self):
+        """Claim every offered slot (remove the files); returns them."""
+        out = self.returned_slots()
+        for s in out:
+            try:
+                os.remove(os.path.join(self.dirname,
+                                       "%s%d" % (_SLOT_PREFIX, s)))
+            except OSError:
+                pass  # another consumer raced us; the slot is claimed
+        return out
+
+    # -- worker side -------------------------------------------------------
+    def announce(self, rank=None, step=None):
+        """Stamp this worker's membership (rank, pid, optional step) —
+        inspection/debugging; liveness stays with ``heartbeat``."""
+        if rank is None:
+            rank = int(os.environ.get("PADDLE_TRAINER_ID", 0) or 0)
+        payload = {"rank": int(rank), "pid": os.getpid(),
+                   "ts": time.time()}
+        if step is not None:
+            payload["step"] = int(step)
+        self._write_json(
+            os.path.join(self.dirname,
+                         "%s%d" % (_MEMBER_PREFIX, int(rank))), payload)
+
+    def members(self):
+        """{rank: payload} for every parseable member stamp."""
+        out = {}
+        try:
+            names = os.listdir(self.dirname)
+        except OSError:
+            return out
+        for n in names:
+            if not n.startswith(_MEMBER_PREFIX) or ".tmp-" in n:
+                continue
+            data = self._read_json(os.path.join(self.dirname, n))
+            if data is not None and "rank" in data:
+                out[int(data["rank"])] = data
+        return out
+
+    def clear_members(self):
+        """Drop all member stamps (launcher, before a new generation)."""
+        try:
+            names = os.listdir(self.dirname)
+        except OSError:
+            return
+        for n in names:
+            if n.startswith(_MEMBER_PREFIX):
+                try:
+                    os.remove(os.path.join(self.dirname, n))
+                except OSError:
+                    pass  # a member re-stamped mid-sweep; next sweep gets it

@@ -13,9 +13,16 @@ import itertools
 
 import numpy as np
 
+from . import monitor as _monitor
 from . import rng as _rng
 
 __all__ = ["CompiledProgram", "BuildStrategy", "ExecutionStrategy"]
+
+_M_RESHARD_REPL = _monitor.counter(
+    "state_reshard_replicated_total",
+    help="state vars whose shard spec could not be applied on the "
+         "current mesh (axis gone or dim not divisible after an "
+         "elastic reformation) and fell back to replicated")
 
 
 class BuildStrategy:
@@ -472,10 +479,15 @@ class CompiledProgram:
             return NamedSharding(mesh, P(*spec))
         return NamedSharding(mesh, P())
 
-    def _state_sharding(self, block, name, mesh, repl):
+    def _state_sharding(self, block, name, mesh, repl, shape=None):
         """Param layout: ``ParamAttr(shard=...)`` specs over the mesh,
         everything else replicated (shared by the single-step and
-        step-batched GSPMD wrappers)."""
+        step-batched GSPMD wrappers). With ``shape`` given (the restore
+        path, where the mesh may have shrunk since the spec was
+        written), a spec that no longer fits degrades to replicated —
+        counted in ``state_reshard_replicated_total`` — instead of
+        raising; compile-time callers pass no shape and keep the strict
+        error."""
         from jax.sharding import NamedSharding
         from jax.sharding import PartitionSpec as P
 
@@ -488,11 +500,58 @@ class CompiledProgram:
         missing = [a for a in spec if a is not None
                    and a not in mesh.shape]
         if missing:
-            raise ValueError(
+            if shape is None:
+                raise ValueError(
+                    "param %r shard spec %r names mesh axes %r absent "
+                    "from the mesh %r" % (name, spec, missing,
+                                          dict(mesh.shape)))
+            _M_RESHARD_REPL.inc()
+            import logging
+
+            logging.getLogger(__name__).warning(
                 "param %r shard spec %r names mesh axes %r absent from "
-                "the mesh %r" % (name, spec, missing,
-                                 dict(mesh.shape)))
+                "the current mesh %r; restoring replicated",
+                name, spec, missing, dict(mesh.shape))
+            return repl
+        if shape is not None:
+            for d, a in enumerate(spec):
+                if a is None:
+                    continue
+                if d >= len(shape) or shape[d] % mesh.shape[a] != 0:
+                    _M_RESHARD_REPL.inc()
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "param %r shape %r does not divide over mesh "
+                        "axis %r (size %d); restoring replicated",
+                        name, tuple(shape), a, mesh.shape[a])
+                    return repl
         return NamedSharding(mesh, P(*spec))
+
+    def state_sharding(self, block, name, value=None):
+        """The ``NamedSharding`` a persistable var takes under this
+        strategy — the single source of truth
+        ``fluid.io.CheckpointManager.restore`` uses to reshard a
+        restored checkpoint onto the CURRENT mesh, which after an
+        elastic reformation (``distributed.launch`` shrink-to-
+        survivors) may be smaller than the mesh that saved it. With
+        ``value`` given, a spec that no longer fits the mesh (axis
+        gone, dim not divisible) degrades to replicated instead of
+        raising. Returns None when the strategy has no mesh (plain
+        program / pipeline mode — nothing to reshard onto)."""
+        if not self._is_data_parallel or \
+                getattr(self, "_mode", "gspmd") == "pipeline":
+            return None
+        mesh = self.mesh
+        if mesh is None:
+            return None
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        repl = NamedSharding(mesh, P())
+        return self._state_sharding(
+            block, name, mesh, repl,
+            shape=np.shape(value) if value is not None else None)
 
     def _wrap_step_gspmd(self, step, block, feed, fetch_names, state_names):
         """jit the lowered step under the mesh: batch over 'dp', params

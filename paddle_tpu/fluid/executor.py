@@ -633,6 +633,17 @@ class Executor:
 
         block = program.global_block()
 
+        # graceful preemption (distributed.preemption): launched workers
+        # have PADDLE_PREEMPT_DRAIN=1, so the first run() installs the
+        # SIGTERM drain handlers; a signal that already arrived drains
+        # HERE — before the step — through the active CheckpointManager
+        # and exits 0 (drain_exit does not return).
+        from ..distributed import preemption as _preemption
+
+        _preemption.maybe_install_from_env()
+        _preemption.check_drain(checkpoint[0] if checkpoint else None,
+                                program, scope)
+
         # pserver programs don't compile — their listen_and_serv op is a
         # host serving loop; running one blocks, like the reference's
         # pserver Executor (listen_and_serv_op.cc RunSyncLoop). The same
@@ -855,6 +866,11 @@ class Executor:
         if checkpoint is not None and not discarded:
             checkpoint[0].step_completed(program, scope, 1, checkpoint[1])
 
+        # a preemption signal that landed DURING the step drains now,
+        # after the state committed — the step is never torn in half
+        _preemption.check_drain(checkpoint[0] if checkpoint else None,
+                                program, scope)
+
         wall = _time.perf_counter() - _t_run0
         _M_RUN_SECONDS.observe(wall)
         _M_RUNS.inc()
@@ -961,6 +977,15 @@ class Executor:
         if program is None:
             program = framework.default_main_program()
         block = program.global_block()
+
+        # same drain hook as the single-step path: check between
+        # windows, never inside one (the k-step device loop is the
+        # commit unit)
+        from ..distributed import preemption as _preemption
+
+        _preemption.maybe_install_from_env()
+        _preemption.check_drain(checkpoint[0] if checkpoint else None,
+                                program, scope)
 
         py_readers = []
         for op in block.ops:
@@ -1194,6 +1219,11 @@ class Executor:
         if checkpoint is not None and not discarded:
             checkpoint[0].step_completed(program, scope, iters,
                                          checkpoint[1])
+
+        # drain between windows: a signal that landed mid-window exits
+        # here, after all k steps committed
+        _preemption.check_drain(checkpoint[0] if checkpoint else None,
+                                program, scope)
 
         wall = _time.perf_counter() - _t_run0
         _M_RUN_SECONDS.observe(wall)

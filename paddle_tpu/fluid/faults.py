@@ -49,7 +49,16 @@ POINTS = (
     "step.nonfinite",  # executor anomaly check: the step's results are
                        #   treated as non-finite (policy path exercised
                        #   without building a diverging model)
+    "worker.preempt",  # training scripts call check() once per step;
+                       #   fires SIGTERM at this process — the eviction
+                       #   notice distributed.preemption drains on
+    "worker.hang",     # training scripts call check() once per step;
+                       #   sleeps $PADDLE_FAULT_HANG_SECONDS (default
+                       #   3600) with heartbeats still beating — the
+                       #   live-hang the step-deadline watchdog catches
 )
+
+ENV_HANG_SECONDS = "PADDLE_FAULT_HANG_SECONDS"
 
 
 class FaultInjected(TransientError):
@@ -133,13 +142,30 @@ def _fire(point):
 
 def check(point):
     """The injection point: no-op unless armed and due. ``worker.exit``
-    hard-exits the process; every other point raises the armed
-    exception class (constructed with a descriptive message)."""
+    hard-exits the process, ``worker.preempt`` delivers a real SIGTERM
+    to it, ``worker.hang`` wedges the calling thread; every other point
+    raises the armed exception class (constructed with a descriptive
+    message)."""
     exc = _fire(point)
     if exc is None:
         return
     if point == "worker.exit":
-        os._exit(EXIT_CODE)
+        os._exit(EXIT_CODE)  # simulated hard crash: no atexit, no cleanup — anything softer would not exercise the launcher's restart path
+    if point == "worker.preempt":
+        import signal
+
+        os.kill(os.getpid(), signal.SIGTERM)
+        return  # the drain handler decides what happens next
+    if point == "worker.hang":
+        import time
+
+        # a live hang: this thread wedges but daemon threads (the
+        # Heartbeat stamper) keep running, so the stamp stays fresh
+        # while the step counter freezes — only the step-deadline
+        # watchdog can catch it
+        time.sleep(float(os.environ.get(ENV_HANG_SECONDS, "3600")
+                         or 3600))
+        return
     raise exc("injected fault at %r" % point)
 
 

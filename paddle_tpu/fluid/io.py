@@ -47,6 +47,22 @@ _M_CKPT_CORRUPT = _monitor.counter(
     "checkpoint_corrupt_total",
     help="checkpoint versions rejected by manifest/checksum validation "
          "(torn writes, truncation, bit rot)")
+_M_CKPT_FALLBACK = _monitor.counter(
+    "checkpoint_latest_fallback_total",
+    help="latest() calls that skipped a torn newest version and fell "
+         "back to an older intact one")
+_M_CKPT_RESHARDS = _monitor.counter(
+    "checkpoint_reshards_total",
+    help="state arrays re-laid-out (device_put) onto the current mesh "
+         "during restore — the elastic-reformation reshard path")
+_M_CKPT_RESHARD_SECONDS = _monitor.histogram(
+    "checkpoint_reshard_seconds",
+    help="wall time of the reshard-on-restore pass (all state arrays "
+         "of one restore)")
+
+# a crashed reader's leftover .reading-* guard stops blocking rotation
+# after this long
+_GUARD_TTL = 300.0
 
 
 def _atomic_write_bytes(path, data):
@@ -419,10 +435,14 @@ class CheckpointManager:
         (torn by a crash mid-write on a non-atomic filesystem, truncated
         by an operator, rotted) are counted and skipped — restore falls
         back to the previous good one."""
+        fell_back = False
         for step in reversed(self.steps()):
             if self.validate(step):
+                if fell_back:
+                    _M_CKPT_FALLBACK.inc()
                 return step
             _M_CKPT_CORRUPT.inc()
+            fell_back = True
         return None
 
     # -- save -------------------------------------------------------------
@@ -506,6 +526,10 @@ class CheckpointManager:
             _faults.check("io.write")  # simulated crash before the commit rename
             manifest = {"step": step, "files": files,
                         "reader_positions": readers,
+                        # gang size at save time: restore into a
+                        # different world (elastic reformation) reshards
+                        "world_size": int(os.environ.get(
+                            "PADDLE_TRAINERS_NUM", "1") or 1),
                         "time": time.time()}
             mpath = os.path.join(tmp, _MANIFEST)
             with open(mpath, "w") as f:
@@ -521,11 +545,42 @@ class CheckpointManager:
         _M_CKPT_SAVES.inc()
         self._prune()
 
+    def _guard_path(self, step):
+        return os.path.join(self.dirname,
+                            ".reading-%08d-%d" % (int(step), os.getpid()))
+
+    def _guarded_steps(self):
+        """Versions some live reader pinned with a ``.reading-*`` guard
+        file — rotation must not delete them out from under a
+        concurrent ``restore()``. Guards older than ``_GUARD_TTL``
+        belong to crashed readers and are swept."""
+        guarded = set()
+        try:
+            names = os.listdir(self.dirname)
+        except OSError:
+            return guarded
+        now = time.time()
+        for n in names:
+            if not n.startswith(".reading-"):
+                continue
+            p = os.path.join(self.dirname, n)
+            try:
+                if now - os.path.getmtime(p) > _GUARD_TTL:
+                    os.remove(p)
+                    continue
+                guarded.add(int(n[len(".reading-"):].split("-")[0]))
+            except (OSError, ValueError):
+                pass
+        return guarded
+
     def _prune(self):
         import shutil
 
         steps = self.steps()
+        guarded = self._guarded_steps()
         for step in steps[:-self.max_to_keep]:
+            if step in guarded:
+                continue  # a concurrent restore() is reading it
             shutil.rmtree(self._path(step), ignore_errors=True)
         # abandoned tmp dirs from crashed writers
         try:
@@ -551,15 +606,34 @@ class CheckpointManager:
     close = wait
 
     # -- restore ----------------------------------------------------------
-    def restore(self, executor=None, program=None, scope=None, step=None):
+    def restore(self, executor=None, program=None, scope=None, step=None,
+                strategy=None):
         """Load version ``step`` (default: ``latest()`` intact one) into
         the scope: params, optimizer state, executor rng, and py_reader
         positions (live readers fast-forward on their next ``start()``).
         Returns the restored step; raises ``FileNotFoundError`` when no
-        intact version exists."""
+        intact version exists.
+
+        ``strategy`` (a ``CompiledProgram``): reshard-on-restore — every
+        restored array is ``device_put`` with the layout
+        ``strategy.state_sharding`` derives on the CURRENT mesh, so a
+        checkpoint written by a world-size-N gang restores cleanly into
+        the N-k survivors of an elastic reformation (specs that no
+        longer fit the shrunk mesh degrade to replicated). The version
+        being read is pinned with a ``.reading-*`` guard file so a
+        concurrent background save's ``max_to_keep`` rotation can never
+        delete it mid-read."""
         self.wait()
         if program is None:
             program = framework.default_main_program()
+        from . import compiler as _compiler
+
+        if isinstance(program, _compiler.CompiledProgram):
+            # callers may hand the CompiledProgram straight in: it IS
+            # the strategy, and carries the underlying Program
+            if strategy is None:
+                strategy = program
+            program = program._program
         if step is None:
             step = self.latest()
             if step is None:
@@ -572,12 +646,51 @@ class CheckpointManager:
         from .core import tensor_io
 
         d = self._path(step)
-        for fname in ("params.pdparams", "opt.pdopt"):
-            data = self._retry.call(
-                tensor_io.load_combine, os.path.join(d, fname))
-            for name, arr in data.items():
-                scope.set_var(name, arr)
-        positions = self.manifest(step).get("reader_positions", {})
+        guard = self._guard_path(step)
+        try:
+            with open(guard, "w") as f:
+                f.write(str(time.time()))
+        except OSError:
+            guard = None  # unwritable dir: read unguarded, best effort
+        try:
+            block = program.global_block() if (
+                strategy is not None and program is not None) else None
+            resharded = 0
+            t0 = time.monotonic()
+            for fname in ("params.pdparams", "opt.pdopt"):
+                data = self._retry.call(
+                    tensor_io.load_combine, os.path.join(d, fname))
+                for name, arr in data.items():
+                    if strategy is not None:
+                        sh = strategy.state_sharding(block, name, arr)
+                        if sh is not None:
+                            import jax
+
+                            arr = jax.device_put(arr, sh)
+                            resharded += 1
+                    scope.set_var(name, arr)
+            manifest = self.manifest(step)
+        finally:
+            if guard:
+                try:
+                    os.remove(guard)
+                except OSError:
+                    pass
+        if resharded:
+            _M_CKPT_RESHARDS.inc(resharded)
+            _M_CKPT_RESHARD_SECONDS.observe(time.monotonic() - t0)
+            saved_world = manifest.get("world_size")
+            cur_world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1")
+                            or 1)
+            if saved_world and int(saved_world) != cur_world:
+                import logging
+
+                logging.getLogger(__name__).info(
+                    "checkpoint step %d was saved by a world-size-%s "
+                    "gang; resharded %d state arrays onto the current "
+                    "world-size-%d mesh", step, saved_world, resharded,
+                    cur_world)
+        positions = manifest.get("reader_positions", {})
         if positions and program is not None:
             for key, r in _program_py_readers(program):
                 if key in positions:
@@ -586,18 +699,23 @@ class CheckpointManager:
         _M_CKPT_RESTORES.inc()
         return step
 
-    def restore_on_restart(self, executor=None, program=None, scope=None):
+    def restore_on_restart(self, executor=None, program=None, scope=None,
+                           strategy=None):
         """Auto-resume for launcher-restarted workers: when
         ``PADDLE_RESTART_ATTEMPT`` > 0 (set by ``distributed.launch`` on
         every respawn) and an intact version exists, restore it and
         return its step; otherwise return None (fresh start — attempt 0,
-        or the crash predated the first checkpoint)."""
+        an empty/garbage checkpoint dir, or the crash predated the first
+        checkpoint). ``strategy`` enables reshard-on-restore (see
+        ``restore``) — pass the ``CompiledProgram`` when running under
+        an elastic launcher whose gang may have been re-formed at a
+        different world size."""
         attempt = int(os.environ.get(ENV_RESTART_ATTEMPT, "0") or 0)
         if attempt <= 0:
             return None
         if self.latest() is None:
             return None
-        return self.restore(executor, program, scope)
+        return self.restore(executor, program, scope, strategy=strategy)
 
     # -- executor integration ---------------------------------------------
     def step_completed(self, program, scope, iters, every_n_steps):

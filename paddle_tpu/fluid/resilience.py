@@ -26,7 +26,7 @@ import time
 from . import monitor as _monitor
 
 __all__ = ["TransientError", "CircuitOpenError", "Retry",
-           "CircuitBreaker", "backoff_delay"]
+           "CircuitBreaker", "RestartBackoff", "backoff_delay"]
 
 def _site_counters(site):
     return (
@@ -249,3 +249,43 @@ class CircuitBreaker:
             raise
         self.record_success()
         return result
+
+
+class RestartBackoff:
+    """Backoff series for restart loops, with a healthy-run reset: each
+    consecutive failure grows the delay exponentially, but a run that
+    stayed healthy for at least ``reset_after`` seconds before failing
+    resets the series — a crash hours into training must not inherit
+    the max backoff accumulated by startup flakes.
+
+    Usage (``distributed.launch``):
+
+        bo = RestartBackoff(base=0.5, reset_after=60.0)
+        ...gang fails after running healthy_secs...
+        time.sleep(bo.next_delay(healthy_secs))
+    """
+
+    def __init__(self, base=0.5, factor=2.0, max_delay=30.0,
+                 jitter=0.25, reset_after=60.0):
+        self.base = float(base)
+        self.factor = float(factor)
+        self.max_delay = float(max_delay)
+        self.jitter = float(jitter)
+        self.reset_after = float(reset_after)
+        self.attempt = 0
+        self._m_resets = _monitor.counter(
+            "restart_backoff_resets_total",
+            help="backoff series reset after a healthy run "
+                 "(>= reset_after seconds before the failure)")
+
+    def next_delay(self, healthy_seconds):
+        """Delay before the next restart, given how long the failed run
+        stayed healthy. Advances the attempt counter."""
+        if self.attempt and float(healthy_seconds) >= self.reset_after:
+            self.attempt = 0
+            self._m_resets.inc()
+        d = backoff_delay(self.attempt, base=self.base,
+                          factor=self.factor, max_delay=self.max_delay,
+                          jitter=self.jitter)
+        self.attempt += 1
+        return d

@@ -39,6 +39,10 @@ def pytest_configure(config):
         "markers", "faults: exercises the fluid.faults injection "
                    "harness (kills subprocesses, arms global fault "
                    "points)")
+    config.addinivalue_line(
+        "markers", "elastic: exercises the elastic launcher path "
+                   "(preemption drain, gang reformation, hung-step "
+                   "watchdog) — spawns worker subprocesses")
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,163 @@
+"""Elastic-launcher runner for tests/test_elastic.py: trains a small
+MLP with periodic crash-consistent checkpoints under the launcher's
+heartbeat + preemption contract, optionally injuring itself through the
+``faults`` points so the parent test can watch ``distributed.launch``
+drain, reform, or watchdog-kill the gang:
+
+- ``worker.preempt`` — self-SIGTERM partway through the first attempt;
+  the ``distributed.preemption`` drain handlers (installed by
+  ``Executor.run`` because the launcher exports PADDLE_PREEMPT_DRAIN=1)
+  finish the step, force-checkpoint, and exit 0 with a ``.preempted``
+  marker.
+- ``worker.exit`` — hard ``os._exit`` whenever this rank runs at a
+  specific world size, so the launcher exhausts its same-size budget
+  and shrinks the gang to the survivors.
+- ``worker.hang`` — wedge the training thread while the Heartbeat's
+  daemon stamper keeps beating: invisible to the staleness check, only
+  the hung-step deadline watchdog catches it (and SIGUSR1s this process
+  so faulthandler dumps every thread's stack into this log).
+
+Determinism contract is dist_runner_ckpt's: step ``i``'s feed derives
+from ``RandomState(1234 + i)`` and the rng is checkpointed, so a
+drained-and-resumed run must reach final weights BIT-IDENTICAL to an
+uninterrupted one.
+
+Env knobs (all set by tests/test_elastic.py):
+  PADDLE_TEST_TOTAL        total training steps (default 8)
+  PADDLE_TEST_EVERY        checkpoint every n steps (default 2)
+  PADDLE_TEST_PREEMPT_AT   arm worker.preempt after N steps (rank 0,
+                           first attempt only)
+  PADDLE_TEST_CRASH_RANK   arm worker.exit on this rank ...
+  PADDLE_TEST_CRASH_WORLD  ... whenever the gang runs at this size
+  PADDLE_TEST_CRASH_AT     ... after this many completed steps (def. 2)
+  PADDLE_TEST_HANG_AT      arm worker.hang after N steps (first attempt)
+  PADDLE_TEST_HANG_RANK    which rank hangs (default 0)
+  PADDLE_TEST_COMPILED     "1": rank 0 trains a data-parallel
+                           CompiledProgram over the local virtual-CPU
+                           mesh and restores THROUGH it, exercising
+                           reshard-on-restore
+
+Prints ``WORLD <n> RANK <r> ATTEMPT <a>``, ``RESUMED <step>``,
+``RESHARD <n>`` (compiled mode) and ``WEIGHTS <sha256>`` lines the
+parent parses from the worker log.
+"""
+
+import hashlib
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu.fluid as fluid  # noqa: E402
+from paddle_tpu.fluid import faults, layers, monitor, optimizer  # noqa: E402
+from paddle_tpu.distributed.heartbeat import Heartbeat  # noqa: E402
+
+TOTAL = int(os.environ.get("PADDLE_TEST_TOTAL", "8"))
+EVERY = int(os.environ.get("PADDLE_TEST_EVERY", "2"))
+ATTEMPT = int(os.environ.get("PADDLE_RESTART_ATTEMPT", "0") or 0)
+RANK = int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0)
+WORLD = int(os.environ.get("PADDLE_TRAINERS_NUM", "1") or 1)
+COMPILED = os.environ.get("PADDLE_TEST_COMPILED") == "1"
+
+
+def arm_faults():
+    """Programmatic arming (PADDLE_FAULTS would re-arm on every respawn;
+    these knobs gate on attempt/rank/world so the launcher's recovery
+    path actually gets exercised instead of re-injured forever)."""
+    preempt_at = os.environ.get("PADDLE_TEST_PREEMPT_AT")
+    if preempt_at is not None and ATTEMPT == 0 and RANK == 0:
+        faults.arm("worker.preempt", after_n=int(preempt_at))
+    crash_rank = os.environ.get("PADDLE_TEST_CRASH_RANK")
+    if crash_rank is not None and RANK == int(crash_rank) and \
+            WORLD == int(os.environ.get("PADDLE_TEST_CRASH_WORLD", "-1")):
+        faults.arm("worker.exit",
+                   after_n=int(os.environ.get("PADDLE_TEST_CRASH_AT",
+                                              "2")))
+    hang_at = os.environ.get("PADDLE_TEST_HANG_AT")
+    if hang_at is not None and ATTEMPT == 0 and \
+            RANK == int(os.environ.get("PADDLE_TEST_HANG_RANK", "0")):
+        faults.arm("worker.hang", after_n=int(hang_at))
+
+
+def build(seed=29):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[6], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="float32")
+        h = layers.fc(x, size=8, act="relu")
+        pred = layers.fc(h, size=1)
+        loss = layers.reduce_mean(layers.square(pred - y))
+        optimizer.SGD(0.05).minimize(loss)
+    return main, startup, loss
+
+
+def feed_for(step):
+    # batch 8: divides the 8-device virtual mesh in compiled mode
+    rs = np.random.RandomState(1234 + step)
+    return {"x": rs.rand(8, 6).astype(np.float32),
+            "y": rs.rand(8, 1).astype(np.float32)}
+
+
+def weight_digest(program, scope):
+    h = hashlib.sha256()
+    for v in sorted(program.list_vars(), key=lambda v: v.name):
+        if not v.persistable:
+            continue
+        val = scope.find_var(v.name)
+        if val is not None:
+            h.update(v.name.encode())
+            h.update(np.ascontiguousarray(np.asarray(val)).tobytes())
+    return h.hexdigest()
+
+
+def main():
+    arm_faults()
+    print("WORLD %d RANK %d ATTEMPT %d" % (WORLD, RANK, ATTEMPT),
+          flush=True)
+    main_p, startup, loss = build()
+    exe = fluid.Executor()
+    exe.run(startup)
+    train_p = main_p
+    if COMPILED and RANK == 0:
+        train_p = fluid.CompiledProgram(main_p).with_data_parallel(
+            loss_name=loss.name)
+    hb = Heartbeat(interval=0.2).start()
+
+    # rank 0 owns the shared checkpoint dir; the other ranks train
+    # checkpoint-free (they still drain + leave markers on preemption)
+    mgr = None
+    resumed = None
+    if RANK == 0:
+        mgr = fluid.io.CheckpointManager(max_to_keep=2)
+        reshards = monitor.counter("checkpoint_reshards_total")
+        before = reshards.value
+        resumed = mgr.restore_on_restart(
+            exe, train_p, strategy=train_p if COMPILED else None)
+        if COMPILED:
+            print("RESHARD %d" % (reshards.value - before), flush=True)
+    start = resumed if resumed is not None else 0
+    print("RESUMED %s" % (resumed if resumed is not None else -1),
+          flush=True)
+
+    for step in range(start, TOTAL):
+        if mgr is not None:
+            exe.run(train_p, feed=feed_for(step), fetch_list=[loss],
+                    checkpoint=(mgr, EVERY))
+        else:
+            exe.run(train_p, feed=feed_for(step), fetch_list=[loss])
+        hb.beat(step + 1)
+        faults.check("worker.exit")
+        faults.check("worker.hang")
+        faults.check("worker.preempt")
+    if mgr is not None:
+        mgr.wait()
+    print("WEIGHTS %s" % weight_digest(main_p, fluid.global_scope()),
+          flush=True)
+    hb.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Resilience lint: no silent catch-alls in the runtime.
+"""Resilience lint: no silent catch-alls, no rogue signal handlers.
 
 A bare ``except:`` or ``except BaseException`` swallows
 KeyboardInterrupt, SystemExit, and injected faults alike — in a
@@ -10,10 +10,19 @@ a consumer thread, crash-consistency cleanup, etc.). This checker
 fails on any unjustified site; it runs inside the test suite
 (tests/test_resilience.py) so a new one can't land unnoticed.
 
+The same discipline applies to raw ``signal.signal`` registration and
+raw ``os._exit`` calls: ``distributed/preemption.py`` is the ONE
+sanctioned home for signal handlers (a second registration site would
+clobber the drain handler), and a raw exit skips the drain/checkpoint
+machinery entirely. Both are detected at the AST level (a docstring
+MENTIONING os._exit is fine; a call needs a trailing justification
+comment or must move into preemption.py).
+
 Usage: python tools/check_resilience.py [root]   (default: repo root)
 Exit code 0 = clean, 1 = violations (one per line on stdout).
 """
 
+import ast
 import io
 import os
 import re
@@ -28,6 +37,13 @@ _EXCEPT_RE = re.compile(r"^\s*except\s*(:|[^:]*\bBaseException\b)")
 # directories that are not runtime code
 _SKIP_DIRS = {".git", "__pycache__", ".pytest_cache", "build", "dist",
               ".eggs", "node_modules"}
+
+# the sanctioned home for raw signal.signal / os._exit (see module doc)
+_RAW_CALL_EXEMPT = ("distributed/preemption.py",)
+
+# module.attr calls that need a justification (or to live in an exempt
+# file): rogue handler registration / raw process exits
+_RAW_CALLS = {("signal", "signal"), ("os", "_exit")}
 
 
 def _line_has_justification(line):
@@ -58,16 +74,45 @@ def _line_has_justification(line):
     return False
 
 
+def _raw_call_violations(source):
+    """(lineno, line) for raw ``signal.signal(...)`` / ``os._exit(...)``
+    CALLS without a trailing justification comment. AST-based on
+    purpose: prose or docstrings mentioning the names must not trip the
+    lint, only actual call sites."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return []
+    lines = source.splitlines()
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not (isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and (f.value.id, f.attr) in _RAW_CALLS):
+            continue
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if not _line_has_justification(line):
+            out.append((node.lineno, line.strip()))
+    return out
+
+
 def check_file(path):
     """Violations in one file: list of (lineno, line)."""
     out = []
     with open(path, encoding="utf-8", errors="replace") as f:
-        for lineno, line in enumerate(f, 1):
-            if not _EXCEPT_RE.match(line):
-                continue
-            if not _line_has_justification(line.rstrip("\n")):
-                out.append((lineno, line.strip()))
-    return out
+        source = f.read()
+    for lineno, line in enumerate(source.splitlines(), 1):
+        if not _EXCEPT_RE.match(line):
+            continue
+        if not _line_has_justification(line):
+            out.append((lineno, line.strip()))
+    norm = path.replace(os.sep, "/")
+    if not any(norm.endswith(suffix) for suffix in _RAW_CALL_EXEMPT):
+        out.extend(_raw_call_violations(source))
+    return sorted(out)
 
 
 def check_tree(root):
@@ -90,11 +135,14 @@ def main(argv=None):
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     violations = check_tree(root)
     for path, lineno, line in violations:
-        print("%s:%d: unjustified catch-all: %s" % (path, lineno, line))
+        print("%s:%d: unjustified resilience hazard: %s"
+              % (path, lineno, line))
     if violations:
-        print("%d unjustified bare-except/BaseException site(s) — add a "
-              "trailing comment explaining why the catch-all is safe, "
-              "or narrow the exception" % len(violations))
+        print("%d unjustified site(s): bare-except/BaseException, raw "
+              "signal.signal, or raw os._exit — add a trailing comment "
+              "explaining why the site is safe, narrow the exception, "
+              "or route signals through distributed/preemption"
+              % len(violations))
         return 1
     return 0
 
