@@ -492,6 +492,78 @@ def bench_transformer(batch_size=32, seq_len=64, warmup=3, iters=10):
             "transformer_big_seq_len": seq_len}
 
 
+def bench_transformer_decode(batch_sizes=(1, 64), src_len=128,
+                             prompt_len=64, cache_capacity=1024,
+                             new_tokens=64):
+    """Autoregressive greedy decode through the KV-cache fast path
+    (opt-in BENCH_DECODE=1). Per batch size: build a Transformer-big
+    decode session (ring capacity 1024 — the Pallas decode-kernel
+    regime), time the prefill once and the per-token decode loop
+    separately, and report GENERATED tokens/sec. The decode program
+    never retraces: after the warmup generation the compile-cache miss
+    counter must not move, and the full trajectory costs exactly two
+    compiles (prefill + decode) — both asserted here, both visible in
+    the JSON's monitor sub-dict (decode_steps_total climbs, misses
+    don't)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import dygraph, monitor
+    from paddle_tpu.models import transformer
+
+    out = {}
+    for B in batch_sizes:
+        with dygraph.guard():
+            model = transformer.Transformer.big()
+            m0 = monitor.counter("executor_compile_cache_miss_total").value
+            sess = transformer.build_decode_session(
+                model, B, src_len, prompt_len, cache_capacity, end_id=1)
+            rng = np.random.RandomState(0)
+            src = rng.randint(2, 32000, (B, src_len)).astype(np.int64)
+            prompt = rng.randint(2, 32000,
+                                 (B, prompt_len)).astype(np.int64)
+            plens = np.full((B,), prompt_len, np.int64)
+
+            sess.generate(src, prompt, plens, 2)  # compile both programs
+            m1 = monitor.counter("executor_compile_cache_miss_total").value
+            assert m1 - m0 == 2, (
+                "decode session cost %d compiles, want 2 (prefill + "
+                "decode)" % (m1 - m0))
+
+            t0 = time.perf_counter()
+            sess.generate(src, prompt, plens, 1)  # prefill + argmax only
+            t_prefill = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            toks, _ = sess.generate(src, prompt, plens, new_tokens)
+            t_full = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            toks2, _ = sess.generate(src, prompt, plens, 2 * new_tokens)
+            t_full2 = time.perf_counter() - t0
+            m2 = monitor.counter("executor_compile_cache_miss_total").value
+            assert m2 == m1, (
+                "decode steps retraced: %d extra compiles" % (m2 - m1))
+            assert (toks2[:, :new_tokens] == toks).all(), (
+                "decode is not deterministic across generations")
+
+        step_s = (t_full2 - t_full) / (B * new_tokens)  # marginal token
+        rate = 1.0 / max(step_s, 1e-12)
+        r1 = B * (new_tokens - 1) / max(t_full - t_prefill, 1e-12)
+        tag = "_batch%d" % B
+        out["transformer_decode_tokens_per_sec" + tag] = round(rate, 1)
+        out["transformer_decode_tokens_per_sec_short_window" + tag] = \
+            round(r1, 1)
+        out["transformer_decode_prefill_ms" + tag] = \
+            round(t_prefill * 1e3, 3)
+        out["transformer_decode_step_ms" + tag] = \
+            round(step_s * B * 1e3, 3)
+        out["transformer_decode_compile_misses" + tag] = m1 - m0
+    # headline: the throughput-oriented batch (the last one)
+    out["transformer_decode_tokens_per_sec"] = \
+        out["transformer_decode_tokens_per_sec_batch%d" % batch_sizes[-1]]
+    out["transformer_decode_new_tokens"] = new_tokens
+    out["transformer_decode_prompt_len"] = prompt_len
+    out["transformer_decode_cache_capacity"] = cache_capacity
+    return out
+
+
 def monitor_summary():
     """Framework-counter sub-dict for the JSON line (fluid/monitor.py):
     the same counters a production scrape would see, so BENCH_r0x.json
@@ -502,6 +574,8 @@ def monitor_summary():
     misses = monitor.counter("executor_compile_cache_miss_total").value
     run_hist = monitor.get_metric("executor_run_seconds")
     fetch_hist = monitor.get_metric("executor_fetch_sync_seconds")
+    dec_hist = monitor.get_metric("decode_step_seconds")
+    dec_cache = monitor.get_metric("decode_cache_tokens")
     return {
         "executor_run_count": monitor.counter("executor_run_total").value,
         "compile_cache_hits": hits,
@@ -521,6 +595,15 @@ def monitor_summary():
             monitor.counter("executor_window_overlap_hit_total").value,
         "window_overlap_misses":
             monitor.counter("executor_window_overlap_miss_total").value,
+        # decode fast path: steps climb, compile_cache_misses don't — the
+        # "no per-token retrace" invariant is readable straight off the
+        # JSON line
+        "decode_steps_total":
+            monitor.counter("decode_steps_total").value,
+        "decode_cache_tokens": dec_cache.value
+        if dec_cache is not None else 0.0,
+        "decode_step_seconds_sum": round(dec_hist.sum, 3)
+        if dec_hist is not None else 0.0,
     }
 
 
@@ -568,6 +651,31 @@ def bench_smoke():
     assert all(np.isfinite(np.asarray(l)).all() for l in losses), losses
     hits = monitor.counter("executor_window_overlap_hit_total").value
     assert hits >= 1, "window 2 did not consume the prefetched window"
+
+    # tiny KV-cache decode loop (CPU): the (prefill, decode) pair must
+    # compile exactly twice and a repeat generation must not retrace —
+    # the fast path can't silently rot out of --smoke coverage
+    from paddle_tpu.fluid import dygraph
+    from paddle_tpu.models import transformer
+
+    with dygraph.guard():
+        model = transformer.Transformer.tiny()
+        sess = transformer.build_decode_session(
+            model, batch_size=2, src_len=6, prompt_len=4,
+            cache_capacity=16, end_id=1)
+        rng = np.random.RandomState(1)
+        src = rng.randint(2, 512, (2, 6)).astype(np.int64)
+        prompt = rng.randint(2, 512, (2, 4)).astype(np.int64)
+        plens = np.array([4, 3], np.int64)
+        m0 = monitor.counter("executor_compile_cache_miss_total").value
+        toks, _ = sess.generate(src, prompt, plens, 6)
+        m1 = monitor.counter("executor_compile_cache_miss_total").value
+        toks2, _ = sess.generate(src, prompt, plens, 6)
+        m2 = monitor.counter("executor_compile_cache_miss_total").value
+    assert m1 - m0 == 2, "decode smoke: %d compiles, want 2" % (m1 - m0)
+    assert m2 == m1, "decode smoke: repeat generation retraced"
+    assert (toks == toks2).all(), "decode smoke: non-deterministic"
+
     return {
         "metric": "smoke_async_pipeline_seconds",
         "value": round(time.perf_counter() - t0, 3),
@@ -576,6 +684,8 @@ def bench_smoke():
         "windows": 2,
         "iters_per_window": K,
         "window_losses": losses,
+        "decode_smoke_tokens": int(toks.size),
+        "decode_smoke_compile_misses": int(m1 - m0),
         "monitor": monitor_summary(),
     }
 
@@ -603,6 +713,8 @@ if __name__ == "__main__":
         out.update(bench_deepfm())
     if os.environ.get("BENCH_TRANSFORMER") == "1":
         out.update(bench_transformer())
+    if os.environ.get("BENCH_DECODE") == "1":
+        out.update(bench_transformer_decode())
     if os.environ.get("BENCH_LONGSEQ") == "1":
         out.update(bench_longseq())
         out.update(bench_longseq(batch_size=4, seq_len=4096,

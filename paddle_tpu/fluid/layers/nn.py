@@ -1612,3 +1612,39 @@ def fused_attention_packed(q, k, v, n_heads, attn_bias=None, scale=None,
     helper.append_op(type="fused_multihead_attention_packed",
                      inputs=inputs, outputs={"Out": [out]}, attrs=attrs)
     return out
+
+
+def kv_cache_update(cache, new, cache_len, name=None):
+    """Write ``new`` [B, H, T, d] into the KV ring buffer ``cache``
+    [B, H, C, d] at per-sequence slot ``cache_len % C``; returns
+    ``(updated_cache, cache_len + T)``. A single write must not cross
+    the ring boundary (T=1 decode always holds; prefill needs prompt
+    length <= C). See kernels/attention.py kv_cache_update."""
+    helper = LayerHelper("kv_cache_update", name=name)
+    out = helper.create_variable_for_type_inference(cache.dtype)
+    out_len = helper.create_variable_for_type_inference("int32")
+    helper.append_op(type="kv_cache_update",
+                     inputs={"Cache": [cache], "New": [new],
+                             "CacheLen": [cache_len]},
+                     outputs={"Out": [out], "OutLen": [out_len]})
+    return out, out_len
+
+
+def fused_attention_cache(q, k_cache, v_cache, cache_len, scale=None,
+                          name=None):
+    """Decode-step attention of q [B, H, Q, d] against a KV ring buffer
+    [B, H, C, d] with per-sequence valid lengths ``cache_len`` [B]
+    (post-update token counts). Dispatches to the Pallas decode tier at
+    large capacities, masked-length fp32 fallback otherwise
+    (kernels/attention.py attention_with_cache). Inference-only: no
+    gradient."""
+    helper = LayerHelper("fused_multihead_attention_cache", name=name)
+    out = helper.create_variable_for_type_inference(q.dtype)
+    attrs = {}
+    if scale is not None:
+        attrs["scale"] = float(scale)
+    helper.append_op(type="fused_multihead_attention_cache",
+                     inputs={"Q": [q], "KCache": [k_cache],
+                             "VCache": [v_cache], "CacheLen": [cache_len]},
+                     outputs={"Out": [out]}, attrs=attrs)
+    return out

@@ -710,3 +710,35 @@ def _fused_multihead_attention_packed(ctx, op):
     ctx.set_output(op, "Out", fused_attention_packed(
         q, k, v, bias, n_heads=n_heads, scale=scale, dropout_prob=drop,
         rng_key=key))
+
+
+@register("kv_cache_update")
+def _kv_cache_update(ctx, op):
+    """Ring-buffer KV cache write (kernels/attention.py): New [B, H, T, d]
+    lands at slot CacheLen % C of Cache [B, H, C, d]; OutLen = CacheLen
+    + T so decode programs carry the token count on-device (no host
+    round-trip between steps)."""
+    from ...kernels.attention import kv_cache_update
+
+    cache = ctx.get_input(op, "Cache")
+    new = ctx.get_input(op, "New")
+    cache_len = ctx.get_input(op, "CacheLen")
+    out, out_len = kv_cache_update(cache, new, cache_len)
+    ctx.set_output(op, "Out", out)
+    ctx.set_output(op, "OutLen", out_len)
+
+
+@register("fused_multihead_attention_cache")
+def _fused_multihead_attention_cache(ctx, op):
+    """Decode-step attention against a KV ring buffer
+    (kernels/attention.py attention_with_cache): masked-length fallback
+    or the Pallas decode tier at large capacities. Inference-only."""
+    from ...kernels.attention import attention_with_cache
+
+    q = ctx.get_input(op, "Q")
+    k_cache = ctx.get_input(op, "KCache")
+    v_cache = ctx.get_input(op, "VCache")
+    cache_len = ctx.get_input(op, "CacheLen")
+    scale = op.attr("scale", None)
+    ctx.set_output(op, "Out", attention_with_cache(
+        q, k_cache, v_cache, cache_len, scale=scale))

@@ -18,7 +18,8 @@ import numpy as np
 from .. import fluid
 from ..fluid import monitor as _monitor
 
-__all__ = ["Config", "Predictor", "create_predictor", "PredictorPool"]
+__all__ = ["Config", "Predictor", "create_predictor", "PredictorPool",
+           "GenerativePredictor"]
 
 _M_RUNS = _monitor.counter(
     "predictor_runs_total", help="Predictor.run calls served")
@@ -168,6 +169,69 @@ class _TensorHandle:
 
     def reshape(self, shape):
         pass  # shapes are taken from the fed array
+
+
+class GenerativePredictor:
+    """Serves autoregressive generation through a fixed (prefill, decode)
+    program pair instead of the plain Predictor's one-program path.
+
+    A generative model run through ``Predictor`` re-feeds the growing
+    output sequence, so every generated token presents a NEW feed shape
+    — ``predictor_shape_recompile_total`` climbs once per token. This
+    predictor routes through ``models.transformer.build_decode_session``:
+    the decode program is shape-closed (q_len=1, ring caches at fixed
+    capacity), so a request's signature is the (src, prompt) shapes only
+    and ``max_new_tokens`` never participates — N-token generation costs
+    exactly one prefill compile plus one decode compile, ever."""
+
+    def __init__(self, model, batch_size, src_len, prompt_len,
+                 cache_capacity, end_id=1):
+        from ..fluid import framework
+        from ..models.transformer import build_decode_session
+
+        if framework._dygraph_tracer() is not None:
+            self._session = build_decode_session(
+                model, batch_size, src_len, prompt_len, cache_capacity,
+                end_id=end_id)
+        else:
+            with fluid.dygraph.guard():
+                self._session = build_decode_session(
+                    model, batch_size, src_len, prompt_len, cache_capacity,
+                    end_id=end_id)
+        self._seen_sigs = set()
+
+    def get_input_names(self):
+        return ["src", "prompt", "prompt_lens"]
+
+    def get_output_names(self):
+        return ["tokens", "finished"]
+
+    def run(self, feed, max_new_tokens):
+        """feed: {"src": [B, S] int64, "prompt": [B, P] int64,
+        "prompt_lens": [B] (optional; defaults to full P)}. Returns
+        (tokens [B, max_new_tokens] int64, finished [B] bool)."""
+        feed = dict(feed)
+        missing = [n for n in ("src", "prompt") if n not in feed]
+        if missing:
+            raise ValueError("missing generative feeds: %r" % missing)
+        src, prompt = feed["src"], feed["prompt"]
+        lens = feed.get("prompt_lens")
+        if lens is None:
+            lens = np.full((np.shape(prompt)[0],), np.shape(prompt)[1],
+                           np.int64)
+        # signature tracks PROMPT shapes only — output length is not a
+        # shape, so growing max_new_tokens can never recompile
+        sig = (tuple(np.shape(src)), tuple(np.shape(prompt)))
+        if sig not in self._seen_sigs:
+            if self._seen_sigs:
+                _M_RECOMPILES.inc()
+            self._seen_sigs.add(sig)
+        t0 = _time.perf_counter()
+        tokens, finished = self._session.generate(src, prompt, lens,
+                                                  max_new_tokens)
+        _M_LATENCY.observe(_time.perf_counter() - t0)
+        _M_RUNS.inc()
+        return tokens, finished
 
 
 def create_predictor(config):

@@ -72,6 +72,24 @@ def _interpret():
     return os.environ.get("PADDLE_TPU_PALLAS_INTERPRET", "") == "1"
 
 
+_ATTN_FORCE_VALUES = ("flash", "packed", "decode")
+
+
+def _attn_force():
+    """The ONE read site for the PADDLE_TPU_ATTN_FORCE escape hatch.
+
+    Returns "" (no forcing) or one of ``_ATTN_FORCE_VALUES``; any other
+    value raises instead of silently routing to the default tier (a typo
+    like FORCE=falsh used to measure exactly the path the user was
+    trying to bypass)."""
+    v = os.environ.get("PADDLE_TPU_ATTN_FORCE", "")
+    if v and v not in _ATTN_FORCE_VALUES:
+        raise ValueError(
+            "PADDLE_TPU_ATTN_FORCE=%r not understood; expected one of "
+            "%s (or unset)" % (v, ", ".join(_ATTN_FORCE_VALUES)))
+    return v
+
+
 def _supports_pallas():
     try:
         from jax.experimental import pallas as pl  # noqa: F401
@@ -437,7 +455,7 @@ def _use_long_kernel(q, p_drop, bias):
     B, H, S, d = q.shape
     if not _supports_pallas():
         return False
-    if os.environ.get("PADDLE_TPU_ATTN_FORCE") == "flash":
+    if _attn_force() == "flash":
         return False        # measurement escape hatch: skip to flash
     if not (_MAX_FUSED_SEQ < S <= _MAX_LONG_SEQ) or _long_qb(S, d) is None:
         return False
@@ -1081,7 +1099,7 @@ def _use_res_kernel(q3, n_heads, p_drop, bias):
     B, S, HD = q3.shape
     if not _supports_pallas() or S > _MAX_FUSED_SEQ:
         return False
-    if os.environ.get("PADDLE_TPU_ATTN_FORCE") == "packed":
+    if _attn_force() == "packed":
         return False        # measurement/bypass hatch: old packed tier
     d = HD // n_heads
     # head pairs: 2d must hit the 128-lane alignment Mosaic can prove
@@ -1505,3 +1523,171 @@ def fused_attention(q, k, v, bias=None, scale=None, dropout_prob=0.0,
     scale, bias, seed = _prep_bias_seed(B, S, d, bias, scale,
                                         dropout_prob, rng_key)
     return _fused(q, k, v, bias, scale, float(dropout_prob), seed)
+
+
+# ---------------------------------------------------------------------------
+# Incremental decode: KV ring-buffer update + cache-aware attention.
+#
+# Inference-only (no custom_vjp): the decode program is traced once with a
+# fixed cache CAPACITY C, so every per-token step reuses one executable.
+# The cache is a ring buffer — token t lands at slot t % C, and once more
+# than C tokens have been written the buffer holds the most recent C in
+# scrambled slot order, which is fine because softmax attention is
+# permutation-invariant over the key axis.
+# ---------------------------------------------------------------------------
+
+def kv_cache_update(cache, new, cache_len):
+    """Write ``new`` [B, H, T, d] into the ring buffer ``cache``
+    [B, H, C, d] at per-sequence slot ``cache_len % C`` and return
+    ``(updated_cache, cache_len + T)``.
+
+    ``cache_len`` [B] int32 counts TOTAL tokens ever written per
+    sequence (it is not clamped to C — the ring position and the
+    valid-length mask are both derived from it). A single write must not
+    cross the ring boundary: (cache_len % C) + T <= C per sequence.
+    Decode steps (T=1) always satisfy this; prefill writes start at
+    cache_len=0 and need prompt length <= C."""
+    B, H, C, d = cache.shape
+    T = new.shape[2]
+    lens = jnp.reshape(cache_len, (B,)).astype(jnp.int32)
+    pos = jnp.mod(lens, jnp.int32(C))
+
+    def upd(c, n, p):
+        return jax.lax.dynamic_update_slice(c, n, (0, p, 0))
+
+    out = jax.vmap(upd)(cache, new.astype(cache.dtype), pos)
+    return out, lens + jnp.int32(T)
+
+
+def _ref_attention_cache(q, k_cache, v_cache, cache_len, scale):
+    """Masked-length fallback (and the numerics oracle in tests): fp32
+    scores over the FULL capacity, slots at column >= min(cache_len, C)
+    masked to -1e30 (not -inf: an exp(-inf - -inf) NaN would poison
+    rows), softmax, PV."""
+    B, H, Q, d = q.shape
+    C = k_cache.shape[2]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k_cache.astype(jnp.float32)) * scale
+    valid = jnp.minimum(jnp.reshape(cache_len, (B,)).astype(jnp.int32),
+                        jnp.int32(C))
+    col = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, C), 3)
+    s = jnp.where(col < valid.reshape(B, 1, 1, 1), s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p,
+                      v_cache.astype(jnp.float32)).astype(q.dtype)
+
+
+_DECODE_KB_CANDIDATES = (512, 256, 128)
+
+
+def _decode_kb(C):
+    for kb in _DECODE_KB_CANDIDATES:
+        if C % kb == 0:
+            return kb
+    return None
+
+
+def _use_decode_kernel(k_cache):
+    """Pallas decode tier: same dispatch shape as training attention —
+    the S>=1024 regime where the Pallas tiers win (PROFILE_r05), with
+    PADDLE_TPU_ATTN_FORCE=decode as the escape hatch that forces the
+    kernel at any capacity (tests run it on CPU under interpret)."""
+    if not _supports_pallas():
+        return False
+    if _attn_force() == "decode":
+        return True
+    return k_cache.shape[2] >= _MAX_FUSED_SEQ
+
+
+def _decode_fwd_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
+                       acc_scr, m_scr, l_scr, *, scale, kb, nk):
+    """Grid (B, H, nk), k-block fastest: online softmax over cache
+    blocks, same (m, l, acc) VMEM-scratch carry as the flash forward.
+    The per-sequence valid length rides whole-array in SMEM; columns at
+    or past it (including ring capacity padding) mask to -1e30."""
+    from jax.experimental import pallas as pl
+
+    b, j = pl.program_id(0), pl.program_id(2)
+    q = q_ref[0, 0]                               # [Q, d]
+    k = k_ref[0, 0]                               # [KB, d]
+    v = v_ref[0, 0]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    col = j * kb + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(col < len_ref[b], s, -1e30)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full(m_scr.shape, -1e30, jnp.float32)
+        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    m_prev = m_scr[...]                           # [Q, 1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)                        # [Q, KB]
+    corr = jnp.exp(m_prev - m_new)
+    l_scr[...] = l_scr[...] * corr + jnp.sum(p, axis=-1, keepdims=True)
+    acc_scr[...] = acc_scr[...] * corr + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_scr[...] = m_new
+
+    @pl.when(j == nk - 1)
+    def _emit():
+        o_ref[0, 0] = (acc_scr[...] / l_scr[...]).astype(o_ref.dtype)
+
+
+def _pallas_attention_decode(q, k_cache, v_cache, cache_len, scale):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, Q, d = q.shape
+    C = k_cache.shape[2]
+    KB = _decode_kb(C)
+    if KB is None:
+        # odd/prime capacity (forced-kernel case): pad the cache to the
+        # next 128 multiple — padded columns sit past the valid length
+        # and mask out like any empty slot
+        KB = _DECODE_KB_CANDIDATES[-1]
+        pad = (-C) % KB
+        zeros = jnp.zeros((B, H, pad, d), k_cache.dtype)
+        k_cache = jnp.concatenate([k_cache, zeros], axis=2)
+        v_cache = jnp.concatenate([v_cache, zeros], axis=2)
+    nk = k_cache.shape[2] // KB
+    lens = jnp.minimum(jnp.reshape(cache_len, (B,)).astype(jnp.int32),
+                       jnp.int32(C))
+    qspec = pl.BlockSpec((1, 1, Q, d), lambda b, h, j: (b, h, 0, 0))
+    kspec = pl.BlockSpec((1, 1, KB, d), lambda b, h, j: (b, h, j, 0))
+    f32 = jnp.float32
+    return pl.pallas_call(
+        functools.partial(_decode_fwd_kernel, scale=scale, kb=KB, nk=nk),
+        grid=(B, H, nk),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  qspec, kspec, kspec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((Q, d), f32),
+                        pltpu.VMEM((Q, 1), f32),
+                        pltpu.VMEM((Q, 1), f32)],
+        interpret=_interpret(),
+    )(lens, q, k_cache, v_cache)
+
+
+def attention_with_cache(q, k_cache, v_cache, cache_len, scale=None):
+    """Decode-step attention against a KV ring buffer.
+
+    q [B, H, Q, d] (Q=1 for incremental decode), k_cache/v_cache
+    [B, H, C, d], cache_len [B] int32 = tokens written so far per
+    sequence (post-update, so the current token attends to itself;
+    must be >= 1). Only the first min(cache_len, C) slots participate;
+    slot order does not matter (softmax is permutation-invariant), so
+    ring wraparound needs no unscrambling. Returns [B, H, Q, d] in q's
+    dtype. Inference-only: no backward."""
+    B, H, Q, d = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    scale = float(scale)
+    if _use_decode_kernel(k_cache):
+        return _pallas_attention_decode(q, k_cache, v_cache, cache_len,
+                                        scale)
+    return _ref_attention_cache(q, k_cache, v_cache, cache_len, scale)

@@ -11,7 +11,9 @@ import numpy as np
 import paddle_tpu.fluid as fluid
 from paddle_tpu.fluid import layers, optimizer
 
-__all__ = ["build_train_program", "build_infer_program", "synthetic_pairs"]
+__all__ = ["build_train_program", "build_infer_program",
+           "build_encoder_program", "build_decode_program",
+           "run_split_infer", "synthetic_pairs"]
 
 
 def _encoder(src, vocab_size, emb_dim, hidden):
@@ -87,6 +89,55 @@ def build_infer_program(src_vocab=32, tgt_vocab=32, emb_dim=16, hidden=32,
         final, _ = layers.dynamic_decode(decoder, inits=enc_final,
                                          max_step_num=max_tgt_len)
     return main, startup, final["sequences"]
+
+
+def build_encoder_program(src_vocab=32, emb_dim=16, hidden=32, src_len=6,
+                          seed=9):
+    """Encoder-only half of the split inference pipeline: source in,
+    final encoder state out. Run ONCE per source batch — the historical
+    ``build_infer_program`` re-ran this inside every beam-search session
+    even though the encoder state never changes."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    with fluid.program_guard(main, startup):
+        src = layers.data("s2s_src", [src_len], dtype="int64")
+        enc_final = _encoder(src, src_vocab, emb_dim, hidden)
+    return main, startup, enc_final
+
+
+def build_decode_program(tgt_vocab=32, emb_dim=16, hidden=32, max_tgt_len=6,
+                         beam_size=4, go_id=0, end_id=1, seed=9):
+    """Beam-search half: decodes from a FED encoder state
+    (``s2s_enc_state`` [B, hidden] float32), so the encoder runs outside
+    the decode loop. Same parameter names as the monolithic program —
+    bit-identical sequences from the same scope."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    with fluid.program_guard(main, startup):
+        enc_state = layers.data("s2s_enc_state", [hidden], dtype="float32")
+        dec_cell = _decoder_cell(hidden)
+        decoder = layers.BeamSearchDecoder(
+            dec_cell, start_token=go_id, end_token=end_id,
+            beam_size=beam_size,
+            embedding_fn=_tgt_embedding(tgt_vocab, emb_dim),
+            output_fn=_output_fn(tgt_vocab))
+        final, _ = layers.dynamic_decode(decoder, inits=enc_state,
+                                         max_step_num=max_tgt_len)
+    return main, startup, final["sequences"]
+
+
+def run_split_infer(exe, scope, enc_prog, enc_state_var, dec_prog, seq_var,
+                    src, return_numpy=True):
+    """Split inference: encoder once, beam decode from the cached state.
+    The encoder state crosses programs as a device array (no host
+    round-trip). Returns the decoded ``sequences`` fetch."""
+    from paddle_tpu.models.transformer import run_cached_phases
+    outs = run_cached_phases(
+        exe, scope,
+        enc_prog, {"s2s_src": src}, [enc_state_var],
+        dec_prog, {}, [seq_var],
+        bridge={"s2s_enc_state": 0}, return_numpy=return_numpy)
+    return outs[0]
 
 
 def synthetic_pairs(rng, n, vocab=32, src_len=6, go_id=0, end_id=1):

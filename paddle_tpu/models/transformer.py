@@ -12,11 +12,13 @@ program — the TPU-native counterpart of the reference's
 """
 
 import math
+import time
 
+import jax.numpy as jnp
 import numpy as np
 
 import paddle_tpu.fluid as fluid
-from paddle_tpu.fluid import framework
+from paddle_tpu.fluid import framework, monitor
 from paddle_tpu.fluid.dygraph import Layer, nn
 
 
@@ -85,26 +87,57 @@ class MultiHeadAttention(Layer):
         self.v_fc = nn.Linear(d_model, d_model)
         self.out_fc = nn.Linear(d_model, d_model)
 
-    def forward(self, q, kv, bias):
-        bsz = q.shape[0]
+    def _split(self, t):
+        t = reshape(t, [t.shape[0], -1, self.n_heads, self.d_key])
+        return transpose(t, [0, 2, 1, 3])
 
-        def split(t):
-            t = reshape(t, [bsz, -1, self.n_heads, self.d_key])
-            return transpose(t, [0, 2, 1, 3])
+    def _q_head(self, q):
+        return self._split(self.q_fc(q))
 
-        qh = split(self.q_fc(q))
-        kh = split(self.k_fc(kv))
-        vh = split(self.v_fc(kv))
+    def _kv_heads(self, kv):
+        """Projected split-head K/V [B, H, S, d] — ALSO the tensors the
+        decode path writes into the KV ring caches (prefill) or
+        precomputes once for cross-attention."""
+        return self._split(self.k_fc(kv)), self._split(self.v_fc(kv))
+
+    def _attend(self, qh, kh, vh, bias):
         scores = matmul(qh, kh, transpose_y=True,
                         alpha=1.0 / math.sqrt(self.d_key))
         if bias is not None:
             scores = scores + bias
         w = dropout(softmax(scores), self.dropout_rate,
                     is_test=not self.training)
-        ctx = matmul(w, vh)
+        return self._merge_out(matmul(w, vh))
+
+    def _merge_out(self, ctx):
         ctx = transpose(ctx, [0, 2, 1, 3])
-        ctx = reshape(ctx, [bsz, -1, self.n_heads * self.d_key])
+        ctx = reshape(ctx, [ctx.shape[0], -1, self.n_heads * self.d_key])
         return self.out_fc(ctx)
+
+    def forward(self, q, kv, bias):
+        qh = self._q_head(q)
+        kh, vh = self._kv_heads(kv)
+        return self._attend(qh, kh, vh, bias)
+
+    def forward_cached(self, x, k_cache, v_cache, cache_len):
+        """ONE decode step of self-attention: project the incoming
+        token(s), write K/V into the ring caches at slot cache_len % C,
+        then attend q against the cache with the post-update length (so
+        the token sees itself). Returns (out, k_cache', v_cache',
+        cache_len + T)."""
+        qh = self._q_head(x)
+        kh, vh = self._kv_heads(x)
+        k_new, new_len = _op("kv_cache_update",
+                             {"Cache": [k_cache], "New": [kh],
+                              "CacheLen": [cache_len]}, ["Out", "OutLen"])
+        v_new, _ = _op("kv_cache_update",
+                       {"Cache": [v_cache], "New": [vh],
+                        "CacheLen": [cache_len]}, ["Out", "OutLen"])
+        (ctx,) = _op("fused_multihead_attention_cache",
+                     {"Q": [qh], "KCache": [k_new], "VCache": [v_new],
+                      "CacheLen": [new_len]}, ["Out"],
+                     {"scale": 1.0 / math.sqrt(self.d_key)})
+        return self._merge_out(ctx), k_new, v_new, new_len
 
 
 class FFN(Layer):
@@ -159,6 +192,47 @@ class DecoderLayer(Layer):
         return self.ln3(x + dropout(y, self.dropout_rate,
                                     is_test=not self.training))
 
+    def forward_prefill(self, x, enc, self_bias, cross_bias, k_cache,
+                        v_cache, cache_len):
+        """Prompt pass: the exact math of forward() — same ops, same
+        causal bias — while ALSO writing this layer's prompt K/V into
+        the ring caches (cache_len = 0, so slots 0..T-1)."""
+        qh = self.self_attn._q_head(x)
+        kh, vh = self.self_attn._kv_heads(x)
+        k_new, _ = _op("kv_cache_update",
+                       {"Cache": [k_cache], "New": [kh],
+                        "CacheLen": [cache_len]}, ["Out", "OutLen"])
+        v_new, _ = _op("kv_cache_update",
+                       {"Cache": [v_cache], "New": [vh],
+                        "CacheLen": [cache_len]}, ["Out", "OutLen"])
+        y = self.self_attn._attend(qh, kh, vh, self_bias)
+        x = self.ln1(x + dropout(y, self.dropout_rate,
+                                 is_test=not self.training))
+        y = self.cross_attn(x, enc, cross_bias)
+        x = self.ln2(x + dropout(y, self.dropout_rate,
+                                 is_test=not self.training))
+        y = self.ffn(x)
+        return self.ln3(x + dropout(y, self.dropout_rate,
+                                    is_test=not self.training)), k_new, v_new
+
+    def forward_step(self, x, cross_k, cross_v, k_cache, v_cache,
+                     cache_len, cross_bias):
+        """ONE decode step: cached self-attention (q_len=1 vs the KV
+        ring buffer) and cross-attention against the PRECOMPUTED
+        encoder K/V — no re-projection of the encoder output."""
+        y, k_new, v_new, new_len = self.self_attn.forward_cached(
+            x, k_cache, v_cache, cache_len)
+        x = self.ln1(x + dropout(y, self.dropout_rate,
+                                 is_test=not self.training))
+        y = self.cross_attn._attend(self.cross_attn._q_head(x), cross_k,
+                                    cross_v, cross_bias)
+        x = self.ln2(x + dropout(y, self.dropout_rate,
+                                 is_test=not self.training))
+        y = self.ffn(x)
+        return self.ln3(x + dropout(y, self.dropout_rate,
+                                    is_test=not self.training)), \
+            k_new, v_new, new_len
+
 
 class Transformer(Layer):
     """Encoder-decoder transformer for teacher-forced NMT training."""
@@ -167,6 +241,8 @@ class Transformer(Layer):
                  d_inner=2048, n_layers=6, max_len=256, dropout_rate=0.1):
         super().__init__()
         self.d_model = d_model
+        self.n_heads = n_heads
+        self.max_len = max_len
         self.src_emb = nn.Embedding(size=[src_vocab, d_model])
         self.tgt_emb = nn.Embedding(size=[tgt_vocab, d_model])
         self.pos_emb = nn.Embedding(size=[max_len, d_model])
@@ -213,6 +289,75 @@ class Transformer(Layer):
             dec = l(dec, enc, causal_bias, src_bias)
         return self.proj(dec)
 
+    # -- incremental decode (prefill + per-token step) -----------------------
+    def prefill(self, src_ids, tgt_ids, pos_src, pos_tgt, causal_bias,
+                cache_len, *rest):
+        """Prefill phase: run the encoder and the prompt through the
+        decoder stack ONCE, populating the per-layer KV ring caches and
+        precomputing the per-layer cross-attention K/V of the encoder
+        output. ``rest`` is L self-K caches, L self-V caches
+        [B, H, C, d] (zeros, capacity C >= prompt length), then an
+        optional src padding bias. Returns (prompt logits [B, P, V],
+        L updated K caches, L updated V caches, L cross-K, L cross-V)."""
+        L = len(self.dec_layers)
+        k_caches, v_caches = rest[:L], rest[L:2 * L]
+        src_bias = rest[2 * L] if len(rest) > 2 * L else None
+        enc = dropout(self._embed(src_ids, self.src_emb, pos_src),
+                      self.dropout_rate, is_test=not self.training)
+        for l in self.enc_layers:
+            enc = l(enc, src_bias)
+        dec = dropout(self._embed(tgt_ids, self.tgt_emb, pos_tgt),
+                      self.dropout_rate, is_test=not self.training)
+        out_k, out_v, cross_k, cross_v = [], [], [], []
+        for l, kc, vc in zip(self.dec_layers, k_caches, v_caches):
+            ck, cv = l.cross_attn._kv_heads(enc)
+            cross_k.append(ck)
+            cross_v.append(cv)
+            dec, k_new, v_new = l.forward_prefill(
+                dec, enc, causal_bias, src_bias, kc, vc, cache_len)
+            out_k.append(k_new)
+            out_v.append(v_new)
+        logits = self.proj(dec)
+        return tuple([logits] + out_k + out_v + cross_k + cross_v)
+
+    def decode_step(self, tok, finished, end_ids, cache_len, *rest):
+        """ONE greedy decode step (q_len=1): embed the incoming token at
+        its absolute position (= cache_len, derived on-device), run the
+        decoder stack against the KV ring caches and precomputed cross
+        K/V, project, argmax, and advance the finished mask. ``rest`` is
+        L cross-K, L cross-V, L self-K caches, L self-V caches, then an
+        optional src padding bias. Returns (next_tok [B, 1] int64,
+        new_len [B] int32, finished' [B, 1] bool, L updated K caches,
+        L updated V caches) — everything a subsequent identical step
+        feeds back, so the step traces exactly once."""
+        L = len(self.dec_layers)
+        cross_k, cross_v = rest[:L], rest[L:2 * L]
+        k_caches, v_caches = rest[2 * L:3 * L], rest[3 * L:4 * L]
+        src_bias = rest[4 * L] if len(rest) > 4 * L else None
+        B = tok.shape[0]
+        # ids with a trailing dim of 1 are squeezed by lookup_table, so a
+        # [B, 1] token would embed to [B, D]; [B, 1, 1] keeps the q_len=1
+        # axis. The position is the pre-update cache length.
+        pos = reshape(cache_len, [B, 1, 1])
+        x = dropout(self._embed(reshape(tok, [B, 1, 1]), self.tgt_emb,
+                                pos),
+                    self.dropout_rate, is_test=not self.training)
+        new_k, new_v, new_len = [], [], None
+        for l, ck, cv, kc, vc in zip(self.dec_layers, cross_k, cross_v,
+                                     k_caches, v_caches):
+            x, k_new, v_new, new_len = l.forward_step(
+                x, ck, cv, kc, vc, cache_len, src_bias)
+            new_k.append(k_new)
+            new_v.append(v_new)
+        logits = self.proj(x)                         # [B, 1, V]
+        (nxt,) = _op("arg_max", {"X": [logits]}, ["Out"], {"axis": -1})
+        (nxt,) = _op("where", {"Condition": [finished], "X": [end_ids],
+                               "Y": [nxt]}, ["Out"])
+        (is_end,) = _op("equal", {"X": [nxt], "Y": [end_ids]}, ["Out"])
+        (fin,) = _op("logical_or", {"X": [finished], "Y": [is_end]},
+                     ["Out"])
+        return tuple([nxt, new_len, fin] + new_k + new_v)
+
 
 def make_causal_bias(seq_len):
     m = np.triu(np.full((seq_len, seq_len), -1e4, np.float32), k=1)
@@ -236,3 +381,215 @@ def synthetic_batch(src_vocab, tgt_vocab, batch, seq_len, seed=0):
     labels = rng.randint(1, tgt_vocab, (batch, seq_len, 1)).astype("int64")
     pos = np.tile(np.arange(seq_len, dtype="int64"), (batch, 1))
     return src, tgt, labels, pos
+
+
+# ---------------------------------------------------------------------------
+# Incremental decode sessions: trace (prefill, decode) once, reuse per token.
+# ---------------------------------------------------------------------------
+
+_M_DECODE_STEPS = monitor.counter(
+    "decode_steps_total", "decode-program steps dispatched")
+_M_DECODE_SECONDS = monitor.histogram(
+    "decode_step_seconds", "per-token decode dispatch latency (async: "
+    "excludes device sync, which happens once per generation)")
+_M_DECODE_CACHE = monitor.gauge(
+    "decode_cache_tokens", "live KV-cache tokens across the batch after "
+    "the last generation (sum of min(len, capacity))")
+
+
+class _MethodShim(Layer):
+    """Expose a bound model METHOD as a traceable Layer: jit.trace calls
+    ``layer(*inputs)`` and walks ``layer.named_parameters()``, both of
+    which resolve through the wrapped model."""
+
+    def __init__(self, model, method):
+        super().__init__()
+        self.model = model          # __setattr__ registers the sublayer
+        self._method = method
+
+    def forward(self, *inputs):
+        return getattr(self.model, self._method)(*inputs)
+
+
+def run_cached_phases(exe, scope, phase1, feed1, fetch1, phase2, feed2,
+                      fetch2, bridge, return_numpy=True):
+    """Split-inference skeleton: run ``phase1`` ONCE, then run ``phase2``
+    fed phase-1 fetches that never leave the device (return_numpy=False
+    pass-through) — the expensive phase-1 computation is hoisted out of
+    whatever loop drives phase 2. ``bridge`` maps phase-2 feed name ->
+    phase-1 fetch index. Shared by the transformer prefill->decode pair
+    and the seq2seq encoder->beam-decode split
+    (models/seq2seq.py run_split_infer)."""
+    outs = exe.run(phase1, feed=feed1, fetch_list=fetch1, scope=scope,
+                   return_numpy=False)
+    feed = dict(feed2 or {})
+    for name, idx in bridge.items():
+        feed[name] = outs[idx]
+    return exe.run(phase2, feed=feed, fetch_list=fetch2, scope=scope,
+                   return_numpy=return_numpy)
+
+
+def build_decode_session(model, batch_size, src_len, prompt_len,
+                         cache_capacity, end_id=1, use_compiled=True):
+    """Trace ``model``'s (prefill, decode_step) pair at FIXED shapes and
+    wrap them in a DecodeSession. Must run under fluid.dygraph.guard();
+    puts the model in eval() mode (decode is inference-only — the
+    traced programs carry no dropout ops)."""
+    from paddle_tpu.fluid import dygraph
+    from paddle_tpu.fluid.executor import Scope
+
+    if cache_capacity < prompt_len:
+        raise ValueError(
+            "cache_capacity=%d < prompt_len=%d: the prefill write would "
+            "cross the ring boundary" % (cache_capacity, prompt_len))
+    model.eval()
+    L = len(model.dec_layers)
+    B, H = int(batch_size), model.n_heads
+    d = model.d_model // model.n_heads
+    C = int(cache_capacity)
+
+    def zero_caches():
+        return [np.zeros((B, H, C, d), np.float32) for _ in range(2 * L)]
+
+    prefill_in = [
+        np.zeros((B, src_len), np.int64),
+        np.zeros((B, prompt_len), np.int64),
+        np.tile(np.arange(src_len, dtype=np.int64), (B, 1)),
+        np.tile(np.arange(prompt_len, dtype=np.int64), (B, 1)),
+        make_causal_bias(prompt_len),
+        np.zeros((B,), np.int32),
+    ] + zero_caches()
+    _, prefill_tl = dygraph.jit.trace(_MethodShim(model, "prefill"),
+                                      prefill_in)
+
+    # the decode boundary is int32-native: fetched tokens/lengths come
+    # back as int32 jax.Arrays (x64 is disabled) and feed straight back
+    # in, so the feed signature — and therefore the compile-cache key —
+    # is identical from the first step to the last
+    decode_in = [
+        np.zeros((B, 1), np.int32),
+        np.zeros((B, 1), bool),
+        np.array([end_id], np.int32),
+        np.full((B,), prompt_len, np.int32),
+    ] + [np.zeros((B, H, src_len, d), np.float32)
+         for _ in range(2 * L)] + zero_caches()
+    _, decode_tl = dygraph.jit.trace(_MethodShim(model, "decode_step"),
+                                     decode_in)
+
+    scope = Scope()
+    for _, p in model.named_parameters():
+        # The executor donates the state buffers to XLA on every run, so the
+        # scope must own its copies — sharing ``p._ivar`` directly would
+        # delete the eager model's parameter arrays on the first step.
+        scope.set_var(p.name, jnp.array(p._ivar, copy=True))
+    return DecodeSession(prefill_tl, decode_tl, scope, n_layers=L,
+                         batch_size=B, src_len=src_len,
+                         prompt_len=prompt_len, cache_capacity=C,
+                         n_heads=H, d_key=d, end_id=end_id,
+                         use_compiled=use_compiled)
+
+
+class DecodeSession:
+    """Batched greedy autoregressive decoding over a traced (prefill,
+    decode) program pair sharing one parameter scope.
+
+    The decode program's feeds and fetches are shape-closed: every fetch
+    (next token, per-sequence lengths, finished mask, updated ring
+    caches) feeds straight back in as a ``jax.Array`` with an identical
+    signature, so an N-token generation costs exactly TWO executor
+    compiles (one prefill, one decode) and zero per-token host syncs —
+    tokens materialize once, after the last step. Per-sequence lengths
+    and the finished mask make batch slots independent: a finished slot
+    keeps emitting end_id and can be re-prefixed by a later prefill
+    (the continuous-batching hook for the serving tier)."""
+
+    def __init__(self, prefill_tl, decode_tl, scope, n_layers, batch_size,
+                 src_len, prompt_len, cache_capacity, n_heads, d_key,
+                 end_id, use_compiled=True):
+        self._exe = fluid.Executor()
+        self.scope = scope
+        self._L = n_layers
+        self.batch_size = batch_size
+        self.src_len = src_len
+        self.prompt_len = prompt_len
+        self.cache_capacity = cache_capacity
+        self.end_id = int(end_id)
+        self._prefill_feeds = list(prefill_tl._feed_names)
+        self._prefill_fetches = list(prefill_tl._fetch_names)
+        self._decode_feeds = list(decode_tl._feed_names)
+        self._decode_fetches = list(decode_tl._fetch_names)
+        if use_compiled:
+            self.prefill_program = fluid.CompiledProgram(prefill_tl.program)
+            self.decode_program = fluid.CompiledProgram(decode_tl.program)
+        else:
+            self.prefill_program = prefill_tl.program
+            self.decode_program = decode_tl.program
+        B, H, C, d = batch_size, n_heads, cache_capacity, d_key
+        self._zero_caches = [np.zeros((B, H, C, d), np.float32)
+                             for _ in range(2 * n_layers)]
+        self._pos_src = np.tile(np.arange(src_len, dtype=np.int64), (B, 1))
+        self._pos_tgt = np.tile(np.arange(prompt_len, dtype=np.int64),
+                                (B, 1))
+        self._causal = make_causal_bias(prompt_len)
+        self._end_ids = np.array([self.end_id], np.int32)
+
+    def generate(self, src, prompt, prompt_lens, max_new_tokens):
+        """Greedy-decode ``max_new_tokens`` tokens per sequence.
+
+        src [B, src_len] int64; prompt [B, prompt_len] int64 right-padded
+        (first token is the GO symbol); prompt_lens [B] = true prompt
+        lengths (pad slots are masked out of attention and overwritten
+        by later decode writes). Returns (tokens [B, max_new_tokens]
+        int64, finished [B] bool)."""
+        B, L = self.batch_size, self._L
+        src = np.ascontiguousarray(src, np.int64)
+        prompt = np.ascontiguousarray(prompt, np.int64)
+        plens = np.asarray(prompt_lens, np.int64).reshape(B)
+        if src.shape != (B, self.src_len) or \
+                prompt.shape != (B, self.prompt_len):
+            raise ValueError(
+                "shape mismatch: session traced for src %s / prompt %s, "
+                "got %s / %s — pad or re-trace" %
+                ((B, self.src_len), (B, self.prompt_len), src.shape,
+                 prompt.shape))
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if plens.min() < 1 or plens.max() > self.prompt_len:
+            raise ValueError("prompt_lens must be in [1, %d]"
+                             % self.prompt_len)
+
+        feed = dict(zip(self._prefill_feeds,
+                        [src, prompt, self._pos_src, self._pos_tgt,
+                         self._causal, np.zeros((B,), np.int32)]
+                        + self._zero_caches))
+        outs = self._exe.run(self.prefill_program, feed=feed,
+                             fetch_list=self._prefill_fetches,
+                             scope=self.scope, return_numpy=False)
+        logits = np.asarray(outs[0])                  # [B, P, V]
+        kc, vc = outs[1:1 + L], outs[1 + L:1 + 2 * L]
+        cross = outs[1 + 2 * L:1 + 4 * L]
+
+        first = logits[np.arange(B), plens - 1, :].argmax(-1)
+        tok = first.astype(np.int32)[:, None]
+        finished = tok == self.end_id
+        cache_len = plens.astype(np.int32)
+        toks = [tok]
+        for _ in range(max_new_tokens - 1):
+            t0 = time.perf_counter()
+            feed = dict(zip(self._decode_feeds,
+                            [tok, finished, self._end_ids, cache_len]
+                            + list(cross) + list(kc) + list(vc)))
+            outs = self._exe.run(self.decode_program, feed=feed,
+                                 fetch_list=self._decode_fetches,
+                                 scope=self.scope, return_numpy=False)
+            tok, cache_len, finished = outs[0], outs[1], outs[2]
+            kc, vc = outs[3:3 + L], outs[3 + L:3 + 2 * L]
+            toks.append(tok)
+            _M_DECODE_STEPS.inc()
+            _M_DECODE_SECONDS.observe(time.perf_counter() - t0)
+        # host-side bookkeeping, no device sync: total tokens resident in
+        # the ring after this generation
+        _M_DECODE_CACHE.set(float(np.minimum(
+            plens + max_new_tokens, self.cache_capacity).sum()))
+        tokens = np.concatenate([np.asarray(t) for t in toks], axis=1)
+        return tokens, np.asarray(finished).reshape(B)

@@ -43,6 +43,10 @@ def pytest_configure(config):
         "markers", "elastic: exercises the elastic launcher path "
                    "(preemption drain, gang reformation, hung-step "
                    "watchdog) — spawns worker subprocesses")
+    config.addinivalue_line(
+        "markers", "decode: exercises the autoregressive KV-cache "
+                   "decode fast path (prefill/decode program pair, "
+                   "cache-aware attention)")
 
 
 @pytest.fixture(autouse=True)
