@@ -564,6 +564,119 @@ def bench_transformer_decode(batch_sizes=(1, 64), src_len=128,
     return out
 
 
+def bench_serve(n_clients=64, per_client=8, max_batch_size=16,
+                max_queue_delay_ms=1.0, max_req_rows=4):
+    """Closed-loop serving-tier load bench (opt-in BENCH_SERVE=1):
+    ``n_clients`` threads submit mixed-size requests through the
+    dynamic batcher vs. the same request stream through one serialized
+    predictor. Reports req/s for both, mean batch occupancy, p50/p99
+    latency from the monitor histograms — and asserts the bucket-ladder
+    compile bound: after warm-up the recompile counter NEVER moves, no
+    matter how many request sizes the stream mixes."""
+    import tempfile
+    import threading
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import inference
+    from paddle_tpu.fluid import layers, monitor
+    from paddle_tpu.inference import ServeConfig, Server
+
+    tmp = tempfile.mkdtemp(prefix="bench_serve_")
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 0
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[32], dtype="float32")
+        h = layers.fc(x, size=64, act="relu")
+        prob = layers.softmax(layers.fc(h, size=8))
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_inference_model(tmp, ["x"], [prob], exe,
+                                      main_program=main)
+
+    rng = np.random.RandomState(0)
+    reqs = [rng.rand(rng.randint(1, max_req_rows + 1), 32)
+            .astype(np.float32) for _ in range(n_clients * per_client)]
+    total_rows = sum(r.shape[0] for r in reqs)
+
+    # serialized baseline: same stream, one request per dispatch, its
+    # own predictor warmed over the same ladder (compiles out of the
+    # timed window for both sides)
+    base = inference.create_predictor(inference.Config(tmp))
+    cfg = ServeConfig(max_batch_size=max_batch_size,
+                      max_queue_delay_ms=max_queue_delay_ms,
+                      max_queue_depth=4 * n_clients)
+    # the serial path sees raw request sizes (no bucketing), so warm
+    # every size it will serve — compiles stay out of both timed windows
+    for b in sorted(set(cfg.ladder()) | set(range(1, max_req_rows + 1))):
+        base.run({"x": np.zeros((b, 32), np.float32)})
+    t0 = time.perf_counter()
+    for r in reqs:
+        base.run({"x": r})
+    t_serial = time.perf_counter() - t0
+
+    pred = inference.create_predictor(inference.Config(tmp))
+    results = {"errors": []}
+    with Server() as srv:
+        ladder = srv.register("bench", pred, config=cfg,
+                              warmup_feed={"x": reqs[0][:1]})
+        assert len(pred._seen_sigs) == len(ladder), (
+            "warm-up must pre-compile exactly the ladder")
+        recompiles0 = monitor.counter(
+            "predictor_shape_recompile_total").value
+
+        def client(cid):
+            try:
+                for i in range(per_client):
+                    r = reqs[cid * per_client + i]
+                    out = srv.submit("bench",
+                                     {"x": r}).result(timeout=120)
+                    assert out[0].shape == (r.shape[0], 8)
+            except BaseException as e:  # surfaced after join
+                results["errors"].append(e)
+
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(n_clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(300)
+        t_served = time.perf_counter() - t0
+        assert not results["errors"], results["errors"][:3]
+        assert len(pred._seen_sigs) == len(ladder), (
+            "mixed-size stream grew the signature set past the ladder")
+        assert monitor.counter(
+            "predictor_shape_recompile_total").value == recompiles0, (
+            "mixed-size stream recompiled after warm-up")
+
+    lbl = {"model": "bench"}
+    occ = monitor.get_metric("serving_batch_occupancy", labels=lbl)
+    e2e = monitor.get_metric("serving_request_seconds", labels=lbl)
+    wait = monitor.get_metric("serving_queue_wait_seconds", labels=lbl)
+    n = len(reqs)
+    return {
+        "serve_requests_per_sec": round(n / t_served, 1),
+        "serve_serial_requests_per_sec": round(n / t_serial, 1),
+        "serve_speedup_vs_serial": round(t_serial / t_served, 3),
+        "serve_rows_per_sec": round(total_rows / t_served, 1),
+        "serve_mean_batch_occupancy": round(occ.sum / max(occ.count, 1), 4),
+        "serve_batches": monitor.get_metric("serving_batches_total",
+                                            labels=lbl).value,
+        "serve_requests": n,
+        "serve_p50_latency_ms": round(1e3 * (e2e.quantile(0.5) or 0), 3),
+        "serve_p99_latency_ms": round(1e3 * (e2e.quantile(0.99) or 0), 3),
+        "serve_p99_queue_wait_ms": round(1e3 * (wait.quantile(0.99) or 0),
+                                         3),
+        "serve_shed": monitor.get_metric("serving_shed_total",
+                                         labels=lbl).value,
+        "serve_bucket_ladder": ladder,
+        "serve_clients": n_clients,
+        "serve_max_batch_size": max_batch_size,
+    }
+
+
 def monitor_summary():
     """Framework-counter sub-dict for the JSON line (fluid/monitor.py):
     the same counters a production scrape would see, so BENCH_r0x.json
@@ -604,7 +717,24 @@ def monitor_summary():
         if dec_cache is not None else 0.0,
         "decode_step_seconds_sum": round(dec_hist.sum, 3)
         if dec_hist is not None else 0.0,
+        # serving tier: coalescing + admission across ALL hosted models
+        # (the per-model labeled series stay in dump_prometheus)
+        "serving_requests_total": _sum_labeled("serving_requests_total"),
+        "serving_batches_total": _sum_labeled("serving_batches_total"),
+        "serving_shed_total": _sum_labeled("serving_shed_total"),
+        "decode_slot_joins_total":
+            monitor.counter("decode_slot_join_total").value,
+        "decode_slot_retires_total":
+            monitor.counter("decode_slot_retire_total").value,
     }
+
+
+def _sum_labeled(name):
+    """Sum a counter across every label set it was registered under."""
+    from paddle_tpu.fluid import monitor
+
+    return sum(m.value for (n, _), m in monitor._REGISTRY.items()
+               if n == name and hasattr(m, "value"))
 
 
 def bench_smoke():
@@ -676,7 +806,17 @@ def bench_smoke():
     assert m2 == m1, "decode smoke: repeat generation retraced"
     assert (toks == toks2).all(), "decode smoke: non-deterministic"
 
+    # tiny serving loop: 8 client threads through the dynamic batcher —
+    # every future must resolve and the stream must coalesce
+    serve = bench_serve(n_clients=8, per_client=2, max_batch_size=4,
+                        max_queue_delay_ms=2.0, max_req_rows=2)
+    assert serve["serve_batches"] < serve["serve_requests"], (
+        "serve smoke: no coalescing happened")
+
     return {
+        "serve_smoke_requests_per_sec": serve["serve_requests_per_sec"],
+        "serve_smoke_mean_batch_occupancy":
+            serve["serve_mean_batch_occupancy"],
         "metric": "smoke_async_pipeline_seconds",
         "value": round(time.perf_counter() - t0, 3),
         "unit": "seconds",
@@ -715,6 +855,8 @@ if __name__ == "__main__":
         out.update(bench_transformer())
     if os.environ.get("BENCH_DECODE") == "1":
         out.update(bench_transformer_decode())
+    if os.environ.get("BENCH_SERVE") == "1":
+        out.update(bench_serve())
     if os.environ.get("BENCH_LONGSEQ") == "1":
         out.update(bench_longseq())
         out.update(bench_longseq(batch_size=4, seq_len=4096,

@@ -190,6 +190,34 @@ class Histogram(_Metric):
     def sum(self):
         return self._sum
 
+    def quantile(self, q):
+        """Approximate q-quantile (0 <= q <= 1) interpolated from the
+        fixed buckets (the ``histogram_quantile`` estimate a Prometheus
+        scrape would compute), clamped to the observed min/max so tight
+        distributions don't report a whole bucket's width of error.
+        Values landing in the +Inf overflow bucket report the observed
+        max. Returns None while the histogram is empty."""
+        if not 0.0 <= float(q) <= 1.0:
+            raise ValueError("quantile q must be in [0, 1], got %r" % (q,))
+        with self._lock:
+            total = self._count
+            counts = list(self._counts)
+            mn, mx = self._min, self._max
+        if not total:
+            return None
+        target = float(q) * total
+        if target <= 0:
+            return mn
+        acc, prev = 0, 0.0
+        for le, c in zip(self.buckets, counts):
+            if c and acc + c >= target:
+                lo = prev if mn is None else max(prev, min(mn, le))
+                hi = le if mx is None else max(lo, min(le, mx))
+                return lo + (hi - lo) * (target - acc) / c
+            acc += c
+            prev = le
+        return mx  # overflow bucket: the best bounded answer available
+
     def cumulative_buckets(self):
         """[(upper_bound, cumulative_count), ...] ending with +Inf —
         the Prometheus histogram series shape."""

@@ -25,7 +25,7 @@ import time
 
 from . import monitor as _monitor
 
-__all__ = ["TransientError", "CircuitOpenError", "Retry",
+__all__ = ["TransientError", "CircuitOpenError", "Overloaded", "Retry",
            "CircuitBreaker", "RestartBackoff", "backoff_delay"]
 
 def _site_counters(site):
@@ -52,6 +52,15 @@ class TransientError(Exception):
 class CircuitOpenError(RuntimeError):
     """The circuit breaker is open: calls are short-circuited without
     touching the protected resource until the reset timeout elapses."""
+
+
+class Overloaded(RuntimeError):
+    """Admission control shed this request: the protected queue is at
+    its depth bound (or the admission breaker is open after consecutive
+    over-bound submissions). Unlike ``TransientError`` this is NOT
+    retried blindly by ``Retry`` defaults — the correct client response
+    is to back off, not to hammer an already-saturated server. Raised
+    by ``inference.serving`` ``submit``; carries no partial state."""
 
 
 def backoff_delay(attempt, base=0.1, factor=2.0, max_delay=30.0,

@@ -19,7 +19,8 @@ from .. import fluid
 from ..fluid import monitor as _monitor
 
 __all__ = ["Config", "Predictor", "create_predictor", "PredictorPool",
-           "GenerativePredictor"]
+           "GenerativePredictor", "Server", "GenerativeServer",
+           "ServeConfig", "Overloaded", "Future"]
 
 _M_RUNS = _monitor.counter(
     "predictor_runs_total", help="Predictor.run calls served")
@@ -31,6 +32,10 @@ _M_RECOMPILES = _monitor.counter(
     help="Predictor.run calls whose input shapes/dtypes differed from "
          "every signature this predictor served before (each costs an "
          "XLA recompile — pad/bucket inputs to avoid)")
+_M_BF16_CASTS = _monitor.counter(
+    "predictor_bf16_cast_total",
+    help="parameter variables cast float32 -> bfloat16 at predictor "
+         "load (Config.enable_bf16)")
 
 
 class Config:
@@ -94,6 +99,7 @@ class Predictor:
             self._fetch_vars = fetches
         self._exe = exe
         self._input_data = {}
+        self._outputs = None
         self._seen_sigs = set()
 
     def _cast_params_bf16(self, scope):
@@ -101,8 +107,14 @@ class Predictor:
 
         for name in list(scope.vars):
             v = scope.vars[name]
-            if hasattr(v, "dtype") and np.dtype(v.dtype) == np.float32:
-                scope.vars[name] = jnp.asarray(v).astype(jnp.bfloat16)
+            if not hasattr(v, "dtype"):
+                continue  # scalars/py objects stay as-is
+            dt = np.dtype(v.dtype)
+            if dt.kind != "f" or dt != np.float32:
+                continue  # int/bool vars (and already-low-precision
+                # floats) must keep their dtype — only f32 params cast
+            scope.vars[name] = jnp.asarray(v).astype(jnp.bfloat16)
+            _M_BF16_CASTS.inc()
 
     # -- handle-style API (reference GetInputHandle / ZeroCopyTensor) ------
     def get_input_names(self):
@@ -122,6 +134,7 @@ class Predictor:
     def run(self, feed=None):
         """feed: {name: ndarray} (or pre-staged via input handles).
         Returns the fetch values as numpy arrays."""
+        handle_fed = not feed
         feed = dict(feed or self._input_data)
         missing = [n for n in self._feed_names if n not in feed]
         if missing:
@@ -134,12 +147,19 @@ class Predictor:
                 _M_RECOMPILES.inc()
             self._seen_sigs.add(sig)
         t0 = _time.perf_counter()
-        with fluid.scope_guard(self._scope):
-            outs = self._exe.run(self._program, feed=feed,
-                                 fetch_list=self._fetch_vars)
+        # scope passed explicitly: scope_guard mutates a process-global
+        # stack, so two serving threads running predictors concurrently
+        # could resolve each other's scopes through it
+        outs = self._exe.run(self._program, feed=feed,
+                             fetch_list=self._fetch_vars,
+                             scope=self._scope)
         _M_LATENCY.observe(_time.perf_counter() - t0)
         _M_RUNS.inc()
         self._outputs = outs
+        if handle_fed:
+            # staged handle inputs are consumed by the run — a later run
+            # must not silently reuse last request's tensors
+            self._input_data = {}
         return outs
 
     def clone(self):
@@ -164,6 +184,10 @@ class _TensorHandle:
         self._p._input_data[self._name] = np.asarray(arr)
 
     def copy_to_cpu(self):
+        if self._p._outputs is None:
+            raise RuntimeError(
+                "run() has not been called: stage inputs with "
+                "copy_from_cpu, call predictor.run(), then read outputs")
         names = self._p.get_output_names()
         return np.asarray(self._p._outputs[names.index(self._name)])
 
@@ -185,20 +209,26 @@ class GenerativePredictor:
     exactly one prefill compile plus one decode compile, ever."""
 
     def __init__(self, model, batch_size, src_len, prompt_len,
-                 cache_capacity, end_id=1):
+                 cache_capacity, end_id=1, slot_prefill=False):
         from ..fluid import framework
         from ..models.transformer import build_decode_session
 
         if framework._dygraph_tracer() is not None:
             self._session = build_decode_session(
                 model, batch_size, src_len, prompt_len, cache_capacity,
-                end_id=end_id)
+                end_id=end_id, slot_prefill=slot_prefill)
         else:
             with fluid.dygraph.guard():
                 self._session = build_decode_session(
                     model, batch_size, src_len, prompt_len, cache_capacity,
-                    end_id=end_id)
+                    end_id=end_id, slot_prefill=slot_prefill)
         self._seen_sigs = set()
+
+    def open_stream(self):
+        """Continuous-batching stream over this predictor's session
+        (requires ``slot_prefill=True`` at construction) — see
+        ``models.transformer.ContinuousDecodeSession``."""
+        return self._session.open_stream()
 
     def get_input_names(self):
         return ["src", "prompt", "prompt_lens"]
@@ -243,8 +273,27 @@ class PredictorPool:
     """N predictors sharing one weight scope (reference PredictorPool)."""
 
     def __init__(self, config, size=1):
+        if int(size) < 1:
+            raise ValueError(
+                "PredictorPool size must be >= 1, got %r" % (size,))
         first = Predictor(config)
-        self._predictors = [first] + [first.clone() for _ in range(size - 1)]
+        self._predictors = [first] + [first.clone()
+                                      for _ in range(int(size) - 1)]
+
+    def __len__(self):
+        return len(self._predictors)
 
     def retrieve(self, idx):
-        return self._predictors[idx]
+        try:
+            return self._predictors[idx]
+        except IndexError:
+            raise IndexError(
+                "PredictorPool.retrieve(%r): pool holds %d predictor(s), "
+                "valid indices are 0..%d"
+                % (idx, len(self._predictors),
+                   len(self._predictors) - 1)) from None
+
+
+# imported last: serving builds on Predictor/GenerativePredictor above
+from .serving import (Future, GenerativeServer, Overloaded,  # noqa: E402
+                      ServeConfig, Server)

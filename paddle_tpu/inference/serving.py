@@ -1,0 +1,559 @@
+"""In-process serving tier: dynamic request batching over ``Predictor``
+and continuous decode batching over ``DecodeSession`` streams.
+
+The economics: one XLA executable serves ANY batch it was compiled for,
+and per-dispatch overhead (host sync, executor bookkeeping) is paid per
+run, not per row — so throughput scales with batch occupancy while each
+extra signature costs a fresh compile. The serving layer therefore does
+two things to the raw request stream:
+
+1. **Coalesce.** Concurrent clients ``submit(feed)`` into a per-model
+   queue; a batcher thread pops same-signature requests and stacks them
+   into one batch, dispatching when ``max_batch_size`` rows are ready or
+   the oldest request has waited ``max_queue_delay_ms``.
+2. **Bucket.** The stacked batch is padded up to a power-of-two ladder
+   (1, 2, 4, ..., max_batch_size), so the whole request stream maps onto
+   ``len(ladder)`` compile-cache entries no matter how request sizes
+   mix. ``register(..., warmup_feed=...)`` pre-compiles the ladder
+   before traffic arrives.
+
+Admission control: beyond ``max_queue_depth`` waiting rows, ``submit``
+sheds with a typed ``Overloaded`` (fluid.resilience) instead of queueing
+unboundedly; consecutive over-bound submissions trip a CircuitBreaker so
+a saturated server rejects in O(1) without even taking the queue lock's
+depth reading seriously. Everything is observable through fluid.monitor
+(per-model labels): queue-depth gauge, occupancy/wait/latency
+histograms, shed counter.
+
+Generative models get ``GenerativeServer``: slot-level continuous
+batching where a fixed-width decode batch keeps stepping while finished
+slots are retired and queued prompts are prefilled into the vacancies
+(``models.transformer.ContinuousDecodeSession``).
+
+Threading model: client threads only touch the queue + their Future;
+ONE worker thread per registered model owns all device dispatches for
+that model, and a module-level ``_DISPATCH_LOCK`` serializes dispatches
+across models (the CPU/TPU backend is one device — interleaving gains
+nothing and jax dispatch from many threads is contention, not
+parallelism). Workers are daemon threads; ``close()`` joins them and
+rejects any still-queued requests.
+"""
+
+import threading
+import time
+
+import numpy as np
+
+from ..fluid import monitor as _monitor
+from ..fluid.resilience import CircuitBreaker, Overloaded
+
+__all__ = ["Future", "ServeConfig", "Server", "GenerativeServer",
+           "Overloaded"]
+
+# one device underneath every model: serialize executable dispatches
+# process-wide so worker threads don't contend inside jax
+_DISPATCH_LOCK = threading.Lock()
+
+
+def _metrics(model):
+    lbl = {"model": model}
+    return {
+        "requests": _monitor.counter(
+            "serving_requests_total",
+            help="requests accepted into the serving queue",
+            labels=lbl),
+        "shed": _monitor.counter(
+            "serving_shed_total",
+            help="requests shed by admission control (Overloaded)",
+            labels=lbl),
+        "batches": _monitor.counter(
+            "serving_batches_total",
+            help="coalesced batches dispatched", labels=lbl),
+        "depth": _monitor.gauge(
+            "serving_queue_depth",
+            help="rows currently waiting in the serving queue",
+            labels=lbl),
+        "occupancy": _monitor.histogram(
+            "serving_batch_occupancy",
+            help="real rows / padded batch rows per dispatch (1.0 = "
+                 "no padding waste)",
+            labels=lbl,
+            buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)),
+        "wait": _monitor.histogram(
+            "serving_queue_wait_seconds",
+            help="submit -> dispatch queue wait", labels=lbl),
+        "e2e": _monitor.histogram(
+            "serving_request_seconds",
+            help="submit -> future resolved end-to-end latency",
+            labels=lbl),
+    }
+
+
+class Future:
+    """Single-assignment result slot resolved by the batcher thread."""
+
+    def __init__(self):
+        self._ev = threading.Event()
+        self._value = None
+        self._exc = None
+
+    def done(self):
+        return self._ev.is_set()
+
+    def result(self, timeout=None):
+        """Block until resolved; re-raises the worker-side exception if
+        the request failed."""
+        if not self._ev.wait(timeout):
+            raise TimeoutError("serving future not resolved within %r s"
+                               % (timeout,))
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+    def _resolve(self, value):
+        if not self._ev.is_set():
+            self._value = value
+            self._ev.set()
+
+    def _reject(self, exc):
+        if not self._ev.is_set():
+            self._exc = exc
+            self._ev.set()
+
+
+class ServeConfig:
+    """Per-model tuning knobs.
+
+    max_batch_size    dispatch as soon as this many rows share a
+                      signature (also the top of the bucket ladder).
+    max_queue_delay_ms  oldest-request wait bound before a partial batch
+                      dispatches anyway — the latency/occupancy dial.
+    max_queue_depth   admission bound in ROWS; beyond it submit sheds
+                      with Overloaded.
+    pad_value         fill for padding rows (repeat-last-row is used for
+                      the batch dim; pad_value fills trailing feature
+                      dims when bucket_dims pads those).
+    bucket_dims       {feed_name: (dim, ...)} trailing dims to bucket to
+                      the next power of two at submit (batch dim 0 is
+                      always bucketed); leave None to require exact
+                      non-batch shapes per signature.
+    breaker_threshold / breaker_reset_s
+                      consecutive shed count that trips the admission
+                      breaker OPEN, and its hysteresis window.
+    """
+
+    def __init__(self, max_batch_size=8, max_queue_delay_ms=2.0,
+                 max_queue_depth=64, pad_value=0.0, bucket_dims=None,
+                 breaker_threshold=16, breaker_reset_s=0.25):
+        if int(max_batch_size) < 1:
+            raise ValueError("max_batch_size must be >= 1")
+        if int(max_queue_depth) < 1:
+            raise ValueError("max_queue_depth must be >= 1")
+        self.max_batch_size = int(max_batch_size)
+        self.max_queue_delay_ms = float(max_queue_delay_ms)
+        self.max_queue_depth = int(max_queue_depth)
+        self.pad_value = pad_value
+        self.bucket_dims = dict(bucket_dims or {})
+        self.breaker_threshold = int(breaker_threshold)
+        self.breaker_reset_s = float(breaker_reset_s)
+
+    def ladder(self):
+        """The power-of-two batch sizes this model compiles for."""
+        sizes = []
+        b = 1
+        while b < self.max_batch_size:
+            sizes.append(b)
+            b <<= 1
+        sizes.append(self.max_batch_size)
+        return sizes
+
+
+def _pow2ceil(n):
+    return 1 << (int(n) - 1).bit_length() if n > 1 else 1
+
+
+def _bucket_pad(arr, dims, pad_value):
+    """Pad ``arr``'s listed trailing dims up to the next power of two."""
+    arr = np.asarray(arr)
+    pads = [(0, 0)] * arr.ndim
+    changed = False
+    for d in dims:
+        if d == 0:
+            raise ValueError("bucket_dims pads feature dims; the batch "
+                             "dim (0) is always bucketed by the server")
+        want = _pow2ceil(arr.shape[d])
+        if want != arr.shape[d]:
+            pads[d] = (0, want - arr.shape[d])
+            changed = True
+    if not changed:
+        return arr
+    return np.pad(arr, pads, constant_values=pad_value)
+
+
+class _Request:
+    __slots__ = ("feed", "rows", "sig", "future", "t_submit", "extra")
+
+    def __init__(self, feed, rows, sig, extra=None):
+        self.feed = feed
+        self.rows = rows
+        self.sig = sig
+        self.future = Future()
+        self.t_submit = time.perf_counter()
+        self.extra = extra
+
+
+class _ModelEntry:
+    def __init__(self, name, predictor, config):
+        self.name = name
+        self.predictor = predictor
+        self.config = config
+        self.queue = []          # FIFO of _Request
+        self.rows_queued = 0
+        self.lock = threading.Lock()
+        self.cv = threading.Condition(self.lock)
+        self.breaker = CircuitBreaker(
+            failure_threshold=config.breaker_threshold,
+            reset_timeout=config.breaker_reset_s,
+            name="serving:%s" % name)
+        self.metrics = _metrics(name)
+        self.worker = None
+
+
+class Server:
+    """Multi-model dynamic-batching server over ``Predictor``s.
+
+    ::
+
+        srv = Server()
+        srv.register("fc", predictor, config=ServeConfig(max_batch_size=8),
+                     warmup_feed={"x": one_row})
+        fut = srv.submit("fc", {"x": rows})     # any client thread
+        outs = fut.result(timeout=30)           # numpy fetches, sliced
+        srv.close()
+
+    Requests whose feeds share a post-bucketing signature (same feed
+    names, dtypes, non-batch shapes) coalesce; a request may carry
+    multiple rows (its feeds' common leading dim) as long as it fits
+    ``max_batch_size``.
+    """
+
+    def __init__(self):
+        self._models = {}
+        self._closed = False
+        self._lock = threading.Lock()
+
+    # -- registration ------------------------------------------------------
+    def register(self, name, predictor, config=None, warmup_feed=None):
+        """Host ``predictor`` under ``name``. ``warmup_feed`` is ONE
+        exemplar row ({feed_name: [1, ...] array}); when given, every
+        ladder batch size is dispatched once so the whole bucket ladder
+        is compiled before the first real request."""
+        config = config or ServeConfig()
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("server is closed")
+            if name in self._models:
+                raise ValueError("model %r already registered" % name)
+            entry = _ModelEntry(name, predictor, config)
+            self._models[name] = entry
+        if warmup_feed is not None:
+            self._warmup(entry, warmup_feed)
+        entry.worker = threading.Thread(
+            target=self._worker_loop, args=(entry,),
+            name="serve-%s" % name, daemon=True)
+        entry.worker.start()
+        return entry.config.ladder()
+
+    def _warmup(self, entry, warmup_feed):
+        exemplar = {n: np.asarray(v) for n, v in warmup_feed.items()}
+        for n, v in exemplar.items():
+            if v.ndim < 1 or v.shape[0] != 1:
+                raise ValueError(
+                    "warmup_feed[%r] must be one exemplar row "
+                    "[1, ...], got shape %r" % (n, v.shape))
+        with _DISPATCH_LOCK:
+            for b in entry.config.ladder():
+                feed = {n: np.repeat(_bucket_pad(
+                            v, entry.config.bucket_dims.get(n, ()),
+                            entry.config.pad_value), b, axis=0)
+                        for n, v in exemplar.items()}
+                entry.predictor.run(feed)
+
+    # -- client side -------------------------------------------------------
+    def submit(self, model, feed):
+        """Enqueue one request; returns a ``Future`` resolving to the
+        predictor's fetch list, sliced to this request's rows. Sheds
+        with ``Overloaded`` beyond the admission bound."""
+        entry = self._models[model]
+        cfg, m = entry.config, entry.metrics
+        if not entry.breaker.allow():
+            m["shed"].inc()
+            raise Overloaded(
+                "model %r admission breaker is open (queue saturated); "
+                "back off and retry" % model)
+        feed = {n: _bucket_pad(np.asarray(v),
+                               cfg.bucket_dims.get(n, ()), cfg.pad_value)
+                for n, v in feed.items()}
+        rows = {int(np.shape(v)[0]) for v in feed.values()}
+        if len(rows) != 1:
+            raise ValueError(
+                "all feeds must share one leading (batch) dim; got %r"
+                % {n: np.shape(v) for n, v in feed.items()})
+        rows = rows.pop()
+        if not 1 <= rows <= cfg.max_batch_size:
+            raise ValueError(
+                "request rows must be in [1, max_batch_size=%d], got %d"
+                % (cfg.max_batch_size, rows))
+        sig = tuple(sorted((n, str(v.dtype), v.shape[1:])
+                           for n, v in feed.items()))
+        req = _Request(feed, rows, sig)
+        with entry.cv:
+            if self._closed:
+                raise RuntimeError("server is closed")
+            if entry.rows_queued + rows > cfg.max_queue_depth:
+                entry.breaker.record_failure()
+                m["shed"].inc()
+                raise Overloaded(
+                    "model %r queue is at its depth bound (%d rows "
+                    "waiting, bound %d)" % (model, entry.rows_queued,
+                                            cfg.max_queue_depth))
+            entry.breaker.record_success()
+            entry.queue.append(req)
+            entry.rows_queued += rows
+            m["depth"].set(float(entry.rows_queued))
+            m["requests"].inc()
+            entry.cv.notify()
+        return req.future
+
+    # -- batcher worker ----------------------------------------------------
+    def _worker_loop(self, entry):
+        cfg, m = entry.config, entry.metrics
+        delay = cfg.max_queue_delay_ms / 1000.0
+        while True:
+            with entry.cv:
+                while not entry.queue and not self._closed:
+                    entry.cv.wait(0.1)
+                if self._closed and not entry.queue:
+                    return
+                head = entry.queue[0]
+                deadline = head.t_submit + delay
+                # wait for more same-signature rows until the head's
+                # delay budget is spent or a full batch is ready
+                while True:
+                    avail = sum(r.rows for r in entry.queue
+                                if r.sig == head.sig)
+                    now = time.perf_counter()
+                    if avail >= cfg.max_batch_size or now >= deadline \
+                            or self._closed:
+                        break
+                    entry.cv.wait(deadline - now)
+                batch, total = [], 0
+                rest = []
+                for r in entry.queue:
+                    if r.sig == head.sig and \
+                            total + r.rows <= cfg.max_batch_size:
+                        batch.append(r)
+                        total += r.rows
+                    else:
+                        rest.append(r)
+                entry.queue = rest
+                entry.rows_queued -= total
+                m["depth"].set(float(entry.rows_queued))
+            self._dispatch(entry, batch, total)
+
+    def _dispatch(self, entry, batch, total):
+        cfg, m = entry.config, entry.metrics
+        t0 = time.perf_counter()
+        for r in batch:
+            m["wait"].observe(t0 - r.t_submit)
+        padded = _pow2ceil(total)
+        if padded > cfg.max_batch_size:
+            padded = cfg.max_batch_size
+        try:
+            feed = {}
+            for n in batch[0].feed:
+                stack = np.concatenate([r.feed[n] for r in batch], axis=0)
+                if padded > total:
+                    # repeat the last row: keeps dtype/values in-domain
+                    # (pad_value could be an invalid embedding id)
+                    fill = np.repeat(stack[-1:], padded - total, axis=0)
+                    stack = np.concatenate([stack, fill], axis=0)
+                feed[n] = stack
+            with _DISPATCH_LOCK:
+                outs = entry.predictor.run(feed)
+            outs = [np.asarray(o) for o in outs]
+        except BaseException as e:  # resolve every rider, then keep serving
+            for r in batch:
+                r.future._reject(e)
+            return
+        m["batches"].inc()
+        m["occupancy"].observe(total / float(padded))
+        off = 0
+        t1 = time.perf_counter()
+        for r in batch:
+            sliced = [o[off:off + r.rows] if np.ndim(o) >= 1
+                      and np.shape(o)[0] == padded else o
+                      for o in outs]
+            off += r.rows
+            r.future._resolve(sliced)
+            m["e2e"].observe(t1 - r.t_submit)
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self, timeout=5.0):
+        """Stop the workers; queued-but-undispatched requests are
+        rejected with RuntimeError."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            models = list(self._models.values())
+        for entry in models:
+            with entry.cv:
+                entry.cv.notify_all()
+        for entry in models:
+            if entry.worker is not None:
+                entry.worker.join(timeout)
+        for entry in models:
+            with entry.cv:
+                leftovers, entry.queue = entry.queue, []
+                entry.rows_queued = 0
+                entry.metrics["depth"].set(0.0)
+            for r in leftovers:
+                r.future._reject(RuntimeError("server closed"))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class GenerativeServer:
+    """Continuous-batching server over ONE decode stream: clients
+    ``submit(src, prompt, ...)``; the worker joins waiting prompts into
+    vacant slots of the live decode batch and steps it, resolving each
+    request's future with ``(tokens [n] int64, finished bool)`` as its
+    slot retires — decode occupancy stays high under ragged lengths
+    because the batch never drains to serve a new arrival.
+
+    ``stream`` is a ``ContinuousDecodeSession`` (``DecodeSession.
+    open_stream()`` / ``GenerativePredictor.open_stream()``)."""
+
+    def __init__(self, stream, max_queue_depth=64, breaker_threshold=16,
+                 breaker_reset_s=0.25, model="generative"):
+        self._stream = stream
+        self._name = model
+        self._max_queue_depth = int(max_queue_depth)
+        self._queue = []
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._closed = False
+        self._breaker = CircuitBreaker(
+            failure_threshold=int(breaker_threshold),
+            reset_timeout=float(breaker_reset_s),
+            name="serving:%s" % model)
+        self._m = _metrics(model)
+        self._inflight = {}      # slot -> _Request
+        self._worker = threading.Thread(
+            target=self._loop, name="serve-%s" % model, daemon=True)
+        self._worker.start()
+
+    def submit(self, src, prompt, prompt_len=None, max_new_tokens=8):
+        """One generation request -> Future of (tokens, finished)."""
+        if not self._breaker.allow():
+            self._m["shed"].inc()
+            raise Overloaded(
+                "model %r admission breaker is open (queue saturated); "
+                "back off and retry" % self._name)
+        req = _Request(
+            feed=None, rows=1, sig=None,
+            extra=(np.asarray(src), np.asarray(prompt), prompt_len,
+                   int(max_new_tokens)))
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("server is closed")
+            if len(self._queue) >= self._max_queue_depth:
+                self._breaker.record_failure()
+                self._m["shed"].inc()
+                raise Overloaded(
+                    "model %r queue is at its depth bound (%d waiting, "
+                    "bound %d)" % (self._name, len(self._queue),
+                                   self._max_queue_depth))
+            self._breaker.record_success()
+            self._queue.append(req)
+            self._m["depth"].set(float(len(self._queue)))
+            self._m["requests"].inc()
+            self._cv.notify()
+        return req.future
+
+    def _loop(self):
+        stream, m = self._stream, self._m
+        while True:
+            with self._cv:
+                while not self._queue and not self._inflight \
+                        and not self._closed:
+                    self._cv.wait(0.1)
+                if self._closed and not self._queue and not self._inflight:
+                    return
+                waiting = self._queue
+                self._queue = []
+                m["depth"].set(0.0)
+            try:
+                self._pump(waiting)
+            except BaseException as e:  # fail every rider, keep serving
+                for req in waiting:
+                    if not req.future.done():
+                        req.future._reject(e)
+                for req in self._inflight.values():
+                    req.future._reject(e)
+                self._inflight.clear()
+
+    def _pump(self, waiting):
+        """Join as many waiting requests as there are vacant slots, then
+        step the batch once, resolving retiring slots. Leftover waiting
+        requests go back to the queue head (FIFO preserved)."""
+        stream, m = self._stream, self._m
+        with _DISPATCH_LOCK:
+            while waiting and stream.vacant_slots():
+                req = waiting.pop(0)
+                src, prompt, plen, budget = req.extra
+                m["wait"].observe(time.perf_counter() - req.t_submit)
+                slot, done = stream.join(src, prompt, prompt_len=plen,
+                                         max_new_tokens=budget)
+                if done is not None:    # finished at prefill
+                    req.future._resolve(done)
+                    m["e2e"].observe(time.perf_counter() - req.t_submit)
+                else:
+                    self._inflight[slot] = req
+            completed = stream.step() if self._inflight else []
+        if waiting:
+            with self._cv:
+                self._queue = waiting + self._queue
+                m["depth"].set(float(len(self._queue)))
+        t1 = time.perf_counter()
+        for slot, tokens, finished in completed:
+            req = self._inflight.pop(slot)
+            req.future._resolve((tokens, finished))
+            m["e2e"].observe(t1 - req.t_submit)
+        m["batches"].inc()
+        m["occupancy"].observe(
+            (len(self._inflight) + len(completed)) / float(stream.width))
+
+    def close(self, timeout=5.0):
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            self._cv.notify_all()
+        self._worker.join(timeout)
+        with self._cv:
+            leftovers, self._queue = self._queue, []
+            self._m["depth"].set(0.0)
+        for r in leftovers:
+            r.future._reject(RuntimeError("server closed"))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

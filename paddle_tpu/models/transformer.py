@@ -395,6 +395,17 @@ _M_DECODE_SECONDS = monitor.histogram(
 _M_DECODE_CACHE = monitor.gauge(
     "decode_cache_tokens", "live KV-cache tokens across the batch after "
     "the last generation (sum of min(len, capacity))")
+_M_SLOT_JOIN = monitor.counter(
+    "decode_slot_join_total", "requests prefilled into a vacant slot of "
+    "a live continuous-batching decode stream")
+_M_SLOT_RETIRE = monitor.counter(
+    "decode_slot_retire_total", "continuous-batching slots retired "
+    "(sequence finished or token budget reached)")
+_M_SLOT_OCC = monitor.histogram(
+    "decode_slot_occupancy", "active slots / batch width observed at "
+    "each continuous-batching decode step (1.0 = full batch; drained "
+    "batch-1 decoding sits at 1/width)",
+    buckets=(0.0625, 0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0))
 
 
 class _MethodShim(Layer):
@@ -430,11 +441,18 @@ def run_cached_phases(exe, scope, phase1, feed1, fetch1, phase2, feed2,
 
 
 def build_decode_session(model, batch_size, src_len, prompt_len,
-                         cache_capacity, end_id=1, use_compiled=True):
+                         cache_capacity, end_id=1, use_compiled=True,
+                         slot_prefill=False):
     """Trace ``model``'s (prefill, decode_step) pair at FIXED shapes and
     wrap them in a DecodeSession. Must run under fluid.dygraph.guard();
     puts the model in eval() mode (decode is inference-only — the
-    traced programs carry no dropout ops)."""
+    traced programs carry no dropout ops).
+
+    ``slot_prefill=True`` additionally traces the prefill at batch 1 —
+    the program ``session.open_stream()`` uses to prefill ONE request's
+    prompt into a vacant slot of a live decode batch (continuous
+    batching) without touching the other slots. Three compiles total
+    instead of two; the third is amortized over every mid-stream join."""
     from paddle_tpu.fluid import dygraph
     from paddle_tpu.fluid.executor import Scope
 
@@ -476,6 +494,19 @@ def build_decode_session(model, batch_size, src_len, prompt_len,
     _, decode_tl = dygraph.jit.trace(_MethodShim(model, "decode_step"),
                                      decode_in)
 
+    prefill1_tl = None
+    if slot_prefill:
+        prefill1_in = [
+            np.zeros((1, src_len), np.int64),
+            np.zeros((1, prompt_len), np.int64),
+            np.arange(src_len, dtype=np.int64).reshape(1, -1),
+            np.arange(prompt_len, dtype=np.int64).reshape(1, -1),
+            make_causal_bias(prompt_len),
+            np.zeros((1,), np.int32),
+        ] + [np.zeros((1, H, C, d), np.float32) for _ in range(2 * L)]
+        _, prefill1_tl = dygraph.jit.trace(_MethodShim(model, "prefill"),
+                                           prefill1_in)
+
     scope = Scope()
     for _, p in model.named_parameters():
         # The executor donates the state buffers to XLA on every run, so the
@@ -486,7 +517,7 @@ def build_decode_session(model, batch_size, src_len, prompt_len,
                          batch_size=B, src_len=src_len,
                          prompt_len=prompt_len, cache_capacity=C,
                          n_heads=H, d_key=d, end_id=end_id,
-                         use_compiled=use_compiled)
+                         use_compiled=use_compiled, prefill1_tl=prefill1_tl)
 
 
 class DecodeSession:
@@ -505,7 +536,7 @@ class DecodeSession:
 
     def __init__(self, prefill_tl, decode_tl, scope, n_layers, batch_size,
                  src_len, prompt_len, cache_capacity, n_heads, d_key,
-                 end_id, use_compiled=True):
+                 end_id, use_compiled=True, prefill1_tl=None):
         self._exe = fluid.Executor()
         self.scope = scope
         self._L = n_layers
@@ -514,6 +545,8 @@ class DecodeSession:
         self.prompt_len = prompt_len
         self.cache_capacity = cache_capacity
         self.end_id = int(end_id)
+        self.n_heads = n_heads
+        self.d_key = d_key
         self._prefill_feeds = list(prefill_tl._feed_names)
         self._prefill_fetches = list(prefill_tl._fetch_names)
         self._decode_feeds = list(decode_tl._feed_names)
@@ -524,6 +557,13 @@ class DecodeSession:
         else:
             self.prefill_program = prefill_tl.program
             self.decode_program = decode_tl.program
+        self.prefill1_program = None
+        if prefill1_tl is not None:
+            self._prefill1_feeds = list(prefill1_tl._feed_names)
+            self._prefill1_fetches = list(prefill1_tl._fetch_names)
+            self.prefill1_program = (
+                fluid.CompiledProgram(prefill1_tl.program)
+                if use_compiled else prefill1_tl.program)
         B, H, C, d = batch_size, n_heads, cache_capacity, d_key
         self._zero_caches = [np.zeros((B, H, C, d), np.float32)
                              for _ in range(2 * n_layers)]
@@ -593,3 +633,191 @@ class DecodeSession:
             plens + max_new_tokens, self.cache_capacity).sum()))
         tokens = np.concatenate([np.asarray(t) for t in toks], axis=1)
         return tokens, np.asarray(finished).reshape(B)
+
+    def open_stream(self):
+        """A ``ContinuousDecodeSession`` over this session's programs:
+        a live fixed-width decode batch where requests join vacant slots
+        mid-stream (slot-level prefill) and finished slots retire
+        without draining the batch. Requires the session to have been
+        built with ``slot_prefill=True``."""
+        if self.prefill1_program is None:
+            raise ValueError(
+                "continuous batching needs the batch-1 slot-prefill "
+                "program: build_decode_session(..., slot_prefill=True)")
+        return ContinuousDecodeSession(self)
+
+
+class _SlotState:
+    """Host-side bookkeeping for one active continuous-batching slot."""
+
+    def __init__(self, tokens, budget):
+        self.tokens = tokens        # emitted token ids (ints, grows)
+        self.budget = int(budget)   # max_new_tokens for this request
+
+
+class ContinuousDecodeSession:
+    """Slot-level continuous batching over a (prefill, slot-prefill,
+    decode) program trio: the decode batch is a FIXED width of
+    ``session.batch_size`` slots, each step runs the whole batch through
+    the one compiled decode program, and between steps finished slots
+    are retired while waiting requests' prompts are prefilled into the
+    vacant slots (batch-1 prefill program, K/V scattered into the slot's
+    rows of the live ring caches) — so decode-batch occupancy stays high
+    under ragged generation lengths instead of draining to batch-1.
+
+    Unlike ``DecodeSession.generate`` (zero per-token host syncs, one
+    caller) this syncs the [B,1] token + finished fetches each step —
+    the scheduler must see per-slot completion to retire/join. The big
+    tensors (ring caches, cross K/V) never leave the device; joins and
+    retires touch them only through on-device index updates. Slot rows
+    are mathematically independent through the whole decode program (no
+    cross-batch reductions), so a request's tokens are identical whether
+    it shares the batch or runs alone — asserted in tests.
+
+    Single-threaded by design: ``join``/``step`` dispatch through the
+    session's executor. Serialize externally (inference.serving holds
+    one dispatch lock) if multiple threads drive sessions."""
+
+    def __init__(self, session):
+        s = self._s = session
+        B, H, C, d = (s.batch_size, s.n_heads, s.cache_capacity, s.d_key)
+        L = s._L
+        self._tok = np.full((B, 1), s.end_id, np.int32)
+        self._fin = np.ones((B, 1), bool)
+        # idle slots sit at cache_len=1 over zero caches: attention sees
+        # one all-zero key (finite softmax), and the position embed stays
+        # in range no matter how long the stream runs (re-clamped each
+        # step in _clamp_idle)
+        self._len = np.ones((B,), np.int32)
+        self._kc = [np.zeros((B, H, C, d), np.float32) for _ in range(L)]
+        self._vc = [np.zeros((B, H, C, d), np.float32) for _ in range(L)]
+        self._cross = [np.zeros((B, H, s.src_len, d), np.float32)
+                       for _ in range(2 * L)]
+        self._slots = [None] * B    # _SlotState or None (vacant)
+        self._zero_caches1 = [np.zeros((1, H, C, d), np.float32)
+                              for _ in range(2 * L)]
+        self._pos_src1 = np.arange(s.src_len, dtype=np.int64).reshape(1, -1)
+        self._pos_tgt1 = np.arange(s.prompt_len,
+                                   dtype=np.int64).reshape(1, -1)
+
+    @property
+    def width(self):
+        return self._s.batch_size
+
+    @property
+    def active_count(self):
+        return sum(st is not None for st in self._slots)
+
+    def vacant_slots(self):
+        return [i for i, st in enumerate(self._slots) if st is None]
+
+    def _scatter(self, slot, outs):
+        """Write one request's prefill results into ``slot``'s rows of
+        the live batch state — on-device index updates, the caches never
+        round-trip through the host."""
+        L = self._s._L
+        kc1, vc1 = outs[1:1 + L], outs[1 + L:1 + 2 * L]
+        cross1 = outs[1 + 2 * L:1 + 4 * L]
+        for l in range(L):
+            self._kc[l] = jnp.asarray(self._kc[l]).at[slot].set(
+                jnp.asarray(kc1[l])[0])
+            self._vc[l] = jnp.asarray(self._vc[l]).at[slot].set(
+                jnp.asarray(vc1[l])[0])
+        for i in range(2 * L):
+            self._cross[i] = jnp.asarray(self._cross[i]).at[slot].set(
+                jnp.asarray(cross1[i])[0])
+
+    def join(self, src, prompt, prompt_len=None, max_new_tokens=1):
+        """Prefill ONE request into a vacant slot while the rest of the
+        batch keeps its decode state. src: [src_len] or [1, src_len];
+        prompt likewise. Returns ``(slot, done)`` where ``done`` is None
+        while the request decodes, or ``(tokens [n] int64, finished)``
+        if it completed at join (budget 1, or the first token is
+        end_id). Raises RuntimeError when no slot is vacant — callers
+        queue and retry after a ``step`` retires one."""
+        s = self._s
+        vacant = self.vacant_slots()
+        if not vacant:
+            raise RuntimeError(
+                "no vacant slot (all %d active) — step() until one "
+                "retires" % s.batch_size)
+        if int(max_new_tokens) < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        src = np.ascontiguousarray(src, np.int64).reshape(1, s.src_len)
+        prompt = np.ascontiguousarray(prompt,
+                                      np.int64).reshape(1, s.prompt_len)
+        plen = int(s.prompt_len if prompt_len is None else prompt_len)
+        if not 1 <= plen <= s.prompt_len:
+            raise ValueError("prompt_len must be in [1, %d], got %d"
+                             % (s.prompt_len, plen))
+        slot = vacant[0]
+        feed = dict(zip(s._prefill1_feeds,
+                        [src, prompt, self._pos_src1, self._pos_tgt1,
+                         s._causal, np.zeros((1,), np.int32)]
+                        + self._zero_caches1))
+        outs = s._exe.run(s.prefill1_program, feed=feed,
+                          fetch_list=s._prefill1_fetches, scope=s.scope,
+                          return_numpy=False)
+        first = int(np.asarray(outs[0])[0, plen - 1].argmax())
+        _M_SLOT_JOIN.inc()
+        if int(max_new_tokens) == 1 or first == s.end_id:
+            _M_SLOT_RETIRE.inc()
+            return slot, (np.array([first], np.int64), first == s.end_id)
+        self._scatter(slot, outs)
+        self._tok = jnp.asarray(self._tok).at[slot, 0].set(
+            np.int32(first))
+        self._fin = jnp.asarray(self._fin).at[slot, 0].set(False)
+        self._len = jnp.asarray(self._len).at[slot].set(np.int32(plen))
+        self._slots[slot] = _SlotState([first], max_new_tokens)
+        return slot, None
+
+    def step(self):
+        """ONE decode step of the whole batch. Appends each active
+        slot's new token, retires slots that finished or exhausted their
+        budget, and returns the completions:
+        ``[(slot, tokens [n] int64, finished), ...]``."""
+        s = self._s
+        if self.active_count == 0:
+            raise RuntimeError("step() with no active slot — join first")
+        _M_SLOT_OCC.observe(self.active_count / float(s.batch_size))
+        self._clamp_idle()
+        t0 = time.perf_counter()
+        feed = dict(zip(s._decode_feeds,
+                        [self._tok, self._fin, s._end_ids, self._len]
+                        + list(self._cross) + list(self._kc)
+                        + list(self._vc)))
+        outs = s._exe.run(s.decode_program, feed=feed,
+                          fetch_list=s._decode_fetches, scope=s.scope,
+                          return_numpy=False)
+        L = s._L
+        self._tok, self._len, self._fin = outs[0], outs[1], outs[2]
+        self._kc = list(outs[3:3 + L])
+        self._vc = list(outs[3 + L:3 + 2 * L])
+        _M_DECODE_STEPS.inc()
+        _M_DECODE_SECONDS.observe(time.perf_counter() - t0)
+        tok_np = np.asarray(self._tok)      # [B,1] — the per-step sync
+        fin_np = np.asarray(self._fin)      # the scheduler needs to see
+        completed = []
+        for slot, st in enumerate(self._slots):
+            if st is None:
+                continue
+            st.tokens.append(int(tok_np[slot, 0]))
+            finished = bool(fin_np[slot, 0])
+            if finished or len(st.tokens) >= st.budget:
+                completed.append((slot,
+                                  np.array(st.tokens, np.int64),
+                                  finished))
+                self._slots[slot] = None
+                self._fin = jnp.asarray(self._fin).at[slot, 0].set(True)
+                _M_SLOT_RETIRE.inc()
+        return completed
+
+    def _clamp_idle(self):
+        """Pin idle slots to cache_len=1 before each dispatch so a
+        long-lived stream never walks their position ids past the
+        embedding table (their outputs are discarded; the write keeps
+        the ring slot churn bounded too)."""
+        idle = np.array([st is None for st in self._slots])
+        if idle.any():
+            self._len = jnp.where(jnp.asarray(idle), np.int32(1),
+                                  jnp.asarray(self._len))

@@ -47,6 +47,10 @@ def pytest_configure(config):
         "markers", "decode: exercises the autoregressive KV-cache "
                    "decode fast path (prefill/decode program pair, "
                    "cache-aware attention)")
+    config.addinivalue_line(
+        "markers", "serving: exercises the in-process serving tier "
+                   "(dynamic request batching, bucket ladder, "
+                   "admission control, continuous decode batching)")
 
 
 @pytest.fixture(autouse=True)

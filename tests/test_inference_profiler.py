@@ -339,6 +339,69 @@ def test_dygraph_gperf_routes_through_shared_profiler(tmp_path,
     profiler.reset_profiler()
 
 
+def test_predictor_pool_validates_size(tmp_path):
+    _train_and_save(tmp_path, seed=12)
+    config = inference.Config(str(tmp_path))
+    with pytest.raises(ValueError, match="size must be >= 1"):
+        inference.PredictorPool(config, size=0)
+    pool = inference.PredictorPool(config, size=2)
+    assert len(pool) == 2
+    with pytest.raises(IndexError, match="holds 2 predictor"):
+        pool.retrieve(2)
+
+
+def test_predictor_clone_compile_cache_independent(tmp_path):
+    """clone() shares weights but NOT the seen-signature set: the clone
+    serving a brand-new shape must not count as a recompile against the
+    source (each predictor's first signature is its initial compile)."""
+    from paddle_tpu.fluid import monitor
+
+    _, xv = _train_and_save(tmp_path, seed=13)
+    p1 = inference.Predictor(inference.Config(str(tmp_path)))
+    p2 = p1.clone()
+    monitor.reset()
+    p1.run({"x": xv})        # p1's first signature: initial compile
+    p2.run({"x": xv[:2]})    # p2's first signature — NOT a recompile
+    p1.run({"x": xv})        # repeat signature on p1
+    assert monitor.counter("predictor_shape_recompile_total").value == 0
+    p1.run({"x": xv[:2]})    # new shape for p1 (even though p2 saw it)
+    assert monitor.counter("predictor_shape_recompile_total").value == 1
+
+
+def test_tensor_handle_roundtrip_and_unrun_error(tmp_path):
+    expect, xv = _train_and_save(tmp_path, seed=14)
+    p = inference.create_predictor(inference.Config(str(tmp_path)))
+    out_name = p.get_output_names()[0]
+    with pytest.raises(RuntimeError, match="run\\(\\) has not been called"):
+        p.get_output_handle(out_name).copy_to_cpu()
+    h = p.get_input_handle("x")
+    h.copy_from_cpu(xv)
+    p.run()
+    np.testing.assert_allclose(
+        p.get_output_handle(out_name).copy_to_cpu(), expect, rtol=1e-5)
+    # staged inputs are consumed by the run: a second handle-fed run
+    # must demand fresh copy_from_cpu instead of silently reusing them
+    with pytest.raises(ValueError, match="missing inference feeds"):
+        p.run()
+
+
+def test_bf16_cast_counter(tmp_path):
+    """enable_bf16 is observable: one counter tick per f32 param cast
+    (two fc layers -> 2 weights + 2 biases)."""
+    from paddle_tpu.fluid import monitor
+
+    _train_and_save(tmp_path, seed=15)
+    config = inference.Config(str(tmp_path))
+    config.enable_bf16()
+    monitor.reset()
+    p = inference.create_predictor(config)
+    assert monitor.counter("predictor_bf16_cast_total").value == 4
+    import jax.numpy as jnp
+
+    assert all(np.dtype(v.dtype) == np.dtype(jnp.bfloat16)
+               for v in p._scope.vars.values() if hasattr(v, "dtype"))
+
+
 def test_dropout_inference_scales_by_exact_keep():
     """downgrade_in_infer inference multiplies by EXACT 1-p (reference
     checkpoint parity) while training folds the realized-keep correction

@@ -1,0 +1,330 @@
+"""Serving tier: dynamic request batching (bucket ladder, admission
+control, multi-client coalescing) + continuous decode batching."""
+
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import inference
+from paddle_tpu.fluid import layers, monitor
+from paddle_tpu.inference import Overloaded, ServeConfig, Server
+from paddle_tpu.models.transformer import Transformer, build_decode_session
+
+pytestmark = pytest.mark.serving
+
+
+def _save_fc(tmpdir, seed=21):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[6], dtype="float32")
+        h = layers.fc(x, size=16, act="relu")
+        prob = layers.softmax(layers.fc(h, size=3))
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_inference_model(str(tmpdir), ["x"], [prob], exe,
+                                      main_program=main)
+
+
+def _predictor(tmpdir, **kw):
+    return inference.create_predictor(inference.Config(str(tmpdir)))
+
+
+def test_server_batches_match_direct(tmp_path):
+    """Coalesced+padded batches resolve each future to exactly what a
+    direct per-request Predictor.run would return."""
+    _save_fc(tmp_path)
+    pred = _predictor(tmp_path)
+    direct = _predictor(tmp_path)
+    rng = np.random.RandomState(3)
+    with Server() as srv:
+        srv.register("fc", pred,
+                     config=ServeConfig(max_batch_size=8,
+                                        max_queue_delay_ms=2.0),
+                     warmup_feed={"x": rng.rand(1, 6).astype(np.float32)})
+        feeds = [rng.rand(rng.randint(1, 5), 6).astype(np.float32)
+                 for _ in range(24)]
+        futs = [srv.submit("fc", {"x": f}) for f in feeds]
+        for f, fut in zip(feeds, futs):
+            out = fut.result(timeout=60)
+            assert out[0].shape == (f.shape[0], 3)
+            np.testing.assert_allclose(out[0], direct.run({"x": f})[0],
+                                       atol=1e-5)
+    m = monitor.get_metric("serving_batches_total", labels={"model": "fc"})
+    assert m is not None and m.value >= 1
+
+
+def test_mixed_size_stream_compiles_once_per_bucket(tmp_path):
+    """After warm-up pre-compiles the ladder, the recompile counter must
+    NEVER grow with request count — every request size maps onto an
+    already-compiled bucket."""
+    _save_fc(tmp_path, seed=22)
+    pred = _predictor(tmp_path)
+    rng = np.random.RandomState(4)
+    with Server() as srv:
+        ladder = srv.register(
+            "fc", pred,
+            config=ServeConfig(max_batch_size=8, max_queue_delay_ms=1.0,
+                               max_queue_depth=512),
+            warmup_feed={"x": rng.rand(1, 6).astype(np.float32)})
+        assert ladder == [1, 2, 4, 8]
+        # warm-up = ladder-many signatures; the first is the initial
+        # compile, so the counter sits at len(ladder) - 1
+        assert len(pred._seen_sigs) == len(ladder)
+        before = monitor.counter("predictor_shape_recompile_total").value
+        futs = [srv.submit("fc", {"x": rng.rand(rng.randint(1, 9), 6)
+                                  .astype(np.float32)})
+                for _ in range(40)]
+        for fut in futs:
+            fut.result(timeout=60)
+        assert len(pred._seen_sigs) == len(ladder)
+        assert monitor.counter(
+            "predictor_shape_recompile_total").value == before
+
+
+def test_overload_sheds_with_typed_error(tmp_path):
+    """Beyond max_queue_depth rows, submit sheds instantly with
+    Overloaded; consecutive sheds trip the admission breaker so a
+    saturated server rejects without inspecting the queue."""
+    _save_fc(tmp_path, seed=23)
+    pred = _predictor(tmp_path)
+    rng = np.random.RandomState(5)
+    row = {"x": rng.rand(1, 6).astype(np.float32)}
+    srv = Server()
+    try:
+        # huge delay + batch: the worker holds back, so the queue fills
+        srv.register("fc", pred,
+                     config=ServeConfig(max_batch_size=8,
+                                        max_queue_delay_ms=500.0,
+                                        max_queue_depth=4,
+                                        breaker_threshold=2,
+                                        breaker_reset_s=30.0),
+                     warmup_feed=row)
+        futs = [srv.submit("fc", row) for _ in range(4)]
+        with pytest.raises(Overloaded, match="depth bound"):
+            srv.submit("fc", row)
+        with pytest.raises(Overloaded):
+            srv.submit("fc", row)
+        # breaker tripped by 2 consecutive over-bound submissions
+        with pytest.raises(Overloaded, match="breaker is open"):
+            srv.submit("fc", row)
+        shed = monitor.get_metric("serving_shed_total",
+                                  labels={"model": "fc"})
+        assert shed.value >= 3
+        for fut in futs:  # queued work still completes after the delay
+            fut.result(timeout=60)
+    finally:
+        srv.close()
+
+
+def test_closed_loop_64_clients(tmp_path):
+    """>= 64 concurrent client threads: every future resolves, requests
+    coalesce (strictly fewer batches than requests), queue depth stays
+    bounded, and the latency histograms can answer p50/p99."""
+    _save_fc(tmp_path, seed=24)
+    pred = _predictor(tmp_path)
+    rng = np.random.RandomState(6)
+    xs = [rng.rand(1, 6).astype(np.float32) for _ in range(8)]
+    expect = {i: _predictor(tmp_path).run({"x": x})[0]
+              for i, x in enumerate(xs)}
+    n_clients, per_client = 64, 3
+    errors = []
+    with Server() as srv:
+        srv.register("load", pred,
+                     config=ServeConfig(max_batch_size=16,
+                                        max_queue_delay_ms=4.0,
+                                        max_queue_depth=256),
+                     warmup_feed={"x": xs[0]})
+
+        def client(cid):
+            try:
+                for r in range(per_client):
+                    i = (cid + r) % len(xs)
+                    out = srv.submit("load", {"x": xs[i]}).result(timeout=60)
+                    np.testing.assert_allclose(out[0], expect[i], atol=1e-5)
+            except BaseException as e:  # collected and asserted empty after join
+                errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(n_clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+    assert not errors, errors[:3]
+    lbl = {"model": "load"}
+    reqs = monitor.get_metric("serving_requests_total", labels=lbl).value
+    batches = monitor.get_metric("serving_batches_total", labels=lbl).value
+    assert reqs == n_clients * per_client
+    assert 1 <= batches < reqs  # coalescing actually happened
+    assert monitor.get_metric("serving_queue_depth", labels=lbl).value == 0
+    e2e = monitor.get_metric("serving_request_seconds", labels=lbl)
+    assert e2e.count == reqs
+    p50, p99 = e2e.quantile(0.5), e2e.quantile(0.99)
+    assert 0 < p50 <= p99
+
+
+def test_server_lifecycle_and_validation(tmp_path):
+    _save_fc(tmp_path, seed=25)
+    pred = _predictor(tmp_path)
+    srv = Server()
+    srv.register("fc", pred, config=ServeConfig(max_batch_size=4))
+    with pytest.raises(ValueError, match="already registered"):
+        srv.register("fc", pred)
+    with pytest.raises(ValueError, match="max_batch_size"):
+        srv.submit("fc", {"x": np.zeros((5, 6), np.float32)})
+    with pytest.raises(ValueError, match="leading"):
+        srv.submit("fc", {"x": np.zeros((2, 6), np.float32),
+                          "y": np.zeros((3, 1), np.float32)})
+    srv.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        srv.submit("fc", {"x": np.zeros((1, 6), np.float32)})
+    srv.close()  # idempotent
+
+
+# -- continuous decode batching -------------------------------------------
+
+
+def _decode_fixture(n_req=6, B=4, V=32, S=6, P=4, C=24, seed=0):
+    np.random.seed(seed)
+    with fluid.dygraph.guard():
+        model = Transformer(V, V, d_model=16, n_heads=2, d_inner=32,
+                            n_layers=1, max_len=C + 8, dropout_rate=0.0)
+        sess = build_decode_session(model, B, S, P, C, end_id=1,
+                                    slot_prefill=True)
+    srcs = [np.random.randint(2, V, (S,)).astype(np.int64)
+            for _ in range(n_req)]
+    prompts = [np.random.randint(2, V, (P,)).astype(np.int64)
+               for _ in range(n_req)]
+    return sess, srcs, prompts
+
+
+def _run_solo(sess, src, prompt, budget):
+    st = sess.open_stream()
+    slot, done = st.join(src, prompt, max_new_tokens=budget)
+    if done is not None:
+        return done[0]
+    while True:
+        for s, toks, _fin in st.step():
+            if s == slot:
+                return toks
+
+
+def test_continuous_batching_token_identical():
+    """Requests joining mid-stream into vacant slots of a live decode
+    batch produce TOKEN-IDENTICAL output to running each alone — slot
+    rows never interact inside the decode program."""
+    sess, srcs, prompts = _decode_fixture()
+    budget = 6
+    solo = [_run_solo(sess, s, p, budget) for s, p in zip(srcs, prompts)]
+
+    occ = monitor.histogram("decode_slot_occupancy")
+    joins0 = monitor.counter("decode_slot_join_total").value
+    retires0 = monitor.counter("decode_slot_retire_total").value
+    sum0, count0 = occ.sum, occ.count
+
+    st = sess.open_stream()
+    results, slot_of = {}, {}
+    pending = list(range(len(srcs)))
+
+    def join_next():
+        i = pending.pop(0)
+        slot, done = st.join(srcs[i], prompts[i], max_new_tokens=budget)
+        if done is not None:
+            results[i] = done[0]
+        else:
+            slot_of[slot] = i
+
+    while pending and st.vacant_slots():
+        join_next()
+    steps = 0
+    while len(results) < len(srcs):
+        for slot, toks, _fin in st.step():
+            results[slot_of.pop(slot)] = toks
+            if pending:
+                join_next()       # mid-stream join into the freed slot
+        steps += 1
+        assert steps < 200
+    for i, want in enumerate(solo):
+        np.testing.assert_array_equal(results[i], want)
+
+    n = len(srcs)
+    assert monitor.counter("decode_slot_join_total").value - joins0 == n
+    assert monitor.counter("decode_slot_retire_total").value - retires0 == n
+    # occupancy stayed above drained batch-1 decoding (1/width)
+    d_count = occ.count - count0
+    assert d_count > 0
+    mean_occ = (occ.sum - sum0) / d_count
+    assert mean_occ > 1.0 / st.width
+
+
+def test_stream_requires_slot_prefill():
+    sess, _, _ = _decode_fixture(n_req=0, seed=1)
+    np.random.seed(1)
+    with fluid.dygraph.guard():
+        model = Transformer(32, 32, d_model=16, n_heads=2, d_inner=32,
+                            n_layers=1, max_len=32, dropout_rate=0.0)
+        plain = build_decode_session(model, 2, 6, 4, 24, end_id=1)
+    with pytest.raises(ValueError, match="slot_prefill=True"):
+        plain.open_stream()
+    # the slot_prefill session costs exactly ONE extra trace/compile,
+    # amortized over every later join
+    assert sess.prefill1_program is not None
+
+
+def test_stream_join_validation():
+    sess, srcs, prompts = _decode_fixture(n_req=12, B=2, seed=2)
+    st = sess.open_stream()
+    with pytest.raises(RuntimeError, match="no active slot"):
+        st.step()
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        st.join(srcs[0], prompts[0], max_new_tokens=0)
+    # occupy both slots (a join may legitimately complete at prefill
+    # when the first greedy token is end_id — those leave the slot free)
+    i = 0
+    while st.vacant_slots():
+        assert i < len(srcs), "every request finished at prefill"
+        st.join(srcs[i], prompts[i], max_new_tokens=50)
+        i += 1
+    with pytest.raises(RuntimeError, match="no vacant slot"):
+        st.join(srcs[i], prompts[i], max_new_tokens=50)
+
+
+def test_generative_server_continuous(tmp_path):
+    """GenerativeServer: concurrent clients' generations resolve with
+    the same tokens as solo runs, through one live decode batch."""
+    from paddle_tpu.inference import GenerativeServer
+
+    sess, srcs, prompts = _decode_fixture(n_req=8, seed=3)
+    budget = 6
+    solo = [_run_solo(sess, s, p, budget) for s, p in zip(srcs, prompts)]
+    results, errors = {}, []
+    with GenerativeServer(sess.open_stream(), model="gen-test") as srv:
+
+        def client(i):
+            try:
+                toks, _fin = srv.submit(
+                    srcs[i], prompts[i],
+                    max_new_tokens=budget).result(timeout=120)
+                results[i] = toks
+            except BaseException as e:  # collected and asserted empty after join
+                errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(len(srcs))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(180)
+    assert not errors, errors[:3]
+    for i, want in enumerate(solo):
+        np.testing.assert_array_equal(results[i], want)
+    lbl = {"model": "gen-test"}
+    assert monitor.get_metric("serving_requests_total",
+                              labels=lbl).value == len(srcs)
+    e2e = monitor.get_metric("serving_request_seconds", labels=lbl)
+    assert e2e.count == len(srcs) and e2e.quantile(0.99) > 0
