@@ -77,7 +77,17 @@ def init_parallel_env(ndev_per_proc=None):
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
         if ndev_per_proc is None:
             ndev_per_proc = _env_int("PADDLE_LOCAL_DEVICES", 1)
-        jax.config.update("jax_num_cpu_devices", int(ndev_per_proc))
+        try:
+            jax.config.update("jax_num_cpu_devices", int(ndev_per_proc))
+        except AttributeError:
+            # jax builds without the config option take the device count
+            # from XLA_FLAGS; only effective before backend init, which
+            # holds here — workers call this before touching devices
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count=%d"
+                    % int(ndev_per_proc)).strip()
     coordinator = eps[0] if eps else "127.0.0.1:12765"
     jax.distributed.initialize(
         coordinator_address=coordinator,

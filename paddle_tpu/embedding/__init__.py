@@ -1,0 +1,95 @@
+"""paddle_tpu.embedding — the sparse embedding engine.
+
+Two residence tiers behind one API (ROADMAP "planet-scale embeddings"; the
+reference's PSLib/Downpour-style large-vocabulary capability, SURVEY §2.5):
+
+* ``ShardedEmbeddingTable`` — rows live in HBM as a device parameter
+  sharded over a mesh axis; lookups dedup unique ids then gather only
+  unique rows, and the backward/optimizer applies a fused scatter-add
+  row-sparse update (momentum/Adam slots included) with O(#lookups) work.
+* ``HostEmbeddingTable`` — the table lives in host RAM behind a
+  fixed-budget HBM row cache with async prefetch-on-lookup, write-back of
+  updated rows, and LRU/TTL eviction for dynamic vocabularies. Vocabulary
+  growth never retraces the device program.
+
+``fluid.layers.embedding(is_sparse=True)`` routes onto the engine: the
+device tier by default, the host tier when a ``HostEmbeddingTable`` is
+registered under the embedding's param name (or ``residence="host"``).
+Monitor series: ``embedding_lookup_seconds``, ``embedding_unique_ratio``,
+``embedding_prefetch_{hit,miss}_total``, ``embedding_evictions_total``,
+``embedding_resident_rows``.
+"""
+
+from . import lookup, metrics  # noqa: F401
+from .host import HostEmbeddingTable, HostLookupBinding  # noqa: F401
+from .sharded import ShardedEmbeddingTable  # noqa: F401
+from .lookup import (  # noqa: F401
+    find_distributed_lookup_table,
+    find_distributed_lookup_table_inputs,
+    find_distributed_lookup_table_outputs,
+    find_host_lookup_ops,
+    find_sparse_lookup_ops,
+    is_sparse_lookup,
+)
+
+__all__ = [
+    "HostEmbeddingTable", "ShardedEmbeddingTable", "register_host_table",
+    "get_host_table", "has_host_table", "reset_tables", "prepare_feed",
+    "prefetch", "find_sparse_lookup_ops", "find_host_lookup_ops",
+    "is_sparse_lookup", "find_distributed_lookup_table",
+    "find_distributed_lookup_table_inputs",
+    "find_distributed_lookup_table_outputs",
+]
+
+_HOST_TABLES = {}
+
+
+def register_host_table(table):
+    """Register a HostEmbeddingTable under its name (done by the
+    constructor). ``layers.embedding`` auto-routes a sparse lookup whose
+    param name matches onto the host tier."""
+    prev = _HOST_TABLES.get(table.name)
+    if prev is not None and prev is not table:
+        raise ValueError(
+            "a host embedding table named %r is already registered — "
+            "reset_tables() between model builds, or pick another name"
+            % table.name)
+    _HOST_TABLES[table.name] = table
+    return table
+
+
+def get_host_table(name):
+    t = _HOST_TABLES.get(name)
+    if t is None:
+        raise KeyError(
+            "no host embedding table registered under %r — construct a "
+            "HostEmbeddingTable before building the program" % name)
+    return t
+
+
+def has_host_table(name):
+    return name in _HOST_TABLES
+
+
+def reset_tables():
+    """Close and forget every registered host table (test isolation)."""
+    for t in list(_HOST_TABLES.values()):
+        t.close()
+    _HOST_TABLES.clear()
+
+
+def prepare_feed(program, feed, scope, iters=1):
+    """Executor hook: before a step (or iters=k window) dispatches, every
+    host-tier binding on ``program`` maps its raw-ids feed onto resident
+    cache slots (staging/evicting as needed) and injects the
+    ``<table>@SLOTS`` feed. No-op for programs without bindings."""
+    for b in getattr(program, "_embedding_bindings", ()):
+        b.prepare(program, feed, scope, iters=iters)
+
+
+def prefetch(program, next_feed):
+    """Overlap hint: background-stage the rows ``next_feed``'s batch will
+    miss for every host-tier binding on ``program``, while the current
+    step computes on device."""
+    for b in getattr(program, "_embedding_bindings", ()):
+        b.prefetch(next_feed)

@@ -1,0 +1,79 @@
+"""Program introspection for sparse-lookup ops — the ONE entry point.
+
+Supersedes ``fluid/distribute_lookup_table.py`` (which now re-exports from
+here): the engine added two lookup op types beyond the legacy PS shim, so
+anything that wants "the sparse lookups of this program" (transpilers,
+backward, tooling) asks this module instead of pattern-matching op types
+itself.
+"""
+
+# Op types whose backward is a SelectedRows (rows, values) pair on a device
+# parameter ("W" input). lookup_table only qualifies with is_sparse=True.
+SPARSE_LOOKUP_TYPES = ("embedding_lookup", "host_embedding_lookup",
+                       "lookup_table", "lookup_table_v2")
+
+# Host-resident lookup op types: the table (or its resident cache) is
+# managed by a host-side store rather than being a plain dense parameter.
+HOST_LOOKUP_TYPES = ("host_embedding_lookup", "distributed_lookup_table")
+
+
+def is_sparse_lookup(op):
+    """True when ``op`` is an embedding lookup whose W-grad is sparse."""
+    if op.type in ("embedding_lookup", "host_embedding_lookup"):
+        return op.attr("is_sparse", True)
+    if op.type in ("lookup_table", "lookup_table_v2"):
+        return op.attr("is_sparse", False)
+    return False
+
+
+def find_sparse_lookup_ops(program):
+    """Every sparse-lookup op in the global block (engine + legacy types)."""
+    return [op for op in program.global_block().ops if is_sparse_lookup(op)]
+
+
+def find_host_lookup_ops(program):
+    """Every host-resident lookup op (engine host tier + legacy PS shim)."""
+    return [op for op in program.global_block().ops
+            if op.type in HOST_LOOKUP_TYPES]
+
+
+def find_distributed_lookup_table(program):
+    """Name of the single distributed lookup table, or None.
+
+    Legacy surface (reference ``distribute_lookup_table.py``): matches the
+    PS-tier ``distributed_lookup_table`` op. Raises if programs mix tables
+    — the transpiler splits exactly one table.
+    """
+    table_name = None
+    for op in program.global_block().ops:
+        if op.type == "distributed_lookup_table":
+            if table_name is None:
+                table_name = op.attr("table_name")
+            elif table_name != op.attr("table_name"):
+                raise RuntimeError(
+                    "all distributed_lookup_table ops must share one "
+                    "table: saw %r and %r"
+                    % (table_name, op.attr("table_name")))
+    return table_name
+
+
+def find_distributed_lookup_table_inputs(program, table_name):
+    """Ids input vars of every lookup on ``table_name``."""
+    block = program.global_block()
+    inputs = []
+    for op in block.ops:
+        if op.type == "distributed_lookup_table" \
+                and op.attr("table_name") == table_name:
+            inputs.extend(block.var(n) for n in op.input("Ids"))
+    return inputs
+
+
+def find_distributed_lookup_table_outputs(program, table_name):
+    """Out vars of every lookup on ``table_name``."""
+    block = program.global_block()
+    outputs = []
+    for op in block.ops:
+        if op.type == "distributed_lookup_table" \
+                and op.attr("table_name") == table_name:
+            outputs.extend(block.var(n) for n in op.output("Out"))
+    return outputs

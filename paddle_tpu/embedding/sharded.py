@@ -1,0 +1,60 @@
+"""ShardedEmbeddingTable — the device (in-HBM) residence tier.
+
+Rows live as a device parameter sharded over a mesh axis
+(``ParamAttr(shard=(axis, None))`` -> GSPMD row layout). Lookups lower to
+the ``embedding_lookup`` op (ops/embedding_ops.py): unique-ids dedup on
+device, then a gather of only the unique rows. Under GSPMD a gather from a
+row-sharded operand with replicated indices lowers to a partial gather on
+each shard plus one all-reduce — no all-to-all of table rows ever moves
+over the interconnect.
+
+The backward stays a SelectedRows (rows, values) pair (fluid/backward.py
+``sparse_wrt`` + the autodiff eps trick), and the optimizer applies a fused
+scatter-add row update (ops/optimizer_ops.py) whose work is O(#lookups),
+never O(vocab) — momentum/Adam slots move row-sparsely too.
+"""
+
+
+class ShardedEmbeddingTable:
+    """Mesh-sharded in-HBM embedding table behind the engine API.
+
+    ``mesh_axis=None`` keeps the table replicated (single-chip case) while
+    still using the dedup-gather lookup + fused sparse update path.
+    """
+
+    residence = "device"
+
+    def __init__(self, name, num_rows, dim, mesh_axis=None,
+                 dtype="float32", initializer=None, trainable=True):
+        if num_rows < 1 or dim < 1:
+            raise ValueError(
+                "ShardedEmbeddingTable %r: num_rows and dim must be >= 1, "
+                "got (%r, %r)" % (name, num_rows, dim))
+        self.name = name
+        self.num_rows = int(num_rows)
+        self.dim = int(dim)
+        self.mesh_axis = mesh_axis
+        self.dtype = dtype
+        self.initializer = initializer
+        self.trainable = trainable
+
+    def param_attr(self):
+        from ..fluid.param_attr import ParamAttr
+
+        shard = (self.mesh_axis, None) if self.mesh_axis else None
+        return ParamAttr(name=self.name, initializer=self.initializer,
+                         trainable=self.trainable, shard=shard)
+
+    def lookup(self, ids, padding_idx=None):
+        """Append a dedup-gather lookup of ``ids`` to the current program.
+
+        Returns the ``[*, dim]`` output var. Equivalent to
+        ``layers.embedding(..., is_sparse=True)`` with this table's
+        param_attr — the layer routes onto the same op.
+        """
+        from ..fluid import layers
+
+        return layers.embedding(
+            ids, size=[self.num_rows, self.dim], is_sparse=True,
+            padding_idx=padding_idx, param_attr=self.param_attr(),
+            dtype=self.dtype)

@@ -42,25 +42,27 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
     if not params and not has_dist:
         raise ValueError("No trainable parameters to differentiate")
 
-    # Params consumed ONLY by is_sparse lookup_table ops get SelectedRows
+    # Params consumed ONLY by one sparse lookup op get SelectedRows
     # gradients (reference lookup_table_op.cc grad kernel + selected_rows.h):
-    # rows = the looked-up ids, values = per-lookup cotangents. The autodiff
-    # lowering emits the pair without ever materializing the dense grad.
-    # Two passes, order-independent: first collect every is_sparse lookup
+    # rows = the looked-up ids (cache slots for the host tier), values =
+    # per-lookup cotangents. The autodiff lowering emits the pair without
+    # ever materializing the dense grad. Sparse-eligible op types come from
+    # the embedding engine (the one sparse-lookup entry point): legacy
+    # lookup_table with is_sparse=True plus the engine's embedding_lookup /
+    # host_embedding_lookup.
+    # Two passes, order-independent: first collect every sparse lookup
     # param, then demote any param with another use ANYWHERE in the block
     # (input or output of any other op, or a dense lookup) — a single
     # program-order pass would miss consumers appearing before the lookup.
+    from ..embedding.lookup import is_sparse_lookup
+
     sparse_params = {}
     for op in block.ops:
-        if op.type in ("lookup_table", "lookup_table_v2") and op.attr(
-                "is_sparse", False):
+        if is_sparse_lookup(op):
             for w in op.input("W"):
                 sparse_params.setdefault(w, []).append(op)
     for op in block.ops:
-        sparse_w = set()
-        if op.type in ("lookup_table", "lookup_table_v2") and op.attr(
-                "is_sparse", False):
-            sparse_w = set(op.input("W"))
+        sparse_w = set(op.input("W")) if is_sparse_lookup(op) else set()
         for name in list(op.input_arg_names()) + list(op.output_arg_names()):
             if name in sparse_params and name not in sparse_w:
                 sparse_params[name] = None  # other use seen -> dense grad

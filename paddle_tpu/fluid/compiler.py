@@ -15,6 +15,8 @@ import numpy as np
 
 from . import monitor as _monitor
 from . import rng as _rng
+from .. import jax_compat as _jax_compat
+from ..jax_compat import shard_map as _shard_map_compat
 
 __all__ = ["CompiledProgram", "BuildStrategy", "ExecutionStrategy"]
 
@@ -371,12 +373,13 @@ class CompiledProgram:
             return fetches, new_params, new_rest, _rng.key_data(next_rng)
 
         repl = NamedSharding(mesh, P())
-        smapped = jax.shard_map(
+        smapped = _shard_map_compat(
             kernel, mesh=mesh,
             in_specs=(P(), P(), P(), P(), P()),
             out_specs=(P(), P(), P(), P()),
             check_vma=False)
-        donate = (0, 1) if self._build_strategy.enable_inplace else ()
+        donate = ((0, 1) if self._build_strategy.enable_inplace
+                  and _jax_compat.SHARD_MAP_DONATION_OK else ())
         jfn = jax.jit(smapped, donate_argnums=donate)
 
         def fn(state, feed_vals, rng):
@@ -426,14 +429,15 @@ class CompiledProgram:
                     out.append(jax.lax.pmax(f, axis))
             return out, new_state, new_rng
 
-        smapped = jax.shard_map(
+        smapped = _shard_map_compat(
             inner,
             mesh=mesh,
             in_specs=({n: P() for n in state_names}, feed_specs, P()),
             out_specs=([P() for _ in fetch_names], {n: P() for n in state_names}, P()),
             check_vma=False,
         )
-        donate = (0,) if self._build_strategy.enable_inplace else ()
+        donate = ((0,) if self._build_strategy.enable_inplace
+                  and _jax_compat.SHARD_MAP_DONATION_OK else ())
         jfn = jax.jit(smapped, donate_argnums=donate)
         feed_shardings = {n: NamedSharding(mesh, feed_specs[n]) for n in feed}
 
@@ -573,7 +577,11 @@ class CompiledProgram:
             feed_shardings,
             repl,
         )
-        out_shardings = ([repl for _ in fetch_names], None, repl)
+        # Pin the new-state layouts to the input layouts: a donated state
+        # buffer must alias an identically-sharded output, and leaving the
+        # state output unconstrained lets XLA pick per-shard layouts that
+        # break the aliasing on older jax builds.
+        out_shardings = ([repl for _ in fetch_names], state_shardings, repl)
         donate = (0,) if self._build_strategy.enable_inplace else ()
         jfn = jax.jit(
             step,

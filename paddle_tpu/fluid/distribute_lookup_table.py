@@ -1,12 +1,16 @@
-"""Locate distributed embedding tables in a Program.
+"""DEPRECATED — folded into :mod:`paddle_tpu.embedding.lookup`.
 
 Parity: reference ``fluid/distribute_lookup_table.py`` (the transpiler/
 fleet helper that finds the single distributed ``lookup_table`` and its
-ids/outputs). Here the distributed embedding lowers to the
-``distributed_lookup_table`` op (``ops/distributed_ops.py``) whose table
-lives in the host PS store keyed by the ``table_name`` attr, so the
-search matches on that op type.
+ids/outputs). The sparse embedding engine is now the one entry point for
+sparse-lookup introspection — it knows about the engine's own op types
+(``embedding_lookup``, ``host_embedding_lookup``) in addition to the
+legacy PS shim matched here. Import from ``paddle_tpu.embedding.lookup``;
+this module stays as a thin re-export so existing callers keep working,
+with a :class:`DeprecationWarning` per call.
 """
+
+import warnings
 
 LOOKUP_TABLE_TYPE = "distributed_lookup_table"
 
@@ -17,42 +21,33 @@ __all__ = [
 ]
 
 
-def _table_of(op):
-    return op.attr("table_name")
+def _deprecated(name):
+    # lazy import: fluid/__init__ imports this module, so pulling the
+    # engine in at module level would cycle through a half-built fluid
+    from ..embedding import lookup
+
+    warnings.warn(
+        "fluid.distribute_lookup_table.%s is deprecated; use "
+        "paddle_tpu.embedding.lookup.%s instead" % (name, name),
+        DeprecationWarning, stacklevel=3)
+    return lookup
 
 
 def find_distributed_lookup_table(program):
-    """The single distributed table's name, or None. More than one
-    distinct table raises (same contract as the reference — the PS
-    split path assumes one)."""
-    table_name = None
-    for op in program.global_block().ops:
-        if op.type == LOOKUP_TABLE_TYPE:
-            name = _table_of(op)
-            if table_name is None:
-                table_name = name
-            elif table_name != name:
-                raise RuntimeError(
-                    "all distributed lookup_table ops should share one "
-                    "table; found %r and %r" % (table_name, name))
-    return table_name
+    """See :func:`paddle_tpu.embedding.lookup.find_distributed_lookup_table`."""
+    return _deprecated(
+        "find_distributed_lookup_table").find_distributed_lookup_table(program)
 
 
 def find_distributed_lookup_table_inputs(program, table_name):
-    """Ids variables feeding the distributed table's lookups."""
-    local_vars = program.current_block().vars
-    inputs = []
-    for op in program.global_block().ops:
-        if op.type == LOOKUP_TABLE_TYPE and _table_of(op) == table_name:
-            inputs.extend(local_vars[name] for name in op.input("Ids"))
-    return inputs
+    """See :func:`paddle_tpu.embedding.lookup.find_distributed_lookup_table_inputs`."""
+    return _deprecated(
+        "find_distributed_lookup_table_inputs"
+    ).find_distributed_lookup_table_inputs(program, table_name)
 
 
 def find_distributed_lookup_table_outputs(program, table_name):
-    """Output variables produced by the distributed table's lookups."""
-    local_vars = program.current_block().vars
-    outputs = []
-    for op in program.global_block().ops:
-        if op.type == LOOKUP_TABLE_TYPE and _table_of(op) == table_name:
-            outputs.extend(local_vars[name] for name in op.output("Out"))
-    return outputs
+    """See :func:`paddle_tpu.embedding.lookup.find_distributed_lookup_table_outputs`."""
+    return _deprecated(
+        "find_distributed_lookup_table_outputs"
+    ).find_distributed_lookup_table_outputs(program, table_name)

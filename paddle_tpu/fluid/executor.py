@@ -703,6 +703,16 @@ class Executor:
                     for key in {configured, srv.endpoint}:
                         _fl.SERVING.pop(key, None)
                 return []
+            if op.type == "host_embedding_init":
+                # host-side residency reset, synchronous with this run —
+                # the in-program op is a no-op (an io_callback there fires
+                # on a runtime thread after the async dispatch returns,
+                # racing the next step's residency prepare and wiping the
+                # LUT it just admitted)
+                from .. import embedding as _embedding
+
+                _embedding.get_host_table(
+                    op.attr("table_name")).reset_residency()
             if op.type == "py_reader_dequeue":
                 from .layers.py_reader import _READERS
 
@@ -749,6 +759,15 @@ class Executor:
                     "re-start() for the next pass")
             for r, vals in pulled:
                 feed.update(zip(r.names, vals))
+
+        # host-tier embedding tables: translate this batch's raw ids into
+        # resident-cache slots (admitting missing rows) and inject the
+        # <table>@SLOTS feed — BEFORE normalization so the slots array is
+        # part of the feed signature like any other input
+        if getattr(program, "_embedding_bindings", None):
+            from .. import embedding as _embedding
+
+            _embedding.prepare_feed(program, feed, scope)
 
         # normalize feeds to declared dtype; device-resident jax Arrays pass
         # through untouched (the DataLoader/buffered-reader path pre-stages
@@ -994,6 +1013,11 @@ class Executor:
                     "iters>1 cannot drive a server program (%s op): the "
                     "serving loop runs on the host — call exe.run "
                     "without iters" % op.type)
+            if op.type == "host_embedding_init":
+                from .. import embedding as _embedding
+
+                _embedding.get_host_table(
+                    op.attr("table_name")).reset_residency()
             if op.type == "py_reader_dequeue":
                 from .layers.py_reader import _READERS
 
@@ -1094,6 +1118,14 @@ class Executor:
             for r, items in pulled.items():
                 for j, name in enumerate(r.names):
                     feed[name] = np.stack([vals[j] for vals in items])
+
+        # host-tier embeddings: one residency transaction covers the whole
+        # [k, ...] window — ids across all k steps are admitted together so
+        # the scanned body only ever gathers resident slots
+        if getattr(program, "_embedding_bindings", None):
+            from .. import embedding as _embedding
+
+            _embedding.prepare_feed(program, feed, scope, iters=iters)
 
         from .lod import LoDTensor
 

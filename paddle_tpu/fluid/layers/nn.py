@@ -97,7 +97,15 @@ def _append_bias(helper, x, bias_attr, dim_start=1, channel_dim=None):
 
 def embedding(input, size, is_sparse=False, is_distributed=False,
               padding_idx=None, param_attr=None, dtype="float32",
-              table_lr=0.01, table_optimizer="sgd"):
+              table_lr=0.01, table_optimizer="sgd", residence=None):
+    """Embedding lookup. ``is_sparse=True`` routes onto the sparse
+    embedding engine (paddle_tpu.embedding): the device tier's
+    dedup-gather ``embedding_lookup`` op with a SelectedRows backward and
+    fused row-sparse optimizer updates. ``residence`` picks the tier
+    explicitly ("device" | "host"); by default a lookup whose param name
+    has a registered ``HostEmbeddingTable`` goes to the host tier (table
+    in host RAM behind a fixed HBM cache). ``is_distributed=True`` stays
+    the legacy parameter-server shim."""
     helper = LayerHelper("embedding", **locals())
     if is_distributed:
         # PS tier (reference distributed_lookup_table_op.cc): the table is a
@@ -127,8 +135,44 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
                    "dtype": dtype},
         )
         return out
+    pname = (param_attr.name if param_attr is not None
+             and getattr(param_attr, "name", None) else None)
+    if residence not in (None, "device", "host"):
+        raise ValueError(
+            "embedding residence must be None, 'device' or 'host', got %r"
+            % (residence,))
+    if residence is None and pname is not None:
+        from ... import embedding as _embedding
+
+        if _embedding.has_host_table(pname):
+            residence = "host"
+    if residence == "host":
+        if pname is None:
+            raise ValueError(
+                "residence='host' needs param_attr with a name matching a "
+                "registered HostEmbeddingTable")
+        from ... import embedding as _embedding
+        from ...embedding.host import append_host_lookup
+
+        return append_host_lookup(helper, input, size,
+                                  _embedding.get_host_table(pname),
+                                  padding_idx, dtype)
     w = helper.create_parameter(param_attr, size, dtype)
     out = helper.create_variable_for_type_inference(dtype)
+    if is_sparse:
+        # engine device tier: dedup-gather lookup; backward stays the
+        # SelectedRows pair, the optimizer applies the fused row update
+        helper.append_op(
+            type="embedding_lookup",
+            inputs={"W": [w], "Ids": [input]},
+            outputs={"Out": [out]},
+            attrs={
+                "is_sparse": True,
+                "dedup": True,
+                "padding_idx": -1 if padding_idx is None else padding_idx,
+            },
+        )
+        return out
     helper.append_op(
         type="lookup_table",
         inputs={"W": [w], "Ids": [input]},

@@ -13,6 +13,7 @@ from . import (  # noqa: F401
     detection_ops,
     distributed_ops,
     elementwise,
+    embedding_ops,
     loss,
     math,
     metrics,

@@ -1,0 +1,90 @@
+"""Lowerings for the sparse embedding engine (paddle_tpu.embedding).
+
+``embedding_lookup`` — the device tier's dedup-gather: unique the batch's
+flat ids (static ``size=`` so shapes stay XLA-closed), gather only the
+unique rows, index the result back per position. Under GSPMD a gather from
+a row-sharded table with replicated (small) indices lowers to a per-shard
+partial gather + one all-reduce — all-to-all-free. Bit-identical to a
+naive gather because rows are copied, never recomputed.
+
+``host_embedding_lookup`` — the host tier's device half: a plain gather
+from the fixed-shape resident cache param, indexed by the engine-computed
+``<table>@SLOTS`` feed. The raw ids ride along only for the padding mask,
+so the compiled step never depends on the vocabulary size.
+
+Both honor ``ctx.sparse_eps`` (ops/autodiff.py): the additive eps at the
+lookup output is how the backward reads a SelectedRows (rows, values)
+cotangent without ever building a dense W-grad.
+"""
+
+import numpy as np
+
+from ..registry import register
+
+
+def _maybe_eps(ctx, op, out):
+    eps_map = getattr(ctx, "sparse_eps", None)
+    if eps_map is not None:
+        eps = eps_map.get(op.output("Out")[0])
+        if eps is not None:
+            # before the padding mask, so padding positions get zero
+            # cotangent exactly like the dense grad path
+            out = out + eps
+    return out
+
+
+def _squeeze_ids(ids):
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    return ids
+
+
+@register("embedding_lookup")
+def _embedding_lookup(ctx, op):
+    import jax.numpy as jnp
+
+    w = ctx.get_input(op, "W")
+    ids = _squeeze_ids(ctx.get_input(op, "Ids"))
+    idx = ids.astype(np.dtype("int32"))
+    flat = idx.reshape(-1)
+    if op.attr("dedup", True) and flat.shape[0] > 1:
+        # fill_value=0 keeps padded lanes in-range; their gathered rows are
+        # never indexed because inv only points at real lanes
+        uniq, inv = jnp.unique(flat, return_inverse=True,
+                               size=flat.shape[0], fill_value=0)
+        rows = jnp.take(w, uniq, axis=0)
+        out = jnp.take(rows, inv.reshape(-1).astype(np.dtype("int32")),
+                       axis=0).reshape(idx.shape + w.shape[1:])
+    else:
+        out = jnp.take(w, idx, axis=0)
+    out = _maybe_eps(ctx, op, out)
+    padding_idx = op.attr("padding_idx", -1)
+    if padding_idx is not None and padding_idx >= 0:
+        out = jnp.where((ids == padding_idx)[..., None], 0.0, out)
+    ctx.set_output(op, "Out", out)
+
+
+@register("host_embedding_lookup")
+def _host_embedding_lookup(ctx, op):
+    import jax.numpy as jnp
+
+    w = ctx.get_input(op, "W")  # resident cache, [budget + 1, dim]
+    slots = _squeeze_ids(ctx.get_input(op, "Ids"))
+    out = jnp.take(w, slots.astype(np.dtype("int32")), axis=0)
+    out = _maybe_eps(ctx, op, out)
+    padding_idx = op.attr("padding_idx", -1)
+    if padding_idx is not None and padding_idx >= 0 and op.input("RawIds"):
+        raw = _squeeze_ids(ctx.get_input(op, "RawIds"))
+        out = jnp.where((raw == padding_idx)[..., None], 0.0, out)
+    ctx.set_output(op, "Out", out)
+
+
+@register("host_embedding_init")
+def _host_embedding_init(ctx, op):
+    """(Re-)initialize a host table's device residency — placed in the
+    STARTUP program by ``layers.embedding`` (host tier) so
+    ``exe.run(startup)`` forgets the cache exactly like it re-initializes
+    device parameters. Executed eagerly by the Executor's host-op scan,
+    NOT in the compiled program: an in-program io_callback fires on an
+    XLA runtime thread after the async dispatch returns, racing the next
+    step's residency prepare() and wiping a freshly-admitted LUT."""

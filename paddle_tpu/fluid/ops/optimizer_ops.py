@@ -30,11 +30,32 @@ def _sparse_grad(ctx, op):
     return ctx.get(gname + "@ROWS"), ctx.get(gname)
 
 
-def _touched_mask(p, rows):
+def _fused_rows(p, rows, vals):
+    """Fused sparse-update prep, all O(#lookups): unique touched rows (the
+    lookup's dedup mirrored in the backward), the per-unique-row summed
+    gradient, and a validity mask for the static-size padding.
+    ``jnp.unique(size=n)`` pads with fill_value=0 / count 0; padded lanes
+    are masked out downstream so no dense [vocab, ...] gradient — or any
+    vocab-sized temporary at all — is ever materialized."""
     import jax.numpy as jnp
 
-    t = jnp.zeros((p.shape[0],), bool).at[rows].set(True)
-    return t.reshape((-1,) + (1,) * (p.ndim - 1))
+    n = rows.shape[0]
+    vals = vals.astype(p.dtype).reshape((n,) + p.shape[1:])
+    uniq, inv, counts = jnp.unique(rows, return_inverse=True,
+                                   return_counts=True, size=n, fill_value=0)
+    g = jnp.zeros_like(vals).at[inv.reshape(-1)].add(vals)
+    valid = (counts > 0).reshape((n,) + (1,) * (p.ndim - 1))
+    return uniq, valid, g
+
+
+def _apply_rows(dst, uniq, valid, new_rows, old_rows):
+    """Scatter the per-row update into ``dst`` additively (delta form):
+    padded duplicate lanes (all index 0) carry a masked zero delta, so the
+    scatter-add is exact without needing collision-free indices."""
+    import jax.numpy as jnp
+
+    delta = jnp.where(valid, new_rows - old_rows, 0).astype(dst.dtype)
+    return dst.at[uniq].add(delta)
 
 
 @register("sgd")
@@ -63,18 +84,21 @@ def _momentum(ctx, op):
     lr = _lr(ctx, op)
     sp = _sparse_grad(ctx, op)
     if sp is not None:
-        # lazy rows-only update (reference momentum_op.h SelectedRows path)
+        # lazy rows-only update (reference momentum_op.h SelectedRows
+        # path), fused: gather touched rows, update, scatter-add the delta
+        # — O(#lookups) work, no vocab-sized gradient temporary
         rows, vals = sp
-        g = jnp.zeros_like(p).at[rows].add(
-            vals.reshape((rows.shape[0],) + p.shape[1:]))
-        touched = _touched_mask(p, rows)
-        v_new = jnp.where(touched, mu * v + g, v)
+        uniq, valid, g = _fused_rows(p, rows, vals)
+        p_rows, v_rows = p[uniq], v[uniq]
+        v_new_rows = mu * v_rows + g
         if op.attr("use_nesterov", False):
-            p_new = jnp.where(touched, p - (g + mu * v_new) * lr, p)
+            p_new_rows = p_rows - (g + mu * v_new_rows) * lr
         else:
-            p_new = jnp.where(touched, p - lr * v_new, p)
-        ctx.set_output(op, "ParamOut", p_new)
-        ctx.set_output(op, "VelocityOut", v_new)
+            p_new_rows = p_rows - lr * v_new_rows
+        ctx.set_output(op, "ParamOut",
+                       _apply_rows(p, uniq, valid, p_new_rows, p_rows))
+        ctx.set_output(op, "VelocityOut",
+                       _apply_rows(v, uniq, valid, v_new_rows, v_rows))
         return
     g = ctx.get_input(op, "Grad")
     v_new = mu * v + g
@@ -123,15 +147,19 @@ def _adam(ctx, op):
     sp = _sparse_grad(ctx, op)
     if sp is not None:
         # lazy-mode sparse adam (reference adam_op.h SelectedRows kernel):
-        # moments decay and params move only on touched rows
+        # moments decay and params move only on touched rows. Fused
+        # gather/update/scatter-add — the moment slots are row-sparse too,
+        # and nothing vocab-sized is materialized
         rows, vals = sp
-        g = jnp.zeros_like(p).at[rows].add(
-            vals.reshape((rows.shape[0],) + p.shape[1:]))
-        touched = _touched_mask(p, rows)
-        m_new = jnp.where(touched, b1 * m + (1 - b1) * g, m)
-        v_new = jnp.where(touched, b2 * v + (1 - b2) * jnp.square(g), v)
-        p_new = jnp.where(touched,
-                          p - lr_t * m_new / (jnp.sqrt(v_new) + eps), p)
+        uniq, valid, g = _fused_rows(p, rows, vals)
+        p_rows, m_rows, v_rows = p[uniq], m[uniq], v[uniq]
+        m_new_rows = b1 * m_rows + (1 - b1) * g
+        v_new_rows = b2 * v_rows + (1 - b2) * jnp.square(g)
+        p_new_rows = p_rows - lr_t * m_new_rows / (jnp.sqrt(v_new_rows)
+                                                   + eps)
+        m_new = _apply_rows(m, uniq, valid, m_new_rows, m_rows)
+        v_new = _apply_rows(v, uniq, valid, v_new_rows, v_rows)
+        p_new = _apply_rows(p, uniq, valid, p_new_rows, p_rows)
     else:
         g = ctx.get_input(op, "Grad")
         m_new = b1 * m + (1 - b1) * g
@@ -177,13 +205,14 @@ def _adagrad(ctx, op):
     sp = _sparse_grad(ctx, op)
     if sp is not None:
         rows, vals = sp
-        g = jnp.zeros_like(p).at[rows].add(
-            vals.reshape((rows.shape[0],) + p.shape[1:]))
-        touched = _touched_mask(p, rows)
-        m_new = jnp.where(touched, m + jnp.square(g), m)
-        p_new = jnp.where(touched, p - lr * g / (jnp.sqrt(m_new) + eps), p)
-        ctx.set_output(op, "ParamOut", p_new)
-        ctx.set_output(op, "MomentOut", m_new)
+        uniq, valid, g = _fused_rows(p, rows, vals)
+        p_rows, m_rows = p[uniq], m[uniq]
+        m_new_rows = m_rows + jnp.square(g)
+        p_new_rows = p_rows - lr * g / (jnp.sqrt(m_new_rows) + eps)
+        ctx.set_output(op, "ParamOut",
+                       _apply_rows(p, uniq, valid, p_new_rows, p_rows))
+        ctx.set_output(op, "MomentOut",
+                       _apply_rows(m, uniq, valid, m_new_rows, m_rows))
         return
     g = ctx.get_input(op, "Grad")
     m_new = m + jnp.square(g)

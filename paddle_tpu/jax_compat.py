@@ -1,0 +1,37 @@
+"""Version bridges for the jax surface this codebase targets.
+
+``shard_map`` moved twice across jax releases: old builds expose it only
+as ``jax.experimental.shard_map.shard_map`` (replication check kwarg
+``check_rep``), newer ones promote it to ``jax.shard_map`` and rename
+the kwarg ``check_vma``. Every internal call site goes through
+:func:`shard_map` below so the rest of the tree can use the modern
+spelling unconditionally.
+"""
+
+import jax
+
+__all__ = ["shard_map", "axis_size", "SHARD_MAP_DONATION_OK"]
+
+# The pre-promotion shard_map miscomputes jit donation aliases for
+# replicated operands (size-mismatched input/output pairing at run
+# time); donation must be skipped when running on that fallback.
+SHARD_MAP_DONATION_OK = hasattr(jax, "shard_map")
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma=True):
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_vma)
+
+
+def axis_size(axis_name):
+    """``jax.lax.axis_size`` appeared after the oldest supported jax;
+    inside a mapped region the psum of 1 over the axis is the same
+    number on every build."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)

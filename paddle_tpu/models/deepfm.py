@@ -9,17 +9,32 @@ embedding tables live device-resident and sharded; fields are a dense
 keeping XLA shapes static.
 """
 
+import operator
+
 import paddle_tpu.fluid as fluid
 from paddle_tpu.fluid import layers, optimizer
+
+
+def _at_least_one(name, value):
+    try:
+        value = operator.index(value)
+    except TypeError:
+        raise ValueError("DeepFMConfig.%s must be an int >= 1, got %r"
+                         % (name, value))
+    if value < 1:
+        raise ValueError("DeepFMConfig.%s must be an int >= 1, got %r"
+                         % (name, value))
+    return value
 
 
 class DeepFMConfig:
     def __init__(self, sparse_feature_dim=int(1e5), num_fields=26,
                  num_dense=13, embedding_size=10, fc_sizes=(400, 400, 400)):
-        self.sparse_feature_dim = sparse_feature_dim
-        self.num_fields = num_fields
-        self.num_dense = num_dense
-        self.embedding_size = embedding_size
+        self.sparse_feature_dim = _at_least_one(
+            "sparse_feature_dim", sparse_feature_dim)
+        self.num_fields = _at_least_one("num_fields", num_fields)
+        self.num_dense = _at_least_one("num_dense", num_dense)
+        self.embedding_size = _at_least_one("embedding_size", embedding_size)
         self.fc_sizes = tuple(fc_sizes)
 
     @staticmethod
@@ -28,8 +43,14 @@ class DeepFMConfig:
                             num_dense=4, embedding_size=8, fc_sizes=(32, 32))
 
 
-def deepfm_forward(sparse_ids, dense_x, label, cfg, is_sparse=True):
-    """sparse_ids: [B, F] int64; dense_x: [B, D] float32; label: [B, 1]."""
+def deepfm_forward(sparse_ids, dense_x, label, cfg, is_sparse=True,
+                   residence=None):
+    """sparse_ids: [B, F] int64; dense_x: [B, D] float32; label: [B, 1].
+
+    ``residence`` is forwarded to ``layers.embedding`` for the second-order
+    table ``fm_emb`` (the big one): ``"host"`` routes it onto a registered
+    ``HostEmbeddingTable``; the tiny first-order table stays device-resident.
+    """
     # ---- first order: per-field scalar weights
     w1 = layers.embedding(sparse_ids, size=[cfg.sparse_feature_dim, 1],
                           is_sparse=is_sparse,
@@ -39,7 +60,7 @@ def deepfm_forward(sparse_ids, dense_x, label, cfg, is_sparse=True):
     # ---- second order: 0.5 * ((sum e)^2 - sum e^2)
     emb = layers.embedding(sparse_ids,
                            size=[cfg.sparse_feature_dim, cfg.embedding_size],
-                           is_sparse=is_sparse,
+                           is_sparse=is_sparse, residence=residence,
                            param_attr=fluid.ParamAttr(name="fm_emb"))  # [B,F,E]
     sum_e = layers.reduce_sum(emb, dim=1)                       # [B, E]
     sum_sq = layers.elementwise_mul(sum_e, sum_e)
@@ -64,7 +85,8 @@ def deepfm_forward(sparse_ids, dense_x, label, cfg, is_sparse=True):
     return pred, loss
 
 
-def build_train_program(cfg=None, lr=1e-3, is_sparse=True, seed=7):
+def build_train_program(cfg=None, lr=1e-3, is_sparse=True, seed=7,
+                        residence=None):
     cfg = cfg or DeepFMConfig()
     main, startup = fluid.Program(), fluid.Program()
     main.random_seed = seed
@@ -75,7 +97,7 @@ def build_train_program(cfg=None, lr=1e-3, is_sparse=True, seed=7):
                               dtype="float32")
         label = layers.data("label", shape=[1], dtype="int64")
         pred, loss = deepfm_forward(sparse_ids, dense_x, label, cfg,
-                                    is_sparse=is_sparse)
+                                    is_sparse=is_sparse, residence=residence)
         optimizer.Adam(learning_rate=lr).minimize(loss)
     return main, startup, loss, pred
 
@@ -84,9 +106,14 @@ def synthetic_batch(cfg, batch, seed=0):
     import numpy as np
 
     rng = np.random.RandomState(seed)
+    # modulo makes in-vocab true by construction (randint's high bound
+    # already excludes the vocab size; the reduction guards any future
+    # generator change), and the assert makes it checked, not assumed
+    ids = rng.randint(0, cfg.sparse_feature_dim,
+                      (batch, cfg.num_fields)) % cfg.sparse_feature_dim
+    assert ids.min() >= 0 and ids.max() < cfg.sparse_feature_dim
     return {
-        "sparse_ids": rng.randint(0, cfg.sparse_feature_dim,
-                                  (batch, cfg.num_fields)).astype("int64"),
+        "sparse_ids": ids.astype("int64"),
         "dense_x": rng.rand(batch, cfg.num_dense).astype("float32"),
         "label": rng.randint(0, 2, (batch, 1)).astype("int64"),
     }

@@ -22,6 +22,9 @@ import functools
 
 import jax
 import jax.numpy as jnp
+
+from ..jax_compat import axis_size as _axis_size_compat
+from ..jax_compat import shard_map as _shard_map_compat
 from jax.sharding import PartitionSpec as P
 
 from .mesh import SP
@@ -60,7 +63,7 @@ def ring_attention_sharded(q, k, v, axis_name=SP, causal=False, scale=None):
     contiguously by rank along the ring. Runs inside shard_map."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size_compat(axis_name)
     rank = jax.lax.axis_index(axis_name)
     chunk = q.shape[1]
     q_off = rank * chunk
@@ -125,7 +128,7 @@ def ulysses_attention_sharded(q, k, v, axis_name=SP, causal=False,
 
 def _wrap_sp(kernel, mesh, axis_name):
     spec = P(None, axis_name, None, None)
-    return jax.shard_map(
+    return _shard_map_compat(
         kernel, mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

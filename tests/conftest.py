@@ -51,6 +51,10 @@ def pytest_configure(config):
         "markers", "serving: exercises the in-process serving tier "
                    "(dynamic request batching, bucket ladder, "
                    "admission control, continuous decode batching)")
+    config.addinivalue_line(
+        "markers", "embedding: exercises the sparse embedding engine "
+                   "(mesh-sharded dedup-gather tier, host-offloaded "
+                   "resident-cache tier, fused sparse optimizer updates)")
 
 
 @pytest.fixture(autouse=True)

@@ -619,3 +619,37 @@ def test_train_from_dataset_ragged_lod_feed(tmp_path):
         exe.run(startup)
         n = exe.train_from_dataset(main, ds, fetch_list=[loss])
     assert n == 2
+
+
+def test_global_shuffle_deterministic_under_set_seed(tmp_path):
+    """Two global_shuffles from the same set_seed produce the same order
+    (no fleet: the shuffle itself is the only reordering)."""
+    f = str(tmp_path / "gs.txt")
+    _write_multislot(f, 30, seed=6)
+    _, _, use_vars = _use_vars()
+
+    def shuffled():
+        ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+        ds.set_batch_size(30)
+        ds.set_use_var(use_vars)
+        ds.set_filelist([f])
+        ds.set_seed(321)
+        ds.load_into_memory()
+        ds.global_shuffle()
+        return next(ds.batch_reader()())["dense"]
+
+    a, b = shuffled(), shuffled()
+    np.testing.assert_allclose(a, b)
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(30)
+    ds.set_use_var(use_vars)
+    ds.set_filelist([f])
+    ds.load_into_memory()
+    unshuffled = next(ds.batch_reader()())["dense"]
+    assert not np.allclose(a, unshuffled)  # it did reorder something
+
+
+def test_queue_dataset_global_shuffle_error_names_alternative():
+    ds = fluid.DatasetFactory().create_dataset("QueueDataset")
+    with pytest.raises(NotImplementedError, match="InMemoryDataset"):
+        ds.global_shuffle()

@@ -157,6 +157,7 @@ def _build_mlp(seed=13, use_pipeline=False):
     return main, startup, loss
 
 
+@pytest.mark.slow
 def test_pipeline_program_matches_single_device():
     rng = np.random.RandomState(7)
     feed = {"x": rng.rand(8, 32).astype(np.float32),

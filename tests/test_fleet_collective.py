@@ -31,6 +31,7 @@ def _model(seed):
     return main, startup, loss
 
 
+@pytest.mark.slow
 def test_fleet_collective_matches_baseline():
     rng = np.random.RandomState(0)
     xv = rng.rand(16, 8).astype(np.float32)

@@ -28,6 +28,7 @@ def test_choose_axes():
     assert hybrid.choose_axes(1) == {"sp": 1, "tp": 1, "pp": 1, "dp": 1}
 
 
+@pytest.mark.slow
 def test_hybrid_loss_matches_reference(setup):
     cfg, mesh, params, ids, labels = setup
     lr = 0.0  # no update: isolates the forward
@@ -37,6 +38,7 @@ def test_hybrid_loss_matches_reference(setup):
     np.testing.assert_allclose(float(loss), float(ref), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_hybrid_sgd_step_matches_reference(setup):
     cfg, mesh, params, ids, labels = setup
     lr = 0.1
@@ -58,6 +60,7 @@ def test_hybrid_sgd_step_matches_reference(setup):
             err_msg=f"param mismatch at {jax.tree_util.keystr(path)}")
 
 
+@pytest.mark.slow
 def test_hybrid_training_reduces_loss(setup):
     cfg, mesh, params, ids, labels = setup
     step = hybrid.make_train_step(cfg, mesh, lr=0.1)

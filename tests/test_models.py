@@ -2,6 +2,7 @@
 "book"/dist model suite scaled down — SURVEY §4 end-to-end tests)."""
 
 import numpy as np
+import pytest
 
 import paddle_tpu.fluid as fluid
 from paddle_tpu.fluid import dygraph, optimizer
@@ -31,6 +32,7 @@ def test_resnet18_train_step():
     assert losses[-1] < losses[0]  # memorizes the fixed batch
 
 
+@pytest.mark.slow
 def test_resnet50_builds_and_runs():
     main, startup, loss, acc = resnet.build_train_program(
         depth=50, num_classes=10, image_size=32)
@@ -94,6 +96,7 @@ def test_transformer_jit_trace_matches_eager():
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_resnet_nhwc_matches_nchw():
     """data_format="NHWC" runs the SAME math as NCHW (feed contract
     unchanged — one transpose at graph entry): losses agree to float

@@ -42,6 +42,7 @@ def _single_process_baseline():
     return out
 
 
+@pytest.mark.slow
 def test_two_process_dp_matches_single_process(tmp_path):
     base = _single_process_baseline()
 

@@ -5,6 +5,7 @@ round-3 op families. Tensors stay tiny: every perturbation re-runs the
 program."""
 
 import numpy as np
+import pytest
 
 from op_test import OpTest
 
@@ -156,6 +157,7 @@ class TestSigmoidFocalLossGrad(OpTest):
         self.check_grad(["X"], "Out")
 
 
+@pytest.mark.slow
 class TestFusedAttentionGrad(OpTest):
     """Finite differences through the full custom-VJP path of the fused
     attention op (jnp fallback on CPU — same formula as the kernel)."""

@@ -12,6 +12,7 @@ import pytest
 from jax.sharding import PartitionSpec as P
 
 from paddle_tpu import parallel as pl
+from paddle_tpu import jax_compat
 
 
 @pytest.fixture(scope="module")
@@ -36,6 +37,7 @@ def _qkv(b=2, s=32, h=4, d=8, seed=0):
 
 
 @pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.slow
 def test_ring_attention_matches_reference(sp_mesh, causal):
     q, k, v = _qkv()
     ref = pl.attention_reference(q, k, v, causal=causal)
@@ -53,6 +55,7 @@ def test_ulysses_attention_matches_reference(sp_mesh, causal):
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_ring_attention_grads(sp_mesh):
     q, k, v = _qkv(b=1, s=16, h=2, d=4)
 
@@ -83,7 +86,7 @@ def test_tp_column_then_row_linear(tp_mesh):
         h = jax.nn.relu(h)
         return pl.row_parallel_linear(h, w2, b2)
 
-    out = jax.shard_map(
+    out = jax_compat.shard_map(
         mlp, mesh=tp_mesh,
         in_specs=(P(), P(None, "tp"), P("tp"), P("tp", None), P()),
         out_specs=P(),
@@ -98,7 +101,7 @@ def test_vocab_parallel_embedding(tp_mesh):
     table = jnp.asarray(rng.randn(64, 8).astype(np.float32))
     ids = jnp.asarray(rng.randint(0, 64, (4, 7)))
     ref = jnp.take(table, ids, axis=0)
-    out = jax.shard_map(
+    out = jax_compat.shard_map(
         functools.partial(pl.vocab_parallel_embedding),
         mesh=tp_mesh,
         in_specs=(P(), P("tp", None)),
@@ -128,6 +131,7 @@ def test_pipeline_matches_sequential(pp_mesh):
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_differentiable(pp_mesh):
     rng = np.random.RandomState(4)
     n_stage, m, bsz, dim = 4, 4, 2, 4

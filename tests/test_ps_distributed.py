@@ -151,8 +151,10 @@ def test_sparse_param_demoted_on_use_before_lookup():
         loss = layers.mean(layers.reduce_sum(emb, dim=-1)) + wsum
     block = main.global_block()
     # move the reduce_sum(w_pre) op BEFORE the lookup op
+    from paddle_tpu.embedding.lookup import SPARSE_LOOKUP_TYPES
+
     lookup_i = next(i for i, o in enumerate(block.ops)
-                    if o.type == "lookup_table")
+                    if o.type in SPARSE_LOOKUP_TYPES)
     red_i = next(i for i, o in enumerate(block.ops)
                  if o.type.startswith("reduce_sum")
                  and "w_pre" in o.input_arg_names())

@@ -3,6 +3,7 @@ in the SAME scope (shared parameter names) — the reference
 test_machine_translation flow end to end."""
 
 import numpy as np
+import pytest
 
 import paddle_tpu.fluid as fluid
 from paddle_tpu.models import seq2seq
@@ -38,6 +39,7 @@ def test_seq2seq_trains_and_beam_decodes_echo():
                                                          want)
 
 
+@pytest.mark.slow
 def test_crf_tagger_trains_and_decodes():
     from paddle_tpu.models import tagger
 

@@ -55,6 +55,7 @@ def _mlp(seed, tp):
     return main, startup, loss
 
 
+@pytest.mark.slow
 def test_tp_mlp_matches_single_device():
     rng = np.random.RandomState(0)
     feed = {"x": rng.rand(16, 16).astype(np.float32),
@@ -82,6 +83,7 @@ def test_tp_mlp_matches_single_device():
     np.testing.assert_allclose(base, got, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_bert_tiny_dp_tp_matches_single_device():
     """The flagship path: a fluid BERT Program with tp>1 trains on the
     8-device mesh and reproduces the single-device loss curve."""
