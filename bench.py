@@ -783,6 +783,137 @@ def bench_serve(n_clients=64, per_client=8, max_batch_size=16,
     }
 
 
+def bench_restart():
+    """``BENCH_RESTART=1``: restart-to-first-step and serving
+    ``register()`` warm-up, cold (empty persistent compile cache) vs
+    warm (populated) — the two downtime windows the on-disk AOT tier
+    (fluid/compile_cache.py) exists to shrink. Each "restart" is a
+    fresh Executor + a rebuilt program (``unique_name.guard`` makes the
+    rebuild byte-identical, as a real process restart would be), so the
+    in-memory tier starts empty and only the disk tier can help.
+    Asserts the acceptance invariant: with a warm cache, the restart
+    and the serving warm-up ladder compile ZERO programs live."""
+    import shutil
+    import tempfile
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import inference
+    from paddle_tpu.fluid import compile_cache, layers, monitor, unique_name
+    from paddle_tpu.inference import ServeConfig, Server
+
+    cache_dir = tempfile.mkdtemp(prefix="bench_restart_cache_")
+    model_dir = tempfile.mkdtemp(prefix="bench_restart_model_")
+    env_prev = os.environ.get(compile_cache.ENV_DIR)
+    os.environ[compile_cache.ENV_DIR] = cache_dir
+
+    def hits_misses():
+        return (
+            monitor.counter("executor_compile_cache_disk_hit_total").value,
+            monitor.counter("executor_compile_cache_disk_miss_total").value)
+
+    def build_train():
+        main, startup = fluid.Program(), fluid.Program()
+        with unique_name.guard(), fluid.program_guard(main, startup):
+            x = layers.data("x", shape=[64], dtype="float32")
+            y = layers.data("y", shape=[1], dtype="float32")
+            h = x
+            for _ in range(4):
+                h = layers.fc(h, 256, act="relu")
+            loss = layers.reduce_mean(
+                layers.square_error_cost(layers.fc(h, 1), y))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        return main, startup, loss
+
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(32, 64).astype(np.float32),
+            "y": rng.rand(32, 1).astype(np.float32)}
+
+    def one_restart():
+        """Build + init + first step: the whole downtime window a
+        respawned worker pays before training resumes."""
+        t0 = time.perf_counter()
+        main, startup, loss = build_train()
+        exe = fluid.Executor()
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
+            lv = float(np.asarray(lv))
+        return time.perf_counter() - t0, lv
+
+    try:
+        h0, m0 = hits_misses()
+        t_cold, loss_cold = one_restart()
+        h1, m1 = hits_misses()
+        t_warm, loss_warm = one_restart()
+        h2, m2 = hits_misses()
+        assert m1 - m0 == 2 and h1 == h0, (
+            "cold restart: want 2 disk misses (startup+main), "
+            "got %d misses / %d hits" % (m1 - m0, h1 - h0))
+        assert h2 - h1 == 2 and m2 == m1, (
+            "warm restart compiled live: %d hits / %d misses "
+            "(want 2 / 0)" % (h2 - h1, m2 - m1))
+        assert loss_warm == loss_cold, (
+            "deserialized executable diverged: %r vs %r"
+            % (loss_cold, loss_warm))
+
+        # serving cold-start: save a model once, then register it on
+        # two fresh Servers — the second warm-up ladder must be served
+        # entirely from disk
+        smain, sstartup = fluid.Program(), fluid.Program()
+        with unique_name.guard(), fluid.program_guard(smain, sstartup):
+            x = layers.data("x", shape=[32], dtype="float32")
+            prob = layers.softmax(layers.fc(layers.fc(
+                x, 64, act="relu"), 8))
+        exe = fluid.Executor()
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(sstartup)
+            fluid.io.save_inference_model(model_dir, ["x"], [prob], exe,
+                                          main_program=smain)
+        cfg = ServeConfig(max_batch_size=8)
+        ladder = len(cfg.ladder())
+        exemplar = {"x": np.zeros((1, 32), np.float32)}
+
+        def one_register():
+            pred = inference.create_predictor(inference.Config(model_dir))
+            t0 = time.perf_counter()
+            with Server() as srv:
+                srv.register("m", pred, config=cfg, warmup_feed=exemplar)
+                return time.perf_counter() - t0
+
+        h0, m0 = hits_misses()
+        t_serve_cold = one_register()
+        h1, m1 = hits_misses()
+        t_serve_warm = one_register()
+        h2, m2 = hits_misses()
+        assert m1 - m0 == ladder and h1 == h0, (
+            "cold register: want %d disk misses, got %d misses / %d "
+            "hits" % (ladder, m1 - m0, h1 - h0))
+        assert h2 - h1 == ladder and m2 == m1, (
+            "warm register compiled live: %d hits / %d misses "
+            "(want %d / 0)" % (h2 - h1, m2 - m1, ladder))
+    finally:
+        if env_prev is None:
+            os.environ.pop(compile_cache.ENV_DIR, None)
+        else:
+            os.environ[compile_cache.ENV_DIR] = env_prev
+        shutil.rmtree(cache_dir, ignore_errors=True)
+        shutil.rmtree(model_dir, ignore_errors=True)
+
+    load_hist = monitor.get_metric("compile_cache_load_seconds")
+    return {
+        "restart_cold_to_first_step_seconds": round(t_cold, 3),
+        "restart_warm_to_first_step_seconds": round(t_warm, 3),
+        "restart_speedup": round(t_cold / max(t_warm, 1e-9), 3),
+        "restart_register_cold_seconds": round(t_serve_cold, 3),
+        "restart_register_warm_seconds": round(t_serve_warm, 3),
+        "restart_register_speedup":
+            round(t_serve_cold / max(t_serve_warm, 1e-9), 3),
+        "restart_ladder_size": ladder,
+        "restart_cache_load_seconds_sum": round(load_hist.sum, 3)
+        if load_hist is not None else 0.0,
+    }
+
+
 def monitor_summary():
     """Framework-counter sub-dict for the JSON line (fluid/monitor.py):
     the same counters a production scrape would see, so BENCH_r0x.json
@@ -800,6 +931,16 @@ def monitor_summary():
         "compile_cache_hits": hits,
         "compile_cache_misses": misses,
         "compile_cache_hit_ratio": round(hits / max(1, hits + misses), 4),
+        # persistent disk tier (fluid/compile_cache.py): restarts and
+        # serving cold-starts that deserialized instead of compiling
+        "compile_cache_disk_hits": monitor.counter(
+            "executor_compile_cache_disk_hit_total").value,
+        "compile_cache_disk_misses": monitor.counter(
+            "executor_compile_cache_disk_miss_total").value,
+        "compile_cache_quarantined": monitor.counter(
+            "compile_cache_quarantined_total").value,
+        "compile_cache_evicted": monitor.counter(
+            "compile_cache_evicted_total").value,
         "executor_run_seconds_sum": round(run_hist.sum, 3)
         if run_hist is not None else 0.0,
         "batched_run_count":
@@ -964,6 +1105,61 @@ def bench_smoke():
     assert serve["serve_batches"] < serve["serve_requests"], (
         "serve smoke: no coalescing happened")
 
+    # persistent compile cache: a warm "restart" (fresh Executor,
+    # rebuilt program, same cache dir) must deserialize BOTH programs
+    # from disk and compile zero live — the restart fast path can't
+    # silently rot out of --smoke coverage
+    import shutil
+    import tempfile
+
+    from paddle_tpu.fluid import compile_cache
+
+    cache_tmp = tempfile.mkdtemp(prefix="bench_smoke_cache_")
+    cache_env_prev = os.environ.get(compile_cache.ENV_DIR)
+    os.environ[compile_cache.ENV_DIR] = cache_tmp
+    try:
+        def _cc_restart():
+            cmain, cstartup = fluid.Program(), fluid.Program()
+            with unique_name.guard(), fluid.program_guard(cmain, cstartup):
+                cx = layers.data("x", shape=[D], dtype="float32")
+                cy = layers.data("y", shape=[1], dtype="float32")
+                closs = layers.reduce_mean(layers.square_error_cost(
+                    layers.fc(cx, 1, name="cc_fc"), cy))
+                fluid.optimizer.SGD(learning_rate=0.1).minimize(closs)
+            cexe = fluid.Executor()
+            with fluid.scope_guard(fluid.Scope()):
+                cexe.run(cstartup)
+                (clv,) = cexe.run(cmain, feed={"x": batches[0][0],
+                                               "y": batches[0][1]},
+                                  fetch_list=[closs])
+                return float(np.asarray(clv))
+
+        def _cc_counters():
+            return (monitor.counter(
+                        "executor_compile_cache_disk_hit_total").value,
+                    monitor.counter(
+                        "executor_compile_cache_disk_miss_total").value)
+
+        ch0, cm0 = _cc_counters()
+        cc_cold = _cc_restart()
+        ch1, cm1 = _cc_counters()
+        cc_warm = _cc_restart()
+        ch2, cm2 = _cc_counters()
+        assert cm1 - cm0 == 2 and ch1 == ch0, (
+            "cache smoke cold: %d misses / %d hits, want 2 / 0"
+            % (cm1 - cm0, ch1 - ch0))
+        assert ch2 - ch1 == 2 and cm2 == cm1, (
+            "cache smoke warm restart compiled live: %d hits / %d "
+            "misses, want 2 / 0" % (ch2 - ch1, cm2 - cm1))
+        assert cc_warm == cc_cold, (
+            "cache smoke: deserialized executable diverged")
+    finally:
+        if cache_env_prev is None:
+            os.environ.pop(compile_cache.ENV_DIR, None)
+        else:
+            os.environ[compile_cache.ENV_DIR] = cache_env_prev
+        shutil.rmtree(cache_tmp, ignore_errors=True)
+
     return {
         "serve_smoke_requests_per_sec": serve["serve_requests_per_sec"],
         "serve_smoke_mean_batch_occupancy":
@@ -980,6 +1176,8 @@ def bench_smoke():
         "embed_smoke_steps": len(embed_losses),
         "embed_smoke_prefetch_hits": embed_hits,
         "embed_smoke_evictions": embed_evictions,
+        "cache_smoke_disk_hits": int(ch2 - ch1),
+        "cache_smoke_disk_misses": int(cm1 - cm0),
         "monitor": monitor_summary(),
     }
 
@@ -1013,6 +1211,8 @@ if __name__ == "__main__":
         out.update(bench_serve())
     if os.environ.get("BENCH_EMBED") == "1":
         out.update(bench_embedding())
+    if os.environ.get("BENCH_RESTART") == "1":
+        out.update(bench_restart())
     if os.environ.get("BENCH_LONGSEQ") == "1":
         out.update(bench_longseq())
         out.update(bench_longseq(batch_size=4, seq_len=4096,

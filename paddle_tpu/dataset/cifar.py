@@ -33,7 +33,8 @@ def _reader(url, md5, sub_name, label_key, n_classes, n_synth, seed):
             for member in tf.getmembers():
                 if sub_name not in member.name:
                     continue
-                batch = pickle.load(tf.extractfile(member), encoding="bytes")
+                batch = pickle.load(  # upstream CIFAR archive format IS pickle
+                    tf.extractfile(member), encoding="bytes")
                 data = batch[b"data"].astype("float32") / 255.0
                 labels = batch.get(label_key)
                 for x, y in zip(data, labels):

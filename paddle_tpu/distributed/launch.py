@@ -20,6 +20,7 @@ import sys
 import tempfile
 import time
 
+from ..fluid import compile_cache as _compile_cache
 from ..fluid import monitor as _monitor
 from ..fluid import resilience as _resilience
 from . import preemption as _preemption
@@ -158,7 +159,7 @@ def launch(nproc, cmd, node_ip="127.0.0.1", started_port=None, env=None,
            port_retries=3, checkpoint_dir=None,
            max_restarts_at_size=None, min_world_size=None,
            rendezvous_dir=None, max_preempt_restarts=8,
-           preempt_drain=True):
+           preempt_drain=True, compile_cache_dir=None):
     """Spawn ``nproc`` copies of ``cmd`` (argv list) with the trainer env;
     returns the list of exit codes of the final attempt.
 
@@ -223,6 +224,14 @@ def launch(nproc, cmd, node_ip="127.0.0.1", started_port=None, env=None,
     base_env = dict(os.environ if env is None else env)
     if checkpoint_dir:
         base_env["PADDLE_CHECKPOINT_DIR"] = checkpoint_dir
+    # persistent compile cache shared across gang generations: every
+    # worker (re)spawn sees the same dir, so a reformed gang
+    # deserializes its executables instead of recompiling them inside
+    # the downtime window (fluid/compile_cache.py)
+    compile_cache_dir = compile_cache_dir or \
+        base_env.get(_compile_cache.ENV_DIR)
+    if compile_cache_dir:
+        base_env[_compile_cache.ENV_DIR] = compile_cache_dir
     base_env[_preemption.ENV_DRAIN] = "1" if preempt_drain else "0"
     base_env[_rendezvous.ENV_DIR] = rdzv.dirname
 
@@ -243,6 +252,12 @@ def launch(nproc, cmd, node_ip="127.0.0.1", started_port=None, env=None,
                 # the hb dir is unconditional now: the .exit/.preempted
                 # markers live there even when heartbeats are off
                 hb_dir = tempfile.mkdtemp(prefix="paddle_tpu_hb_")
+                # pre-warm BEFORE the gang spawns and rendezvous
+                # completes: entries land in the page cache and corrupt
+                # ones are quarantined while the workers are still
+                # booting, not inside their first-step window
+                if compile_cache_dir:
+                    _compile_cache.prewarm(compile_cache_dir)
                 procs, logs = _spawn_gang(world, cmd, node_ip, base,
                                           base_env, backend, log_dir,
                                           hb_dir, spawn_no)

@@ -13,6 +13,7 @@ import itertools
 
 import numpy as np
 
+from . import compile_cache as _compile_cache
 from . import monitor as _monitor
 from . import rng as _rng
 from .. import jax_compat as _jax_compat
@@ -193,7 +194,14 @@ class CompiledProgram:
             ctx.shard_axes = list(mesh.axis_names)
             ctx.shard_sizes = dict(mesh.shape)
 
-    def wrap_step(self, step, program, block, feed, fetch_names, state_names):
+    def wrap_step(self, step, program, block, feed, fetch_names, state_names,
+                  cache_key=None, cache_read_dirs=None):
+        # cache_key/cache_read_dirs: the executor's persistent-compile-
+        # cache key for this step (fluid/compile_cache.py); each wrapper
+        # decorates its inner jit so a restart deserializes instead of
+        # recompiling. None => wrap_jit is a no-op passthrough.
+        self._cache_key = cache_key
+        self._cache_read_dirs = cache_read_dirs
         mode = getattr(self, "_mode", "gspmd")
         if mode == "shard_map":
             return self._wrap_step_shard_map(step, feed, fetch_names,
@@ -203,6 +211,11 @@ class CompiledProgram:
                                             fetch_names, state_names)
         return self._wrap_step_gspmd(step, block, feed, fetch_names,
                                      state_names)
+
+    def _cache_wrap(self, jfn, label):
+        return _compile_cache.wrap_jit(
+            jfn, getattr(self, "_cache_key", None),
+            read_dirs=getattr(self, "_cache_read_dirs", None), label=label)
 
     def _wrap_step_pipeline(self, program, block, feed, fetch_names,
                             state_names):
@@ -380,7 +393,8 @@ class CompiledProgram:
             check_vma=False)
         donate = ((0, 1) if self._build_strategy.enable_inplace
                   and _jax_compat.SHARD_MAP_DONATION_OK else ())
-        jfn = jax.jit(smapped, donate_argnums=donate)
+        jfn = self._cache_wrap(jax.jit(smapped, donate_argnums=donate),
+                               "pipeline")
 
         def fn(state, feed_vals, rng):
             params = {n: state[n] for n in state if n in wrt}
@@ -438,7 +452,8 @@ class CompiledProgram:
         )
         donate = ((0,) if self._build_strategy.enable_inplace
                   and _jax_compat.SHARD_MAP_DONATION_OK else ())
-        jfn = jax.jit(smapped, donate_argnums=donate)
+        jfn = self._cache_wrap(jax.jit(smapped, donate_argnums=donate),
+                               "shard_map")
         feed_shardings = {n: NamedSharding(mesh, feed_specs[n]) for n in feed}
 
         def fn(state, feed_vals, rng):
@@ -583,12 +598,12 @@ class CompiledProgram:
         # break the aliasing on older jax builds.
         out_shardings = ([repl for _ in fetch_names], state_shardings, repl)
         donate = (0,) if self._build_strategy.enable_inplace else ()
-        jfn = jax.jit(
+        jfn = self._cache_wrap(jax.jit(
             step,
             in_shardings=in_shardings,
             out_shardings=out_shardings,
             donate_argnums=donate,
-        )
+        ), "gspmd")
 
         def fn(state, feed_vals, rng):
             # Committed single-device arrays (e.g. from the startup program)
@@ -604,7 +619,8 @@ class CompiledProgram:
         return fn
 
     def wrap_batched_step(self, batched, block, stacked_feed,
-                          invariant_feed, fetch_names, state_names):
+                          invariant_feed, fetch_names, state_names,
+                          cache_key=None, cache_read_dirs=None):
         """Step-batched (``iters=k``) execution under this strategy.
         GSPMD only: stacked feeds shard their SECOND axis over 'dp' (the
         leading axis is the iteration index the device-side scan slices),
@@ -633,14 +649,16 @@ class CompiledProgram:
                              for n in stacked_feed}
         invariant_shardings = {n: self.feed_sharding(invariant_feed[n])
                                for n in invariant_feed}
+        self._cache_key = cache_key
+        self._cache_read_dirs = cache_read_dirs
         donate = (0,) if self._build_strategy.enable_inplace else ()
-        jfn = jax.jit(
+        jfn = self._cache_wrap(jax.jit(
             batched,
             in_shardings=(state_shardings, stacked_shardings,
                           invariant_shardings, repl),
             out_shardings=([repl for _ in fetch_names], None, repl),
             donate_argnums=donate,
-        )
+        ), "gspmd_batched")
 
         def fn(state, stacked_vals, invariant_vals, rng):
             state = {k: jax.device_put(v, state_shardings.get(k, repl))

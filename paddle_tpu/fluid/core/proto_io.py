@@ -121,7 +121,10 @@ def program_to_bytes(desc):
                 s.args.extend(args)
             for k, v in odesc["attrs"].items():
                 _attr_to_pb(o.attrs[k], v)
-    return p.SerializeToString()
+    # deterministic: map fields (op attrs, param_grad_map) otherwise
+    # serialize in per-process hash order, so the same program would
+    # hash to a different compile-cache key after every restart
+    return p.SerializeToString(deterministic=True)
 
 
 def program_from_bytes(data, check=True):

@@ -433,7 +433,7 @@ class QueueDataset(DatasetBase):
                     blob = chan.get()
                     if blob is None:
                         break
-                    item = pickle.loads(blob)
+                    item = pickle.loads(blob)  # trusted: bytes from OUR child worker over a private channel
                     if isinstance(item, tuple) and len(item) == 2 and \
                             item[0] == "__dataset_error__":
                         raise RuntimeError(

@@ -26,6 +26,7 @@ import os
 
 import numpy as np
 
+from . import compile_cache as _compile_cache
 from . import framework
 from . import monitor as _monitor
 from . import rng as _rng
@@ -49,6 +50,18 @@ _M_CACHE_MISS = _monitor.counter(
     "executor_compile_cache_miss_total",
     help="Executor.run that traced+jitted a new step "
          "(program/feed-signature/fetch-list/sharding change)")
+# tier-labeled views of the same series (the unlabeled legacy counters
+# keep their exact semantics): tier=memory is this process's dict,
+# tier=disk (owned by fluid/compile_cache.py) is the persistent tier a
+# restart hits
+_M_CACHE_HIT_MEM = _monitor.counter(
+    "executor_compile_cache_hit_total",
+    help="compile-cache hits by tier",
+    labels={"tier": "memory"})
+_M_CACHE_MISS_MEM = _monitor.counter(
+    "executor_compile_cache_miss_total",
+    help="compile-cache misses by tier",
+    labels={"tier": "memory"})
 _M_BATCHED_RUNS = _monitor.counter(
     "executor_batched_run_total",
     help="Executor.run calls that lowered iters>1 steps into one "
@@ -441,6 +454,10 @@ class Executor:
     def __init__(self, place=None):
         self.place = place
         self._cache = {}
+        # extra read-only disk-cache tiers consulted on a memory miss
+        # (e.g. a Predictor's model-adjacent __prelowered__ directory);
+        # the env-configured PADDLE_COMPILE_CACHE_DIR joins implicitly
+        self._cache_read_dirs = []
         # (reader ids, iters) -> in-flight _WindowPrefetch; one entry
         # per distinct prefetching batched loop (close() reaps them all)
         self._window_prefetch = {}
@@ -814,6 +831,7 @@ class Executor:
         step = self._cache.get(key)
         cache_hit = step is not None
         (_M_CACHE_HIT if cache_hit else _M_CACHE_MISS).inc()
+        (_M_CACHE_HIT_MEM if cache_hit else _M_CACHE_MISS_MEM).inc()
         if step is None:
             if _flags.check_program_enabled():
                 # debug mode (reference multi_devices_check_pass): validate
@@ -941,12 +959,23 @@ class Executor:
                     new_state[name] = env[name]
             return fetches, new_state, _rng.key_data(ctx.rng_key)
 
+        from . import flags as _flags
+
+        cache_key = None
+        if _compile_cache.active(self._cache_read_dirs):
+            cache_key = _compile_cache.step_key(
+                program, _feed_signature(feed, block), fetch_names,
+                state_names, strategy, 1,
+                _flags.anomaly_policy() == "raise")
+
         # Startup-style programs create new persistables -> output structure
         # depends on trace; jit handles that fine since structure is fixed
         # per cache entry.
         if strategy is not None and mesh is not None:
             return _CompiledStep(
-                strategy.wrap_step(step, program, block, feed, fetch_names, state_names),
+                strategy.wrap_step(step, program, block, feed, fetch_names,
+                                   state_names, cache_key=cache_key,
+                                   cache_read_dirs=self._cache_read_dirs),
                 state_names,
                 fetch_names,
             )
@@ -957,10 +986,11 @@ class Executor:
         # undonated. The policy sits in the compile-cache key, so
         # flipping FLAGS_anomaly_policy recompiles rather than reusing a
         # mismatched executable.
-        from . import flags as _flags
-
         donate = (0,) if _flags.anomaly_policy() == "raise" else ()
-        jfn = jax.jit(step, donate_argnums=donate)
+        jfn = _compile_cache.wrap_jit(
+            jax.jit(step, donate_argnums=donate), cache_key,
+            read_dirs=self._cache_read_dirs,
+            label="step#%s" % ",".join(fetch_names[:3]))
         return _CompiledStep(jfn, state_names, fetch_names)
 
     # -- step-batched execution (iters=k) ------------------------------
@@ -1172,6 +1202,7 @@ class Executor:
         step = self._cache.get(key)
         cache_hit = step is not None
         (_M_CACHE_HIT if cache_hit else _M_CACHE_MISS).inc()
+        (_M_CACHE_HIT_MEM if cache_hit else _M_CACHE_MISS_MEM).inc()
         if step is None:
             if _flags.check_program_enabled():
                 from .passes import apply_pass
@@ -1333,21 +1364,35 @@ class Executor:
                 body, (state, rng_key), stacked_feeds, length=iters)
             return traj, final_state, final_rng
 
+        from . import flags as _flags
+
+        cache_key = None
+        if _compile_cache.active(self._cache_read_dirs):
+            merged = dict(stacked)
+            merged.update(invariant)
+            cache_key = _compile_cache.step_key(
+                program, _feed_signature(merged, block), fetch_names,
+                state_names, strategy, iters,
+                _flags.anomaly_policy() == "raise")
+
         if strategy is not None and mesh is not None:
             return _CompiledStep(
                 strategy.wrap_batched_step(batched, block, stacked,
                                            invariant, fetch_names,
-                                           state_names),
+                                           state_names,
+                                           cache_key=cache_key,
+                                           cache_read_dirs=self._cache_read_dirs),
                 state_names,
                 fetch_names,
             )
 
         # see _build: donation off under skip_step/rollback so a
         # discarded window's pre-step state stays valid
-        from . import flags as _flags
-
         donate = (0,) if _flags.anomaly_policy() == "raise" else ()
-        jfn = jax.jit(batched, donate_argnums=donate)
+        jfn = _compile_cache.wrap_jit(
+            jax.jit(batched, donate_argnums=donate), cache_key,
+            read_dirs=self._cache_read_dirs,
+            label="batched#k=%d" % iters)
         return _CompiledStep(jfn, state_names, fetch_names)
 
     # convenience ------------------------------------------------------

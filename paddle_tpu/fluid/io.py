@@ -175,11 +175,20 @@ def load_persistables(executor, dirname, main_program=None, filename=None):
 def save_inference_model(dirname, feeded_var_names, target_vars, executor,
                          main_program=None, model_filename=None,
                          params_filename=None, export_for_deployment=True,
-                         program_only=False):
+                         program_only=False, prelower=False,
+                         prelower_batch_sizes=(1,)):
     """Prunes to the inference subgraph and saves program + params
     (reference ``io.py:1011``). ``export_for_deployment=False`` keeps the
     full (unpruned) program so it can be re-optimized later;
     ``program_only=True`` writes ``__model__`` without parameter files.
+
+    ``prelower=True`` additionally AOT-compiles the pruned program (one
+    executable per batch size in ``prelower_batch_sizes``; dynamic
+    non-batch dims fill with 1) and serializes the executables into
+    ``<dirname>/__prelowered__`` via ``fluid.compile_cache`` — a
+    ``Predictor`` opening this model then cold-starts by deserializing
+    instead of tracing+compiling, no ``PADDLE_COMPILE_CACHE_DIR``
+    needed. Batch sizes not in the list still compile live as usual.
     """
     main_program = main_program or framework.default_main_program()
     if export_for_deployment:
@@ -225,7 +234,56 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
                 if v.persistable and v.name in needed]
         save_vars(executor, dirname, main_program, vars=vars,
                   filename=params_filename)
+    if prelower:
+        _prelower_executables(dirname, model_bytes, prelower_batch_sizes)
     return pruned._fetch_names
+
+
+def _prelower_executables(dirname, model_bytes, batch_sizes):
+    """AOT-compile + serialize the saved inference program into
+    ``<dirname>/__prelowered__``.
+
+    The program is re-parsed from the exact ``__model__`` bytes just
+    written (not the in-memory pruned object) so the content digest in
+    the cache key matches what ``load_inference_model`` will compute at
+    cold start; params come from the calling scope (they were just
+    saved from it). Exemplar feeds are zeros in the declared shapes —
+    the first dynamic (-1) dim takes the batch size, any other dynamic
+    dim takes 1."""
+    from . import compile_cache as _compile_cache
+    from .core import proto_io
+    from .executor import Executor
+
+    desc = proto_io.program_from_bytes(model_bytes)
+    program = Program.from_desc(desc)
+    block = program.global_block()
+    feed_names = list(desc.get("feed_names", []))
+    fetch_names = list(desc.get("fetch_names", []))
+    out_dir = os.path.join(dirname, _compile_cache.PRELOWERED_DIRNAME)
+    exe = Executor()
+    # a child scope keeps the exemplar run's state commits (and the rng
+    # var) out of the caller's scope while params resolve through it
+    scope = global_scope().new_scope()
+    with _compile_cache.override_dir(out_dir):
+        for b in batch_sizes:
+            feed = {}
+            for name in feed_names:
+                var = block._find_var_recursive(name)
+                if var is None or var.shape is None:
+                    raise ValueError(
+                        "prelower: feed var %r has no declared shape — "
+                        "pass explicit exemplar batches through the "
+                        "serving warm-up instead" % name)
+                shape, batch_dim_used = [], False
+                for d in var.shape:
+                    if int(d) < 0:
+                        shape.append(1 if batch_dim_used else int(b))
+                        batch_dim_used = True
+                    else:
+                        shape.append(int(d))
+                feed[name] = np.zeros(shape, dtype=np.dtype(var.dtype))
+            exe.run(program, feed=feed, fetch_list=fetch_names,
+                    scope=scope)
 
 
 def load_inference_model(dirname, executor, model_filename=None,
@@ -713,6 +771,12 @@ class CheckpointManager:
         attempt = int(os.environ.get(ENV_RESTART_ATTEMPT, "0") or 0)
         if attempt <= 0:
             return None
+        # restarted worker: page in + validate the persistent compile
+        # cache now, so the first step deserializes instead of
+        # recompiling (no-op when PADDLE_COMPILE_CACHE_DIR is unset)
+        from . import compile_cache as _compile_cache
+
+        _compile_cache.prewarm()
         if self.latest() is None:
             return None
         return self.restore(executor, program, scope, strategy=strategy)

@@ -11,6 +11,7 @@ compile-cached jit of the whole pruned program — one fused executable
 instead of an op interpreter.
 """
 
+import os
 import time as _time
 
 import numpy as np
@@ -76,6 +77,19 @@ class Predictor:
             config = Config(model_dir=config)
         self._config = config
         exe = fluid.Executor()
+        # a model exported with save_inference_model(prelower=True)
+        # carries serialized executables next to __model__; registering
+        # the dir as a read-only cache tier makes this predictor's cold
+        # start deserialize instead of trace+compile (fluid/compile_cache)
+        from ..fluid import compile_cache as _compile_cache
+
+        if _clone_of is not None:
+            exe._cache_read_dirs = list(_clone_of._exe._cache_read_dirs)
+        elif getattr(config, "model_dir", None):
+            prelowered = os.path.join(
+                config.model_dir, _compile_cache.PRELOWERED_DIRNAME)
+            if os.path.isdir(prelowered):
+                exe._cache_read_dirs.append(prelowered)
         if _clone_of is not None:
             # share the source predictor's weights AND parsed program —
             # no disk re-read, and scope contents (e.g. bf16-cast weights)

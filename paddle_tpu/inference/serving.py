@@ -86,6 +86,17 @@ def _metrics(model):
             "serving_request_seconds",
             help="submit -> future resolved end-to-end latency",
             labels=lbl),
+        "warmup_seconds": _monitor.histogram(
+            "serving_warmup_seconds",
+            help="register() warm-up ladder wall time (one sample per "
+                 "register call; the replica's cold-start compile cost)",
+            labels=lbl),
+        "warmup_disk_hits": _monitor.counter(
+            "serving_warmup_disk_hits_total",
+            help="warm-up ladder executables deserialized from the "
+                 "persistent compile cache instead of compiled live "
+                 "(restart skipped these compiles)",
+            labels=lbl),
     }
 
 
@@ -265,12 +276,16 @@ class Server:
         return entry.config.ladder()
 
     def _warmup(self, entry, warmup_feed):
+        from ..fluid import compile_cache as _compile_cache
+
         exemplar = {n: np.asarray(v) for n, v in warmup_feed.items()}
         for n, v in exemplar.items():
             if v.ndim < 1 or v.shape[0] != 1:
                 raise ValueError(
                     "warmup_feed[%r] must be one exemplar row "
                     "[1, ...], got shape %r" % (n, v.shape))
+        t0 = time.perf_counter()
+        disk_hits0 = _compile_cache.disk_hit_count()
         with _DISPATCH_LOCK:
             for b in entry.config.ladder():
                 feed = {n: np.repeat(_bucket_pad(
@@ -278,6 +293,10 @@ class Server:
                             entry.config.pad_value), b, axis=0)
                         for n, v in exemplar.items()}
                 entry.predictor.run(feed)
+        entry.metrics["warmup_seconds"].observe(time.perf_counter() - t0)
+        skipped = _compile_cache.disk_hit_count() - disk_hits0
+        if skipped:
+            entry.metrics["warmup_disk_hits"].inc(skipped)
 
     # -- client side -------------------------------------------------------
     def submit(self, model, feed):

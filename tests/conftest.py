@@ -55,6 +55,10 @@ def pytest_configure(config):
         "markers", "embedding: exercises the sparse embedding engine "
                    "(mesh-sharded dedup-gather tier, host-offloaded "
                    "resident-cache tier, fused sparse optimizer updates)")
+    config.addinivalue_line(
+        "markers", "compile_cache: exercises the persistent on-disk "
+                   "compile cache (AOT serialize/deserialize, "
+                   "quarantine, eviction, prelowered models)")
 
 
 @pytest.fixture(autouse=True)

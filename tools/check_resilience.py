@@ -18,6 +18,14 @@ machinery entirely. Both are detected at the AST level (a docstring
 MENTIONING os._exit is fine; a call needs a trailing justification
 comment or must move into preemption.py).
 
+``pickle.load``/``pickle.loads`` gets the same treatment:
+``fluid/compile_cache.py`` is the single sanctioned deserialization
+site for persistent compile-cache entries (it quarantines on ANY
+failure instead of crashing); any other call site needs a trailing
+comment saying why its input is trusted. Likewise, ``open()`` with a
+``.xc`` literal (a cache entry) outside compile_cache.py bypasses the
+quarantine/atomic-write discipline and is flagged.
+
 Usage: python tools/check_resilience.py [root]   (default: repo root)
 Exit code 0 = clean, 1 = violations (one per line on stdout).
 """
@@ -44,6 +52,15 @@ _RAW_CALL_EXEMPT = ("distributed/preemption.py",)
 # module.attr calls that need a justification (or to live in an exempt
 # file): rogue handler registration / raw process exits
 _RAW_CALLS = {("signal", "signal"), ("os", "_exit")}
+
+# the single sanctioned home for deserializing compile-cache entries
+# (quarantine-on-failure; see fluid/compile_cache.py module doc)
+_PICKLE_EXEMPT = ("fluid/compile_cache.py",)
+_PICKLE_CALLS = {("pickle", "load"), ("pickle", "loads")}
+
+# compile-cache entry suffix: open()ing one of these anywhere else
+# bypasses the quarantine/atomic-write discipline
+_CACHE_ENTRY_SUFFIX = ".xc"
 
 
 def _line_has_justification(line):
@@ -74,11 +91,11 @@ def _line_has_justification(line):
     return False
 
 
-def _raw_call_violations(source):
-    """(lineno, line) for raw ``signal.signal(...)`` / ``os._exit(...)``
-    CALLS without a trailing justification comment. AST-based on
-    purpose: prose or docstrings mentioning the names must not trip the
-    lint, only actual call sites."""
+def _call_violations(source, calls):
+    """(lineno, line) for ``module.attr(...)`` CALLS from ``calls``
+    without a trailing justification comment. AST-based on purpose:
+    prose or docstrings mentioning the names must not trip the lint,
+    only actual call sites."""
     try:
         tree = ast.parse(source)
     except SyntaxError:
@@ -91,7 +108,35 @@ def _raw_call_violations(source):
         f = node.func
         if not (isinstance(f, ast.Attribute)
                 and isinstance(f.value, ast.Name)
-                and (f.value.id, f.attr) in _RAW_CALLS):
+                and (f.value.id, f.attr) in calls):
+            continue
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if not _line_has_justification(line):
+            out.append((node.lineno, line.strip()))
+    return out
+
+
+def _cache_open_violations(source):
+    """(lineno, line) for ``open(...)`` calls whose arguments carry a
+    ``.xc`` string literal — a compile-cache entry touched outside the
+    sanctioned module skips quarantine-on-corruption on the read side
+    and atomic tmp+fsync+rename on the write side."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return []
+    lines = source.splitlines()
+    out = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "open"):
+            continue
+        literal = any(
+            isinstance(sub, ast.Constant) and isinstance(sub.value, str)
+            and _CACHE_ENTRY_SUFFIX in sub.value
+            for arg in node.args for sub in ast.walk(arg))
+        if not literal:
             continue
         line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
         if not _line_has_justification(line):
@@ -111,7 +156,10 @@ def check_file(path):
             out.append((lineno, line.strip()))
     norm = path.replace(os.sep, "/")
     if not any(norm.endswith(suffix) for suffix in _RAW_CALL_EXEMPT):
-        out.extend(_raw_call_violations(source))
+        out.extend(_call_violations(source, _RAW_CALLS))
+    if not any(norm.endswith(suffix) for suffix in _PICKLE_EXEMPT):
+        out.extend(_call_violations(source, _PICKLE_CALLS))
+        out.extend(_cache_open_violations(source))
     return sorted(out)
 
 
@@ -139,10 +187,11 @@ def main(argv=None):
               % (path, lineno, line))
     if violations:
         print("%d unjustified site(s): bare-except/BaseException, raw "
-              "signal.signal, or raw os._exit — add a trailing comment "
-              "explaining why the site is safe, narrow the exception, "
-              "or route signals through distributed/preemption"
-              % len(violations))
+              "signal.signal, raw os._exit, raw pickle.load(s), or a "
+              ".xc cache entry opened outside fluid/compile_cache — "
+              "add a trailing comment explaining why the site is safe, "
+              "narrow the exception, or route the access through the "
+              "sanctioned module" % len(violations))
         return 1
     return 0
 
