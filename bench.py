@@ -382,6 +382,85 @@ def bench_longseq(batch_size=8, seq_len=2048, warmup=3, iters=10,
             prefix + "_seq_len": seq_len}
 
 
+def bench_multihost(warmup=3, iters=10, grad_mb=4):
+    """Hierarchical-DP scaling curve (opt-in BENCH_MULTIHOST=1, the
+    MULTICHIP_r06 shape): simulate H hosts x D devices over the local
+    device set for H in 1,2,4 and measure (a) steps/sec of an MLP
+    trained under ``HierarchicalGradAllReduce`` on the ("host",
+    "device") mesh and (b) the per-phase ici/dcn seconds+bytes of a
+    ``CrossHostGradSync`` allreduce over a ``grad_mb``-MB gradient,
+    with and without DGC top-k compression of the DCN phase — the
+    ici/dcn split and the DGC byte reduction are the two numbers the
+    DCN story stands on."""
+    import jax
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers, monitor, optimizer
+    from paddle_tpu.fluid.transpiler.collective import (
+        HierarchicalGradAllReduce)
+    from paddle_tpu.parallel import CrossHostGradSync
+
+    ndev = len(jax.devices())
+    out = {"multihost_devices": ndev}
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(64, 64).astype(np.float32),
+            "y": rng.rand(64, 1).astype(np.float32)}
+    for hosts in (1, 2, 4):
+        if ndev % hosts or hosts > ndev:
+            continue
+        dev_per_host = ndev // hosts
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 11
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", shape=[64], dtype="float32")
+            y = layers.data("y", shape=[1], dtype="float32")
+            h = layers.fc(x, size=256, act="relu")
+            p = layers.fc(h, size=1)
+            loss = layers.mean(layers.square(p - y))
+            optimizer.SGD(0.01).minimize(loss)
+        HierarchicalGradAllReduce(nranks=ndev).transpile(startup, main)
+        compiled = fluid.CompiledProgram(main).with_explicit_collectives(
+            loss_name=loss.name, mesh_axes=("host", "device"),
+            mesh_shape={"host": hosts, "device": dev_per_host})
+        exe = fluid.Executor()
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            for _ in range(warmup):
+                exe.run(compiled, feed=feed, fetch_list=[loss])
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                (lv,) = exe.run(compiled, feed=feed, fetch_list=[loss])
+            jax.block_until_ready(lv)
+            step_s = (time.perf_counter() - t0) / iters
+        out["multihost_h%d_steps_per_sec" % hosts] = round(1.0 / step_s, 2)
+
+        # phase-attributed allreduce, dense vs DGC-compressed DCN
+        n = grad_mb * (1 << 20) // 4
+        grad = rng.rand(hosts, dev_per_host, n).astype(np.float32)
+        for tag, ratio in (("dense", None), ("dgc", 0.01)):
+            monitor.reset()
+            sync = CrossHostGradSync(hosts, dev_per_host, dgc_ratio=ratio)
+            for _ in range(warmup):
+                sync.allreduce([grad])
+            monitor.reset()
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                sync.allreduce([grad])
+            total = time.perf_counter() - t0
+            dump = monitor.dump_json()
+            sec = {e["labels"]["phase"]: e["sum"]
+                   for e in dump["crosshost_allreduce_seconds"]}
+            byt = {e["labels"]["phase"]: e["value"]
+                   for e in dump["crosshost_allreduce_bytes_total"]}
+            pre = "multihost_h%d_%s" % (hosts, tag)
+            out[pre + "_allreduce_ms"] = round(total / iters * 1e3, 3)
+            out[pre + "_ici_seconds"] = round(sec.get("ici", 0.0), 4)
+            out[pre + "_dcn_seconds"] = round(sec.get("dcn", 0.0), 4)
+            out[pre + "_dcn_bytes_per_step"] = \
+                int(byt.get("dcn", 0) // iters)
+    return out
+
+
 def bench_deepfm(batch_size=4096, warmup=20, iters=2000):
     """BASELINE config 4 (DeepFM CTR examples/sec/chip); opt-in via
     BENCH_DEEPFM=1. Embedding-gather dominated — the number that matters
@@ -1213,6 +1292,8 @@ if __name__ == "__main__":
         out.update(bench_embedding())
     if os.environ.get("BENCH_RESTART") == "1":
         out.update(bench_restart())
+    if os.environ.get("BENCH_MULTIHOST") == "1":
+        out.update(bench_multihost())
     if os.environ.get("BENCH_LONGSEQ") == "1":
         out.update(bench_longseq())
         out.update(bench_longseq(batch_size=4, seq_len=4096,

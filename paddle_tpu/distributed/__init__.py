@@ -1,10 +1,16 @@
-"""Distributed runtime: parameter-server tier + multi-process launch.
+"""Distributed runtime: parameter-server tier + multi-process launch +
+the TCP coordination service multi-host jobs bootstrap from.
 
 Reference: ``python/paddle/distributed/`` (launch.py) and the PS stack
-(SURVEY §2.5/§2.6).
+(SURVEY §2.5/§2.6); the coordination service is the gen_nccl_id
+analogue (SURVEY names it a "jax.distributed-style coordination
+service").
 """
 
-from . import env, heartbeat, launch, ps  # noqa: F401
+from . import coordination, env, heartbeat, launch, ps  # noqa: F401
+from . import rendezvous, wire  # noqa: F401
+from .coordination import CoordClient, CoordServer  # noqa: F401
 from .heartbeat import Heartbeat, Watchdog  # noqa: F401
+from .rendezvous import Rendezvous, TcpRendezvous  # noqa: F401
 from .env import (init_parallel_env, parallel_env,  # noqa: F401
                   wait_server_ready)

@@ -1,0 +1,430 @@
+"""Coordination service: the TCP control plane multi-host training
+bootstraps from (reference ``gen_nccl_id``/``c_gen_nccl_id`` over gRPC
+— a tiny RPC service every trainer contacts before the first collective
+runs; SURVEY §2.6 names our equivalent a "jax.distributed-style
+coordination service").
+
+One ``CoordServer`` (rank-0-hosted by the launcher, or standalone)
+holds the whole control-plane state in memory:
+
+  * a key-value store (small blobs) with wait-and-watch GET — the
+    primitive rendezvous, rank assignment, and jax-coordinator
+    discovery are built from;
+  * generation-numbered barriers with idempotent arrival (a retried
+    ARRIVE after a dropped response must not count twice);
+  * liveness leases mirroring the file-heartbeat model of
+    ``heartbeat.py`` — a client renews ``lease(id, ttl)``; ``live()``
+    is the set whose leases have not expired.
+
+Transport is the shared ``distributed/wire.py`` framing (length-prefix,
+magic+token handshake under ``PADDLE_COORD_TOKEN``, reconnect with the
+``fluid.resilience.Retry`` policy at site ``coord.rpc``). Server-side
+blocking is deliberately SHORT per request (≤ ``_WAIT_SLICE``): the
+client's socket carries a fixed timeout, so long waits are client-side
+loops of short server-side waits — a dropped connection mid-wait then
+costs one slice, not the whole deadline.
+
+Env contract: ``PADDLE_COORD_ADDR`` (host:port of a live server) and
+``PADDLE_COORD_BACKEND`` ("tcp" | "file") select the rendezvous
+backend end to end; see ``rendezvous.create``.
+"""
+
+import json
+import os
+import struct
+import threading
+import time
+
+from ..fluid import monitor as _monitor
+from . import wire as _wire
+
+__all__ = ["ENV_ADDR", "ENV_BACKEND", "ENV_TOKEN", "CoordServer",
+           "CoordClient", "current_coord_addr"]
+
+ENV_ADDR = "PADDLE_COORD_ADDR"
+ENV_BACKEND = "PADDLE_COORD_BACKEND"
+ENV_TOKEN = "PADDLE_COORD_TOKEN"
+
+_MAGIC = b"PTCO1"
+
+# opcodes
+(_PUT, _GET, _DEL, _ADD, _LIST, _BAR_ARRIVE, _BAR_WAIT, _LEASE, _LIVE,
+ _PING, _STOP) = range(1, 12)
+
+# server-side waits are bounded by this slice; clients loop short waits
+# up to their own deadline (see module doc)
+_WAIT_SLICE = 5.0
+
+# control-plane blobs are small (world plans, endpoints, nccl-id-sized
+# payloads); a far lower cap than the PS tier keeps a bad peer from
+# parking 256 MiB in the KV store
+_MAX_FRAME = int(os.environ.get("PADDLE_COORD_MAX_FRAME_BYTES",
+                                16 * 1024 * 1024))
+
+_M_PUTS = _monitor.counter(
+    "coord_puts_total", "KV put requests served by the coordination service")
+_M_GETS = _monitor.counter(
+    "coord_gets_total", "KV get requests served by the coordination service")
+_M_BARRIERS = _monitor.counter(
+    "coord_barriers_total", "barrier generations released")
+_M_BARRIER_WAIT = _monitor.histogram(
+    "coord_barrier_wait_seconds",
+    "per-participant wall time from arrival to barrier release")
+_M_WATCHERS = _monitor.gauge(
+    "coord_watch_clients",
+    "requests currently blocked server-side in a wait (watching GET or "
+    "barrier wait)")
+
+
+def current_coord_addr():
+    """The coordination-service endpoint this process should use, or
+    None outside a TCP-coordinated job."""
+    return os.environ.get(ENV_ADDR) or None
+
+
+def _pack_str(s):
+    b = s.encode()
+    if len(b) > 0xFFFF:
+        raise ValueError("string field of %d bytes too long" % len(b))
+    return struct.pack("<H", len(b)) + b
+
+
+def _unpack_str(buf, off):
+    try:
+        (n,) = struct.unpack_from("<H", buf, off)
+        off += 2
+        s = buf[off:off + n]
+        if len(s) != n:
+            raise _wire.DecodeError("truncated string field")
+        return s.decode("utf-8"), off + n
+    except (struct.error, UnicodeDecodeError) as e:
+        raise _wire.DecodeError("malformed string field: %r" % e)
+
+
+def _unpack(fmt, buf, off):
+    try:
+        vals = struct.unpack_from(fmt, buf, off)
+    except struct.error as e:
+        raise _wire.DecodeError("truncated fields %s: %r" % (fmt, e))
+    return vals, off + struct.calcsize(fmt)
+
+
+class _Barrier:
+    __slots__ = ("generation", "arrived", "arrive_ts")
+
+    def __init__(self):
+        self.generation = 0
+        self.arrived = set()
+        self.arrive_ts = {}
+
+
+class CoordServer(_wire.FramedServer):
+    """Threaded in-memory control-plane server. All state lives under
+    one ``threading.Condition`` — every mutation notifies, every wait
+    is a bounded ``wait_for`` on it; with tens of clients and
+    control-plane-sized traffic the single lock is nowhere near
+    contention."""
+
+    MAGIC = _MAGIC
+    TOKEN_ENV = ENV_TOKEN
+
+    def __init__(self, host="127.0.0.1", port=0, token=None):
+        super().__init__(host=host, port=port, token=token, backlog=64)
+        self._cv = threading.Condition()
+        self._kv = {}             # key -> bytes
+        self._barriers = {}       # name -> _Barrier
+        self._leases = {}         # client id -> absolute expiry deadline
+
+    # -- request handling ---------------------------------------------------
+    def _serve_authenticated(self, conn):
+        while not self._stop.is_set():
+            try:
+                req = _wire.read_frame(conn, _MAX_FRAME)
+            except (ConnectionError, OSError):
+                return
+            resp = self._handle(req)
+            try:
+                _wire.send_all(conn, _wire.frame(resp))
+            except (ConnectionError, OSError):
+                return
+            if req and req[0] == _STOP:
+                self._stop.set()
+                return
+
+    def _handle(self, req):
+        try:
+            if not req:
+                raise _wire.DecodeError("empty request")
+            op = req[0]
+            if op == _PING:
+                return b"\x00"
+            if op == _STOP:
+                return b"\x00"
+            key, off = _unpack_str(req, 1)
+            if op == _PUT:
+                return self._do_put(key, req[off:])
+            if op == _GET:
+                (wait,), off = _unpack("<d", req, off)
+                return self._do_get(key, wait)
+            if op == _DEL:
+                return self._do_del(key)
+            if op == _ADD:
+                (delta,), off = _unpack("<q", req, off)
+                return self._do_add(key, delta)
+            if op == _LIST:
+                return self._do_list(key)
+            if op == _BAR_ARRIVE:
+                cid, off = _unpack_str(req, off)
+                (world,), off = _unpack("<q", req, off)
+                return self._do_barrier_arrive(key, cid, world)
+            if op == _BAR_WAIT:
+                (gen, wait), off = _unpack("<qd", req, off)
+                return self._do_barrier_wait(key, gen, wait)
+            if op == _LEASE:
+                (ttl,), off = _unpack("<d", req, off)
+                return self._do_lease(key, ttl)
+            if op == _LIVE:
+                return self._do_live()
+            raise _wire.DecodeError("unknown opcode %d" % op)
+        except _wire.DecodeError as e:
+            return b"\x01" + ("decode error: %s" % e).encode()[:512]
+        except Exception as e:  # surface to the client, keep serving
+            return b"\x01" + repr(e).encode()[:512]
+
+    # -- KV -----------------------------------------------------------------
+    def _do_put(self, key, value):
+        with self._cv:
+            self._kv[key] = bytes(value)
+            self._cv.notify_all()
+        _M_PUTS.inc()
+        return b"\x00"
+
+    def _do_get(self, key, wait):
+        _M_GETS.inc()
+        deadline = time.monotonic() + min(max(wait, 0.0), _WAIT_SLICE)
+        with self._cv:
+            if key in self._kv:
+                return b"\x00\x01" + self._kv[key]  # ok, found + value
+            with _M_WATCHERS.track():
+                while key not in self._kv:
+                    left = deadline - time.monotonic()
+                    if left <= 0 or self._stop.is_set():
+                        return b"\x00\x00"          # ok, not found
+                    self._cv.wait(timeout=min(left, 0.2))
+            return b"\x00\x01" + self._kv[key]
+
+    def _do_del(self, key):
+        with self._cv:
+            existed = self._kv.pop(key, None) is not None
+            self._cv.notify_all()
+        return b"\x00" + (b"\x01" if existed else b"\x00")
+
+    def _do_add(self, key, delta):
+        # atomic fetch-add; stored as ascii so a plain GET interops
+        with self._cv:
+            cur = int(self._kv.get(key, b"0") or b"0")
+            cur += int(delta)
+            self._kv[key] = str(cur).encode()
+            self._cv.notify_all()
+        return b"\x00" + struct.pack("<q", cur)
+
+    def _do_list(self, prefix):
+        with self._cv:
+            keys = sorted(k for k in self._kv if k.startswith(prefix))
+        return b"\x00" + json.dumps(keys).encode()
+
+    # -- barriers -----------------------------------------------------------
+    def _do_barrier_arrive(self, name, cid, world):
+        if world <= 0:
+            raise _wire.DecodeError("barrier world must be positive")
+        now = time.monotonic()
+        with self._cv:
+            bar = self._barriers.setdefault(name, _Barrier())
+            entry_gen = bar.generation
+            if cid not in bar.arrived:       # idempotent re-arrival
+                bar.arrived.add(cid)
+                bar.arrive_ts[cid] = now
+            if len(bar.arrived) >= world:
+                for t in bar.arrive_ts.values():
+                    _M_BARRIER_WAIT.observe(now - t)
+                bar.generation += 1
+                bar.arrived.clear()
+                bar.arrive_ts.clear()
+                _M_BARRIERS.inc()
+                self._cv.notify_all()
+            return b"\x00" + struct.pack("<q", entry_gen)
+
+    def _do_barrier_wait(self, name, gen, wait):
+        deadline = time.monotonic() + min(max(wait, 0.0), _WAIT_SLICE)
+        with self._cv:
+            bar = self._barriers.setdefault(name, _Barrier())
+            if bar.generation > gen:
+                return b"\x00\x01" + struct.pack("<q", bar.generation)
+            with _M_WATCHERS.track():
+                while bar.generation <= gen:
+                    left = deadline - time.monotonic()
+                    if left <= 0 or self._stop.is_set():
+                        return (b"\x00\x00"
+                                + struct.pack("<q", bar.generation))
+                    self._cv.wait(timeout=min(left, 0.2))
+            return b"\x00\x01" + struct.pack("<q", bar.generation)
+
+    # -- leases -------------------------------------------------------------
+    def _do_lease(self, cid, ttl):
+        with self._cv:
+            self._leases[cid] = time.monotonic() + max(float(ttl), 0.0)
+        return b"\x00"
+
+    def _do_live(self):
+        now = time.monotonic()
+        with self._cv:
+            # expired leases are garbage, not history — drop them so the
+            # map cannot grow with elastic client churn
+            dead = [c for c, d in self._leases.items() if d <= now]
+            for c in dead:
+                del self._leases[c]
+            live = sorted(self._leases)
+        return b"\x00" + json.dumps(live).encode()
+
+
+class CoordClient:
+    """Client proxy over one ``wire.Conn``. Thread-safe (the Conn owns a
+    request lock). Every wait is a client-side loop of short
+    server-side waits so socket timeouts never fire mid-wait."""
+
+    def __init__(self, endpoint, token=None):
+        self._conn = _CoordConn(endpoint, token=token)
+        self._lease_thread = None
+        self._lease_stop = threading.Event()
+
+    @property
+    def endpoint(self):
+        return self._conn.endpoint
+
+    # -- KV -----------------------------------------------------------------
+    def put(self, key, value):
+        if isinstance(value, str):
+            value = value.encode()
+        self._conn.request(
+            struct.pack("<B", _PUT) + _pack_str(key) + bytes(value))
+
+    def get(self, key, wait=False, timeout=60.0):
+        """Value bytes, or None when absent. ``wait=True`` blocks up to
+        ``timeout`` seconds for the key to appear."""
+        deadline = time.monotonic() + (timeout if wait else 0.0)
+        while True:
+            left = max(deadline - time.monotonic(), 0.0)
+            resp = self._conn.request(
+                struct.pack("<B", _GET) + _pack_str(key) +
+                struct.pack("<d", min(left, _WAIT_SLICE)))
+            if resp[:1] == b"\x01":
+                return resp[1:]
+            if not wait or time.monotonic() >= deadline:
+                return None
+
+    def delete(self, key):
+        """True when the key existed — the atomic claim primitive
+        (exactly one of N concurrent deleters sees True)."""
+        resp = self._conn.request(struct.pack("<B", _DEL) + _pack_str(key))
+        return resp[:1] == b"\x01"
+
+    def add(self, key, delta=1):
+        """Atomic fetch-add; returns the post-add value."""
+        resp = self._conn.request(
+            struct.pack("<B", _ADD) + _pack_str(key) +
+            struct.pack("<q", int(delta)))
+        return struct.unpack("<q", resp)[0]
+
+    def keys(self, prefix=""):
+        resp = self._conn.request(struct.pack("<B", _LIST) +
+                                  _pack_str(prefix))
+        return json.loads(resp.decode())
+
+    # -- barrier ------------------------------------------------------------
+    def barrier(self, name, world, client_id, timeout=120.0):
+        """Block until ``world`` distinct client ids arrive at
+        ``name``. Arrival is idempotent per client id, so transport
+        retries cannot double-count. Returns the released generation;
+        raises TimeoutError past ``timeout``."""
+        resp = self._conn.request(
+            struct.pack("<B", _BAR_ARRIVE) + _pack_str(name) +
+            _pack_str(client_id) + struct.pack("<q", int(world)))
+        (entry_gen,) = struct.unpack("<q", resp)
+        deadline = time.monotonic() + timeout
+        while True:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                raise TimeoutError(
+                    "barrier %r (world %d) not released within %.1fs"
+                    % (name, world, timeout))
+            resp = self._conn.request(
+                struct.pack("<B", _BAR_WAIT) + _pack_str(name) +
+                struct.pack("<qd", entry_gen, min(left, _WAIT_SLICE)))
+            released, gen = resp[0], struct.unpack_from("<q", resp, 1)[0]
+            if released:
+                return gen
+
+    # -- broadcast ----------------------------------------------------------
+    def broadcast(self, key, value=None, timeout=60.0):
+        """Small-blob broadcast: the root passes ``value`` (put), every
+        other rank passes None (wait-get). Returns the blob bytes."""
+        if value is not None:
+            if isinstance(value, str):
+                value = value.encode()
+            self.put(key, value)
+            return bytes(value)
+        got = self.get(key, wait=True, timeout=timeout)
+        if got is None:
+            raise TimeoutError("broadcast key %r not published within "
+                               "%.1fs" % (key, timeout))
+        return got
+
+    # -- liveness -----------------------------------------------------------
+    def lease(self, client_id, ttl=10.0):
+        self._conn.request(struct.pack("<B", _LEASE) +
+                           _pack_str(client_id) + struct.pack("<d", ttl))
+
+    def live(self):
+        resp = self._conn.request(struct.pack("<B", _LIVE) +
+                                  _pack_str(""))
+        return json.loads(resp.decode())
+
+    def start_lease_keeper(self, client_id, ttl=10.0, interval=None):
+        """Daemon thread renewing this client's lease at interval
+        (default ttl/3) — the TCP mirror of heartbeat.Heartbeat."""
+        if self._lease_thread is not None:
+            return self
+        interval = interval or max(ttl / 3.0, 0.5)
+
+        def _keep():
+            while not self._lease_stop.wait(interval):
+                try:
+                    self.lease(client_id, ttl=ttl)
+                except (ConnectionError, RuntimeError):
+                    return  # server gone; the lease will expire on its own
+        self.lease(client_id, ttl=ttl)
+        self._lease_thread = threading.Thread(target=_keep, daemon=True)
+        self._lease_thread.start()
+        return self
+
+    def ping(self):
+        self._conn.request(struct.pack("<B", _PING))
+
+    def stop_server(self):
+        self._conn.request(struct.pack("<B", _STOP))
+
+    def close(self):
+        self._lease_stop.set()
+        if self._lease_thread is not None:
+            self._lease_thread.join(timeout=2)
+            self._lease_thread = None
+        self._conn.close()
+
+
+class _CoordConn(_wire.Conn):
+    MAGIC = _MAGIC
+    TOKEN_ENV = ENV_TOKEN
+
+    def __init__(self, endpoint, token=None):
+        super().__init__(endpoint, token=token, retry_name="coord.rpc",
+                         max_frame=_MAX_FRAME)

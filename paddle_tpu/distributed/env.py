@@ -6,7 +6,15 @@ Env contract (reference role_maker.py:327 + launch.py):
   PADDLE_TRAINER_ID        this process's rank
   PADDLE_TRAINERS_NUM      world size
   PADDLE_TRAINER_ENDPOINTS comma list; endpoint 0 doubles as the
-                           coordination-service address
+                           jax coordinator when no coordination
+                           service is configured
+  PADDLE_COORD_ADDR        host:port of a live coordination service
+                           (distributed/coordination.py). When set,
+                           rank/world/jax-coordinator are derived FROM
+                           THE SERVICE — no shared filesystem, and
+                           missing PADDLE_TRAINER_ID/TRAINERS_NUM are
+                           assigned by the service (atomic rank
+                           counter + published world size).
   PADDLE_DIST_BACKEND      optional: "cpu" forces the virtual-CPU backend
                            with gloo cross-process collectives (the test
                            fake-cluster mode, SURVEY §4); unset = chips.
@@ -59,12 +67,63 @@ def trainer_env(rank, endpoints, attempt=0, base_env=None):
     return env
 
 
+def _coord_bootstrap():
+    """(rank, world, jax_coordinator) from the coordination service.
+    Rank/world come from the PADDLE_* env when the launcher set them;
+    a standalone joiner without them draws a rank from the service's
+    atomic counter and waits for the published world size. Rank 0
+    picks a fresh port on its own host for the jax coordinator and
+    publishes it — the piece that previously required endpoint 0 of a
+    shared env list. All keys are namespaced by the restart attempt so
+    a reformed gang can never read the previous generation's values."""
+    from . import coordination as _coord
+    from . import wire as _wire
+
+    client = _coord.CoordClient(_coord.current_coord_addr())
+    try:
+        ns = "env/%s/" % os.environ.get("PADDLE_RESTART_ATTEMPT", "0")
+        rank_s = os.environ.get("PADDLE_TRAINER_ID")
+        if rank_s:
+            rank = int(rank_s)
+        else:
+            rank = client.add(ns + "rank_counter", 1) - 1
+        world_s = os.environ.get("PADDLE_TRAINERS_NUM")
+        if world_s:
+            world = int(world_s)
+        else:
+            raw = client.get(ns + "world_size", wait=True, timeout=120.0)
+            if raw is None:
+                raise TimeoutError(
+                    "coordination service never published %sworld_size "
+                    "(set PADDLE_TRAINERS_NUM or have the launcher put "
+                    "it)" % ns)
+            world = int(raw)
+        if rank == 0:
+            host = os.environ.get("PADDLE_CURRENT_ENDPOINT",
+                                  "").rsplit(":", 1)[0] or "127.0.0.1"
+            coordinator = "%s:%d" % (host, _wire.free_port(host))
+            client.put(ns + "jax_coordinator", coordinator)
+        else:
+            raw = client.get(ns + "jax_coordinator", wait=True,
+                             timeout=120.0)
+            if raw is None:
+                raise TimeoutError(
+                    "rank 0 never published %sjax_coordinator" % ns)
+            coordinator = raw.decode()
+        return rank, world, coordinator
+    finally:
+        client.close()
+
+
 def init_parallel_env(ndev_per_proc=None):
     """Join the job's coordination service (idempotent). Returns
     (rank, world_size). Single-process jobs return immediately."""
     global _initialized
+    from . import coordination as _coord
+
+    coord_addr = _coord.current_coord_addr()
     rank, world, eps = parallel_env()
-    if world <= 1:
+    if world <= 1 and not coord_addr:
         return rank, world
     if _initialized:
         return rank, world
@@ -88,7 +147,13 @@ def init_parallel_env(ndev_per_proc=None):
                 os.environ["XLA_FLAGS"] = (
                     flags + " --xla_force_host_platform_device_count=%d"
                     % int(ndev_per_proc)).strip()
-    coordinator = eps[0] if eps else "127.0.0.1:12765"
+    if coord_addr:
+        rank, world, coordinator = _coord_bootstrap()
+        if world <= 1:
+            _initialized = True
+            return rank, world
+    else:
+        coordinator = eps[0] if eps else "127.0.0.1:12765"
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=world,

@@ -14,7 +14,6 @@ import argparse
 import os
 import shutil
 import signal
-import socket
 import subprocess
 import sys
 import tempfile
@@ -23,8 +22,10 @@ import time
 from ..fluid import compile_cache as _compile_cache
 from ..fluid import monitor as _monitor
 from ..fluid import resilience as _resilience
+from . import coordination as _coordination
 from . import preemption as _preemption
 from . import rendezvous as _rendezvous
+from . import wire as _wire
 
 __all__ = ["launch", "main"]
 
@@ -59,36 +60,51 @@ ENV_STEP_DEADLINE = "PADDLE_STEP_DEADLINE"
 
 
 def _free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+    return _wire.free_port()
 
 
-def _reserve_port_range(nproc, tries=10):
-    """A base port such that base..base+nproc-1 are ALL bindable right
-    now. _free_port only probes one port, so a consecutive range starting
-    there can still collide with a live listener; verify the whole range
-    (and retry with a fresh base) before handing it to a gang. The race
-    window between this check and the workers binding remains — the
-    launcher additionally retries a gang that dies on 'Address already
-    in use' without burning a restart (see launch())."""
-    for _ in range(tries):
-        base = _free_port()
-        socks = []
+def _reserve_port_range(nproc, tries=10, extra=0):
+    """A base port such that base..base+nproc-1 (plus ``extra`` ports
+    beyond the worker range — the coordination-service port rides at
+    base+nproc) are ALL bindable right now. The socket probing lives in
+    ``wire.reserve_port_range`` (the one sanctioned socket site); the
+    race window between this check and the real binds remains — the
+    launcher retries a gang that dies on 'Address already in use' and a
+    coordination server whose bind fails with a fresh base, neither
+    burning a restart (see launch() / _start_coord_server())."""
+    return _wire.reserve_port_range(int(nproc) + int(extra), tries=tries)
+
+
+def _start_coord_server(node_ip, nproc, started_port, port_retries,
+                        token=None):
+    """Bind + start the gang's CoordServer on the port just past the
+    worker range (base+nproc). A lost bind race (another process took
+    the port between the probe and the bind — the same TOCTOU shape as
+    worker ports) picks a FRESH base up to ``port_retries`` times,
+    counting against _M_PORT_RETRIES but never against the caller's
+    restart budget (this runs before the first spawn). Returns
+    ``(server, base)`` — the caller hands ``base`` to the first gang so
+    the reserved worker range is not re-probed."""
+    retry = 0
+    while True:
+        base = _reserve_port_range(nproc, extra=1) \
+            if started_port is None else int(started_port)
         try:
-            for i in range(1, nproc):
-                s = socket.socket()
-                s.bind(("127.0.0.1", base + i))
-                socks.append(s)
-            return base
+            srv = _coordination.CoordServer(host=node_ip,
+                                            port=base + int(nproc),
+                                            token=token)
         except OSError:
+            if started_port is not None or retry >= port_retries:
+                raise
+            retry += 1
+            _M_PORT_RETRIES.inc()
+            sys.stderr.write(
+                "launch: coordination service lost the port race "
+                "(port %d), retrying with a fresh range %d/%d (restart "
+                "budget untouched)\n"
+                % (base + int(nproc), retry, port_retries))
             continue
-        finally:
-            for s in socks:
-                s.close()
-    return _free_port()  # contended host: fall back to the single probe
+        return srv.start(), base
 
 
 def _bind_failure(log_dir, nproc):
@@ -159,7 +175,8 @@ def launch(nproc, cmd, node_ip="127.0.0.1", started_port=None, env=None,
            port_retries=3, checkpoint_dir=None,
            max_restarts_at_size=None, min_world_size=None,
            rendezvous_dir=None, max_preempt_restarts=8,
-           preempt_drain=True, compile_cache_dir=None):
+           preempt_drain=True, compile_cache_dir=None,
+           rendezvous_backend=None):
     """Spawn ``nproc`` copies of ``cmd`` (argv list) with the trainer env;
     returns the list of exit codes of the final attempt.
 
@@ -207,7 +224,17 @@ def launch(nproc, cmd, node_ip="127.0.0.1", started_port=None, env=None,
     A gang that dies to a port bind failure ('Address already in use'
     in a worker log — the ``_free_port`` TOCTOU race, launcher's fault)
     is redone with a fresh base port up to ``port_retries`` times
-    WITHOUT consuming ``max_restarts`` or backing off."""
+    WITHOUT consuming ``max_restarts`` or backing off.
+
+    Rendezvous backend (``rendezvous_backend``): "tcp" (the default)
+    hosts a ``coordination.CoordServer`` next to the gang — no shared
+    filesystem needed — and exports ``PADDLE_COORD_ADDR`` /
+    ``PADDLE_COORD_BACKEND`` so workers bootstrap rank/world and the
+    jax coordinator from the service; "file" keeps the shared-directory
+    rendezvous and exports ``PADDLE_RENDEZVOUS_DIR``. An explicit
+    ``rendezvous_dir`` implies the file backend; with no explicit
+    choice ``$PADDLE_COORD_BACKEND`` wins. Exit-code semantics are
+    identical across backends."""
     from .heartbeat import Watchdog
 
     if step_deadline is None:
@@ -218,10 +245,34 @@ def launch(nproc, cmd, node_ip="127.0.0.1", started_port=None, env=None,
         min_world_size = int(v) if v else 1
     min_world_size = max(1, min(int(min_world_size), int(nproc)))
 
-    rdzv_is_tmp = rendezvous_dir is None
-    rdzv = _rendezvous.Rendezvous(
-        rendezvous_dir or tempfile.mkdtemp(prefix="paddle_tpu_rdzv_"))
+    rdzv_backend = (rendezvous_backend or
+                    ("file" if rendezvous_dir else None) or
+                    os.environ.get(_coordination.ENV_BACKEND) or
+                    "tcp").strip().lower()
+    if rdzv_backend not in ("tcp", "file"):
+        raise ValueError("unknown rendezvous backend %r "
+                         "(want 'tcp' or 'file')" % rdzv_backend)
+
     base_env = dict(os.environ if env is None else env)
+    coord_srv = None
+    coord_base = None
+    rdzv_is_tmp = False
+    if rdzv_backend == "tcp":
+        coord_srv, coord_base = _start_coord_server(
+            node_ip, int(nproc), started_port, port_retries)
+        base_env[_coordination.ENV_ADDR] = coord_srv.endpoint
+        base_env[_coordination.ENV_BACKEND] = "tcp"
+        # stale PADDLE_RENDEZVOUS_DIR from an outer launcher must not
+        # leak: workers (and rendezvous.create) would pick the file path
+        base_env.pop(_rendezvous.ENV_DIR, None)
+        rdzv = _rendezvous.TcpRendezvous(
+            client=_coordination.CoordClient(coord_srv.endpoint))
+    else:
+        rdzv_is_tmp = rendezvous_dir is None
+        rdzv = _rendezvous.Rendezvous(
+            rendezvous_dir or tempfile.mkdtemp(prefix="paddle_tpu_rdzv_"))
+        base_env[_rendezvous.ENV_DIR] = rdzv.dirname
+        base_env[_coordination.ENV_BACKEND] = "file"
     if checkpoint_dir:
         base_env["PADDLE_CHECKPOINT_DIR"] = checkpoint_dir
     # persistent compile cache shared across gang generations: every
@@ -233,7 +284,6 @@ def launch(nproc, cmd, node_ip="127.0.0.1", started_port=None, env=None,
     if compile_cache_dir:
         base_env[_compile_cache.ENV_DIR] = compile_cache_dir
     base_env[_preemption.ENV_DRAIN] = "1" if preempt_drain else "0"
-    base_env[_rendezvous.ENV_DIR] = rdzv.dirname
 
     backoff = _resilience.RestartBackoff(
         base=restart_backoff, max_delay=30.0, jitter=0.25,
@@ -247,8 +297,14 @@ def launch(nproc, cmd, node_ip="127.0.0.1", started_port=None, env=None,
     try:
         with _preemption.LauncherForward() as fwd:
             while True:
-                base = _reserve_port_range(world) \
-                    if started_port is None else int(started_port)
+                if started_port is not None:
+                    base = int(started_port)
+                elif coord_base is not None:
+                    # first attempt reuses the range reserved alongside
+                    # the coordination-service port
+                    base, coord_base = coord_base, None
+                else:
+                    base = _reserve_port_range(world)
                 # the hb dir is unconditional now: the .exit/.preempted
                 # markers live there even when heartbeats are off
                 hb_dir = tempfile.mkdtemp(prefix="paddle_tpu_hb_")
@@ -442,6 +498,12 @@ def launch(nproc, cmd, node_ip="127.0.0.1", started_port=None, env=None,
     finally:
         if rdzv_is_tmp:
             shutil.rmtree(rdzv.dirname, ignore_errors=True)
+        if coord_srv is not None:
+            try:
+                rdzv.close()
+            except (OSError, RuntimeError):
+                pass
+            coord_srv.stop()
 
 def main(argv=None):
     parser = argparse.ArgumentParser(
@@ -487,6 +549,13 @@ def main(argv=None):
                              "PADDLE_RENDEZVOUS_DIR; default: a temp "
                              "dir); drop slot.<k> files here to offer "
                              "recovered capacity back")
+    parser.add_argument("--rendezvous_backend", default=None,
+                        choices=["tcp", "file"],
+                        help="'tcp' (default) hosts a coordination "
+                             "service next to the gang — no shared "
+                             "filesystem; 'file' keeps the shared-"
+                             "directory rendezvous (also "
+                             "$PADDLE_COORD_BACKEND)")
     parser.add_argument("--no_preempt_drain", action="store_true",
                         help="do not export PADDLE_PREEMPT_DRAIN=1 "
                              "(workers die on SIGTERM instead of "
@@ -507,7 +576,8 @@ def main(argv=None):
                    max_restarts_at_size=args.max_restarts_at_size,
                    min_world_size=args.min_world_size,
                    rendezvous_dir=args.rendezvous_dir,
-                   preempt_drain=not args.no_preempt_drain)
+                   preempt_drain=not args.no_preempt_drain,
+                   rendezvous_backend=args.rendezvous_backend)
     bad = [(i, c) for i, c in enumerate(codes) if c != 0]
     if bad:
         sys.exit("workers failed: %r" % bad)

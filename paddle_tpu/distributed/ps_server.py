@@ -21,7 +21,6 @@ against remote pservers with no graph changes.
 """
 
 import os
-import socket
 import struct
 import threading
 import time
@@ -31,7 +30,7 @@ import logging
 
 import numpy as np
 
-from ..fluid import resilience as _resilience
+from . import wire as _wire
 
 _LOG = logging.getLogger(__name__)
 
@@ -60,18 +59,12 @@ def _default_token():
     return os.environ.get("PADDLE_PS_TOKEN", "")
 
 
-def _send_all(sock, data):
-    sock.sendall(data)
-
-
-def _recv_exact(sock, n):
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            raise ConnectionError("peer closed mid-frame")
-        buf.extend(chunk)
-    return bytes(buf)
+# framing primitives live in the shared wire module now (the
+# coordination service and sample-exchange shuffle ride the same
+# transport); these aliases keep this module's historical surface —
+# sample_exchange.py and fl_server.py import them from here.
+_send_all = _wire.send_all
+_recv_exact = _wire.recv_exact
 
 
 def _pack_arr(a):
@@ -97,18 +90,13 @@ def _unpack_arr(buf, off):
     return a.copy(), off
 
 
-def _frame(payload):
-    return struct.pack("<I", len(payload)) + payload
+_frame = _wire.frame
 
 
 def _read_frame(sock, max_bytes=None):
-    (n,) = struct.unpack("<I", _recv_exact(sock, 4))
-    if n > (max_bytes or _MAX_FRAME):
-        # the stream cannot be resynchronized after a refused frame
-        raise ConnectionError(
-            "frame of %d bytes exceeds the %d-byte cap "
-            "(PADDLE_PS_MAX_FRAME_BYTES)" % (n, max_bytes or _MAX_FRAME))
-    return _recv_exact(sock, n)
+    # PS frames default to the PADDLE_PS_MAX_FRAME_BYTES cap; the raised
+    # wire.FrameTooLarge IS a ConnectionError (stream unsyncable)
+    return _wire.read_frame(sock, max_bytes or _MAX_FRAME)
 
 
 def shard_vocab(vocab, n_shards, shard_idx):
@@ -116,108 +104,16 @@ def shard_vocab(vocab, n_shards, shard_idx):
     return (int(vocab) - shard_idx + n_shards - 1) // n_shards
 
 
-class FramedServer:
-    """Shared transport base: bound socket, daemon accept loop, live
-    connection tracking (``stop()`` severs serving threads, not just the
-    acceptor), and the magic+token handshake — subclasses implement
-    ``_serve_authenticated(conn)``. Used by TableServer here and
-    ExchangeServer (sample_exchange.py) so the hardening lives once."""
+class FramedServer(_wire.FramedServer):
+    """PS-tier transport base: the shared ``wire.FramedServer`` (bound
+    socket, daemon accept loop, live-connection severing ``stop()``,
+    magic+token handshake) pinned to the PS protocol magic and the
+    ``PADDLE_PS_TOKEN`` secret. Used by TableServer here, ExchangeServer
+    (sample_exchange.py), and FLServer (fl_server.py) so the hardening
+    lives once."""
 
-    def __init__(self, host="127.0.0.1", port=0, token=None, backlog=64):
-        self.token = _default_token() if token is None else str(token)
-        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._srv.bind((host, port))
-        self._srv.listen(backlog)
-        self.host, self.port = self._srv.getsockname()
-        self._stop = threading.Event()
-        self._accept_thread = None
-        self._conns = set()
-        self._conns_mu = threading.Lock()
-
-    @property
-    def endpoint(self):
-        return "%s:%d" % (self.host, self.port)
-
-    def start(self):
-        self._accept_thread = threading.Thread(target=self._accept_loop,
-                                               daemon=True)
-        self._accept_thread.start()
-        return self
-
-    def _accept_loop(self):
-        self._srv.settimeout(0.2)
-        while not self._stop.is_set():
-            try:
-                conn, _ = self._srv.accept()
-            except socket.timeout:
-                continue
-            except OSError:
-                break
-            threading.Thread(target=self._serve_conn, args=(conn,),
-                             daemon=True).start()
-        try:
-            self._srv.close()
-        except OSError:
-            pass
-
-    def stop(self):
-        self._stop.set()
-        # sever live connections too — their serving threads would
-        # otherwise keep answering after "shutdown". shutdown() (not just
-        # close()) reliably wakes threads blocked in recv and prevents
-        # the freed fd from being re-read by the old thread.
-        with self._conns_mu:
-            conns = list(self._conns)
-        for c in conns:
-            try:
-                c.shutdown(socket.SHUT_RDWR)
-            except OSError:
-                pass
-            try:
-                c.close()
-            except OSError:
-                pass
-        if self._accept_thread is not None:
-            self._accept_thread.join(timeout=5)
-        # a never-started server still holds its bound socket — release it
-        try:
-            self._srv.close()
-        except OSError:
-            pass
-
-    def _serve_conn(self, conn):
-        with self._conns_mu:
-            self._conns.add(conn)
-        try:
-            # hello: magic + u16 token length + token; anything else is
-            # dropped before a single opcode can run
-            try:
-                conn.settimeout(10)
-                hello = _recv_exact(conn, len(_MAGIC) + 2)
-                if hello[:len(_MAGIC)] != _MAGIC:
-                    return
-                (tlen,) = struct.unpack_from("<H", hello, len(_MAGIC))
-                tok = _recv_exact(conn, tlen).decode("utf-8", "replace") \
-                    if tlen else ""
-                if tok != self.token:
-                    _send_all(conn, _frame(b"\x01bad token"))
-                    return
-                _send_all(conn, _frame(b"\x00"))
-                conn.settimeout(None)
-            except (ConnectionError, OSError, struct.error):
-                return
-            self._serve_authenticated(conn)
-        finally:
-            with self._conns_mu:
-                self._conns.discard(conn)
-            try:
-                conn.close()
-            except OSError:
-                pass
-
-    def _serve_authenticated(self, conn):
-        raise NotImplementedError
+    MAGIC = _MAGIC
+    TOKEN_ENV = "PADDLE_PS_TOKEN"
 
 
 class TableServer(FramedServer):
@@ -370,91 +266,21 @@ class TableServer(FramedServer):
         return None
 
 
-class _Conn:
-    """One persistent client connection with a request lock, the shared
-    token handshake, and reconnect-with-backoff. Requests are retried
-    across reconnects — safe for every opcode because pushes carry a
+class _Conn(_wire.Conn):
+    """PS-tier client connection: the shared ``wire.Conn`` (request
+    lock, token handshake, reconnect-with-backoff under the
+    ``fluid.resilience.Retry`` policy, ``ps.rpc`` fault site) pinned to
+    the PS magic/token/frame-cap. Requests are retried across
+    reconnects — safe for every opcode because pushes carry a
     (client, seq) pair the server dedupes (at-most-once apply), and the
-    rest are idempotent reads/overwrites.
+    rest are idempotent reads/overwrites."""
 
-    The retry policy is the shared ``fluid.resilience.Retry`` (site
-    ``ps.rpc`` in monitor) instead of a hand-rolled loop — same attempt
-    budget and doubling backoff as before (5 attempts, 0.2s base)."""
-
-    RETRIES = 4
-    BACKOFF = 0.2  # seconds, doubled per attempt
+    MAGIC = _MAGIC
+    TOKEN_ENV = "PADDLE_PS_TOKEN"
 
     def __init__(self, endpoint, token=None):
-        host, port = endpoint.rsplit(":", 1)
-        self._addr = (host, int(port))
-        self._token = _default_token() if token is None else str(token)
-        self._mu = threading.Lock()
-        self._sock = None
-        self._retry = _resilience.Retry(
-            max_attempts=self.RETRIES + 1, base_delay=self.BACKOFF,
-            factor=2.0, max_delay=30.0, jitter=0.0,
-            retryable=(OSError, ConnectionError,
-                       _resilience.TransientError),
-            name="ps.rpc")
-        self._connect()
-
-    def _connect(self):
-        sock = socket.create_connection(self._addr, timeout=30)
-        tok = self._token.encode()
-        try:
-            _send_all(sock, _MAGIC + struct.pack("<H", len(tok)) + tok)
-            resp = _read_frame(sock)
-            if not resp or resp[0] != 0:
-                raise ConnectionError(
-                    "pserver rejected handshake: %s"
-                    % resp[1:].decode("utf-8", "replace"))
-        except Exception:
-            sock.close()
-            raise
-        self._sock = sock
-
-    def _round_trip(self, payload):
-        """One attempt: (re)connect if needed, send, read the response.
-        A failure mid-stream leaves the framing desynchronized, so the
-        socket is dropped before the error propagates to the Retry —
-        the next attempt starts on a fresh connection (push dedup makes
-        the re-send safe)."""
-        from ..fluid import faults as _faults
-
-        if self._sock is None:
-            self._connect()
-        try:
-            _faults.check("ps.rpc")
-            _send_all(self._sock, _frame(payload))
-            return _read_frame(self._sock)
-        except (OSError, ConnectionError, _resilience.TransientError):
-            try:
-                self._sock.close()
-            except OSError:
-                pass
-            self._sock = None
-            raise
-
-    def request(self, payload):
-        with self._mu:
-            try:
-                resp = self._retry.call(self._round_trip, payload)
-            except (OSError, ConnectionError) as e:
-                raise ConnectionError(
-                    "pserver %s:%d unreachable after %d attempts: %r"
-                    % (self._addr + (self.RETRIES + 1, e)))
-        if not resp or resp[0] != 0:
-            raise RuntimeError("pserver error: %s"
-                               % resp[1:].decode("utf-8", "replace"))
-        return resp[1:]
-
-    def close(self):
-        if self._sock is not None:
-            try:
-                self._sock.close()
-            except OSError:
-                pass
-            self._sock = None
+        super().__init__(endpoint, token=token, retry_name="ps.rpc",
+                         max_frame=_MAX_FRAME)
 
 
 def _req(op, name, body=b""):

@@ -22,8 +22,8 @@ import json
 import os
 import time
 
-__all__ = ["ENV_DIR", "Rendezvous", "current_rendezvous_dir",
-           "plan_next_world"]
+__all__ = ["ENV_DIR", "Rendezvous", "TcpRendezvous", "create",
+           "current_rendezvous_dir", "plan_next_world"]
 
 ENV_DIR = "PADDLE_RENDEZVOUS_DIR"
 
@@ -180,3 +180,139 @@ class Rendezvous:
                     os.remove(os.path.join(self.dirname, n))
                 except OSError:
                     pass  # a member re-stamped mid-sweep; next sweep gets it
+
+
+class TcpRendezvous:
+    """Same interface as ``Rendezvous``, stored in the coordination
+    service's KV instead of a shared filesystem — the end-to-end
+    replacement for the shared-FS assumption. Keys mirror the file
+    names (``rdzv/world``, ``rdzv/member.<r>``, ``rdzv/slot.<k>``)
+    under one namespace so a single CoordServer can host rendezvous,
+    rank bootstrap, and user barriers side by side.
+
+    ``consume_slots`` claims each slot with the service's atomic
+    delete-if-exists, so two consumers racing on the same returned slot
+    cannot both scale up with it (the file backend gets the same
+    guarantee from os.remove)."""
+
+    _NS = "rdzv/"
+
+    def __init__(self, addr=None, client=None, token=None):
+        from . import coordination as _coord
+
+        if client is not None:
+            self._client = client
+            self._owns_client = False
+        else:
+            addr = addr or _coord.current_coord_addr()
+            if not addr:
+                raise ValueError(
+                    "TcpRendezvous needs a coordination service: pass "
+                    "addr=/client= or set %s" % _coord.ENV_ADDR)
+            self._client = _coord.CoordClient(addr, token=token)
+            self._owns_client = True
+        # launch.py logs/cleans up ``rdzv.dirname`` for the file
+        # backend; expose the endpoint under the same attribute name
+        self.dirname = "coord://%s" % self._client.endpoint
+
+    def close(self):
+        if self._owns_client:
+            self._client.close()
+
+    def _put_json(self, key, payload):
+        self._client.put(self._NS + key, json.dumps(payload).encode())
+
+    def _get_json(self, key):
+        raw = self._client.get(self._NS + key)
+        if raw is None:
+            return None
+        try:
+            return json.loads(raw.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            return None  # garbage-tolerant, like the file backend
+
+    # -- launcher side -----------------------------------------------------
+    def record_world(self, world_size, generation, slots=None):
+        self._put_json(_WORLD, {
+            "world_size": int(world_size),
+            "generation": int(generation),
+            "slots": [int(s) for s in
+                      (slots if slots is not None
+                       else range(int(world_size)))],
+            "ts": time.time(),
+        })
+
+    def world(self):
+        return self._get_json(_WORLD)
+
+    def generation(self):
+        w = self.world()
+        return int(w["generation"]) if w and "generation" in w else 0
+
+    # -- returned capacity (scale back up) ---------------------------------
+    def offer_slot(self, slot):
+        self._put_json("%s%d" % (_SLOT_PREFIX, int(slot)),
+                       {"slot": int(slot), "ts": time.time()})
+
+    def returned_slots(self):
+        out = []
+        for k in self._client.keys(self._NS + _SLOT_PREFIX):
+            try:
+                out.append(int(k[len(self._NS + _SLOT_PREFIX):]))
+            except ValueError:
+                pass
+        return sorted(out)
+
+    def consume_slots(self):
+        # delete() returns whether the key existed — the slot is ours
+        # only when we were the deleter (atomic claim under races)
+        out = []
+        for s in self.returned_slots():
+            if self._client.delete("%s%s%d" % (self._NS, _SLOT_PREFIX,
+                                               s)):
+                out.append(s)
+        return out
+
+    # -- worker side -------------------------------------------------------
+    def announce(self, rank=None, step=None):
+        if rank is None:
+            rank = int(os.environ.get("PADDLE_TRAINER_ID", 0) or 0)
+        payload = {"rank": int(rank), "pid": os.getpid(),
+                   "ts": time.time()}
+        if step is not None:
+            payload["step"] = int(step)
+        self._put_json("%s%d" % (_MEMBER_PREFIX, int(rank)), payload)
+
+    def members(self):
+        out = {}
+        for k in self._client.keys(self._NS + _MEMBER_PREFIX):
+            data = self._get_json(k[len(self._NS):])
+            if data is not None and "rank" in data:
+                out[int(data["rank"])] = data
+        return out
+
+    def clear_members(self):
+        for k in self._client.keys(self._NS + _MEMBER_PREFIX):
+            self._client.delete(k)
+
+
+def create(backend=None, dirname=None, addr=None, client=None,
+           token=None):
+    """Rendezvous factory honoring the env contract: explicit
+    ``backend`` wins, then ``PADDLE_COORD_BACKEND``; with no signal,
+    a provided/available coordination address selects TCP and a
+    dirname (or ``PADDLE_RENDEZVOUS_DIR``) selects the file fallback."""
+    from . import coordination as _coord
+
+    backend = (backend or os.environ.get(_coord.ENV_BACKEND) or
+               "").strip().lower()
+    if backend not in ("", "file", "tcp"):
+        raise ValueError("unknown rendezvous backend %r "
+                         "(want 'tcp' or 'file')" % backend)
+    if backend == "file":
+        return Rendezvous(dirname)
+    if backend == "tcp":
+        return TcpRendezvous(addr=addr, client=client, token=token)
+    if client is not None or addr or _coord.current_coord_addr():
+        return TcpRendezvous(addr=addr, client=client, token=token)
+    return Rendezvous(dirname)

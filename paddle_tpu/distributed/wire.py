@@ -1,0 +1,343 @@
+"""Shared framed-TCP plumbing for every socket-served tier — factored
+out of ``ps_server.py`` so the PS tier, the sample-exchange shuffle, and
+the coordination service (``coordination.py``) ride ONE hardened
+transport instead of three socket implementations.
+
+The protocol is the PS tier's: u32 length-prefixed frames, a
+magic + u16-token-length + token hello before any opcode is served, a
+frame-size cap an attacker-supplied length cannot blow past, and
+``stop()`` that severs live connections (shutdown + close) so serving
+threads cannot keep answering after shutdown. Clients reconnect with
+the shared ``fluid.resilience.Retry`` policy and drop their socket on
+any mid-stream failure — framing cannot be resynchronized, so the next
+attempt starts on a fresh connection.
+
+This module is also the single sanctioned ``socket.socket(`` site in
+the tree (``tools/check_resilience.py`` lints every other one): port
+probing, listener creation, and connections all route through here.
+"""
+
+import os
+import socket
+import struct
+import threading
+
+from ..fluid import resilience as _resilience
+
+__all__ = ["DecodeError", "FrameTooLarge", "send_all", "recv_exact",
+           "frame", "read_frame", "create_listener", "connect",
+           "free_port", "reserve_port_range", "FramedServer", "Conn"]
+
+# default frame cap; servers/clients for a specific tier may pass their
+# own (the PS tier keeps PADDLE_PS_MAX_FRAME_BYTES)
+_MAX_FRAME = int(os.environ.get("PADDLE_WIRE_MAX_FRAME_BYTES",
+                                256 * 1024 * 1024))
+
+_DEFAULT_MAGIC = b"PTWR1"
+
+
+class DecodeError(RuntimeError):
+    """A well-framed message whose PAYLOAD is malformed (bad opcode
+    layout, truncated field, non-UTF-8 key). Connection-level failures
+    raise ConnectionError instead — a DecodeError means the peer speaks
+    the framing but sent garbage inside it, so the server can answer
+    with an error frame and keep the connection."""
+
+
+class FrameTooLarge(ConnectionError):
+    """A frame length past the cap. Subclasses ConnectionError on
+    purpose: the refused bytes are still in the stream, so the
+    connection cannot be resynchronized and must be dropped."""
+
+
+def send_all(sock, data):
+    sock.sendall(data)
+
+
+def recv_exact(sock, n):
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def frame(payload):
+    return struct.pack("<I", len(payload)) + payload
+
+
+def read_frame(sock, max_bytes=None):
+    (n,) = struct.unpack("<I", recv_exact(sock, 4))
+    if n > (max_bytes or _MAX_FRAME):
+        raise FrameTooLarge(
+            "frame of %d bytes exceeds the %d-byte cap"
+            % (n, max_bytes or _MAX_FRAME))
+    return recv_exact(sock, n)
+
+
+# -- port/listener helpers ---------------------------------------------------
+
+def create_listener(host="127.0.0.1", port=0, backlog=64):
+    """A bound, listening TCP socket with SO_REUSEADDR. Raises OSError
+    when the port is taken — callers own the retry policy."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    try:
+        s.bind((host, port))
+        s.listen(backlog)
+    except OSError:
+        s.close()
+        raise
+    return s
+
+
+def connect(endpoint, timeout=30):
+    """TCP connection to ``host:port`` (thin create_connection wrapper
+    so callers stay socket-free under the lint)."""
+    host, port = endpoint.rsplit(":", 1)
+    return socket.create_connection((host, int(port)), timeout=timeout)
+
+
+def free_port(host="127.0.0.1"):
+    s = socket.socket()
+    s.bind((host, 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def reserve_port_range(n, tries=10, host="127.0.0.1"):
+    """A base port such that base..base+n-1 are ALL bindable right now.
+    ``free_port`` probes one port only, so a consecutive range starting
+    there can still collide with a live listener; verify the whole
+    range (retrying with a fresh base) before handing it out. The
+    TOCTOU window between this check and the real bind remains — the
+    caller must treat a later bind failure as retryable."""
+    for _ in range(tries):
+        base = free_port(host)
+        socks = []
+        try:
+            for i in range(1, n):
+                s = socket.socket()
+                s.bind((host, base + i))
+                socks.append(s)
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    return free_port(host)  # contended host: fall back to the single probe
+
+
+# -- server ------------------------------------------------------------------
+
+class FramedServer:
+    """Shared transport base: bound socket, daemon accept loop, live
+    connection tracking (``stop()`` severs serving threads, not just
+    the acceptor), and the magic+token handshake — subclasses implement
+    ``_serve_authenticated(conn)``. ``magic`` namespaces the protocol
+    (PS tier vs coordination service) so a client of one cannot
+    accidentally drive the other; ``token_env`` names the env var the
+    shared secret defaults from."""
+
+    MAGIC = _DEFAULT_MAGIC
+    TOKEN_ENV = "PADDLE_WIRE_TOKEN"
+
+    def __init__(self, host="127.0.0.1", port=0, token=None, backlog=64):
+        self.token = os.environ.get(self.TOKEN_ENV, "") \
+            if token is None else str(token)
+        self._srv = create_listener(host, port, backlog)
+        self.host, self.port = self._srv.getsockname()
+        self._stop = threading.Event()
+        self._accept_thread = None
+        self._conns = set()
+        self._conns_mu = threading.Lock()
+
+    @property
+    def endpoint(self):
+        return "%s:%d" % (self.host, self.port)
+
+    def start(self):
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+        return self
+
+    def _accept_loop(self):
+        self._srv.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    def stop(self):
+        self._stop.set()
+        # sever live connections too — their serving threads would
+        # otherwise keep answering after "shutdown". shutdown() (not just
+        # close()) reliably wakes threads blocked in recv and prevents
+        # the freed fd from being re-read by the old thread.
+        with self._conns_mu:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5)
+        # a never-started server still holds its bound socket — release it
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    def _serve_conn(self, conn):
+        with self._conns_mu:
+            self._conns.add(conn)
+        try:
+            # hello: magic + u16 token length + token; anything else is
+            # dropped before a single opcode can run
+            try:
+                conn.settimeout(10)
+                magic = self.MAGIC
+                hello = recv_exact(conn, len(magic) + 2)
+                if hello[:len(magic)] != magic:
+                    return
+                (tlen,) = struct.unpack_from("<H", hello, len(magic))
+                tok = recv_exact(conn, tlen).decode("utf-8", "replace") \
+                    if tlen else ""
+                if tok != self.token:
+                    send_all(conn, frame(b"\x01bad token"))
+                    return
+                send_all(conn, frame(b"\x00"))
+                conn.settimeout(None)
+            except (ConnectionError, OSError, struct.error):
+                return
+            self._serve_authenticated(conn)
+        finally:
+            with self._conns_mu:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _serve_authenticated(self, conn):
+        raise NotImplementedError
+
+
+# -- client ------------------------------------------------------------------
+
+class Conn:
+    """One persistent client connection with a request lock, the shared
+    token handshake, and reconnect-with-backoff. Requests are retried
+    across reconnects — callers must keep every opcode idempotent or
+    carry their own dedup (the PS tier's push (client, seq) pair).
+
+    The retry policy is the shared ``fluid.resilience.Retry`` (5
+    attempts, 0.2s base, doubled per attempt) under the caller's
+    ``retry_name`` monitor site; ``fault_site`` (default: retry_name)
+    is checked through ``fluid.faults`` before every attempt so tests
+    can inject transport failures."""
+
+    MAGIC = _DEFAULT_MAGIC
+    TOKEN_ENV = "PADDLE_WIRE_TOKEN"
+    RETRIES = 4
+    BACKOFF = 0.2  # seconds, doubled per attempt
+
+    def __init__(self, endpoint, token=None, retry_name="wire.rpc",
+                 fault_site=None, max_frame=None, connect_timeout=30):
+        host, port = endpoint.rsplit(":", 1)
+        self._addr = (host, int(port))
+        self._token = os.environ.get(self.TOKEN_ENV, "") \
+            if token is None else str(token)
+        self._max_frame = max_frame
+        self._connect_timeout = connect_timeout
+        self._fault_site = fault_site or retry_name
+        self._mu = threading.Lock()
+        self._sock = None
+        self._retry = _resilience.Retry(
+            max_attempts=self.RETRIES + 1, base_delay=self.BACKOFF,
+            factor=2.0, max_delay=30.0, jitter=0.0,
+            retryable=(OSError, ConnectionError,
+                       _resilience.TransientError),
+            name=retry_name)
+        self._connect()
+
+    @property
+    def endpoint(self):
+        return "%s:%d" % self._addr
+
+    def _connect(self):
+        sock = socket.create_connection(self._addr,
+                                        timeout=self._connect_timeout)
+        tok = self._token.encode()
+        try:
+            send_all(sock, self.MAGIC + struct.pack("<H", len(tok)) + tok)
+            resp = read_frame(sock, self._max_frame)
+            if not resp or resp[0] != 0:
+                raise ConnectionError(
+                    "server rejected handshake: %s"
+                    % resp[1:].decode("utf-8", "replace"))
+        except Exception:
+            sock.close()
+            raise
+        self._sock = sock
+
+    def _round_trip(self, payload):
+        """One attempt: (re)connect if needed, send, read the response.
+        A failure mid-stream leaves the framing desynchronized, so the
+        socket is dropped before the error propagates to the Retry —
+        the next attempt starts on a fresh connection."""
+        from ..fluid import faults as _faults
+
+        if self._sock is None:
+            self._connect()
+        try:
+            _faults.check(self._fault_site)
+            send_all(self._sock, frame(payload))
+            return read_frame(self._sock, self._max_frame)
+        except (OSError, ConnectionError, _resilience.TransientError):
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+            raise
+
+    def request(self, payload):
+        with self._mu:
+            try:
+                resp = self._retry.call(self._round_trip, payload)
+            except (OSError, ConnectionError) as e:
+                raise ConnectionError(
+                    "server %s:%d unreachable after %d attempts: %r"
+                    % (self._addr + (self.RETRIES + 1, e)))
+        if not resp or resp[0] != 0:
+            raise RuntimeError("server error: %s"
+                               % resp[1:].decode("utf-8", "replace"))
+        return resp[1:]
+
+    def close(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None

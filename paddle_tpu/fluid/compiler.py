@@ -138,17 +138,25 @@ class CompiledProgram:
         return self
 
     def with_explicit_collectives(self, loss_name=None, places=None,
-                                  mesh_axes=("dp",)):
+                                  mesh_axes=("dp",), mesh_shape=None):
         """SPMD execution via shard_map: every op runs per-shard and the
         program's explicit collective ops (c_allreduce_* etc., inserted by
         the Fleet/collective transpiler) lower to real XLA collectives over
         the named mesh axes. This is the reference's Fleet-collective mode
-        (transpiler/collective.py GradAllReduce) on ICI."""
+        (transpiler/collective.py GradAllReduce) on ICI.
+
+        ``mesh_axes``/``mesh_shape`` open the hierarchical surface:
+        mesh_axes=("host","device"), mesh_shape={"host":2,"device":4}
+        builds the 2-level mesh ``HierarchicalGradAllReduce`` targets —
+        ring 0 resolves to 'host' (DCN), ring 1 to 'device' (ICI), and
+        feeds/fetch reductions span BOTH axes (the batch shards over all
+        8 shards, losses pmean over the full mesh)."""
         self._is_data_parallel = True
         self._mode = "shard_map"
         self._loss_name = loss_name
         self._places = places
         self._mesh_axes = tuple(mesh_axes)
+        self._mesh_shape = dict(mesh_shape) if mesh_shape else None
         return self
 
     # ------------------------------------------------------------------
@@ -426,7 +434,10 @@ class CompiledProgram:
         from jax.sharding import PartitionSpec as P
 
         mesh = self.mesh
-        axis = mesh.axis_names[0]
+        # fetch reductions span the WHOLE mesh: on a hierarchical
+        # ("host","device") mesh the loss must average over all H*D
+        # shards, not just the first axis
+        axis = tuple(mesh.axis_names)
         repl = NamedSharding(mesh, P())
 
         feed_specs = {n: self.feed_sharding(feed[n]).spec for n in feed}
@@ -489,8 +500,19 @@ class CompiledProgram:
         from jax.sharding import PartitionSpec as P
 
         mesh = self.mesh
-        axis = "dp" if mode == "gspmd" else mesh.axis_names[0]
         ndim = np.ndim(value)
+        if mode == "shard_map" and len(mesh.axis_names) > 1:
+            # hierarchical mesh: the batch shards over EVERY axis (each
+            # of the H*D shards is one data-parallel rank); fall back to
+            # the leading axis when only its size divides the batch
+            axes = tuple(mesh.axis_names)
+            total = int(np.prod([mesh.shape[a] for a in axes]))
+            if ndim > batch_dim and \
+                    np.shape(value)[batch_dim] % total == 0:
+                spec = [None] * ndim
+                spec[batch_dim] = axes
+                return NamedSharding(mesh, P(*spec))
+        axis = "dp" if mode == "gspmd" else mesh.axis_names[0]
         if axis in mesh.shape and ndim > batch_dim and \
                 np.shape(value)[batch_dim] % mesh.shape[axis] == 0:
             spec = [None] * ndim

@@ -4,7 +4,6 @@ candidate tokens to distributed search agents and folds their rewards
 back in. Line protocol: ``tokens`` -> "t0,t1,..."; ``update
 t0,t1,... reward`` -> "ok best:..."; ``best`` -> best tokens."""
 
-import socket
 import threading
 
 __all__ = ["ControllerServer"]
@@ -13,11 +12,15 @@ __all__ = ["ControllerServer"]
 class ControllerServer:
     def __init__(self, controller, address=("127.0.0.1", 0),
                  max_client_num=64):
+        # listener setup (SO_REUSEADDR, close-on-bind-failure) lives in
+        # distributed.wire — the one sanctioned raw-socket module;
+        # imported lazily to keep contrib/slim free of the distributed
+        # package at import time
+        from .....distributed import wire as _wire
+
         self._controller = controller
-        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._sock.bind(address)
-        self._sock.listen(max_client_num)
+        self._sock = _wire.create_listener(
+            host=address[0], port=address[1], backlog=max_client_num)
         self._lock = threading.Lock()
         self._thread = None
         self._closed = False

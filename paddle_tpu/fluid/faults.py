@@ -44,6 +44,8 @@ POINTS = (
                        #   producer thread, before the device_put
     "ps.rpc",          # distributed/ps_server._Conn: before each framed
                        #   request round-trip
+    "coord.rpc",       # distributed/coordination.CoordClient: before
+                       #   each coordination-service round-trip
     "worker.exit",     # training scripts call check() once per step;
                        #   fires os._exit(EXIT_CODE) — a hard crash
     "step.nonfinite",  # executor anomaly check: the step's results are

@@ -84,6 +84,30 @@ def _c_allgather(ctx, op):
     ctx.set_output(op, "Out", jnp.reshape(gathered, (-1,) + tuple(x.shape[1:])))
 
 
+@register("c_hierarchical_allreduce")
+def _c_hierarchical_allreduce(ctx, op):
+    """Hierarchical allreduce (reference ``use_hierarchical_allreduce``):
+    on a 2-level ``(host, device)`` mesh the gradient reduce-scatters and
+    all-gathers inside a host (ICI, axes[1]) and only the 1/D shard
+    crosses hosts (DCN, axes[0] — the outermost/slowest axis). On a
+    single-axis mesh this degrades to a flat psum; with no mesh it is
+    identity — so the transpiler can emit it unconditionally."""
+    import jax
+
+    x = ctx.get_input(op, "X")
+    axes = getattr(ctx, "shard_axes", None)
+    if not axes:
+        ctx.set_output(op, "Out", x)
+        return
+    if len(axes) < 2:
+        ctx.set_output(op, "Out", jax.lax.psum(x, axes[0]))
+        return
+    from ...parallel.cross_host import hier_psum
+
+    ctx.set_output(op, "Out", hier_psum(x, host_axis=axes[0],
+                                        device_axis=axes[1]))
+
+
 @register("c_reducescatter")
 def _c_reducescatter(ctx, op):
     import jax

@@ -95,9 +95,80 @@ class GradAllReduce(Collective):
         self.main_program._bump()
 
 
+class HierarchicalGradAllReduce(GradAllReduce):
+    """GradAllReduce for a 2-level ``(host, device)`` mesh (reference
+    ``use_hierarchical_allreduce``): dense grads get one
+    ``c_hierarchical_allreduce`` — reduce-scatter/all-gather over the
+    in-host ICI axis, allreduce of the 1/D shard over the DCN axis.
+    DGC-compressed grads ride a two-phase split instead: the DENSE
+    gradient allreduces in-host first (ring 1 -> axes[1], ICI — cheap,
+    and it feeds the compressor the host-summed signal), then the
+    masked-dense compressed output crosses hosts (ring 0 -> axes[0],
+    DCN) — compression spends exactly where the bandwidth gap pays,
+    never on ICI. SelectedRows grads all-gather over ICI then DCN.
+    On a single-axis mesh every emitted op degrades to the flat
+    collective (``_axis_for`` clamps the ring index), so programs
+    transpiled here run unchanged on one host."""
+
+    def _transpile_main_program(self):
+        block = self.main_program.global_block()
+        dgc_grads = set()
+        for op in block.ops:
+            if op.type == "dgc":
+                dgc_grads.update(op.input("Grad"))
+        new_ops = []
+        for op in block.ops:
+            new_ops.append(op)
+            if op.type == "autodiff":
+                op.attrs["loss_scale"] = \
+                    op.attrs.get("loss_scale", 1.0) / self.nranks
+                for gname in op.attr("grad_names"):
+                    if gname in dgc_grads:
+                        # in-host dense reduction feeding the compressor
+                        # (ring 1 = the ICI/device axis)
+                        new_ops.append(framework.Operator(
+                            block, "c_allreduce_sum",
+                            inputs={"X": [gname]},
+                            outputs={"Out": [gname]},
+                            attrs={"ring_id": 1, "use_calc_stream": True}))
+                        continue
+                    gvar = block.vars.get(gname)
+                    if gvar is not None and getattr(
+                            gvar, "type", "lod_tensor") == "selected_rows":
+                        # sparse grads: gather rows/values in-host first,
+                        # then across hosts (see GradAllReduce for why
+                        # gather-not-reduce)
+                        for ring in (1, 0):
+                            for name in (gname, gname + "@ROWS"):
+                                new_ops.append(framework.Operator(
+                                    block, "c_allgather",
+                                    inputs={"X": [name]},
+                                    outputs={"Out": [name]},
+                                    attrs={"ring_id": ring,
+                                           "use_calc_stream": True}))
+                        continue
+                    new_ops.append(framework.Operator(
+                        block, "c_hierarchical_allreduce",
+                        inputs={"X": [gname]}, outputs={"Out": [gname]},
+                        attrs={"ring_id": 0, "use_calc_stream": True}))
+            elif op.type == "dgc":
+                for cname in op.output("GradOut"):
+                    # only the compressed payload crosses DCN (ring 0)
+                    new_ops.append(framework.Operator(
+                        block, "c_allreduce_sum",
+                        inputs={"X": [cname]}, outputs={"Out": [cname]},
+                        attrs={"ring_id": 0, "use_calc_stream": True}))
+        block.ops = new_ops
+        self.main_program._bump()
+
+
 class LocalSGD(Collective):
     """Periodic parameter averaging (reference ``collective.py:269``):
-    every k steps, params = pmean(params)."""
+    every k steps, params = pmean(params). The emitted
+    ``c_allreduce_avg`` rides ring 0 — on a 2-level ``(host, device)``
+    mesh that is the DCN/host axis, so LocalSGD syncs ONLY across
+    hosts (devices inside a host already share gradients every step);
+    on a flat mesh ring 0 is the one axis and behavior is unchanged."""
 
     def __init__(self, nranks=None, k_steps=1):
         super().__init__(nranks)

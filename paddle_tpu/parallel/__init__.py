@@ -30,6 +30,11 @@ from .tp import (  # noqa: F401
     vocab_parallel_embedding,
 )
 from .pipeline import pipeline  # noqa: F401
+from .cross_host import (  # noqa: F401
+    CrossHostGradSync,
+    hier_psum,
+    make_host_device_mesh,
+)
 from .moe import (  # noqa: F401
     MoEConfig,
     init_moe_params,

@@ -1,0 +1,280 @@
+"""Hierarchical DCN data-parallelism: reduce-scatter/all-gather inside
+a host over ICI, allreduce across hosts over DCN (reference
+``use_hierarchical_allreduce`` — inter/exter NCCL rings,
+``platform/nccl_helper.h``; SURVEY §5.8 maps the ring split to XLA's
+ICI+DCN phases).
+
+Why split: the gradient allreduce of an H-host x D-device gang moves
+the full gradient over every link in a flat ring; splitting it as
+
+    phase 1 (ICI)  reduce-scatter over the D devices of a host
+                   -> each device owns 1/D of the host-summed gradient
+    phase 2 (DCN)  allreduce each 1/D shard across the H hosts
+                   -> only 1/D of the bytes ever cross the slow network
+    phase 3 (ICI)  all-gather over the D devices
+                   -> every device ends with the full global sum
+
+keeps DCN traffic at 1/D of the flat scheme and is where compression
+pays: DGC top-k (``parallel/dgc.py``) and LocalSGD are applied ONLY to
+phase 2, because ICI bandwidth makes compressing phase 1/3 a loss.
+The result equals a flat psum up to float reassociation; on the CPU
+test mesh with fp32 the trajectories match bit-for-bit per phase
+ordering being deterministic.
+
+Two entry points:
+
+  * ``hier_psum`` — the in-graph building block, usable inside any
+    shard_map over a ``("host", "device")`` mesh; this is what the
+    ``c_hierarchical_allreduce`` op lowering calls.
+  * ``CrossHostGradSync`` — a host-level driver over stacked
+    ``[H, D, ...]`` gradient slots (slot (h, d) = that device's local
+    gradient) with the three phases separately jitted and timed, so
+    the monitor can attribute seconds/bytes to ``phase="ici"`` vs
+    ``phase="dcn"`` (the MULTICHIP_r06 scaling-curve instrumentation),
+    plus DGC residual state and cross-host-only LocalSGD.
+"""
+
+import time
+
+import numpy as np
+
+from ..fluid import monitor as _monitor
+from . import dgc as _dgc
+from .mesh import make_hybrid_mesh
+
+__all__ = ["make_host_device_mesh", "hier_psum", "CrossHostGradSync"]
+
+_SECONDS_HELP = ("wall seconds per hierarchical-allreduce phase "
+                 "(ici = in-host reduce-scatter + all-gather, dcn = "
+                 "cross-host allreduce)")
+_BYTES_HELP = ("logical payload bytes moved per hierarchical-allreduce "
+               "phase (dcn bytes shrink under DGC)")
+
+_M_ICI_SEC = _monitor.histogram("crosshost_allreduce_seconds",
+                                _SECONDS_HELP, labels={"phase": "ici"})
+_M_DCN_SEC = _monitor.histogram("crosshost_allreduce_seconds",
+                                _SECONDS_HELP, labels={"phase": "dcn"})
+_M_ICI_BYTES = _monitor.counter("crosshost_allreduce_bytes_total",
+                                _BYTES_HELP, labels={"phase": "ici"})
+_M_DCN_BYTES = _monitor.counter("crosshost_allreduce_bytes_total",
+                                _BYTES_HELP, labels={"phase": "dcn"})
+
+
+def make_host_device_mesh(hosts, devices_per_host=None, devices=None):
+    """A 2-level ``("host", "device")`` mesh — host (the DCN-crossing
+    axis) outermost so every "device"-axis collective stays on ICI.
+    ``devices_per_host=None`` divides the available devices evenly."""
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+    hosts = int(hosts)
+    if devices_per_host is None:
+        if len(devices) % hosts:
+            raise ValueError("%d devices do not split over %d hosts"
+                             % (len(devices), hosts))
+        devices_per_host = len(devices) // hosts
+    return make_hybrid_mesh({"device": int(devices_per_host)},
+                            {"host": hosts}, devices=devices)
+
+
+def hier_psum(x, host_axis="host", device_axis="device"):
+    """Hierarchical psum of ``x`` inside a shard_map over a
+    ``(host, device)`` mesh: reduce-scatter over ``device_axis`` (ICI),
+    psum the shard over ``host_axis`` (DCN), all-gather back over
+    ``device_axis``. Equals ``psum(x, (host, device))`` up to float
+    reassociation while moving only 1/D of the bytes over DCN."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    d = lax.psum(1, device_axis)  # static device-axis size
+    shape = x.shape
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    pad = (-n) % d
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    # (D, chunk): psum_scatter with tiled=False REMOVES dim 0 — device i
+    # ends with the in-host sum of chunk i
+    shard = lax.psum_scatter(flat.reshape(d, -1), device_axis,
+                             scatter_dimension=0, tiled=False)
+    shard = lax.psum(shard, host_axis)
+    # all_gather tiled=False ADDS the leading (D,) dim back
+    full = lax.all_gather(shard, device_axis, tiled=False).reshape(-1)
+    if pad:
+        full = full[:n]
+    return full.reshape(shape)
+
+
+class CrossHostGradSync:
+    """Three-phase gradient synchronizer over stacked ``[H, D, ...]``
+    slots, with per-phase timing/bytes and the cross-host-only
+    DGC/LocalSGD hooks.
+
+    The stacked layout simulates an H-host gang on any device set
+    (including the single-process CPU mesh the tests and bench run
+    on): slot (h, d) holds the local gradient of device d of host h.
+    ``allreduce`` returns the same stacked shape where every slot holds
+    the global MEAN — what each device would see after the wire
+    version. ``dgc_ratio`` enables top-k compression of the DCN phase
+    only (residuals u/v are carried per slot across steps, exactly the
+    ``dgc.dgc_compress`` error-feedback rules); ``local_sgd_steps > 1``
+    skips the DCN phase except every k-th step, where parameters (not
+    gradients) are averaged across hosts via ``localsgd_params``."""
+
+    def __init__(self, hosts, devices_per_host, dgc_ratio=None,
+                 dgc_momentum=0.9, local_sgd_steps=1):
+        self.hosts = int(hosts)
+        self.devices_per_host = int(devices_per_host)
+        self.dgc_ratio = dgc_ratio
+        self.dgc_momentum = float(dgc_momentum)
+        self.local_sgd_steps = max(1, int(local_sgd_steps))
+        self._u = {}  # grad index -> DGC momentum residual [H, D, chunk]
+        self._v = {}  # grad index -> DGC error-feedback residual
+        self._fns = self._build()
+
+    # -- jitted phase fns ---------------------------------------------------
+    def _build(self):
+        import jax
+        import jax.numpy as jnp
+
+        h, d = self.hosts, self.devices_per_host
+
+        def ici_reduce_scatter(x):
+            # x: [H, D, n] -> [H, D, chunk]; device slot (h, i) ends with
+            # sum over the host's D devices of chunk i
+            n = x.shape[-1]
+            pad = (-n) % d
+            if pad:
+                x = jnp.pad(x, ((0, 0), (0, 0), (0, pad)))
+            chunks = x.reshape(h, d, d, -1)        # [H, src, chunk_idx, c]
+            return jnp.sum(chunks, axis=1)          # [H, chunk_idx, c]
+
+        def dcn_allreduce(shards):
+            # [H, D, c] -> [H, D, c]: every host sees the cross-host sum
+            total = jnp.sum(shards, axis=0, keepdims=True)
+            return jnp.broadcast_to(total, shards.shape)
+
+        def dcn_dgc(u, v, shards):
+            # per-slot compression: each (host, device) picks the top-k
+            # of ITS OWN shard (a device cannot see other slots'
+            # magnitudes), then only the masked-dense sends cross DCN
+            def one(uu, vv, gg):
+                return _dgc.dgc_compress(uu, vv, gg, self.dgc_momentum,
+                                         self.dgc_ratio)
+            u1, v1, send = jax.vmap(jax.vmap(one))(u, v, shards)
+            total = jnp.sum(send, axis=0, keepdims=True)
+            return u1, v1, jnp.broadcast_to(total, shards.shape)
+
+        def ici_all_gather(shards, n):
+            # [H, D, c] -> [H, D, n]: concatenate the D chunks back and
+            # hand every device the full vector
+            full = shards.reshape(h, 1, -1)[:, :, :n]
+            return jnp.broadcast_to(full, (h, d, n))
+
+        def host_mean(params):
+            # LocalSGD sync point: average across the host axis only
+            avg = jnp.mean(params, axis=0, keepdims=True)
+            return jnp.broadcast_to(avg, params.shape)
+
+        return {
+            "ici_rs": jax.jit(ici_reduce_scatter),
+            "dcn_sum": jax.jit(dcn_allreduce),
+            "dcn_dgc": jax.jit(dcn_dgc),
+            "ici_ag": jax.jit(ici_all_gather, static_argnums=1),
+            "host_mean": jax.jit(host_mean),
+        }
+
+    def _timed(self, hist, counter, nbytes, fn, *args, **kw):
+        import jax
+
+        t0 = time.perf_counter()
+        out = fn(*args, **kw)
+        jax.block_until_ready(out)
+        hist.observe(time.perf_counter() - t0)
+        counter.inc(int(nbytes))
+        return out
+
+    def _check(self, g):
+        g = np.asarray(g) if not hasattr(g, "shape") else g
+        if g.ndim < 2 or g.shape[0] != self.hosts or \
+                g.shape[1] != self.devices_per_host:
+            raise ValueError(
+                "stacked grad must be [H=%d, D=%d, ...], got %r"
+                % (self.hosts, self.devices_per_host, tuple(g.shape)))
+        return g.reshape(self.hosts, self.devices_per_host, -1)
+
+    def allreduce(self, grads):
+        """Hierarchical MEAN of a list of stacked ``[H, D, ...]`` grads;
+        returns the same shapes with every slot holding the global
+        mean. Phases are timed into the ``phase="ici"|"dcn"`` series."""
+        world = self.hosts * self.devices_per_host
+        out = []
+        for i, g in enumerate(grads):
+            orig_shape = tuple(g.shape)
+            flat = self._check(g)
+            n = flat.shape[-1]
+            itemsize = np.dtype(flat.dtype).itemsize
+            shards = self._timed(_M_ICI_SEC, _M_ICI_BYTES,
+                                 self.hosts * n * itemsize,
+                                 self._fns["ici_rs"], flat)
+            shard_elems = int(np.prod(shards.shape))
+            if self.dgc_ratio is not None:
+                if i not in self._u:
+                    import jax.numpy as jnp
+
+                    self._u[i] = jnp.zeros(shards.shape, shards.dtype)
+                    self._v[i] = jnp.zeros(shards.shape, shards.dtype)
+                u, v, summed = self._timed(
+                    _M_DCN_SEC, _M_DCN_BYTES,
+                    max(1, int(shard_elems * itemsize * self.dgc_ratio)),
+                    self._fns["dcn_dgc"], self._u[i], self._v[i], shards)
+                self._u[i], self._v[i] = u, v
+            else:
+                summed = self._timed(_M_DCN_SEC, _M_DCN_BYTES,
+                                     shard_elems * itemsize,
+                                     self._fns["dcn_sum"], shards)
+            full = self._timed(_M_ICI_SEC, _M_ICI_BYTES,
+                               self.hosts * n * itemsize,
+                               self._fns["ici_ag"], summed, n)
+            out.append((full / world).reshape(orig_shape))
+        return out
+
+    def allreduce_local(self, grads):
+        """ICI-only mean — what every non-sync LocalSGD step runs: each
+        host averages over its own D devices, no DCN traffic."""
+        import jax.numpy as jnp
+
+        out = []
+        for g in grads:
+            orig_shape = tuple(g.shape)
+            flat = self._check(g)
+            n = flat.shape[-1]
+            itemsize = np.dtype(flat.dtype).itemsize
+            shards = self._timed(_M_ICI_SEC, _M_ICI_BYTES,
+                                 self.hosts * n * itemsize,
+                                 self._fns["ici_rs"], flat)
+            full = self._timed(_M_ICI_SEC, _M_ICI_BYTES,
+                               self.hosts * n * itemsize,
+                               self._fns["ici_ag"], shards, n)
+            out.append((full / self.devices_per_host)
+                       .reshape(orig_shape))
+        return out
+
+    def localsgd_params(self, params, step):
+        """Cross-host LocalSGD sync: every ``local_sgd_steps``-th step,
+        average each stacked ``[H, D, ...]`` parameter across the HOST
+        axis (DCN-timed); other steps return params unchanged."""
+        if (int(step) + 1) % self.local_sgd_steps:
+            return params
+        out = []
+        for p in params:
+            orig_shape = tuple(p.shape)
+            flat = self._check(p)
+            itemsize = np.dtype(flat.dtype).itemsize
+            avg = self._timed(
+                _M_DCN_SEC, _M_DCN_BYTES,
+                self.hosts * flat.shape[-1] * itemsize,
+                self._fns["host_mean"], flat)
+            out.append(avg.reshape(orig_shape))
+        return out

@@ -59,6 +59,11 @@ def pytest_configure(config):
         "markers", "compile_cache: exercises the persistent on-disk "
                    "compile cache (AOT serialize/deserialize, "
                    "quarantine, eviction, prelowered models)")
+    config.addinivalue_line(
+        "markers", "multihost: exercises the multi-host SPMD runtime "
+                   "(TCP coordination service, hierarchical DCN "
+                   "data-parallelism, cross-host DGC/LocalSGD) — "
+                   "spawns worker subprocesses")
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,84 @@
+"""Multi-host fake-cluster runner: each launched process is one "host"
+of a 2-level ``(host, device)`` mesh, bootstrapped PURELY over the TCP
+coordination service (the runner refuses to start if a shared-FS
+rendezvous dir leaked into its env). Trains a small MLP data-parallel
+with ``HierarchicalGradAllReduce`` — in-host reduce-scatter/all-gather
+over the process-local devices, cross-host allreduce over the gloo
+"DCN" — and prints per-step losses plus a final weight digest so the
+parent test can compare against the single-process baseline.
+
+Run via:
+  python -m paddle_tpu.distributed.launch --nproc_per_node 2 --backend cpu \
+      tests/dist_runner_multihost.py
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# pure-TCP contract: the launcher must have exported the coordination
+# endpoint and must NOT have exported a shared-filesystem rendezvous dir
+assert os.environ.get("PADDLE_COORD_ADDR"), \
+    "runner requires a TCP coordination service (PADDLE_COORD_ADDR)"
+assert "PADDLE_RENDEZVOUS_DIR" not in os.environ, \
+    "shared-FS rendezvous leaked into a TCP-bootstrapped gang"
+
+from paddle_tpu.distributed import env as dist_env  # noqa: E402
+
+rank, world = dist_env.init_parallel_env(ndev_per_proc=2)
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu.fluid as fluid  # noqa: E402
+from paddle_tpu.fluid import layers, optimizer  # noqa: E402
+from paddle_tpu.fluid.transpiler.collective import (  # noqa: E402
+    HierarchicalGradAllReduce)
+
+
+def build(seed=23):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[8], dtype="float32")
+        label = layers.data("label", shape=[1], dtype="int64")
+        h = layers.fc(x, size=16, act="relu",
+                      param_attr=fluid.ParamAttr(name="mh_w1"))
+        logits = layers.fc(h, size=4,
+                           param_attr=fluid.ParamAttr(name="mh_w2"))
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+        optimizer.SGD(0.1).minimize(loss)
+    return main, startup, loss
+
+
+def main():
+    import jax
+
+    assert jax.process_count() == world, (jax.process_count(), world)
+    ndev = jax.local_device_count()
+    main_p, startup, loss = build()
+    HierarchicalGradAllReduce(nranks=world * ndev).transpile(startup, main_p)
+    compiled = fluid.CompiledProgram(main_p).with_explicit_collectives(
+        loss_name=loss.name,
+        mesh_axes=("host", "device"),
+        mesh_shape={"host": world, "device": ndev})
+    exe = fluid.Executor()
+    rng = np.random.RandomState(1)
+    # every rank feeds the same GLOBAL batch; feed_sharding splits it
+    # over all host*device shards of the global mesh
+    feed = {"x": rng.rand(16, 8).astype(np.float32),
+            "label": rng.randint(0, 4, (16, 1)).astype(np.int64)}
+    losses = []
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for _ in range(4):
+            (lv,) = exe.run(compiled, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(lv).ravel()[0]))
+        w = np.asarray(exe.run(compiled, feed=feed, fetch_list=["mh_w1"])[0])
+    print("LOSSES " + json.dumps(losses), flush=True)
+    print("WDIGEST %.10e" % float(np.abs(w).sum()), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -35,6 +35,7 @@ def _clean_elastic_state(monkeypatch):
     preemption.reset()
     for k in ("PADDLE_RESTART_ATTEMPT", "PADDLE_HEARTBEAT_DIR",
               "PADDLE_CHECKPOINT_DIR", "PADDLE_RENDEZVOUS_DIR",
+              "PADDLE_COORD_ADDR", "PADDLE_COORD_BACKEND",
               "PADDLE_TRAINER_ID", "PADDLE_TRAINERS_NUM",
               preemption.ENV_DRAIN, faults.ENV):
         monkeypatch.delenv(k, raising=False)
@@ -494,11 +495,16 @@ def _launch_elastic(tmp_path, tag, nproc, extra_env=None, **kw):
 
 @pytest.mark.elastic
 @pytest.mark.faults
-def test_preempt_drain_checkpoints_and_resumes_bit_identical(tmp_path):
+@pytest.mark.parametrize("backend", ["file", "tcp"])
+def test_preempt_drain_checkpoints_and_resumes_bit_identical(tmp_path,
+                                                            backend):
     """SIGTERM mid-run: the worker finishes its step, force-saves,
     exits 0 — and the respawn (NO restart budget: max_restarts=0)
-    resumes to final weights bit-identical to an uninterrupted run."""
-    base_codes, base_logs = _launch_elastic(tmp_path, "base", 1)
+    resumes to final weights bit-identical to an uninterrupted run.
+    Runs against BOTH rendezvous backends: shared-FS file and the TCP
+    coordination service."""
+    base_codes, base_logs = _launch_elastic(tmp_path, "base", 1,
+                                            rendezvous_backend=backend)
     assert base_codes == [0]
     base_w = re.findall(r"WEIGHTS (\w+)", base_logs[0])
     assert base_w
@@ -506,7 +512,7 @@ def test_preempt_drain_checkpoints_and_resumes_bit_identical(tmp_path):
     before = monitor.counter("launch_preemptions_total").value
     codes, logs = _launch_elastic(
         tmp_path, "pre", 1, {"PADDLE_TEST_PREEMPT_AT": "3"},
-        max_restarts=0)
+        max_restarts=0, rendezvous_backend=backend)
     assert codes == [0]
     log = logs[0]
     assert "drained cleanly" in log
@@ -519,17 +525,21 @@ def test_preempt_drain_checkpoints_and_resumes_bit_identical(tmp_path):
 
 @pytest.mark.elastic
 @pytest.mark.faults
-def test_gang_shrinks_to_survivors_and_reshards(tmp_path):
+@pytest.mark.parametrize("backend", ["file", "tcp"])
+def test_gang_shrinks_to_survivors_and_reshards(tmp_path, backend):
     """Rank 2 hard-crashes whenever the gang runs at size 3; after the
     size-3 budget (max_restarts_at_size=1) is exhausted the launcher
     re-forms at 2, and rank 0 restores the size-3 checkpoint THROUGH
-    its CompiledProgram — reshard-on-restore onto the current mesh."""
+    its CompiledProgram — reshard-on-restore onto the current mesh.
+    The reformation plumbing (offer/consume slots, generation bumps)
+    must behave identically over the file and TCP rendezvous."""
     before = monitor.counter("launch_reformations_total").value
     codes, logs = _launch_elastic(
         tmp_path, "shrink", 3,
         {"PADDLE_TEST_CRASH_RANK": "2", "PADDLE_TEST_CRASH_WORLD": "3",
          "PADDLE_TEST_CRASH_AT": "2", "PADDLE_TEST_COMPILED": "1"},
-        max_restarts=4, max_restarts_at_size=1, min_world_size=2)
+        max_restarts=4, max_restarts_at_size=1, min_world_size=2,
+        rendezvous_backend=backend)
     assert len(codes) == 2  # the reformed gang IS the final attempt
     assert codes == [0, 0]
     assert monitor.counter("launch_reformations_total").value > before
@@ -544,16 +554,18 @@ def test_gang_shrinks_to_survivors_and_reshards(tmp_path):
 
 @pytest.mark.elastic
 @pytest.mark.faults
-def test_hung_step_watchdog_dumps_stacks_and_recovers(tmp_path):
+@pytest.mark.parametrize("backend", ["file", "tcp"])
+def test_hung_step_watchdog_dumps_stacks_and_recovers(tmp_path, backend):
     """A worker wedges mid-step while its heartbeat daemon keeps
     stamping: only the step-deadline watchdog can see it. It SIGUSR1s
     the worker (faulthandler stack dump into the log), kills the gang,
-    and the respawn resumes from the checkpoint."""
+    and the respawn resumes from the checkpoint — on either rendezvous
+    backend."""
     before = monitor.counter("watchdog_hung_steps_total").value
     codes, logs = _launch_elastic(
         tmp_path, "hang", 1,
         {"PADDLE_TEST_HANG_AT": "2", "PADDLE_FAULT_HANG_SECONDS": "3600"},
-        max_restarts=1, step_deadline=3.0)
+        max_restarts=1, step_deadline=3.0, rendezvous_backend=backend)
     assert codes == [0]
     assert monitor.counter("watchdog_hung_steps_total").value > before
     log = logs[0]

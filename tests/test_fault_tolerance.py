@@ -432,7 +432,7 @@ def test_reserve_port_range_is_fully_bindable():
 
     base = _reserve_port_range(4)
     for i in range(4):
-        s = socket.socket()
+        s = socket.socket()  # bind probe only, no protocol spoken
         s.bind(("127.0.0.1", base + i))
         s.close()
 

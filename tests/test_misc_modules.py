@@ -145,7 +145,7 @@ def test_wait_server_ready():
 
     from paddle_tpu.distributed import wait_server_ready
 
-    srv = socket.socket()
+    srv = socket.socket()  # accept-only stub for the readiness poller
     srv.bind(("127.0.0.1", 0))
     srv.listen(1)
     port = srv.getsockname()[1]

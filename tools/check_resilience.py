@@ -62,6 +62,14 @@ _PICKLE_CALLS = {("pickle", "load"), ("pickle", "loads")}
 # bypasses the quarantine/atomic-write discipline
 _CACHE_ENTRY_SUFFIX = ".xc"
 
+# the single sanctioned home for raw socket construction:
+# distributed/wire.py owns listener setup (SO_REUSEADDR, close-on-
+# failure) and framed client connections (handshake, retry/backoff,
+# frame caps). A raw socket.socket elsewhere grows an unframed,
+# un-retried, token-less protocol the fault injector can't see.
+_SOCKET_EXEMPT = ("distributed/wire.py",)
+_SOCKET_CALLS = {("socket", "socket")}
+
 
 def _line_has_justification(line):
     """True when the except line carries a real trailing comment
@@ -160,6 +168,8 @@ def check_file(path):
     if not any(norm.endswith(suffix) for suffix in _PICKLE_EXEMPT):
         out.extend(_call_violations(source, _PICKLE_CALLS))
         out.extend(_cache_open_violations(source))
+    if not any(norm.endswith(suffix) for suffix in _SOCKET_EXEMPT):
+        out.extend(_call_violations(source, _SOCKET_CALLS))
     return sorted(out)
 
 
@@ -187,8 +197,9 @@ def main(argv=None):
               % (path, lineno, line))
     if violations:
         print("%d unjustified site(s): bare-except/BaseException, raw "
-              "signal.signal, raw os._exit, raw pickle.load(s), or a "
-              ".xc cache entry opened outside fluid/compile_cache — "
+              "signal.signal, raw os._exit, raw pickle.load(s), a "
+              ".xc cache entry opened outside fluid/compile_cache, or "
+              "a raw socket.socket outside distributed/wire — "
               "add a trailing comment explaining why the site is safe, "
               "narrow the exception, or route the access through the "
               "sanctioned module" % len(violations))
