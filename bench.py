@@ -862,6 +862,259 @@ def bench_serve(n_clients=64, per_client=8, max_batch_size=16,
     }
 
 
+def _fleet_model_dir(tmp, prelower=True, batch_sizes=(1, 2, 4, 8)):
+    """Export the tiny serving model the fleet benches spawn replicas
+    on; ``prelower=True`` AOT-compiles the bucket ladder so replica
+    processes cold-start with zero live compiles."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 0
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[32], dtype="float32")
+        h = layers.fc(x, size=64, act="relu")
+        prob = layers.softmax(layers.fc(h, size=8))
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_inference_model(
+            tmp, ["x"], [prob], exe, main_program=main,
+            prelower=prelower, prelower_batch_sizes=batch_sizes)
+    return tmp
+
+
+def _fleet_spec(model_dir, delay_ms=2.0, queue_depth=64):
+    # breaker_threshold is effectively disabled: the bench wants every
+    # over-capacity submit to be a deterministic depth shed, not a
+    # breaker-mode fast-reject that depends on shed burstiness
+    return {"prefix": "fleet/",
+            "models": [{"name": "fc", "model_dir": model_dir,
+                        "warmup": {"x": {"shape": [1, 32],
+                                         "dtype": "float32"}},
+                        "config": {"max_batch_size": 8,
+                                   "max_queue_delay_ms": delay_ms,
+                                   "max_queue_depth": queue_depth,
+                                   "breaker_threshold": 10 ** 6}}]}
+
+
+def _fleet_closed_loop(router_ep, n_clients, per_client, deadline_ms,
+                       max_rows=4, on_request=None):
+    """Closed-loop client fleet: ``n_clients`` threads, each with its
+    own FleetClient, measuring per-request wall time. Returns
+    (ok_in_slo, served, shed, errors, latencies_sec)."""
+    import threading
+
+    from paddle_tpu.inference import Overloaded
+    from paddle_tpu.serving import FleetClient
+
+    rng = np.random.RandomState(7)
+    reqs = [rng.rand(rng.randint(1, max_rows + 1), 32).astype(np.float32)
+            for _ in range(n_clients * per_client)]
+    state = {"ok_slo": 0, "served": 0, "shed": 0, "errors": [],
+             "lat": []}
+    mu = threading.Lock()
+
+    def client(cid):
+        cli = FleetClient(router_ep)
+        try:
+            for i in range(per_client):
+                r = reqs[cid * per_client + i]
+                if on_request is not None:
+                    on_request(cid, i)
+                t0 = time.perf_counter()
+                try:
+                    out = cli.submit("fc", {"x": r},
+                                     deadline_ms=deadline_ms)
+                    dt = time.perf_counter() - t0
+                    assert out[0].shape == (r.shape[0], 8)
+                    with mu:
+                        state["served"] += 1
+                        state["lat"].append(dt)
+                        if dt <= deadline_ms / 1000.0:
+                            state["ok_slo"] += 1
+                except Overloaded:
+                    with mu:
+                        state["shed"] += 1
+        except BaseException as e:  # surfaced after join
+            with mu:
+                state["errors"].append(e)
+        finally:
+            cli.close()
+
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(n_clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(600)
+    state["wall"] = time.perf_counter() - t0
+    return state
+
+
+def bench_fleet(replica_counts=(1, 2, 4), n_clients=8, per_client=24,
+                deadline_ms=500.0, scale_queue_depth=6):
+    """``BENCH_FLEET=1``: closed-loop serving-fleet bench. One router +
+    subprocess replica fleets of {1, 2, 4} at fixed offered load:
+    p50/p99 e2e latency and goodput-under-SLO per size, per-replica
+    routed counts proving balance. Each replica's admission bound
+    (``max_queue_depth=scale_queue_depth`` rows) is deliberately tight
+    enough that a single replica sheds part of the offered load; the
+    fleet's capacity is then genuinely the sum of its members, and
+    goodput — the fraction of the FIXED offered load answered within
+    its deadline — must be monotone non-decreasing 1 -> 4 replicas.
+    (The wall-clock rate is reported but not asserted on: on a shared
+    machine more processes can coalesce smaller batches and run
+    slower per request while still serving strictly MORE of the load
+    within SLO.) Then the kill run: SIGKILL one of two replicas
+    mid-stream — every request is accounted (served or typed-shed,
+    requeues counted), and the supervisor's warm respawn re-registers
+    with ZERO live compiles (prelowered ladder + disk hits only)."""
+    import json as _json
+    import tempfile
+
+    from paddle_tpu.distributed.coordination import (CoordClient,
+                                                     CoordServer)
+    from paddle_tpu.fluid import monitor
+    from paddle_tpu.serving import Router
+    from paddle_tpu.serving.supervisor import FleetSupervisor
+
+    tmp = tempfile.mkdtemp(prefix="bench_fleet_")
+    model_dir = _fleet_model_dir(os.path.join(tmp, "model"))
+    # scaling leg: per-replica capacity bound, so replicas add capacity;
+    # kill leg: generous depth, so sheds reflect the kill alone
+    scale_spec = _fleet_spec(model_dir, queue_depth=scale_queue_depth)
+    spec = _fleet_spec(model_dir)
+    coord = CoordServer().start()
+    addr = "%s:%d" % (coord.host, coord.port)
+    dbg = CoordClient(addr)
+    out = {"fleet_deadline_ms": deadline_ms, "fleet_clients": n_clients,
+           "fleet_requests_per_size": n_clients * per_client}
+
+    def wait_members(n, timeout=240):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if len(dbg.live_members("fleet/replicas/")) >= n:
+                return
+            time.sleep(0.2)
+        raise TimeoutError("only %d/%d replicas registered"
+                           % (len(dbg.live_members("fleet/replicas/")), n))
+
+    try:
+        goodputs = []
+        total = n_clients * per_client
+        for n in replica_counts:
+            sup = FleetSupervisor(scale_spec, n, addr,
+                                  env={"PADDLE_FLEET_LEASE_TTL": "3.0"},
+                                  log_dir=os.path.join(tmp, "logs%d" % n))
+            router = Router(coord_addr=addr, refresh_interval=0.1)
+            try:
+                sup.start()
+                wait_members(n)
+                router.start()
+                st = _fleet_closed_loop(
+                    "%s:%d" % (router.host, router.port),
+                    n_clients, per_client, deadline_ms)
+                assert not st["errors"], st["errors"][:3]
+                lat = sorted(st["lat"])
+                # goodput-under-SLO: fraction of the fixed offered load
+                # answered within its deadline — the quantity that is
+                # monotone in fleet capacity
+                goodput = st["ok_slo"] / total
+                goodputs.append(goodput)
+                per_rep = {
+                    rid: monitor.counter("fleet_replica_routed_total",
+                                         labels={"replica": rid}).value
+                    for rid in sup.replica_ids()}
+                out["fleet_%dx_goodput" % n] = round(goodput, 3)
+                out["fleet_%dx_rate_rps" % n] = round(
+                    st["served"] / st["wall"], 1)
+                out["fleet_%dx_p50_ms" % n] = round(
+                    1e3 * lat[len(lat) // 2], 3) if lat else None
+                out["fleet_%dx_p99_ms" % n] = round(
+                    1e3 * lat[int(len(lat) * 0.99) - 1], 3) if lat else None
+                out["fleet_%dx_served" % n] = st["served"]
+                out["fleet_%dx_shed" % n] = st["shed"]
+                out["fleet_%dx_per_replica" % n] = per_rep
+                if n > 1:
+                    assert all(v > 0 for v in per_rep.values()), (
+                        "unbalanced fleet: %s" % per_rep)
+            finally:
+                router.close()
+                sup.stop(timeout=60)
+        # the load must actually saturate ONE replica, else "more
+        # replicas do not hurt" would be vacuously true
+        assert goodputs[0] < 1.0, (
+            "offered load never exceeded a single replica's admission "
+            "bound; tighten scale_queue_depth or raise n_clients")
+        assert all(b >= a - 0.02 for a, b in zip(goodputs, goodputs[1:])), (
+            "goodput-under-SLO regressed with more replicas: %s"
+            % [round(g, 3) for g in goodputs])
+
+        # -- kill-one-replica: zero loss, warm respawn ------------------
+        sup = FleetSupervisor(spec, 2, addr,
+                              env={"PADDLE_FLEET_LEASE_TTL": "3.0"},
+                              log_dir=os.path.join(tmp, "logs_kill"))
+        router = Router(coord_addr=addr, refresh_interval=0.1)
+        try:
+            sup.start()
+            wait_members(2)
+            router.start()
+            requeued0 = monitor.counter("fleet_requeued_total").value
+            shed0 = monitor.sum_labeled("fleet_shed_total")
+            victim = sup.replica_ids()[0]
+            pid0 = sup.pid(victim)
+            killed = {"done": False}
+
+            def killer(cid, i):
+                # first client, a third of the way in: pull the plug
+                if cid == 0 and i == per_client // 3 \
+                        and not killed["done"]:
+                    killed["done"] = True
+                    sup.kill(victim)
+
+            st = _fleet_closed_loop(
+                "%s:%d" % (router.host, router.port),
+                n_clients, per_client, deadline_ms, on_request=killer)
+            assert not st["errors"], st["errors"][:3]
+            total = n_clients * per_client
+            assert st["served"] + st["shed"] == total, (
+                "lost requests: %d served + %d shed != %d"
+                % (st["served"], st["shed"], total))
+            out["fleet_kill_served"] = st["served"]
+            out["fleet_kill_shed"] = (
+                monitor.sum_labeled("fleet_shed_total") - shed0)
+            out["fleet_kill_requeued"] = (
+                monitor.counter("fleet_requeued_total").value - requeued0)
+            # the supervisor respawned the victim warm: same id, new
+            # pid, ZERO live compiles (prelowered ladder off disk)
+            deadline = time.time() + 240
+            info = None
+            while time.time() < deadline:
+                blob = dbg.get("fleet/replicas/%s" % victim)
+                if blob is not None:
+                    info = _json.loads(blob.decode())
+                    if info["pid"] != pid0:
+                        break
+                time.sleep(0.2)
+            assert info is not None and info["pid"] != pid0, (
+                "victim %s never respawned" % victim)
+            assert info["live_compiles"] == 0, info
+            out["fleet_respawn_live_compiles"] = info["live_compiles"]
+            out["fleet_respawn_warmup_disk_hits"] = \
+                info["warmup_disk_hits"]
+            out["fleet_respawns"] = sup.respawns
+        finally:
+            router.close()
+            sup.stop(timeout=60)
+    finally:
+        dbg.close()
+        coord.stop()
+    return out
+
+
 def bench_restart():
     """``BENCH_RESTART=1``: restart-to-first-step and serving
     ``register()`` warm-up, cold (empty persistent compile cache) vs
@@ -1068,8 +1321,7 @@ def _sum_labeled(name):
     """Sum a counter across every label set it was registered under."""
     from paddle_tpu.fluid import monitor
 
-    return sum(m.value for (n, _), m in monitor._REGISTRY.items()
-               if n == name and hasattr(m, "value"))
+    return monitor.sum_labeled(name)
 
 
 def bench_smoke():
@@ -1184,6 +1436,39 @@ def bench_smoke():
     assert serve["serve_batches"] < serve["serve_requests"], (
         "serve smoke: no coalescing happened")
 
+    # tiny fleet loop: coord + one in-process replica + router + client
+    # — registration via lease, routed traffic, graceful drain; the
+    # serving-fleet wiring can't silently rot out of --smoke coverage
+    import tempfile as _tf
+
+    from paddle_tpu.distributed.coordination import CoordServer
+    from paddle_tpu.serving import FleetClient, Replica, Router
+
+    fleet_dir = _fleet_model_dir(_tf.mkdtemp(prefix="bench_smoke_fleet_"),
+                                 prelower=False)
+    fcoord = CoordServer().start()
+    faddr = "%s:%d" % (fcoord.host, fcoord.port)
+    frep = Replica(_fleet_spec(fleet_dir), coord_addr=faddr,
+                   replica_id="smoke0", lease_ttl=5.0,
+                   stats_interval=0.1).start()
+    frouter = Router(coord_addr=faddr, refresh_interval=0.1).start()
+    fleet_routed0 = _sum_labeled("fleet_routed_total")
+    try:
+        fcli = FleetClient("%s:%d" % (frouter.host, frouter.port))
+        frng = np.random.RandomState(2)
+        for _ in range(8):
+            fx = frng.rand(frng.randint(1, 5), 32).astype(np.float32)
+            fout = fcli.submit("fc", {"x": fx}, deadline_ms=10000)
+            assert fout[0].shape == (fx.shape[0], 8)
+        fcli.close()
+    finally:
+        frouter.close()
+        frep.drain(timeout=10)
+        fcoord.stop()
+    fleet_routed = _sum_labeled("fleet_routed_total") - fleet_routed0
+    assert fleet_routed == 8, (
+        "fleet smoke: %d/8 requests routed" % fleet_routed)
+
     # persistent compile cache: a warm "restart" (fresh Executor,
     # rebuilt program, same cache dir) must deserialize BOTH programs
     # from disk and compile zero live — the restart fast path can't
@@ -1257,6 +1542,7 @@ def bench_smoke():
         "embed_smoke_evictions": embed_evictions,
         "cache_smoke_disk_hits": int(ch2 - ch1),
         "cache_smoke_disk_misses": int(cm1 - cm0),
+        "fleet_smoke_routed": fleet_routed,
         "monitor": monitor_summary(),
     }
 
@@ -1288,6 +1574,8 @@ if __name__ == "__main__":
         out.update(bench_transformer_decode())
     if os.environ.get("BENCH_SERVE") == "1":
         out.update(bench_serve())
+    if os.environ.get("BENCH_FLEET") == "1":
+        out.update(bench_fleet())
     if os.environ.get("BENCH_EMBED") == "1":
         out.update(bench_embedding())
     if os.environ.get("BENCH_RESTART") == "1":

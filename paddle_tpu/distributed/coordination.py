@@ -49,7 +49,7 @@ _MAGIC = b"PTCO1"
 
 # opcodes
 (_PUT, _GET, _DEL, _ADD, _LIST, _BAR_ARRIVE, _BAR_WAIT, _LEASE, _LIVE,
- _PING, _STOP) = range(1, 12)
+ _PING, _STOP, _LIVE_MEMBERS) = range(1, 13)
 
 # server-side waits are bounded by this slice; clients loop short waits
 # up to their own deadline (see module doc)
@@ -185,6 +185,8 @@ class CoordServer(_wire.FramedServer):
                 return self._do_lease(key, ttl)
             if op == _LIVE:
                 return self._do_live()
+            if op == _LIVE_MEMBERS:
+                return self._do_live_members(key)
             raise _wire.DecodeError("unknown opcode %d" % op)
         except _wire.DecodeError as e:
             return b"\x01" + ("decode error: %s" % e).encode()[:512]
@@ -284,6 +286,25 @@ class CoordServer(_wire.FramedServer):
             for c in dead:
                 del self._leases[c]
             live = sorted(self._leases)
+        return b"\x00" + json.dumps(live).encode()
+
+    def _do_live_members(self, prefix):
+        # the membership primitive the fleet router polls: sweep expired
+        # leases UNDER THIS PREFIX and delete both the lease record and
+        # the member's KV entry (its registration blob), so one atomic
+        # server-side pass guarantees the returned keys all carry a live
+        # lease — the caller can never observe a dead replica.
+        now = time.monotonic()
+        with self._cv:
+            dead = [c for c, d in self._leases.items()
+                    if c.startswith(prefix) and d <= now]
+            for c in dead:
+                del self._leases[c]
+                self._kv.pop(c, None)
+            if dead:
+                self._cv.notify_all()
+            live = sorted(c for c in self._leases
+                          if c.startswith(prefix) and c in self._kv)
         return b"\x00" + json.dumps(live).encode()
 
 
@@ -387,6 +408,16 @@ class CoordClient:
     def live(self):
         resp = self._conn.request(struct.pack("<B", _LIVE) +
                                   _pack_str(""))
+        return json.loads(resp.decode())
+
+    def live_members(self, prefix):
+        """Keys under ``prefix`` whose lease is still live, after a
+        server-side sweep that evicts expired members (lease AND KV
+        registration blob in one pass). Membership registration is
+        ``put(key, blob)`` + ``lease(key, ttl)`` with the SAME string as
+        key and lease id; this is the read side the fleet router polls."""
+        resp = self._conn.request(struct.pack("<B", _LIVE_MEMBERS) +
+                                  _pack_str(prefix))
         return json.loads(resp.decode())
 
     def start_lease_keeper(self, client_id, ttl=10.0, interval=None):

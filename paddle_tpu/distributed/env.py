@@ -177,8 +177,9 @@ def wait_server_ready(endpoints, timeout=120.0, interval=0.5):
     (reference ``transpiler/distribute_transpiler.py:322`` — trainers poll
     pservers; here: pollers for the PS tier / NAS controller / any
     socket-served component)."""
-    import socket
     import time
+
+    from . import wire as _wire
 
     pending = list(endpoints)
     deadline = time.monotonic() + timeout
@@ -189,10 +190,8 @@ def wait_server_ready(endpoints, timeout=120.0, interval=0.5):
             if remaining <= 0:
                 raise TimeoutError("servers not ready: %s"
                                    % ",".join(still + pending[i:]))
-            host, port = ep.rsplit(":", 1)
             try:
-                with socket.create_connection(
-                        (host, int(port)), timeout=min(2.0, remaining)):
+                with _wire.connect(ep, timeout=min(2.0, remaining)):
                     pass
             except OSError:
                 still.append(ep)

@@ -191,14 +191,12 @@ class FLTrainerClient:
     round (weight = e.g. the local sample count for FedAvg)."""
 
     def __init__(self, endpoint, token=None):
-        import socket
         import uuid
 
+        from . import wire as _wire
         from .ps_server import _default_token
 
-        host, port = endpoint.rsplit(":", 1)
-        self._sock = socket.create_connection((host, int(port)),
-                                              timeout=330)
+        self._sock = _wire.connect(endpoint, timeout=330)
         tok = (_default_token() if token is None else str(token)).encode()
         _send_all(self._sock, _MAGIC + struct.pack("<H", len(tok)) + tok)
         resp = _read_frame(self._sock)

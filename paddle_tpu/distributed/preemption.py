@@ -38,7 +38,7 @@ from ..fluid import monitor as _monitor
 __all__ = [
     "ENV_DRAIN", "install", "uninstall", "installed", "draining",
     "drain_reason", "request_drain", "check_drain", "drain_exit",
-    "maybe_install_from_env", "preempt_marker_path",
+    "on_drain", "maybe_install_from_env", "preempt_marker_path",
     "write_preempt_marker", "reset", "LauncherForward",
 ]
 
@@ -56,6 +56,7 @@ _M_DRAIN_EXITS = _monitor.counter(
 
 _LOCK = threading.Lock()
 _DRAIN = threading.Event()
+_CALLBACKS = []
 _INSTALLED = False
 _ENV_CHECKED = False
 _PREV = {}
@@ -91,6 +92,25 @@ def request_drain(reason="api"):
         _SINCE = time.time()
         _DRAIN.set()
         _M_SIGNALS.inc()
+        for fn in list(_CALLBACKS):
+            try:
+                fn()
+            except Exception:  # a broken callback must not block the drain
+                log.exception("on_drain callback failed")
+
+
+def on_drain(fn):
+    """Register ``fn`` to run when the drain flag flips (signal or
+    ``request_drain``). Callbacks may run ON THE SIGNAL-HANDLER FRAME —
+    they must be tiny and async-signal-tolerant (set an Event, wake a
+    Condition); a serving replica uses this to break out of its idle
+    wait the instant SIGTERM lands instead of polling. If the flag is
+    already set, ``fn`` runs immediately. Returns ``fn``."""
+    with _LOCK:
+        _CALLBACKS.append(fn)
+    if _DRAIN.is_set():
+        fn()
+    return fn
 
 
 def _handler(signum, frame):
@@ -155,6 +175,7 @@ def reset():
     global _REASON, _SINCE, _ENV_CHECKED
     uninstall()
     _DRAIN.clear()
+    del _CALLBACKS[:]
     _REASON = None
     _SINCE = None
     _ENV_CHECKED = False

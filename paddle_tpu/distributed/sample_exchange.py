@@ -9,7 +9,6 @@ frames, no pickle — samples are tuples of dtyped 1-D arrays packed with
 the same array codec as table rows).
 """
 
-import socket
 import struct
 import threading
 
@@ -128,14 +127,14 @@ class _Sender:
     def __init__(self, endpoint, token, connect_timeout=60):
         import time
 
-        host, port = endpoint.rsplit(":", 1)
+        from . import wire as _wire
+
         # peers start at different speeds (interpreter/JAX import skew);
         # retry until the inbox is listening
         deadline = time.time() + connect_timeout
         while True:
             try:
-                self._sock = socket.create_connection(
-                    (host, int(port)), timeout=30)
+                self._sock = _wire.connect(endpoint, timeout=30)
                 break
             except OSError:
                 if time.time() >= deadline:

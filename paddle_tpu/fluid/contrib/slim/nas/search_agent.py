@@ -13,7 +13,9 @@ class SearchAgent:
         self._timeout = timeout
 
     def _rpc(self, msg):
-        with socket.create_connection(self._addr,
+        # reference-shaped raw text protocol (unframed, close-delimited)
+        # — the controller server predates the framed wire tier
+        with socket.create_connection(self._addr,  # legacy NAS controller protocol, see comment above
                                       timeout=self._timeout) as s:
             s.sendall(msg.encode())
             s.shutdown(socket.SHUT_WR)

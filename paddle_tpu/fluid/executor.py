@@ -464,6 +464,17 @@ class Executor:
         # consecutive steps discarded by the skip_step/rollback anomaly
         # policy; a clean step resets it, exceeding the budget raises
         self._anomaly_skips = 0
+        # donate the state dict to the step executable (training wants
+        # the buffer reuse). Inference-path executors (Predictor,
+        # prelower export) set this False: donation bakes input->output
+        # aliasing into AOT-compiled executables — the ones the
+        # persistent cache serializes — and on CPU those run IN-PLACE
+        # over buffers that serving still exposes through zero-copy
+        # numpy views, corrupting served results after a cache restore.
+        # (A plain jit dispatch drops donation on CPU, which is why
+        # only the deserialized/AOT path was exposed.) The bit joins
+        # the disk cache key, so writer and reader must agree.
+        self._donate_state = True
 
     # -- anomaly policy (nan/inf) --------------------------------------
     def _scan_anomaly(self, fetch_names, fetches, new_state):
@@ -961,12 +972,13 @@ class Executor:
 
         from . import flags as _flags
 
+        donate = ((0,) if self._donate_state
+                  and _flags.anomaly_policy() == "raise" else ())
         cache_key = None
         if _compile_cache.active(self._cache_read_dirs):
             cache_key = _compile_cache.step_key(
                 program, _feed_signature(feed, block), fetch_names,
-                state_names, strategy, 1,
-                _flags.anomaly_policy() == "raise")
+                state_names, strategy, 1, bool(donate))
 
         # Startup-style programs create new persistables -> output structure
         # depends on trace; jit handles that fine since structure is fixed
@@ -983,10 +995,10 @@ class Executor:
         # skip_step/rollback re-commit the PRE-step scope arrays after a
         # discarded step; donation would have handed those buffers to XLA
         # (a no-op on CPU but fatal on TPU), so those policies compile
-        # undonated. The policy sits in the compile-cache key, so
-        # flipping FLAGS_anomaly_policy recompiles rather than reusing a
+        # undonated (donate computed above joins the disk key). The
+        # policy sits in the compile-cache key, so flipping
+        # FLAGS_anomaly_policy recompiles rather than reusing a
         # mismatched executable.
-        donate = (0,) if _flags.anomaly_policy() == "raise" else ()
         jfn = _compile_cache.wrap_jit(
             jax.jit(step, donate_argnums=donate), cache_key,
             read_dirs=self._cache_read_dirs,
@@ -1366,14 +1378,15 @@ class Executor:
 
         from . import flags as _flags
 
+        donate = ((0,) if self._donate_state
+                  and _flags.anomaly_policy() == "raise" else ())
         cache_key = None
         if _compile_cache.active(self._cache_read_dirs):
             merged = dict(stacked)
             merged.update(invariant)
             cache_key = _compile_cache.step_key(
                 program, _feed_signature(merged, block), fetch_names,
-                state_names, strategy, iters,
-                _flags.anomaly_policy() == "raise")
+                state_names, strategy, iters, bool(donate))
 
         if strategy is not None and mesh is not None:
             return _CompiledStep(
@@ -1386,9 +1399,9 @@ class Executor:
                 fetch_names,
             )
 
-        # see _build: donation off under skip_step/rollback so a
-        # discarded window's pre-step state stays valid
-        donate = (0,) if _flags.anomaly_policy() == "raise" else ()
+        # see _build: donation off under skip_step/rollback (so a
+        # discarded window's pre-step state stays valid) and for
+        # inference-path executors; donate computed above joins the key
         jfn = _compile_cache.wrap_jit(
             jax.jit(batched, donate_argnums=donate), cache_key,
             read_dirs=self._cache_read_dirs,

@@ -261,6 +261,12 @@ def _prelower_executables(dirname, model_bytes, batch_sizes):
     fetch_names = list(desc.get("fetch_names", []))
     out_dir = os.path.join(dirname, _compile_cache.PRELOWERED_DIRNAME)
     exe = Executor()
+    # inference executables are serialized WITHOUT state donation: a
+    # donated AOT executable runs in-place over param buffers, which
+    # corrupts served values once a cold process serves through the
+    # deserialized copy (see Executor._donate_state). The Predictor's
+    # executor flips the same bit, so reader keys match these entries.
+    exe._donate_state = False
     # a child scope keeps the exemplar run's state commits (and the rng
     # var) out of the caller's scope while params resolve through it
     scope = global_scope().new_scope()

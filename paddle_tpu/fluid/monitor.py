@@ -29,8 +29,8 @@ import threading
 from collections import OrderedDict
 
 __all__ = ["Counter", "Gauge", "Histogram", "counter", "gauge",
-           "histogram", "get_metric", "all_metrics", "reset",
-           "dump_json", "dump_prometheus", "default_buckets"]
+           "histogram", "get_metric", "sum_labeled", "all_metrics",
+           "reset", "dump_json", "dump_prometheus", "default_buckets"]
 
 ENV_DUMP = "PADDLE_MONITOR_DUMP"
 
@@ -303,6 +303,15 @@ def histogram(name, help="", labels=None, buckets=None):
 def get_metric(name, labels=None):
     """The registered metric, or None."""
     return _REGISTRY.get((name, _labels_key(labels)))
+
+
+def sum_labeled(name):
+    """Sum a counter/gauge named ``name`` across every label set it was
+    registered under (0.0 when none exist) — the fleet/bench roll-up for
+    per-model and per-replica series."""
+    with _LOCK:
+        return sum(m.value for (n, _), m in _REGISTRY.items()
+                   if n == name and hasattr(m, "value"))
 
 
 def all_metrics():

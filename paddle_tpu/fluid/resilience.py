@@ -25,8 +25,8 @@ import time
 
 from . import monitor as _monitor
 
-__all__ = ["TransientError", "CircuitOpenError", "Overloaded", "Retry",
-           "CircuitBreaker", "RestartBackoff", "backoff_delay"]
+__all__ = ["TransientError", "CircuitOpenError", "Overloaded", "Closed",
+           "Retry", "CircuitBreaker", "RestartBackoff", "backoff_delay"]
 
 def _site_counters(site):
     return (
@@ -61,6 +61,16 @@ class Overloaded(RuntimeError):
     retried blindly by ``Retry`` defaults — the correct client response
     is to back off, not to hammer an already-saturated server. Raised
     by ``inference.serving`` ``submit``; carries no partial state."""
+
+
+class Closed(RuntimeError):
+    """The target was shut down deliberately: ``Server.close()`` ran (or
+    a fleet replica is draining) and this operation arrived after the
+    fact. NOT a ``TransientError`` — retrying against the same instance
+    can never succeed; the caller should fail over to another replica
+    (what the fleet ``Router`` does) or surface the shutdown. Subclasses
+    ``RuntimeError`` so pre-typed ``except RuntimeError`` call sites
+    keep working."""
 
 
 def backoff_delay(attempt, base=0.1, factor=2.0, max_delay=30.0,

@@ -21,7 +21,7 @@ from ..fluid import monitor as _monitor
 
 __all__ = ["Config", "Predictor", "create_predictor", "PredictorPool",
            "GenerativePredictor", "Server", "GenerativeServer",
-           "ServeConfig", "Overloaded", "Future"]
+           "ServeConfig", "Overloaded", "Closed", "Future"]
 
 _M_RUNS = _monitor.counter(
     "predictor_runs_total", help="Predictor.run calls served")
@@ -77,6 +77,12 @@ class Predictor:
             config = Config(model_dir=config)
         self._config = config
         exe = fluid.Executor()
+        # never donate inference state: params pass through unchanged,
+        # so donation buys nothing — and it poisons the persistent
+        # cache (a donated AOT executable overwrites param buffers
+        # in-place when restored cold; see Executor._donate_state).
+        # Must match the bit __prelowered__ entries were keyed with.
+        exe._donate_state = False
         # a model exported with save_inference_model(prelower=True)
         # carries serialized executables next to __model__; registering
         # the dir as a read-only cache tier makes this predictor's cold
@@ -309,5 +315,5 @@ class PredictorPool:
 
 
 # imported last: serving builds on Predictor/GenerativePredictor above
-from .serving import (Future, GenerativeServer, Overloaded,  # noqa: E402
-                      ServeConfig, Server)
+from .serving import (Closed, Future, GenerativeServer,  # noqa: E402
+                      Overloaded, ServeConfig, Server)

@@ -10,7 +10,15 @@ two things to the raw request stream:
 1. **Coalesce.** Concurrent clients ``submit(feed)`` into a per-model
    queue; a batcher thread pops same-signature requests and stacks them
    into one batch, dispatching when ``max_batch_size`` rows are ready or
-   the oldest request has waited ``max_queue_delay_ms``.
+   the batch must close to meet its SLO: requests carrying
+   ``deadline_ms`` close the batch ``service-time-EWMA`` ahead of the
+   earliest deadline (a tight deadline forces an early partial batch, a
+   loose one lets rows coalesce PAST the legacy fixed delay), requests
+   without a deadline fall back to the classic
+   ``max_queue_delay_ms`` oldest-request bound. ``priority`` orders
+   head-of-line selection across waiting signatures; a request whose
+   deadline expires while still queued is shed with ``Overloaded``
+   instead of burning a dispatch.
 2. **Bucket.** The stacked batch is padded up to a power-of-two ladder
    (1, 2, 4, ..., max_batch_size), so the whole request stream maps onto
    ``len(ladder)`` compile-cache entries no matter how request sizes
@@ -35,8 +43,12 @@ ONE worker thread per registered model owns all device dispatches for
 that model, and a module-level ``_DISPATCH_LOCK`` serializes dispatches
 across models (the CPU/TPU backend is one device — interleaving gains
 nothing and jax dispatch from many threads is contention, not
-parallelism). Workers are daemon threads; ``close()`` joins them and
-rejects any still-queued requests.
+parallelism). Workers are daemon threads; ``close()`` FLUSHES — the
+worker drains every already-queued request through the normal dispatch
+path before exiting, and only requests that could not be dispatched are
+rejected, with the typed ``Closed`` (fluid.resilience). Submitting (or
+registering) after close raises ``Closed`` too; double-close is a
+no-op.
 """
 
 import threading
@@ -45,10 +57,10 @@ import time
 import numpy as np
 
 from ..fluid import monitor as _monitor
-from ..fluid.resilience import CircuitBreaker, Overloaded
+from ..fluid.resilience import CircuitBreaker, Closed, Overloaded
 
 __all__ = ["Future", "ServeConfig", "Server", "GenerativeServer",
-           "Overloaded"]
+           "Overloaded", "Closed"]
 
 # one device underneath every model: serialize executable dispatches
 # process-wide so worker threads don't contend inside jax
@@ -151,15 +163,28 @@ class ServeConfig:
     breaker_threshold / breaker_reset_s
                       consecutive shed count that trips the admission
                       breaker OPEN, and its hysteresis window.
+    priority          default request priority for this model (higher
+                      dispatches first across waiting signatures); a
+                      per-request ``submit(..., priority=)`` overrides.
+    deadline_ms       default per-request SLO budget from submit to
+                      resolved future; the batcher closes batches a
+                      service-time-EWMA margin BEFORE the earliest
+                      deadline in the head group instead of the fixed
+                      ``max_queue_delay_ms``, and sheds queued requests
+                      whose deadline has already passed. None (default)
+                      keeps the legacy fixed-delay closing.
     """
 
     def __init__(self, max_batch_size=8, max_queue_delay_ms=2.0,
                  max_queue_depth=64, pad_value=0.0, bucket_dims=None,
-                 breaker_threshold=16, breaker_reset_s=0.25):
+                 breaker_threshold=16, breaker_reset_s=0.25,
+                 priority=0, deadline_ms=None):
         if int(max_batch_size) < 1:
             raise ValueError("max_batch_size must be >= 1")
         if int(max_queue_depth) < 1:
             raise ValueError("max_queue_depth must be >= 1")
+        if deadline_ms is not None and float(deadline_ms) <= 0:
+            raise ValueError("deadline_ms must be positive when set")
         self.max_batch_size = int(max_batch_size)
         self.max_queue_delay_ms = float(max_queue_delay_ms)
         self.max_queue_depth = int(max_queue_depth)
@@ -167,6 +192,9 @@ class ServeConfig:
         self.bucket_dims = dict(bucket_dims or {})
         self.breaker_threshold = int(breaker_threshold)
         self.breaker_reset_s = float(breaker_reset_s)
+        self.priority = int(priority)
+        self.deadline_ms = None if deadline_ms is None \
+            else float(deadline_ms)
 
     def ladder(self):
         """The power-of-two batch sizes this model compiles for."""
@@ -202,15 +230,28 @@ def _bucket_pad(arr, dims, pad_value):
 
 
 class _Request:
-    __slots__ = ("feed", "rows", "sig", "future", "t_submit", "extra")
+    __slots__ = ("feed", "rows", "sig", "future", "t_submit", "extra",
+                 "deadline", "priority")
 
-    def __init__(self, feed, rows, sig, extra=None):
+    def __init__(self, feed, rows, sig, extra=None, deadline_ms=None,
+                 priority=0):
         self.feed = feed
         self.rows = rows
         self.sig = sig
         self.future = Future()
         self.t_submit = time.perf_counter()
         self.extra = extra
+        self.deadline = None if deadline_ms is None \
+            else self.t_submit + float(deadline_ms) / 1000.0
+        self.priority = int(priority)
+
+
+def _sched_key(r):
+    """Head-of-line order: highest priority, then earliest deadline
+    (deadline-less requests sort after any deadline), then FIFO."""
+    return (-r.priority,
+            r.deadline if r.deadline is not None else float("inf"),
+            r.t_submit)
 
 
 class _ModelEntry:
@@ -220,6 +261,7 @@ class _ModelEntry:
         self.config = config
         self.queue = []          # FIFO of _Request
         self.rows_queued = 0
+        self.service_est = 0.0   # dispatch-wall EWMA, the deadline margin
         self.lock = threading.Lock()
         self.cv = threading.Condition(self.lock)
         self.breaker = CircuitBreaker(
@@ -262,7 +304,7 @@ class Server:
         config = config or ServeConfig()
         with self._lock:
             if self._closed:
-                raise RuntimeError("server is closed")
+                raise Closed("server is closed")
             if name in self._models:
                 raise ValueError("model %r already registered" % name)
             entry = _ModelEntry(name, predictor, config)
@@ -299,12 +341,25 @@ class Server:
             entry.metrics["warmup_disk_hits"].inc(skipped)
 
     # -- client side -------------------------------------------------------
-    def submit(self, model, feed):
+    def submit(self, model, feed, deadline_ms=None, priority=None):
         """Enqueue one request; returns a ``Future`` resolving to the
         predictor's fetch list, sliced to this request's rows. Sheds
-        with ``Overloaded`` beyond the admission bound."""
+        with ``Overloaded`` beyond the admission bound — or when
+        ``deadline_ms`` (per-request SLO budget, default
+        ``ServeConfig.deadline_ms``) is already unmeetable. ``priority``
+        (default ``ServeConfig.priority``) jumps the head-of-line
+        queue."""
         entry = self._models[model]
         cfg, m = entry.config, entry.metrics
+        if deadline_ms is None:
+            deadline_ms = cfg.deadline_ms
+        if priority is None:
+            priority = cfg.priority
+        if deadline_ms is not None and float(deadline_ms) <= 0:
+            m["shed"].inc()
+            raise Overloaded(
+                "model %r request arrived with an expired deadline "
+                "(%.3f ms)" % (model, float(deadline_ms)))
         if not entry.breaker.allow():
             m["shed"].inc()
             raise Overloaded(
@@ -325,10 +380,11 @@ class Server:
                 % (cfg.max_batch_size, rows))
         sig = tuple(sorted((n, str(v.dtype), v.shape[1:])
                            for n, v in feed.items()))
-        req = _Request(feed, rows, sig)
+        req = _Request(feed, rows, sig, deadline_ms=deadline_ms,
+                       priority=priority)
         with entry.cv:
             if self._closed:
-                raise RuntimeError("server is closed")
+                raise Closed("server is closed")
             if entry.rows_queued + rows > cfg.max_queue_depth:
                 entry.breaker.record_failure()
                 m["shed"].inc()
@@ -345,40 +401,72 @@ class Server:
         return req.future
 
     # -- batcher worker ----------------------------------------------------
+    @staticmethod
+    def _group_close_at(entry, group):
+        """When the head-signature batch must stop coalescing and
+        dispatch. Every request carries the classic oldest-request +
+        ``max_queue_delay_ms`` bound; a request with ``deadline_ms``
+        ADDITIONALLY closes the batch ``service_est`` (dispatch-wall
+        EWMA) ahead of its deadline — a tight deadline forces an early
+        partial batch, a loose one leaves the legacy bound governing.
+        The earliest candidate wins: a deadline can only pull the close
+        forward, never starve the queue waiting for it."""
+        delay = entry.config.max_queue_delay_ms / 1000.0
+        cands = [min(r.t_submit for r in group) + delay]
+        with_dl = [r.deadline for r in group if r.deadline is not None]
+        if with_dl:
+            # floor the margin: before the first dispatch the EWMA is 0,
+            # and a batch closed AT the deadline expires in the wake-up
+            # jitter between cv.wait returning and batch formation
+            cands.append(min(with_dl) - max(entry.service_est, 0.005))
+        return min(cands)
+
     def _worker_loop(self, entry):
         cfg, m = entry.config, entry.metrics
-        delay = cfg.max_queue_delay_ms / 1000.0
         while True:
             with entry.cv:
                 while not entry.queue and not self._closed:
                     entry.cv.wait(0.1)
                 if self._closed and not entry.queue:
                     return
-                head = entry.queue[0]
-                deadline = head.t_submit + delay
-                # wait for more same-signature rows until the head's
-                # delay budget is spent or a full batch is ready
+                # coalesce the head-of-line signature group (priority,
+                # then earliest deadline, then FIFO) until a full batch
+                # is ready or its SLO-aware close time arrives; head and
+                # close time are recomputed on every wake so a newly
+                # arrived tighter request re-aims the batch
                 while True:
-                    avail = sum(r.rows for r in entry.queue
-                                if r.sig == head.sig)
                     now = time.perf_counter()
-                    if avail >= cfg.max_batch_size or now >= deadline \
+                    head = min(entry.queue, key=_sched_key)
+                    group = [r for r in entry.queue if r.sig == head.sig]
+                    avail = sum(r.rows for r in group)
+                    close_at = self._group_close_at(entry, group)
+                    if avail >= cfg.max_batch_size or now >= close_at \
                             or self._closed:
                         break
-                    entry.cv.wait(deadline - now)
-                batch, total = [], 0
-                rest = []
-                for r in entry.queue:
-                    if r.sig == head.sig and \
-                            total + r.rows <= cfg.max_batch_size:
+                    entry.cv.wait(close_at - now)
+                now = time.perf_counter()
+                group.sort(key=_sched_key)
+                batch, expired, overflow, total = [], [], [], 0
+                for r in group:
+                    if r.deadline is not None and now > r.deadline:
+                        expired.append(r)
+                    elif total + r.rows <= cfg.max_batch_size:
                         batch.append(r)
                         total += r.rows
                     else:
-                        rest.append(r)
-                entry.queue = rest
-                entry.rows_queued -= total
+                        overflow.append(r)
+                entry.queue = [r for r in entry.queue
+                               if r.sig != head.sig] + overflow
+                entry.rows_queued -= total + sum(r.rows for r in expired)
                 m["depth"].set(float(entry.rows_queued))
-            self._dispatch(entry, batch, total)
+            for r in expired:
+                m["shed"].inc()
+                r.future._reject(Overloaded(
+                    "model %r request deadline expired after %.1f ms in "
+                    "queue; shed without dispatch"
+                    % (entry.name, (now - r.t_submit) * 1000.0)))
+            if batch:
+                self._dispatch(entry, batch, total)
 
     def _dispatch(self, entry, batch, total):
         cfg, m = entry.config, entry.metrics
@@ -409,6 +497,12 @@ class Server:
         m["occupancy"].observe(total / float(padded))
         off = 0
         t1 = time.perf_counter()
+        # dispatch-wall EWMA feeds the deadline-aware batch close; a
+        # heavy weight on the newest sample tracks warm/cold transitions
+        # fast without whiplashing on one outlier
+        dt = t1 - t0
+        entry.service_est = dt if entry.service_est == 0.0 \
+            else 0.5 * entry.service_est + 0.5 * dt
         for r in batch:
             sliced = [o[off:off + r.rows] if np.ndim(o) >= 1
                       and np.shape(o)[0] == padded else o
@@ -419,8 +513,13 @@ class Server:
 
     # -- lifecycle ---------------------------------------------------------
     def close(self, timeout=5.0):
-        """Stop the workers; queued-but-undispatched requests are
-        rejected with RuntimeError."""
+        """Flush and stop. Already-queued requests are NOT abandoned:
+        each worker drains its queue through the normal dispatch path
+        before exiting, so in-flight futures resolve with real results.
+        Only requests the workers could not dispatch within ``timeout``
+        are rejected — with the typed ``Closed``, so clients can tell a
+        deliberate shutdown from a crash. Idempotent: a second close
+        returns immediately."""
         with self._lock:
             if self._closed:
                 return
@@ -438,7 +537,8 @@ class Server:
                 entry.rows_queued = 0
                 entry.metrics["depth"].set(0.0)
             for r in leftovers:
-                r.future._reject(RuntimeError("server closed"))
+                r.future._reject(Closed("server closed before this "
+                                        "request could be dispatched"))
 
     def __enter__(self):
         return self
@@ -490,7 +590,7 @@ class GenerativeServer:
                    int(max_new_tokens)))
         with self._cv:
             if self._closed:
-                raise RuntimeError("server is closed")
+                raise Closed("server is closed")
             if len(self._queue) >= self._max_queue_depth:
                 self._breaker.record_failure()
                 self._m["shed"].inc()
@@ -569,7 +669,8 @@ class GenerativeServer:
             leftovers, self._queue = self._queue, []
             self._m["depth"].set(0.0)
         for r in leftovers:
-            r.future._reject(RuntimeError("server closed"))
+            r.future._reject(Closed("server closed before this request "
+                                    "could be dispatched"))
 
     def __enter__(self):
         return self

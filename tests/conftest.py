@@ -64,6 +64,10 @@ def pytest_configure(config):
                    "(TCP coordination service, hierarchical DCN "
                    "data-parallelism, cross-host DGC/LocalSGD) — "
                    "spawns worker subprocesses")
+    config.addinivalue_line(
+        "markers", "fleet: exercises the serving fleet (SLO-aware "
+                   "router, coordinated replicas, warm respawn, "
+                   "deadline-aware batching)")
 
 
 @pytest.fixture(autouse=True)

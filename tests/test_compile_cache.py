@@ -202,6 +202,80 @@ def test_prelowered_model_cold_start(tmp_path, monkeypatch):
     assert len(_entries(pl_dir)) == 2
 
 
+def test_cold_serve_values_match(tmp_path):
+    """A COLD process serving through deserialized prelowered
+    executables must return the same values as the live program.
+
+    Regression: inference executables used to be serialized with state
+    donation baked in; the deserialized copies then ran in-place over
+    param buffers, so a cold Server returned stale or garbage rows
+    (the in-process path hides this — only a fresh process serves
+    through the deserialized executables with nothing else resolved).
+    """
+    model_dir = str(tmp_path / "model")
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 3
+    with unique_name.guard(), fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4], dtype="float32")
+        pred = layers.fc(x, 3, name="cs_fc", act="softmax")
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_inference_model(
+            model_dir, ["x"], [pred], exe, main_program=main,
+            prelower=True, prelower_batch_sizes=(1, 2))
+    # ground truth straight from the saved params — independent of any
+    # executable, live or deserialized
+    w = np.asarray(scope.vars["cs_fc.w_0"])
+    b = np.asarray(scope.vars["cs_fc.b_0"])
+    rng = np.random.RandomState(7)
+    feeds = [rng.rand(rng.randint(1, 3), 4).astype(np.float32)
+             for _ in range(8)]
+    np.savez(str(tmp_path / "feeds.npz"),
+             **{"f%d" % i: f for i, f in enumerate(feeds)})
+    script = """
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PADDLE_COMPILE_CACHE_DIR", None)
+sys.path.insert(0, sys.argv[2])
+import numpy as np
+from paddle_tpu import inference
+from paddle_tpu.fluid import monitor
+d = np.load(os.path.join(sys.argv[1], "feeds.npz"))
+feeds = [d["f%d" % i] for i in range(8)]
+p = inference.Predictor(os.path.join(sys.argv[1], "model"))
+srv = inference.Server()
+srv.register("m", p, inference.ServeConfig(max_batch_size=2,
+                                           max_queue_delay_ms=1.0),
+             warmup_feed={"x": np.zeros((1, 4), np.float32)})
+outs = [srv.submit("m", {"x": f}).result(timeout=60)[0] for f in feeds]
+srv.close()
+np.savez(os.path.join(sys.argv[1], "outs.npz"),
+         **{"o%d" % i: o for i, o in enumerate(outs)})
+print("MISS=%d" % monitor.counter(
+    "executor_compile_cache_disk_miss_total").value)
+"""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items()
+           if k != compile_cache.ENV_DIR}
+    r = subprocess.run(
+        [sys.executable, "-c", script, str(tmp_path), repo],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert r.returncode == 0, r.stderr
+    assert "MISS=0" in r.stdout, \
+        "cold serve compiled live instead of deserializing: %s" % r.stdout
+    got = np.load(str(tmp_path / "outs.npz"))
+    for i, f in enumerate(feeds):
+        z = f @ w + b
+        e = np.exp(z - z.max(axis=1, keepdims=True))
+        ref = e / e.sum(axis=1, keepdims=True)
+        np.testing.assert_allclose(
+            got["o%d" % i], ref, rtol=1e-4, atol=1e-5,
+            err_msg="cold-served request %d diverged from the saved "
+                    "params' forward pass" % i)
+
+
 def test_lru_eviction_by_mtime(tmp_path, monkeypatch):
     monkeypatch.setenv(compile_cache.ENV_DIR, str(tmp_path))
     _run_restart(_feed())

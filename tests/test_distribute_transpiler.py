@@ -280,7 +280,7 @@ def test_ps_frame_cap_and_magic():
     srv = M.TableServer(tables={"t": EmbeddingTable(8, 4, seed=0)}).start()
     try:
         # no magic: server closes without serving
-        s = socket.create_connection((srv.host, srv.port), timeout=5)
+        s = socket.create_connection((srv.host, srv.port), timeout=5)  # deliberately raw: garbage-bytes handshake-rejection test
         s.sendall(b"GARBAGE-" + b"x" * 20)
         s.settimeout(2)
         try:

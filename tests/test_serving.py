@@ -2,6 +2,7 @@
 control, multi-client coalescing) + continuous decode batching."""
 
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -184,6 +185,75 @@ def test_server_lifecycle_and_validation(tmp_path):
     with pytest.raises(RuntimeError, match="closed"):
         srv.submit("fc", {"x": np.zeros((1, 6), np.float32)})
     srv.close()  # idempotent
+
+
+def test_close_is_typed_flushes_and_idempotent(tmp_path):
+    """close() contract: queued futures FLUSH through the normal
+    dispatch path (never abandoned), post-close submit/register raise
+    the dedicated ``Closed`` (a RuntimeError subclass, NOT retryable),
+    and double-close is a no-op."""
+    from paddle_tpu.inference import Closed
+
+    _save_fc(tmp_path, seed=26)
+    pred = _predictor(tmp_path)
+    direct = _predictor(tmp_path)
+    rng = np.random.RandomState(8)
+    srv = Server()
+    srv.register("fc", pred,
+                 config=ServeConfig(max_batch_size=8,
+                                    max_queue_delay_ms=5000.0),
+                 warmup_feed={"x": rng.rand(1, 6).astype(np.float32)})
+    # park requests behind the huge delay, then close underneath them
+    xs = [rng.rand(1, 6).astype(np.float32) for _ in range(3)]
+    futs = [srv.submit("fc", {"x": x}) for x in xs]
+    srv.close()
+    for x, fut in zip(xs, futs):        # flushed, not abandoned
+        np.testing.assert_allclose(fut.result(timeout=10)[0],
+                                   direct.run({"x": x})[0], atol=1e-5)
+    with pytest.raises(Closed):
+        srv.submit("fc", {"x": xs[0]})
+    with pytest.raises(Closed):
+        srv.register("fc2", pred)
+    assert issubclass(Closed, RuntimeError)
+    assert not issubclass(Closed, Overloaded)
+    srv.close()                         # second close: no-op, no raise
+    srv.close()
+
+
+def test_deadline_aware_batch_close(tmp_path):
+    """SLO batcher: a tight-deadline request forces an EARLY partial
+    batch (well before max_queue_delay_ms) while deadline-less requests
+    still coalesce to full buckets — and neither path grows the
+    recompile counter past the warm-up ladder."""
+    _save_fc(tmp_path, seed=27)
+    pred = _predictor(tmp_path)
+    rng = np.random.RandomState(9)
+    row = lambda: {"x": rng.rand(1, 6).astype(np.float32)}
+    with Server() as srv:
+        srv.register("fc", pred,
+                     config=ServeConfig(max_batch_size=8,
+                                        max_queue_delay_ms=2000.0),
+                     warmup_feed=row())
+        before = monitor.counter("predictor_shape_recompile_total").value
+        # lazy requests would sit out the full 2 s delay; one request
+        # with a 100 ms deadline closes the batch for all of them
+        t0 = time.perf_counter()
+        lazy = [srv.submit("fc", row()) for _ in range(2)]
+        tight = srv.submit("fc", row(), deadline_ms=100.0)
+        for fut in lazy + [tight]:
+            fut.result(timeout=10)
+        assert time.perf_counter() - t0 < 1.0
+        # a full bucket still closes immediately without any deadline
+        t1 = time.perf_counter()
+        full = [srv.submit("fc", row()) for _ in range(8)]
+        for fut in full:
+            fut.result(timeout=10)
+        assert time.perf_counter() - t1 < 1.0
+        # an already-expired deadline is shed typed, before dispatch
+        with pytest.raises(Overloaded, match="deadline"):
+            srv.submit("fc", row(), deadline_ms=0.0)
+        assert monitor.counter(
+            "predictor_shape_recompile_total").value == before
 
 
 # -- continuous decode batching -------------------------------------------

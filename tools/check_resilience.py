@@ -65,10 +65,12 @@ _CACHE_ENTRY_SUFFIX = ".xc"
 # the single sanctioned home for raw socket construction:
 # distributed/wire.py owns listener setup (SO_REUSEADDR, close-on-
 # failure) and framed client connections (handshake, retry/backoff,
-# frame caps). A raw socket.socket elsewhere grows an unframed,
-# un-retried, token-less protocol the fault injector can't see.
+# frame caps). A raw socket.socket — or socket.create_connection, the
+# bypass the serving fleet would otherwise reach for — elsewhere grows
+# an unframed, un-retried, token-less protocol the fault injector
+# can't see.
 _SOCKET_EXEMPT = ("distributed/wire.py",)
-_SOCKET_CALLS = {("socket", "socket")}
+_SOCKET_CALLS = {("socket", "socket"), ("socket", "create_connection")}
 
 
 def _line_has_justification(line):
@@ -199,7 +201,8 @@ def main(argv=None):
         print("%d unjustified site(s): bare-except/BaseException, raw "
               "signal.signal, raw os._exit, raw pickle.load(s), a "
               ".xc cache entry opened outside fluid/compile_cache, or "
-              "a raw socket.socket outside distributed/wire — "
+              "a raw socket.socket/socket.create_connection outside "
+              "distributed/wire — "
               "add a trailing comment explaining why the site is safe, "
               "narrow the exception, or route the access through the "
               "sanctioned module" % len(violations))
