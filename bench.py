@@ -1314,6 +1314,23 @@ def monitor_summary():
             _sum_labeled("embedding_prefetch_miss_total"),
         "embedding_evictions_total":
             _sum_labeled("embedding_evictions_total"),
+        # telemetry plane state, so a BENCH_SERVE/BENCH_FLEET p50 in the
+        # JSON history is comparable against runs with tracing on/off
+        # (the acceptance bar: default-sampled tracing within noise)
+        "telemetry": _telemetry_summary(),
+    }
+
+
+def _telemetry_summary():
+    from paddle_tpu import telemetry
+
+    if not telemetry.enabled():
+        return {"enabled": False}
+    return {
+        "enabled": True,
+        "sample": float(os.environ.get(telemetry.ENV_SAMPLE, 1.0) or 1.0),
+        "spans_recorded": len(telemetry.snapshot()),
+        "spans_dropped": telemetry.dropped_span_count(),
     }
 
 

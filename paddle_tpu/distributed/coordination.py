@@ -50,6 +50,12 @@ _MAGIC = b"PTCO1"
 # opcodes
 (_PUT, _GET, _DEL, _ADD, _LIST, _BAR_ARRIVE, _BAR_WAIT, _LEASE, _LIVE,
  _PING, _STOP, _LIVE_MEMBERS) = range(1, 13)
+# telemetry envelope: opcode + u16 header len + JSON trace header +
+# the ORIGINAL request. A prefix wrapper rather than a trailing field
+# because _PUT consumes req[off:] as the value — appended trace bytes
+# would corrupt every stored blob. Old servers answer it with "unknown
+# opcode"; the client then falls back to unwrapped requests.
+_TRACED = 13
 
 # server-side waits are bounded by this slice; clients loop short waits
 # up to their own deadline (see module doc)
@@ -147,7 +153,7 @@ class CoordServer(_wire.FramedServer):
                 _wire.send_all(conn, _wire.frame(resp))
             except (ConnectionError, OSError):
                 return
-            if req and req[0] == _STOP:
+            if req and req[0] == _STOP:  # trace: shutdown sentinel, no downstream hop
                 self._stop.set()
                 return
 
@@ -160,6 +166,8 @@ class CoordServer(_wire.FramedServer):
                 return b"\x00"
             if op == _STOP:
                 return b"\x00"
+            if op == _TRACED:
+                return self._handle_traced(req)
             key, off = _unpack_str(req, 1)
             if op == _PUT:
                 return self._do_put(key, req[off:])
@@ -192,6 +200,30 @@ class CoordServer(_wire.FramedServer):
             return b"\x01" + ("decode error: %s" % e).encode()[:512]
         except Exception as e:  # surface to the client, keep serving
             return b"\x01" + repr(e).encode()[:512]
+
+    def _handle_traced(self, req):
+        """Unwrap a ``_TRACED`` envelope: activate the carried trace
+        context, record one server-side span, serve the inner request
+        through the normal dispatch. A server with telemetry off (or a
+        garbled header) still serves the inner request — the envelope
+        is observability, never a semantic gate."""
+        from .. import telemetry as _telemetry
+
+        try:
+            (hlen,) = struct.unpack_from("<H", req, 1)
+            hdr = json.loads(req[3:3 + hlen].decode())
+            inner = req[3 + hlen:]
+        except (struct.error, ValueError, UnicodeDecodeError) as e:
+            raise _wire.DecodeError("malformed trace envelope: %r" % e)
+        if not inner:
+            raise _wire.DecodeError("trace envelope with empty request")
+        ctx = _telemetry.decode_header(hdr) \
+            if _telemetry.enabled() else None
+        if ctx is None:
+            return self._handle(inner)
+        with _telemetry.span("coord.rpc", parent=ctx, service="coord",
+                             attrs={"op": inner[0]}):
+            return self._handle(inner)
 
     # -- KV -----------------------------------------------------------------
     def _do_put(self, key, value):
@@ -317,16 +349,40 @@ class CoordClient:
         self._conn = _CoordConn(endpoint, token=token)
         self._lease_thread = None
         self._lease_stop = threading.Event()
+        self._trace_ok = None     # False after an old server rejects _TRACED
 
     @property
     def endpoint(self):
         return self._conn.endpoint
 
+    def _request(self, payload):
+        """Every RPC routes here: with telemetry on and a sampled trace
+        active, the request ships inside the ``_TRACED`` envelope so the
+        server's span lands in the caller's trace. An old server that
+        rejects the envelope ("unknown opcode" — the inner op was NOT
+        executed) downgrades this client to unwrapped requests."""
+        from .. import telemetry as _telemetry
+
+        if self._trace_ok is not False and _telemetry.enabled():
+            ctx = _telemetry.current()
+            if ctx is not None and ctx.sampled:
+                hdr = json.dumps(_telemetry.encode_header(ctx),
+                                 separators=(",", ":")).encode()
+                try:
+                    return self._conn.request(
+                        struct.pack("<BH", _TRACED, len(hdr)) + hdr
+                        + payload)
+                except RuntimeError as e:
+                    if "unknown opcode" not in str(e):
+                        raise
+                    self._trace_ok = False
+        return self._conn.request(payload)
+
     # -- KV -----------------------------------------------------------------
     def put(self, key, value):
         if isinstance(value, str):
             value = value.encode()
-        self._conn.request(
+        self._request(
             struct.pack("<B", _PUT) + _pack_str(key) + bytes(value))
 
     def get(self, key, wait=False, timeout=60.0):
@@ -335,7 +391,7 @@ class CoordClient:
         deadline = time.monotonic() + (timeout if wait else 0.0)
         while True:
             left = max(deadline - time.monotonic(), 0.0)
-            resp = self._conn.request(
+            resp = self._request(
                 struct.pack("<B", _GET) + _pack_str(key) +
                 struct.pack("<d", min(left, _WAIT_SLICE)))
             if resp[:1] == b"\x01":
@@ -346,18 +402,18 @@ class CoordClient:
     def delete(self, key):
         """True when the key existed — the atomic claim primitive
         (exactly one of N concurrent deleters sees True)."""
-        resp = self._conn.request(struct.pack("<B", _DEL) + _pack_str(key))
+        resp = self._request(struct.pack("<B", _DEL) + _pack_str(key))
         return resp[:1] == b"\x01"
 
     def add(self, key, delta=1):
         """Atomic fetch-add; returns the post-add value."""
-        resp = self._conn.request(
+        resp = self._request(
             struct.pack("<B", _ADD) + _pack_str(key) +
             struct.pack("<q", int(delta)))
         return struct.unpack("<q", resp)[0]
 
     def keys(self, prefix=""):
-        resp = self._conn.request(struct.pack("<B", _LIST) +
+        resp = self._request(struct.pack("<B", _LIST) +
                                   _pack_str(prefix))
         return json.loads(resp.decode())
 
@@ -367,7 +423,7 @@ class CoordClient:
         ``name``. Arrival is idempotent per client id, so transport
         retries cannot double-count. Returns the released generation;
         raises TimeoutError past ``timeout``."""
-        resp = self._conn.request(
+        resp = self._request(
             struct.pack("<B", _BAR_ARRIVE) + _pack_str(name) +
             _pack_str(client_id) + struct.pack("<q", int(world)))
         (entry_gen,) = struct.unpack("<q", resp)
@@ -378,7 +434,7 @@ class CoordClient:
                 raise TimeoutError(
                     "barrier %r (world %d) not released within %.1fs"
                     % (name, world, timeout))
-            resp = self._conn.request(
+            resp = self._request(
                 struct.pack("<B", _BAR_WAIT) + _pack_str(name) +
                 struct.pack("<qd", entry_gen, min(left, _WAIT_SLICE)))
             released, gen = resp[0], struct.unpack_from("<q", resp, 1)[0]
@@ -402,11 +458,11 @@ class CoordClient:
 
     # -- liveness -----------------------------------------------------------
     def lease(self, client_id, ttl=10.0):
-        self._conn.request(struct.pack("<B", _LEASE) +
+        self._request(struct.pack("<B", _LEASE) +
                            _pack_str(client_id) + struct.pack("<d", ttl))
 
     def live(self):
-        resp = self._conn.request(struct.pack("<B", _LIVE) +
+        resp = self._request(struct.pack("<B", _LIVE) +
                                   _pack_str(""))
         return json.loads(resp.decode())
 
@@ -416,7 +472,7 @@ class CoordClient:
         registration blob in one pass). Membership registration is
         ``put(key, blob)`` + ``lease(key, ttl)`` with the SAME string as
         key and lease id; this is the read side the fleet router polls."""
-        resp = self._conn.request(struct.pack("<B", _LIVE_MEMBERS) +
+        resp = self._request(struct.pack("<B", _LIVE_MEMBERS) +
                                   _pack_str(prefix))
         return json.loads(resp.decode())
 
@@ -439,9 +495,10 @@ class CoordClient:
         return self
 
     def ping(self):
-        self._conn.request(struct.pack("<B", _PING))
+        self._request(struct.pack("<B", _PING))
 
     def stop_server(self):
+        # trace: STOP stays unwrapped — _serve_authenticated matches req[0] == _STOP
         self._conn.request(struct.pack("<B", _STOP))
 
     def close(self):

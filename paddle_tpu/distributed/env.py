@@ -127,6 +127,10 @@ def init_parallel_env(ndev_per_proc=None):
         return rank, world
     if _initialized:
         return rank, world
+    # arm the flight recorder before any collective can wedge this
+    # worker; no-op unless the launcher exported $PADDLE_FLIGHT_DIR
+    from ..telemetry import flight as _flight
+    _flight.start(rank=rank)
     import jax
 
     if os.environ.get("PADDLE_DIST_BACKEND", "").lower() == "cpu":

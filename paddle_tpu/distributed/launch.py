@@ -168,6 +168,28 @@ def _kill_gang(procs):
             p.wait()  # reap: the caller needs real exit codes
 
 
+def _flight_postmortem(flight_dir):
+    """One stderr line per rank whose flight ring survived the gang
+    death: the dump reason and the newest span — enough to see which
+    rank was wedged where without opening the JSON."""
+    from ..telemetry import flight as _flight
+
+    images = _flight.collect(flight_dir)
+    if not images:
+        return
+    sys.stderr.write("launch: flight-recorder postmortem (%s):\n"
+                     % flight_dir)
+    for rank in sorted(images):
+        image = images[rank]
+        spans = image.get("spans") or []
+        last = spans[-1].get("name") if spans else "-"
+        sys.stderr.write(
+            "launch:   rank %s pid %s reason=%s last_span=%s "
+            "wire_ops=%d\n"
+            % (rank, image.get("pid"), image.get("reason"), last,
+               len(image.get("wire_ops") or ())))
+
+
 def launch(nproc, cmd, node_ip="127.0.0.1", started_port=None, env=None,
            backend=None, log_dir=None, max_restarts=0,
            heartbeat_timeout=None, step_deadline=None,
@@ -176,7 +198,7 @@ def launch(nproc, cmd, node_ip="127.0.0.1", started_port=None, env=None,
            max_restarts_at_size=None, min_world_size=None,
            rendezvous_dir=None, max_preempt_restarts=8,
            preempt_drain=True, compile_cache_dir=None,
-           rendezvous_backend=None):
+           rendezvous_backend=None, flight_dir=None):
     """Spawn ``nproc`` copies of ``cmd`` (argv list) with the trainer env;
     returns the list of exit codes of the final attempt.
 
@@ -284,6 +306,12 @@ def launch(nproc, cmd, node_ip="127.0.0.1", started_port=None, env=None,
     if compile_cache_dir:
         base_env[_compile_cache.ENV_DIR] = compile_cache_dir
     base_env[_preemption.ENV_DRAIN] = "1" if preempt_drain else "0"
+    # flight recorder: every worker rank flushes its ring under this
+    # dir; after a gang death the launcher prints what each survivor's
+    # last image says it was doing (telemetry/flight.py)
+    flight_dir = flight_dir or base_env.get("PADDLE_FLIGHT_DIR")
+    if flight_dir:
+        base_env["PADDLE_FLIGHT_DIR"] = flight_dir
 
     backoff = _resilience.RestartBackoff(
         base=restart_backoff, max_delay=30.0, jitter=0.25,
@@ -449,6 +477,8 @@ def launch(nproc, cmd, node_ip="127.0.0.1", started_port=None, env=None,
                     continue
 
                 _M_FAILED.inc()
+                if flight_dir:
+                    _flight_postmortem(flight_dir)
                 if started_port is None and port_retry < port_retries \
                         and _bind_failure(log_dir, world):
                     port_retry += 1
@@ -556,6 +586,11 @@ def main(argv=None):
                              "filesystem; 'file' keeps the shared-"
                              "directory rendezvous (also "
                              "$PADDLE_COORD_BACKEND)")
+    parser.add_argument("--flight_dir", default=None,
+                        help="export PADDLE_FLIGHT_DIR so every worker "
+                             "keeps a crash flight ring there; the "
+                             "launcher prints a postmortem after a "
+                             "gang death")
     parser.add_argument("--no_preempt_drain", action="store_true",
                         help="do not export PADDLE_PREEMPT_DRAIN=1 "
                              "(workers die on SIGTERM instead of "
@@ -577,7 +612,8 @@ def main(argv=None):
                    min_world_size=args.min_world_size,
                    rendezvous_dir=args.rendezvous_dir,
                    preempt_drain=not args.no_preempt_drain,
-                   rendezvous_backend=args.rendezvous_backend)
+                   rendezvous_backend=args.rendezvous_backend,
+                   flight_dir=args.flight_dir)
     bad = [(i, c) for i, c in enumerate(codes) if c != 0]
     if bad:
         sys.exit("workers failed: %r" % bad)

@@ -14,9 +14,14 @@ heartbeat's ``.exit`` marker, and the process exits 0. The launcher
 reads the marker to tell a clean preempt from a crash and respawns
 WITHOUT burning restart budget.
 
-``install()`` also registers ``faulthandler`` on SIGUSR1, the signal
-the launcher's hung-step watchdog sends so a wedged worker dumps every
-Python thread's stack into its log before the gang is reformed.
+``install()`` also wires SIGUSR1, the signal the launcher's hung-step
+watchdog sends: ``faulthandler`` (C-level, works even when the
+interpreter is wedged in native code) dumps every thread's stack into
+the worker log, then chains into a Python handler that runs
+``on_stack_signal`` callbacks — the telemetry flight recorder hooks
+this to dump its ring on the same signal. The Python half only runs
+when bytecode can still execute; a fully wedged worker is covered by
+the flight recorder's periodic flush instead.
 
 This module is the ONE sanctioned home for raw ``signal.signal`` calls
 (``tools/check_resilience.py`` lints every other site): scattering
@@ -38,7 +43,8 @@ from ..fluid import monitor as _monitor
 __all__ = [
     "ENV_DRAIN", "install", "uninstall", "installed", "draining",
     "drain_reason", "request_drain", "check_drain", "drain_exit",
-    "on_drain", "maybe_install_from_env", "preempt_marker_path",
+    "on_drain", "on_stack_signal", "maybe_install_from_env",
+    "preempt_marker_path",
     "write_preempt_marker", "reset", "LauncherForward",
 ]
 
@@ -61,6 +67,8 @@ _INSTALLED = False
 _ENV_CHECKED = False
 _PREV = {}
 _STACK_SIGNAL = None
+_STACK_PREV = None
+_STACK_CALLBACKS = []
 _REASON = None
 _SINCE = None
 
@@ -113,12 +121,31 @@ def on_drain(fn):
     return fn
 
 
+def on_stack_signal(fn):
+    """Register ``fn`` to run when the watchdog's stack-dump signal
+    (SIGUSR1) lands — AFTER faulthandler has written the C-level stack
+    dump. Same frame rules as ``on_drain``: callbacks run on the
+    signal-handler frame and must tolerate that (the flight recorder's
+    dump is file-write-only). Returns ``fn``."""
+    with _LOCK:
+        _STACK_CALLBACKS.append(fn)
+    return fn
+
+
 def _handler(signum, frame):
     try:
         name = signal.Signals(signum).name
     except ValueError:
         name = str(signum)
     request_drain("signal:%s" % name)
+
+
+def _stack_handler(signum, frame):
+    for fn in list(_STACK_CALLBACKS):
+        try:
+            fn()
+        except Exception:  # postmortem hooks must not kill the worker
+            log.exception("on_stack_signal callback failed")
 
 
 def install(signals=DEFAULT_SIGNALS, stack_dump_signal=signal.SIGUSR1):
@@ -131,7 +158,7 @@ def install(signals=DEFAULT_SIGNALS, stack_dump_signal=signal.SIGUSR1):
     ``faulthandler.register`` so the launcher's hung-step watchdog can
     make this process dump all thread stacks to stderr — which
     ``distributed.launch`` redirects into the worker log."""
-    global _INSTALLED, _STACK_SIGNAL
+    global _INSTALLED, _STACK_SIGNAL, _STACK_PREV
     with _LOCK:
         if _INSTALLED:
             return True
@@ -142,8 +169,13 @@ def install(signals=DEFAULT_SIGNALS, stack_dump_signal=signal.SIGUSR1):
         for s in signals:
             _PREV[s] = signal.signal(s, _handler)
         if stack_dump_signal is not None:
+            # Python handler FIRST, then faulthandler with chain=True:
+            # the C-level stack dump always works (even wedged in native
+            # code) and chains into _stack_handler — the flight-recorder
+            # hook — whenever the interpreter can still run bytecode.
+            _STACK_PREV = signal.signal(stack_dump_signal, _stack_handler)
             faulthandler.register(stack_dump_signal, file=sys.stderr,
-                                  all_threads=True)
+                                  all_threads=True, chain=True)
             _STACK_SIGNAL = stack_dump_signal
         _INSTALLED = True
         return True
@@ -151,7 +183,7 @@ def install(signals=DEFAULT_SIGNALS, stack_dump_signal=signal.SIGUSR1):
 
 def uninstall():
     """Restore the previous signal handlers (test teardown)."""
-    global _INSTALLED, _STACK_SIGNAL
+    global _INSTALLED, _STACK_SIGNAL, _STACK_PREV
     with _LOCK:
         if not _INSTALLED:
             return
@@ -160,6 +192,9 @@ def uninstall():
         _PREV.clear()
         if _STACK_SIGNAL is not None:
             faulthandler.unregister(_STACK_SIGNAL)
+            if _STACK_PREV is not None:
+                signal.signal(_STACK_SIGNAL, _STACK_PREV)
+            _STACK_PREV = None
             _STACK_SIGNAL = None
         _INSTALLED = False
 
@@ -176,6 +211,7 @@ def reset():
     uninstall()
     _DRAIN.clear()
     del _CALLBACKS[:]
+    del _STACK_CALLBACKS[:]
     _REASON = None
     _SINCE = None
     _ENV_CHECKED = False

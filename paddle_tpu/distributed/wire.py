@@ -26,7 +26,8 @@ from ..fluid import resilience as _resilience
 
 __all__ = ["DecodeError", "FrameTooLarge", "send_all", "recv_exact",
            "frame", "read_frame", "create_listener", "connect",
-           "free_port", "reserve_port_range", "FramedServer", "Conn"]
+           "free_port", "reserve_port_range", "FramedServer", "Conn",
+           "set_wire_observer"]
 
 # default frame cap; servers/clients for a specific tier may pass their
 # own (the PS tier keeps PADDLE_PS_MAX_FRAME_BYTES)
@@ -50,7 +51,26 @@ class FrameTooLarge(ConnectionError):
     connection cannot be resynchronized and must be dropped."""
 
 
+# optional frame observer (the telemetry flight recorder's wire-op
+# ring). None on the hot path costs one global load; the hook sees
+# (direction, first-payload-byte, frame-size) only — never payloads.
+_OBSERVER = None
+
+
+def set_wire_observer(fn):
+    """Install ``fn(direction, op_byte, nbytes)`` (or None to remove);
+    returns the previous observer. Must never raise — it runs inside
+    every framed send/recv."""
+    global _OBSERVER
+    prev = _OBSERVER
+    _OBSERVER = fn
+    return prev
+
+
 def send_all(sock, data):
+    if _OBSERVER is not None and len(data) >= 5:
+        # framed payload: 4-byte length prefix then the opcode byte
+        _OBSERVER("send", data[4], len(data) - 4)
     sock.sendall(data)
 
 
@@ -74,7 +94,10 @@ def read_frame(sock, max_bytes=None):
         raise FrameTooLarge(
             "frame of %d bytes exceeds the %d-byte cap"
             % (n, max_bytes or _MAX_FRAME))
-    return recv_exact(sock, n)
+    payload = recv_exact(sock, n)
+    if _OBSERVER is not None and payload:
+        _OBSERVER("recv", payload[0], n)
+    return payload
 
 
 # -- port/listener helpers ---------------------------------------------------

@@ -864,9 +864,26 @@ class Executor:
         state = {n: scope.find_var(n) for n in state_names}
         from . import profiler as _prof
 
+        from .. import telemetry as _telemetry
+
         profiling = _prof.is_profiler_enabled()
         t0 = _prof.now() if profiling else None
-        fetches, new_state, new_rng = step.fn(state, feed, rng)
+        try:
+            if _telemetry.enabled() and _telemetry.current() is not None:
+                # traced request (serving batch ctx is ambient): the
+                # device-dispatch interval joins the request's trace
+                with _telemetry.span("executor.run",
+                                     attrs={"program": program._uid,
+                                            "cache_hit": cache_hit}):
+                    fetches, new_state, new_rng = step.fn(state, feed,
+                                                          rng)
+            else:
+                fetches, new_state, new_rng = step.fn(state, feed, rng)
+        except Exception:
+            # flight-recorder trigger: capture the ring (open spans show
+            # the in-flight request) before the failure unwinds
+            _telemetry.flight.dump(reason="executor_exception")
+            raise
         if profiling:
             jax.block_until_ready(fetches)
             # the #p<uid> suffix keeps distinct programs with the same
@@ -1235,10 +1252,23 @@ class Executor:
         state = {n: scope.find_var(n) for n in state_names}
         from . import profiler as _prof
 
+        from .. import telemetry as _telemetry
+
         profiling = _prof.is_profiler_enabled()
         t0 = _prof.now() if profiling else None
-        fetches, new_state, new_rng = step.fn(state, stacked, invariant,
-                                              rng)
+        try:
+            if _telemetry.enabled() and _telemetry.current() is not None:
+                with _telemetry.span("executor.run_batched",
+                                     attrs={"program": program._uid,
+                                            "iters": iters}):
+                    fetches, new_state, new_rng = step.fn(
+                        state, stacked, invariant, rng)
+            else:
+                fetches, new_state, new_rng = step.fn(state, stacked,
+                                                      invariant, rng)
+        except Exception:
+            _telemetry.flight.dump(reason="executor_exception")
+            raise
         if profiling:
             jax.block_until_ready(fetches)
             _prof._record("executor_batched_run[%s#p%d;k=%d]" % (

@@ -30,7 +30,8 @@ from collections import OrderedDict
 
 __all__ = ["Counter", "Gauge", "Histogram", "counter", "gauge",
            "histogram", "get_metric", "sum_labeled", "all_metrics",
-           "reset", "dump_json", "dump_prometheus", "default_buckets"]
+           "reset", "dump_json", "dump_prometheus", "snapshot",
+           "default_buckets"]
 
 ENV_DUMP = "PADDLE_MONITOR_DUMP"
 
@@ -218,6 +219,14 @@ class Histogram(_Metric):
             prev = le
         return mx  # overflow bucket: the best bounded answer available
 
+    def bucket_counts(self):
+        """Raw per-bucket counts (NOT cumulative), one per bound plus
+        the trailing +Inf overflow slot — the mergeable form: two
+        processes' vectors add element-wise and the merged ``quantile``
+        is exact over the shared bounds (telemetry/aggregate.py)."""
+        with self._lock:
+            return list(self._counts)
+
     def cumulative_buckets(self):
         """[(upper_bound, cumulative_count), ...] ending with +Inf —
         the Prometheus histogram series shape."""
@@ -341,6 +350,32 @@ def dump_json():
     return out
 
 
+def snapshot(proc=None):
+    """Raw mergeable snapshot of the whole registry — the blob each
+    fleet process pushes to the coordination KV for cross-process
+    aggregation (``telemetry/aggregate.merge``). Histograms ship their
+    bucket BOUNDS and raw per-bucket counts so the merge can verify the
+    grids match and add them element-wise; gauges ride with the
+    snapshot timestamp so the merge can apply last-write-wins."""
+    import time
+
+    mets = []
+    for m in all_metrics():
+        rec = {"name": m.name, "kind": m.kind,
+               "labels": dict(m.labels),
+               "help": _KINDS.get(m.name, (m.kind, ""))[1]}
+        if isinstance(m, Histogram):
+            with m._lock:
+                rec.update(bounds=list(m.buckets),
+                           counts=list(m._counts), sum=m._sum,
+                           count=m._count, min=m._min, max=m._max)
+        else:
+            rec["value"] = m.value
+        mets.append(rec)
+    return {"proc": proc, "pid": os.getpid(), "ts": time.time(),
+            "metrics": mets}
+
+
 _NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
 
 
@@ -372,18 +407,24 @@ def _prom_num(v):
     return repr(float(v)) if isinstance(v, float) else str(v)
 
 
-def dump_prometheus(dst=None):
+def dump_prometheus(dst=None, metrics=None, kinds=None):
     """Render every metric in Prometheus text exposition format 0.0.4
     and return the text. ``dst``: None, a path string, or a writable
     stream. Series are grouped per name under one HELP/TYPE header,
-    sorted for deterministic output (golden-testable)."""
+    sorted for deterministic output (golden-testable).
+
+    ``metrics``/``kinds`` render an EXPLICIT metric list instead of the
+    process registry — the fleet-merged view (telemetry/aggregate.py)
+    reuses this renderer so the aggregated dump cannot drift from the
+    per-process format."""
     by_name = OrderedDict()
-    for m in all_metrics():
+    for m in (all_metrics() if metrics is None else metrics):
         by_name.setdefault(m.name, []).append(m)
+    kind_map = _KINDS if kinds is None else kinds
     lines = []
     for name in sorted(by_name):
         pname = _prom_name(name)
-        kind, help = _KINDS.get(name, (by_name[name][0].kind, ""))
+        kind, help = kind_map.get(name, (by_name[name][0].kind, ""))
         if help:
             lines.append("# HELP %s %s"
                          % (pname, help.replace("\\", "\\\\")

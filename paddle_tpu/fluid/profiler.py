@@ -12,7 +12,7 @@ Event / Calls / Total / Min / Max / Ave / Ratio.
 
 import contextlib
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 
 from . import monitor as _monitor
 
@@ -23,16 +23,21 @@ __all__ = ["profiler", "start_profiler", "stop_profiler", "reset_profiler",
 _enabled = False
 _events = OrderedDict()  # name -> [calls, total, min, max]
 _trace_dir = None
-_spans = []              # (name, t_end, dur) — for the chrome timeline
 _MAX_SPANS = 200_000
-_dropped = [0]           # spans lost past _MAX_SPANS (satellite #1)
+# (name, t_end, dur) ring for the chrome timeline. A RING, not a
+# capped list: on overflow the OLDEST span is evicted, so the buffer
+# always holds the last seconds of the run — the flight recorder's
+# postmortem window — instead of the first seconds of warm-up.
+_spans = deque(maxlen=_MAX_SPANS)
+_dropped = [0]           # spans evicted past _MAX_SPANS
 # perf_counter has an arbitrary epoch; anchor it to unix time once so
 # host spans land on the same clock as device XPlane timestamps
 _EPOCH_ANCHOR = (time.perf_counter(), time.time())
 
 _M_DROPPED = _monitor.counter(
     "profiler_dropped_spans_total",
-    help="host spans not recorded because the span buffer was full")
+    help="host spans evicted from the full span ring (oldest-out; the "
+         "ring keeps the newest _MAX_SPANS)")
 # one monitor histogram series per event name, cached so the per-record
 # cost is a dict hit rather than a registry lookup
 _mon_hists = {}
@@ -54,7 +59,8 @@ def now():
 
 
 def dropped_span_count():
-    """Spans lost since the last reset_profiler() (buffer overflow)."""
+    """Spans evicted since the last reset_profiler() (ring overflow —
+    the evicted spans are the OLDEST; the ring keeps the newest)."""
     return _dropped[0]
 
 
@@ -70,11 +76,10 @@ def _record(name, seconds):
         e[2] = min(e[2], seconds)
         e[3] = max(e[3], seconds)
     _mon_hist(name).observe(seconds)
-    if len(_spans) < _MAX_SPANS:
-        _spans.append((name, time.perf_counter(), seconds))
-    else:
+    if len(_spans) == _spans.maxlen:   # appending evicts the oldest
         _dropped[0] += 1
         _M_DROPPED.inc()
+    _spans.append((name, time.perf_counter(), seconds))
 
 
 class RecordEvent:
@@ -200,8 +205,14 @@ def export_chrome_tracing(path, trace_dir=None):
 
 
 def reset_profiler():
+    global _spans
     _events.clear()
-    del _spans[:]
+    if _spans.maxlen != _MAX_SPANS:
+        # _MAX_SPANS was adjusted after import (tests shrink it); the
+        # ring's maxlen is fixed at construction, so rebuild
+        _spans = deque(maxlen=_MAX_SPANS)
+    else:
+        _spans.clear()
     _dropped[0] = 0
 
 

@@ -17,6 +17,7 @@ import time as _time
 import numpy as np
 
 from .. import fluid
+from .. import telemetry as _telemetry
 from ..fluid import monitor as _monitor
 
 __all__ = ["Config", "Predictor", "create_predictor", "PredictorPool",
@@ -170,9 +171,18 @@ class Predictor:
         # scope passed explicitly: scope_guard mutates a process-global
         # stack, so two serving threads running predictors concurrently
         # could resolve each other's scopes through it
-        outs = self._exe.run(self._program, feed=feed,
-                             fetch_list=self._fetch_vars,
-                             scope=self._scope)
+        if _telemetry.enabled() and _telemetry.current() is not None:
+            with _telemetry.span("predictor.run",
+                                 attrs={"rows": int(np.shape(
+                                     next(iter(feed.values())))[0])
+                                     if feed else 0}):
+                outs = self._exe.run(self._program, feed=feed,
+                                     fetch_list=self._fetch_vars,
+                                     scope=self._scope)
+        else:
+            outs = self._exe.run(self._program, feed=feed,
+                                 fetch_list=self._fetch_vars,
+                                 scope=self._scope)
         _M_LATENCY.observe(_time.perf_counter() - t0)
         _M_RUNS.inc()
         self._outputs = outs

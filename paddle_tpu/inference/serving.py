@@ -58,6 +58,7 @@ import numpy as np
 
 from ..fluid import monitor as _monitor
 from ..fluid.resilience import CircuitBreaker, Closed, Overloaded
+from .. import telemetry as _telemetry
 
 __all__ = ["Future", "ServeConfig", "Server", "GenerativeServer",
            "Overloaded", "Closed"]
@@ -231,10 +232,10 @@ def _bucket_pad(arr, dims, pad_value):
 
 class _Request:
     __slots__ = ("feed", "rows", "sig", "future", "t_submit", "extra",
-                 "deadline", "priority")
+                 "deadline", "priority", "trace")
 
     def __init__(self, feed, rows, sig, extra=None, deadline_ms=None,
-                 priority=0):
+                 priority=0, trace=None):
         self.feed = feed
         self.rows = rows
         self.sig = sig
@@ -244,6 +245,10 @@ class _Request:
         self.deadline = None if deadline_ms is None \
             else self.t_submit + float(deadline_ms) / 1000.0
         self.priority = int(priority)
+        # TraceContext captured on the SUBMITTING thread: contextvars
+        # don't cross into the batcher worker, so the request carries
+        # its trace explicitly and the dispatch re-activates it
+        self.trace = trace
 
 
 def _sched_key(r):
@@ -290,10 +295,13 @@ class Server:
     ``max_batch_size``.
     """
 
-    def __init__(self):
+    def __init__(self, service=None):
         self._models = {}
         self._closed = False
         self._lock = threading.Lock()
+        # telemetry lane name for batcher-side spans (a Replica passes
+        # "replica:<id>"; in-process embedders default to the ambient)
+        self.service = service
 
     # -- registration ------------------------------------------------------
     def register(self, name, predictor, config=None, warmup_feed=None):
@@ -381,7 +389,9 @@ class Server:
         sig = tuple(sorted((n, str(v.dtype), v.shape[1:])
                            for n, v in feed.items()))
         req = _Request(feed, rows, sig, deadline_ms=deadline_ms,
-                       priority=priority)
+                       priority=priority,
+                       trace=_telemetry.current()
+                       if _telemetry.enabled() else None)
         with entry.cv:
             if self._closed:
                 raise Closed("server is closed")
@@ -471,11 +481,38 @@ class Server:
     def _dispatch(self, entry, batch, total):
         cfg, m = entry.config, entry.metrics
         t0 = time.perf_counter()
+        traced = [r for r in batch if r.trace is not None] \
+            if _telemetry.enabled() else []
         for r in batch:
             m["wait"].observe(t0 - r.t_submit)
+        for r in traced:
+            # the queue-wait interval the batcher just measured, as a
+            # fresh CHILD span in the request's own trace (the request
+            # span keeps its identity for the batch span's links)
+            _telemetry.record_span(
+                "serving.queue_wait", r.t_submit, t0 - r.t_submit,
+                _telemetry.child_of(r.trace), service=self.service,
+                attrs={"model": entry.name})
         padded = _pow2ceil(total)
         if padded > cfg.max_batch_size:
             padded = cfg.max_batch_size
+        if traced:
+            # ONE batch span for the fan-in: parented into the first
+            # rider's trace, LINKED to every request span that rode in
+            # it, ambient so the executor span nests under it
+            with _telemetry.span(
+                    "serving.batch", parent=traced[0].trace,
+                    service=self.service,
+                    links=[r.trace for r in traced],
+                    attrs={"model": entry.name,
+                           "requests": len(batch), "rows": total,
+                           "padded": padded}):
+                self._run_batch(entry, batch, total, padded, t0)
+        else:
+            self._run_batch(entry, batch, total, padded, t0)
+
+    def _run_batch(self, entry, batch, total, padded, t0):
+        m = entry.metrics
         try:
             feed = {}
             for n in batch[0].feed:

@@ -8,6 +8,7 @@ draining-everything fleet raises ``Closed``.
 """
 
 from ..distributed import wire as _wire
+from .. import telemetry as _telemetry
 from . import protocol as _p
 
 __all__ = ["FleetClient"]
@@ -35,11 +36,30 @@ class FleetClient:
         """Route one request through the fleet. ``deadline_ms`` is the
         end-to-end SLO budget (the router sheds typed-``Overloaded``
         when it cannot be met; replicas batch deadline-aware inside
-        it); ``priority`` orders head-of-line dispatch on the replica."""
-        resp = self._conn.request(_p.pack_request(
-            _p.OP_SUBMIT, model, feed, deadline_ms=deadline_ms,
-            priority=priority))
-        return _p.raise_for_status(resp)
+        it); ``priority`` orders head-of-line dispatch on the replica.
+
+        With telemetry enabled this mints (or continues) the trace: the
+        ``client.submit`` span is the trace root of the whole
+        client -> router -> replica -> executor path, and its header
+        rides the request meta. Off, the frame is byte-identical to the
+        pre-telemetry format."""
+        if not _telemetry.enabled():
+            resp = self._conn.request(_p.pack_request(
+                _p.OP_SUBMIT, model, feed, deadline_ms=deadline_ms,
+                priority=priority))
+            return _p.raise_for_status(resp)
+        parent = _telemetry.current()
+        if parent is None:
+            # minting a root: the ONLY place the sampling rate applies
+            parent = _telemetry.new_trace(sampled=_telemetry.sample())
+        with _telemetry.span("client.submit", parent=parent,
+                             service="client",
+                             attrs={"model": model}) as sp:
+            resp = self._conn.request(_p.pack_request(
+                _p.OP_SUBMIT, model, feed, deadline_ms=deadline_ms,
+                priority=priority,
+                trace=_telemetry.encode_header(sp.ctx)))
+            return _p.raise_for_status(resp)
 
     def ping(self):
         self._conn.request(bytes([_p.OP_PING]))

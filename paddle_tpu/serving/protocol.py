@@ -95,11 +95,21 @@ def unpack_arrays(buf, off=0):
         raise _wire.DecodeError("malformed array payload: %r" % e)
 
 
-def pack_request(op, model, feed, deadline_ms=None, priority=None):
+def pack_request(op, model, feed, deadline_ms=None, priority=None,
+                 trace=None):
     """One inference request frame (client->router or router->replica):
-    opcode byte + JSON SLO header + the feed arrays."""
-    meta = _dumps({"model": model, "deadline_ms": deadline_ms,
-                   "priority": priority})
+    opcode byte + JSON SLO header + the feed arrays.
+
+    ``trace`` is an OPTIONAL telemetry header (the compact dict from
+    ``telemetry.encode_header``). It rides as one extra meta key, so a
+    frame without it is byte-identical to the pre-telemetry format, an
+    old peer's ``meta.get`` simply never sees it, and a telemetry-off
+    sender adds zero wire bytes."""
+    fields = {"model": model, "deadline_ms": deadline_ms,
+              "priority": priority}
+    if trace is not None:
+        fields["trace"] = trace
+    meta = _dumps(fields)
     names = sorted(feed)
     return (struct.pack("<BI", op, len(meta)) + meta
             + pack_arrays([np.asarray(feed[n]) for n in names],
@@ -108,7 +118,9 @@ def pack_request(op, model, feed, deadline_ms=None, priority=None):
 
 def unpack_request(req):
     """Inverse of ``pack_request`` (minus the opcode byte, which the
-    server dispatches on) -> (model, deadline_ms, priority, feed)."""
+    server dispatches on) -> (model, deadline_ms, priority, feed,
+    trace). ``trace`` is the raw header dict or None — old-format
+    frames (no trace key) decode exactly as before."""
     try:
         (mlen,) = struct.unpack_from("<I", req, 1)
         meta = json.loads(req[5:5 + mlen].decode())
@@ -120,7 +132,8 @@ def unpack_request(req):
         if name is None:
             raise _wire.DecodeError("request array missing feed name")
         feed[name] = arr
-    return model, meta.get("deadline_ms"), meta.get("priority"), feed
+    return (model, meta.get("deadline_ms"), meta.get("priority"), feed,
+            meta.get("trace"))
 
 
 def ok_reply(arrays):

@@ -46,6 +46,7 @@ from ..distributed import preemption as _preemption
 from ..distributed import wire as _wire
 from ..fluid import monitor as _monitor
 from ..fluid.resilience import Closed, Overloaded
+from .. import telemetry as _telemetry
 from . import protocol as _p
 
 __all__ = ["ENV_SPEC", "ENV_REPLICA_ID", "ENV_LEASE_TTL", "ENV_STATS_MS",
@@ -101,15 +102,29 @@ class _ReplicaServer(_wire.FramedServer):
         if not req:
             return b"\x01empty request"
         op = req[0]
-        if op == _p.OP_PING:
+        if op == _p.OP_PING:  # trace: ping carries no payload, nothing to propagate
             return b"\x00" + bytes([_p.ST_OK])
-        if op != _p.OP_INFER:
+        if op != _p.OP_INFER:  # trace: error reply, no downstream hop to propagate to
             return b"\x01unknown opcode %d" % op
         try:
-            model, deadline_ms, priority, feed = _p.unpack_request(req)
+            model, deadline_ms, priority, feed, trace = \
+                _p.unpack_request(req)
         except _wire.DecodeError as e:
             return b"\x01%s" % str(e).encode()[:512]
-        return self._replica._infer(model, feed, deadline_ms, priority)
+        # a frame without a trace header (old router / telemetry off)
+        # runs the exact pre-telemetry path; with one, the replica span
+        # becomes ambient so the batcher's submit captures it
+        ctx = _telemetry.decode_header(trace) \
+            if (trace is not None and _telemetry.enabled()) else None
+        if ctx is None:
+            return self._replica._infer(model, feed, deadline_ms,
+                                        priority)
+        with _telemetry.span(
+                "replica.infer", parent=ctx,
+                service="replica:%s" % self._replica.replica_id,
+                attrs={"model": model}):
+            return self._replica._infer(model, feed, deadline_ms,
+                                        priority)
 
 
 class Replica:
@@ -169,7 +184,8 @@ class Replica:
         compiles0 = _live_compile_count()
         disk0 = _monitor.counter(
             "executor_compile_cache_disk_hit_total").value
-        self._server = _inference.Server()
+        self._server = _inference.Server(
+            service="replica:%s" % self.replica_id)
         for ms in self.spec["models"]:
             predictor = _inference.create_predictor(
                 _inference.Config(model_dir=ms["model_dir"]))
@@ -199,6 +215,18 @@ class Replica:
                 target=self._stats_loop, daemon=True,
                 name="fleet-stats-%s" % self.replica_id)
             self._stats_thread.start()
+            if _telemetry.enabled():
+                # share the membership client: the pusher's puts ride
+                # the same authenticated conn (Conn owns a request lock)
+                _telemetry.pusher.start_pusher(
+                    self._coord, "replica:%s" % self.replica_id)
+        if _telemetry.enabled():
+            # default chrome lane / flight-image service for anything
+            # recorded outside an explicit span service
+            os.environ.setdefault(_telemetry.context.ENV_SERVICE,
+                                  "replica:%s" % self.replica_id)
+        # no-op unless $PADDLE_FLIGHT_DIR is set (supervisor exports it)
+        _telemetry.flight.start(rank=self.replica_id)
         return self
 
     @property
@@ -303,6 +331,7 @@ class Replica:
         self._stats_stop.set()
         if self._stats_thread is not None:
             self._stats_thread.join(timeout=2)
+        _telemetry.pusher.stop_pusher("replica:%s" % self.replica_id)
         if self._coord is not None:
             try:
                 self._coord.delete(
@@ -325,11 +354,15 @@ class Replica:
         # failure (eager eviction + requeue), not a graceful refusal
         if self._wire is not None:
             self._wire.stop()
+        # last flight-recorder image before the process state is torn
+        # down — the postmortem's "what was in flight when it died"
+        _telemetry.flight.dump(reason="kill")
         with self._mu:
             self._draining = True
         self._stats_stop.set()
         if self._stats_thread is not None:
             self._stats_thread.join(timeout=2)
+        _telemetry.pusher.stop_pusher("replica:%s" % self.replica_id)
         if self._coord is not None:
             self._coord.close()   # stops the lease keeper; no delete
         if self._server is not None:

@@ -37,6 +37,7 @@ import time
 from ..distributed import coordination as _coordination
 from ..distributed import wire as _wire
 from ..fluid import monitor as _monitor
+from .. import telemetry as _telemetry
 from . import protocol as _p
 
 __all__ = ["Router"]
@@ -251,9 +252,9 @@ class Router(_wire.FramedServer):
                     return
                 if not req:
                     resp = b"\x01empty request"
-                elif req[0] == _p.OP_PING:
+                elif req[0] == _p.OP_PING:  # trace: ping carries no payload, nothing to propagate
                     resp = b"\x00" + bytes([_p.ST_OK])
-                elif req[0] == _p.OP_SUBMIT:
+                elif req[0] == _p.OP_SUBMIT:  # trace: header decoded + forwarded inside _route
                     resp = self._route(req, pool)
                 else:
                     resp = b"\x01unknown opcode %d" % req[0]
@@ -278,9 +279,25 @@ class Router(_wire.FramedServer):
     def _route(self, req, pool):
         t0 = time.perf_counter()
         try:
-            model, deadline_ms, priority, feed = _p.unpack_request(req)
+            model, deadline_ms, priority, feed, trace = \
+                _p.unpack_request(req)
         except _wire.DecodeError as e:
             return b"\x01%s" % str(e).encode()[:512]
+        # trace continues only when the client sent a header AND this
+        # router has telemetry on; otherwise the request runs exactly
+        # the pre-telemetry path (zero per-request allocation)
+        ctx = _telemetry.decode_header(trace) \
+            if (trace is not None and _telemetry.enabled()) else None
+        if ctx is None:
+            return self._route_one(t0, model, deadline_ms, priority,
+                                   feed, pool, None)
+        with _telemetry.span("router.route", parent=ctx, service="router",
+                             attrs={"model": model}):
+            return self._route_one(t0, model, deadline_ms, priority,
+                                   feed, pool, ctx)
+
+    def _route_one(self, t0, model, deadline_ms, priority, feed, pool,
+                   ctx):
         deadline = None if deadline_ms is None \
             else t0 + float(deadline_ms) / 1000.0
         tried = set()
@@ -302,14 +319,27 @@ class Router(_wire.FramedServer):
                     % (model, len(tried)))
             left_ms = None if deadline is None \
                 else max((deadline - now) * 1000.0, 0.001)
-            fwd = _p.pack_request(_p.OP_INFER, model, feed,
-                                  deadline_ms=left_ms,
-                                  priority=priority)
-            try:
+
+            def _forward(trace_hdr):
+                fwd = _p.pack_request(_p.OP_INFER, model, feed,
+                                      deadline_ms=left_ms,
+                                      priority=priority, trace=trace_hdr)
                 try:
-                    resp = self._conn_for(mem, pool).request(fwd)
+                    return self._conn_for(mem, pool).request(fwd)
                 finally:
                     self._release(mem)
+            try:
+                if ctx is None:
+                    resp = _forward(None)
+                else:
+                    # one dispatch span per attempt; a redispatch after
+                    # an eviction shows up as a second span (with the
+                    # failed one carrying attrs.error)
+                    with _telemetry.span(
+                            "router.dispatch", service="router",
+                            attrs={"replica": mem.rid,
+                                   "redispatch": bool(tried)}) as sp:
+                        resp = _forward(_telemetry.encode_header(sp.ctx))
             except (ConnectionError, RuntimeError):
                 # dead or dying replica: evict eagerly, drop its pooled
                 # conn, re-dispatch — the no-loss path

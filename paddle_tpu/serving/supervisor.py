@@ -30,6 +30,7 @@ import threading
 import time
 
 from ..fluid import monitor as _monitor
+from ..telemetry import flight as _flight
 from . import replica as _replica
 
 __all__ = ["FleetSupervisor"]
@@ -46,7 +47,8 @@ class FleetSupervisor:
     ``Replica`` spec dict (shared by every child)."""
 
     def __init__(self, spec, n_replicas, coord_addr, env=None,
-                 python=None, log_dir=None, poll_interval=0.2):
+                 python=None, log_dir=None, poll_interval=0.2,
+                 flight_dir=None):
         self.spec = dict(spec)
         self.n_replicas = int(n_replicas)
         self.coord_addr = coord_addr
@@ -54,6 +56,11 @@ class FleetSupervisor:
         self._python = python or sys.executable
         self._log_dir = log_dir or tempfile.mkdtemp(prefix="fleet-logs-")
         os.makedirs(self._log_dir, exist_ok=True)
+        # flight-recorder dir exported to every child: a killed/crashed
+        # replica leaves flight.<rid>.json here for collect_flight()
+        self.flight_dir = flight_dir or os.environ.get(
+            _flight.ENV_DIR) or os.path.join(self._log_dir, "flight")
+        os.makedirs(self.flight_dir, exist_ok=True)
         self._poll_interval = float(poll_interval)
         self._procs = {}            # rid -> Popen
         self._logs = {}             # rid -> open file handle
@@ -71,6 +78,7 @@ class FleetSupervisor:
         env["PADDLE_COORD_ADDR"] = self.coord_addr
         env[_replica.ENV_SPEC] = self._spec_path
         env[_replica.ENV_REPLICA_ID] = rid
+        env[_flight.ENV_DIR] = self.flight_dir
         env.setdefault("JAX_PLATFORMS", os.environ.get(
             "JAX_PLATFORMS", "cpu"))
         return env
@@ -177,3 +185,15 @@ class FleetSupervisor:
 
     def log_path(self, rid):
         return os.path.join(self._log_dir, "%s.log" % rid)
+
+    # -- postmortem ----------------------------------------------------------
+    def collect_flight(self, rid=None):
+        """Flight-recorder images the children left behind
+        ({rank: image}, or one image / None with ``rid``). A SIGKILLed
+        replica's last periodic flush is still here — the postmortem
+        shows the spans (including OPEN in-flight ones), monitor deltas,
+        and wire ops of its final flush window."""
+        images = _flight.collect(self.flight_dir)
+        if rid is not None:
+            return images.get(str(rid))
+        return images

@@ -68,6 +68,10 @@ def pytest_configure(config):
         "markers", "fleet: exercises the serving fleet (SLO-aware "
                    "router, coordinated replicas, warm respawn, "
                    "deadline-aware batching)")
+    config.addinivalue_line(
+        "markers", "telemetry: exercises the fleet telemetry plane "
+                   "(distributed tracing, cross-process metrics "
+                   "aggregation, crash flight recorder)")
 
 
 @pytest.fixture(autouse=True)

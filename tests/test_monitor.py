@@ -331,3 +331,123 @@ def test_heartbeat_and_watchdog_counters(tmp_path):
     # a detached (no-dir) heartbeat never stamps or counts
     Heartbeat(rank=7, dirname=None).beat(step=1)
     assert monitor.counter("heartbeat_beats_total").value == 2
+
+
+# -- hostile label values (Prometheus escaping regression) --------------------
+
+def test_prometheus_escapes_hostile_label_values():
+    """Quotes, backslashes, and newlines in label VALUES must come out
+    escaped per the text exposition format — an attacker-shaped model
+    name must not be able to inject extra series lines."""
+    hostile = 'a"b\\c\nd'
+    monitor.counter("t_hostile_total", labels={"path": hostile},
+                    help="hostile").inc(2)
+    text = monitor.dump_prometheus()
+    # exactly one physical line carries the series; the newline is \n
+    assert 't_hostile_total{path="a\\"b\\\\c\\nd"} 2' in text.splitlines()
+    # every non-comment line still parses as  name{labels} value
+    for line in text.splitlines():
+        if line.startswith("#") or not line:
+            continue
+        assert re.match(
+            r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+            r'(\{([a-zA-Z_:][a-zA-Z0-9_:]*="(\\.|[^"\\])*",?)*\})? '
+            r'\S+$', line), line
+
+
+def test_prometheus_sanitizes_hostile_names():
+    """Metric and label NAMES with invalid characters are rewritten to
+    the legal charset (values get escaped; names get sanitized)."""
+    monitor.counter("2bad-name.total",
+                    labels={"bad key!": "v"}, help="h").inc()
+    text = monitor.dump_prometheus()
+    assert '_2bad_name_total{bad_key_="v"} 1' in text
+    assert "2bad-name.total" not in text
+
+
+def test_prometheus_hostile_help_and_histogram_suffixes():
+    h = monitor.histogram("t_host_seconds", labels={"m": 'x"y'},
+                          help="line1\nline2 \\ backslash",
+                          buckets=(1.0,))
+    h.observe(0.5)
+    text = monitor.dump_prometheus()
+    assert "# HELP t_host_seconds line1\\nline2 \\\\ backslash" \
+        in text.splitlines()
+    # the _sum/_count suffixes keep the escaped labels
+    assert 't_host_seconds_sum{m="x\\"y"} 0.5' in text
+    assert 't_host_seconds_count{m="x\\"y"} 1' in text
+    assert 't_host_seconds_bucket{m="x\\"y",le="+Inf"} 1' in text
+
+
+# -- Histogram.quantile edge cases (pinned values) ----------------------------
+
+def test_quantile_empty_histogram_is_none():
+    h = monitor.histogram("t_q_empty")
+    for q in (0.0, 0.5, 1.0):
+        assert h.quantile(q) is None
+
+
+def test_quantile_single_observation_is_exact():
+    """One observation: every quantile is that value — the min/max
+    clamp must defeat the bucket's width."""
+    h = monitor.histogram("t_q_one")
+    h.observe(5.0)
+    for q in (0.0, 0.25, 0.5, 0.99, 1.0):
+        assert h.quantile(q) == 5.0
+
+
+def test_quantile_q0_is_min_q1_is_max():
+    h = monitor.histogram("t_q_ends")
+    h.observe(0.2)
+    h.observe(0.9)
+    assert h.quantile(0.0) == 0.2
+    # lo + (hi - lo) interpolation re-associates the float ops, so the
+    # max clamp is hit only to within one ulp
+    assert h.quantile(1.0) == pytest.approx(0.9, rel=1e-12)
+
+
+def test_quantile_overflow_bucket_reports_max():
+    """Observations past the last bound land in +Inf; the only bounded
+    answer is the observed max — never inf, never None."""
+    h = monitor.histogram("t_q_over", buckets=(1.0, 10.0))
+    h.observe(1e6)
+    h.observe(2e6)
+    for q in (0.25, 0.5, 1.0):
+        assert h.quantile(q) == 2e6
+    assert h.quantile(0.0) == 1e6
+
+
+def test_quantile_rejects_out_of_range_q():
+    h = monitor.histogram("t_q_bad")
+    h.observe(1.0)
+    for q in (-0.1, 1.1, 2):
+        with pytest.raises(ValueError, match="q must be in"):
+            h.quantile(q)
+
+
+def test_quantile_of_merged_equals_union():
+    """Two processes' bucket vectors added element-wise give EXACTLY the
+    union's quantiles (the telemetry aggregation contract, pinned here
+    at the Histogram level)."""
+    buckets = monitor.default_buckets()
+    a = monitor.Histogram("a", buckets=buckets)
+    b = monitor.Histogram("b", buckets=buckets)
+    union = monitor.Histogram("u", buckets=buckets)
+    rng = np.random.RandomState(3)
+    for h, vals in ((a, rng.lognormal(-3, 1, 100)),
+                    (b, rng.lognormal(-1, 2, 50))):
+        for v in vals:
+            h.observe(v)
+            union.observe(v)
+    merged = monitor.Histogram("m", buckets=buckets)
+    for src in (a, b):
+        for i, c in enumerate(src.bucket_counts()):
+            merged._counts[i] += c
+        merged._sum += src.sum
+        merged._count += src.count
+        merged._min = src._min if merged._min is None \
+            else min(merged._min, src._min)
+        merged._max = src._max if merged._max is None \
+            else max(merged._max, src._max)
+    for q in (0.0, 0.1, 0.5, 0.9, 0.99, 1.0):
+        assert merged.quantile(q) == union.quantile(q)

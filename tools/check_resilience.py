@@ -72,6 +72,18 @@ _CACHE_ENTRY_SUFFIX = ".xc"
 _SOCKET_EXEMPT = ("distributed/wire.py",)
 _SOCKET_CALLS = {("socket", "socket"), ("socket", "create_connection")}
 
+# distributed-tracing discipline (telemetry/): every opcode-dispatch
+# site in the serving fleet and the coordination service must keep the
+# trace header flowing — a handler that drops it silently truncates
+# every fleet trace at that hop. A function that compares a request
+# byte against an OP_*/opcode constant passes when it mentions "trace"
+# anywhere (it decodes/forwards the header, or a comment says why not);
+# otherwise each dispatch line needs a trailing `# trace: ...`
+# justification.
+_TRACE_FILES = ("paddle_tpu/serving/", "paddle_tpu/distributed/"
+                "coordination.py")
+_TRACE_OP_RE = re.compile(r"^OP_[A-Z_0-9]+$")
+
 
 def _line_has_justification(line):
     """True when the except line carries a real trailing comment
@@ -154,6 +166,65 @@ def _cache_open_violations(source):
     return out
 
 
+def _local_opcode_names(tree):
+    """Module-level _ALL_CAPS integer constants — the coordination
+    service's private opcode set (``_PUT = 2`` style). Collected from
+    the AST so a new opcode is linted the moment it's declared. The
+    leading underscore is deliberate: public ALL-CAPS ints (status
+    codes like ``ST_OK``) ride the RESPONSE path, where there is no
+    header to propagate."""
+    names = set()
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, int)):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name) \
+                    and re.match(r"^_[A-Z][A-Z_0-9]*$", t.id):
+                names.add(t.id)
+    return names
+
+
+def _trace_violations(source):
+    """(lineno, line) for opcode-dispatch Compare sites in a wire
+    handler whose enclosing function neither mentions "trace" nor
+    justifies the site on the dispatch line itself."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return []
+    lines = source.splitlines()
+    local_ops = _local_opcode_names(tree)
+
+    def is_opcode(expr):
+        if isinstance(expr, ast.Attribute):
+            return bool(_TRACE_OP_RE.match(expr.attr))
+        if isinstance(expr, ast.Name):
+            return bool(_TRACE_OP_RE.match(expr.id)) \
+                or expr.id in local_ops
+        return False
+
+    out = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        fn_src = "\n".join(
+            lines[fn.lineno - 1:fn.end_lineno]).lower()
+        if "trace" in fn_src:
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Compare):
+                continue
+            if not (is_opcode(node.left)
+                    or any(is_opcode(c) for c in node.comparators)):
+                continue
+            line = lines[node.lineno - 1] \
+                if node.lineno <= len(lines) else ""
+            out.append((node.lineno, line.strip()))
+    return out
+
+
 def check_file(path):
     """Violations in one file: list of (lineno, line)."""
     out = []
@@ -172,7 +243,9 @@ def check_file(path):
         out.extend(_cache_open_violations(source))
     if not any(norm.endswith(suffix) for suffix in _SOCKET_EXEMPT):
         out.extend(_call_violations(source, _SOCKET_CALLS))
-    return sorted(out)
+    if any(pat in norm for pat in _TRACE_FILES):
+        out.extend(_trace_violations(source))
+    return sorted(set(out))  # nested fns can report a site twice
 
 
 def check_tree(root):
@@ -200,9 +273,10 @@ def main(argv=None):
     if violations:
         print("%d unjustified site(s): bare-except/BaseException, raw "
               "signal.signal, raw os._exit, raw pickle.load(s), a "
-              ".xc cache entry opened outside fluid/compile_cache, or "
+              ".xc cache entry opened outside fluid/compile_cache, "
               "a raw socket.socket/socket.create_connection outside "
-              "distributed/wire — "
+              "distributed/wire, or an opcode handler in "
+              "serving/coordination that drops the trace header — "
               "add a trailing comment explaining why the site is safe, "
               "narrow the exception, or route the access through the "
               "sanctioned module" % len(violations))

@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""fleetstat: one terminal view of a live fleet's merged telemetry.
+
+Reads every live publisher's ``monitor.snapshot()`` from the
+coordination KV (``telemetry/metrics/<proc>``, TTL-leased — dead
+processes age out on their own) and renders either:
+
+  * the default **fleet table** — one row per live publisher (name,
+    pid, snapshot age, metric count) followed by the fleet-MERGED
+    registry: counters summed, gauges last-write-wins, histograms as
+    exact merged quantiles (p50/p99 over the union of observations,
+    see telemetry/aggregate.py);
+  * ``--prom`` — the merged registry as Prometheus text exposition
+    (scrape-file or debugging dump), via ``aggregate.merged_prometheus``
+    so it is rendered by the one canonical ``dump_prometheus``;
+  * ``--watch N`` — re-render the table every N seconds.
+
+Usage:
+    python tools/fleetstat.py --coord HOST:PORT [--token T]
+    python tools/fleetstat.py --coord HOST:PORT --prom [--out FILE]
+    python tools/fleetstat.py --coord HOST:PORT --watch 2
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from paddle_tpu.fluid import monitor as _monitor            # noqa: E402
+from paddle_tpu.telemetry import aggregate as _aggregate    # noqa: E402
+from paddle_tpu.telemetry import pusher as _pusher          # noqa: E402
+
+
+def _fmt_labels(labels):
+    if not labels:
+        return ""
+    return "{%s}" % ",".join("%s=%s" % kv for kv in sorted(labels.items()))
+
+
+def _fmt(v):
+    if isinstance(v, float) and not v.is_integer():
+        return "%.6g" % v
+    return "%d" % v
+
+
+def render_table(snapshots, out=sys.stdout):
+    """The human view: publishers, then the merged registry."""
+    now = time.time()
+    out.write("%-28s %8s %8s %8s\n"
+              % ("PROC", "PID", "AGE_S", "METRICS"))
+    for snap in sorted(snapshots, key=lambda s: str(s.get("proc"))):
+        out.write("%-28s %8s %8.1f %8d\n"
+                  % (snap.get("proc") or "?", snap.get("pid", "?"),
+                     max(now - float(snap.get("ts", now)), 0.0),
+                     len(snap.get("metrics", ()))))
+    if not snapshots:
+        out.write("(no live publishers)\n")
+        return
+    metrics, _kinds = _aggregate.merge(snapshots)
+    out.write("\n%-44s %-10s %s\n" % ("METRIC", "KIND", "VALUE"))
+    for m in sorted(metrics, key=lambda m: (m.name,
+                                            tuple(m.labels.items()))):
+        label = m.name + _fmt_labels(m.labels)
+        if isinstance(m, _monitor.Histogram):
+            p50, p99 = m.quantile(0.5), m.quantile(0.99)
+            out.write("%-44s %-10s count=%d sum=%s p50=%s p99=%s\n"
+                      % (label, m.kind, m._count, _fmt(m._sum),
+                         "-" if p50 is None else _fmt(p50),
+                         "-" if p99 is None else _fmt(p99)))
+        else:
+            out.write("%-44s %-10s %s\n" % (label, m.kind, _fmt(m.value)))
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="live fleet telemetry: merged metrics from the "
+                    "coordination KV")
+    parser.add_argument("--coord", required=True,
+                        help="coordination service host:port")
+    parser.add_argument("--token", default=None,
+                        help="coordination auth token "
+                             "(default $PADDLE_COORD_TOKEN)")
+    parser.add_argument("--prefix", default="telemetry/",
+                        help="KV key prefix the pushers publish under")
+    parser.add_argument("--prom", action="store_true",
+                        help="dump merged Prometheus text instead of "
+                             "the table")
+    parser.add_argument("--out", default=None,
+                        help="write to this file instead of stdout")
+    parser.add_argument("--watch", type=float, default=None,
+                        metavar="SECS",
+                        help="re-render the table every SECS seconds")
+    args = parser.parse_args(argv)
+
+    def once(out):
+        snapshots = _pusher.collect_metrics(
+            args.coord, prefix=args.prefix, token=args.token)
+        if args.prom:
+            out.write(_aggregate.merged_prometheus(snapshots))
+        else:
+            render_table(snapshots, out=out)
+        return len(snapshots)
+
+    if args.watch and not args.prom:
+        try:
+            while True:
+                sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+                once(sys.stdout)
+                sys.stdout.flush()
+                time.sleep(args.watch)
+        except KeyboardInterrupt:
+            return 0
+    if args.out:
+        with open(args.out, "w") as f:
+            n = once(f)
+    else:
+        n = once(sys.stdout)
+    return 0 if n else 2   # 2 = reachable but nobody publishing
+
+
+if __name__ == "__main__":
+    sys.exit(main())
