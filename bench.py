@@ -1115,6 +1115,150 @@ def bench_fleet(replica_counts=(1, 2, 4), n_clients=8, per_client=24,
     return out
 
 
+def bench_coord_recovery(smoke=False, n_clients=None, per_client=None,
+                         deadline_ms=10000.0, model_dir=None):
+    """``BENCH_COORD=1``: kill the coordination service mid-run —
+    ``CoordServer.crash()``, the in-process equivalent of kill -9: no
+    drain, no final snapshot, every connection severed — and restart it
+    on the SAME port against the SAME WAL dir while a closed-loop
+    client fleet keeps hammering a 2-replica serving fleet. The data
+    path never touches the coordinator, so the run must lose ZERO
+    requests; the control path degrades visibly and recovers:
+
+      * the router detects the outage (its fail-fast coordination
+        client) and keeps routing over the last-known replica set —
+        the chaos thread holds the outage open until
+        ``fleet_stale_routing_total`` proves requests rode the stale
+        view;
+      * the restarted server replays its WAL (replica leases included,
+        as wall-clock deadlines) at a bumped epoch; replica clients
+        re-dial transparently, replay their leases, re-register;
+      * the router's next successful refresh clears the stale flag.
+
+    Reported: the outage window (crash -> restarted), the stale-routing
+    window (first stale-routed request -> router fresh again), full
+    recovery time (crash -> fresh), stale-routed count (must be > 0)
+    and requests lost (must be 0)."""
+    import tempfile
+    import threading
+
+    from paddle_tpu.distributed.coordination import (CoordClient,
+                                                     CoordServer)
+    from paddle_tpu.fluid import monitor
+    from paddle_tpu.serving import Replica, Router
+
+    if n_clients is None:
+        n_clients = 2 if smoke else 6
+    if per_client is None:
+        per_client = 40 if smoke else 48
+    # pacing keeps the closed loop alive well past the router's ~1 s
+    # outage-detection latency (its coordination client's fail-fast
+    # grace), so stale routing is actually exercised, not raced
+    pace_s = 0.07 if smoke else 0.05
+    tmp = tempfile.mkdtemp(prefix="bench_coord_")
+    if model_dir is None:
+        model_dir = _fleet_model_dir(os.path.join(tmp, "model"),
+                                     prelower=False)
+    wal_dir = os.path.join(tmp, "wal")
+    spec = _fleet_spec(model_dir)
+    coord = CoordServer(wal_dir=wal_dir).start()
+    addr = "%s:%d" % (coord.host, coord.port)
+    port = coord.port
+    epoch0 = coord.epoch
+    state = {"coord": coord}
+    reps = []
+    router = None
+    dbg = CoordClient(addr)
+    stale0 = monitor.counter("fleet_stale_routing_total").value
+    try:
+        reps = [Replica(spec, coord_addr=addr,
+                        replica_id="cr%d" % i, lease_ttl=5.0,
+                        stats_interval=0.1).start()
+                for i in range(2)]
+        deadline = time.time() + 240
+        while len(dbg.live_members("fleet/replicas/")) < 2:
+            if time.time() > deadline:
+                raise TimeoutError("replicas never registered")
+            time.sleep(0.1)
+        router = Router(coord_addr=addr, refresh_interval=0.1).start()
+        kill_ev = threading.Event()
+        marks = {}
+
+        def chaos():
+            kill_ev.wait(120)
+            marks["t_kill"] = time.perf_counter()
+            state["coord"].crash()
+            # hold the outage open until the router provably routed
+            # over its stale table (bounded: the closed loop outlasts
+            # this by construction, but a wedge must not hang forever)
+            hold = time.time() + 30
+            while time.time() < hold:
+                if monitor.counter(
+                        "fleet_stale_routing_total").value > stale0:
+                    break
+                time.sleep(0.02)
+            marks["t_stale"] = time.perf_counter()
+            state["coord"] = CoordServer(port=port,
+                                         wal_dir=wal_dir).start()
+            marks["t_up"] = time.perf_counter()
+            hold = time.time() + 60
+            while time.time() < hold:
+                with router._table_mu:
+                    fresh = router._stale_since is None
+                if fresh and router.members():
+                    marks["t_fresh"] = time.perf_counter()
+                    return
+                time.sleep(0.02)
+
+        ct = threading.Thread(target=chaos, daemon=True)
+        ct.start()
+
+        def pacer(cid, i):
+            time.sleep(pace_s)
+            if cid == 0 and i == per_client // 3:
+                kill_ev.set()
+
+        st = _fleet_closed_loop(
+            "%s:%d" % (router.host, router.port),
+            n_clients, per_client, deadline_ms, on_request=pacer)
+        ct.join(120)
+        assert not st["errors"], st["errors"][:3]
+        total = n_clients * per_client
+        lost = total - st["served"] - st["shed"]
+        assert lost == 0, (
+            "lost requests across the coordinator outage: %d served + "
+            "%d shed != %d" % (st["served"], st["shed"], total))
+        stale_routed = monitor.counter(
+            "fleet_stale_routing_total").value - stale0
+        assert stale_routed > 0, (
+            "no request ever rode the stale routing table — the outage "
+            "never overlapped the load")
+        assert "t_fresh" in marks, (
+            "router never returned to a fresh view: %s" % marks)
+        epoch1 = state["coord"].epoch
+        assert epoch1 == epoch0 + 1, (epoch0, epoch1)
+        return {
+            "coord_requests_total": total,
+            "coord_requests_served": st["served"],
+            "coord_requests_shed": st["shed"],
+            "coord_requests_lost": lost,
+            "coord_stale_routed": int(stale_routed),
+            "coord_outage_s": round(marks["t_up"] - marks["t_kill"], 3),
+            "coord_stale_window_s": round(
+                marks["t_fresh"] - marks["t_stale"], 3),
+            "coord_recovery_s": round(
+                marks["t_fresh"] - marks["t_kill"], 3),
+            "coord_epochs": [epoch0, epoch1],
+        }
+    finally:
+        if router is not None:
+            router.close()
+        for r in reps:
+            r.drain(timeout=10)
+        dbg.close()
+        state["coord"].stop()
+
+
 def bench_restart():
     """``BENCH_RESTART=1``: restart-to-first-step and serving
     ``register()`` warm-up, cold (empty persistent compile cache) vs
@@ -1486,6 +1630,11 @@ def bench_smoke():
     assert fleet_routed == 8, (
         "fleet smoke: %d/8 requests routed" % fleet_routed)
 
+    # coordinator crash + recovery under fleet load (tiny closed loop,
+    # same model dir): zero requests lost, stale routing observed, WAL
+    # replay brings the same port back at a bumped epoch
+    coordrec = bench_coord_recovery(smoke=True, model_dir=fleet_dir)
+
     # persistent compile cache: a warm "restart" (fresh Executor,
     # rebuilt program, same cache dir) must deserialize BOTH programs
     # from disk and compile zero live — the restart fast path can't
@@ -1560,6 +1709,9 @@ def bench_smoke():
         "cache_smoke_disk_hits": int(ch2 - ch1),
         "cache_smoke_disk_misses": int(cm1 - cm0),
         "fleet_smoke_routed": fleet_routed,
+        "coord_smoke_requests_lost": coordrec["coord_requests_lost"],
+        "coord_smoke_stale_routed": coordrec["coord_stale_routed"],
+        "coord_smoke_recovery_s": coordrec["coord_recovery_s"],
         "monitor": monitor_summary(),
     }
 
@@ -1593,6 +1745,8 @@ if __name__ == "__main__":
         out.update(bench_serve())
     if os.environ.get("BENCH_FLEET") == "1":
         out.update(bench_fleet())
+    if os.environ.get("BENCH_COORD") == "1":
+        out.update(bench_coord_recovery())
     if os.environ.get("BENCH_EMBED") == "1":
         out.update(bench_embedding())
     if os.environ.get("BENCH_RESTART") == "1":

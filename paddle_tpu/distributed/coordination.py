@@ -24,26 +24,64 @@ client's socket carries a fixed timeout, so long waits are client-side
 loops of short server-side waits — a dropped connection mid-wait then
 costs one slice, not the whole deadline.
 
+Durability (``CoordServer(wal_dir=...)``): every mutation is journaled
+to an append-only WAL (JSON lines, fsync'd before the ack) and
+periodically compacted into an atomic snapshot (tmp+fsync+rename via
+the shared ``fluid.io`` helper), so a kill -9 loses nothing that was
+acknowledged. A restarted server replays snapshot+WAL, bumps its
+**epoch**, and advertises it in the handshake hello — reconnecting
+clients can therefore tell "the server restarted" (re-probe
+capabilities, replay leases) from "a partition healed" (nothing was
+lost). Leases are persisted with ABSOLUTE wall-clock deadlines (only
+wall time survives a restart) but swept in-memory on the monotonic
+clock, so an NTP step can never mass-expire live members.
+
+Client resilience: ``CoordClient(grace=...)`` re-dials through
+outages up to the grace window (``PADDLE_COORD_GRACE_S``, default
+30 s) with the shared ``Retry`` policy; after any reconnect it
+re-asserts every lease it holds, re-probes ``_TRACED`` support, and
+fires registered ``on_reconnect`` callbacks (fleet replicas
+re-register through this). Barrier arrivals are generation-numbered
+and idempotent per client id, so replayed requests can never
+double-count.
+
 Env contract: ``PADDLE_COORD_ADDR`` (host:port of a live server) and
 ``PADDLE_COORD_BACKEND`` ("tcp" | "file") select the rendezvous
-backend end to end; see ``rendezvous.create``.
+backend end to end (see ``rendezvous.create``);
+``PADDLE_COORD_WAL_DIR`` makes launcher-owned and standalone servers
+durable; ``PADDLE_COORD_GRACE_S`` bounds client re-dial patience.
 """
 
+import base64
 import json
 import os
 import struct
+import sys
 import threading
 import time
 
+from ..fluid import faults as _faults
 from ..fluid import monitor as _monitor
 from . import wire as _wire
 
-__all__ = ["ENV_ADDR", "ENV_BACKEND", "ENV_TOKEN", "CoordServer",
-           "CoordClient", "current_coord_addr"]
+__all__ = ["ENV_ADDR", "ENV_BACKEND", "ENV_TOKEN", "ENV_WAL_DIR",
+           "ENV_GRACE", "CoordServer", "CoordClient",
+           "current_coord_addr"]
 
 ENV_ADDR = "PADDLE_COORD_ADDR"
 ENV_BACKEND = "PADDLE_COORD_BACKEND"
 ENV_TOKEN = "PADDLE_COORD_TOKEN"
+ENV_WAL_DIR = "PADDLE_COORD_WAL_DIR"
+ENV_GRACE = "PADDLE_COORD_GRACE_S"
+ENV_WAL_FSYNC = "PADDLE_COORD_WAL_FSYNC"
+ENV_SNAPSHOT_EVERY = "PADDLE_COORD_SNAPSHOT_EVERY"
+
+# client re-dial budget across a coordinator outage (seconds)
+_DEFAULT_GRACE = 30.0
+
+# WAL/snapshot layout inside wal_dir
+WAL_FILE = "wal.jsonl"
+SNAPSHOT_FILE = "snapshot.json"
 
 _MAGIC = b"PTCO1"
 
@@ -80,6 +118,26 @@ _M_WATCHERS = _monitor.gauge(
     "coord_watch_clients",
     "requests currently blocked server-side in a wait (watching GET or "
     "barrier wait)")
+_M_WAL_RECORDS = _monitor.counter(
+    "coord_wal_records_total",
+    "mutations journaled to the coordination write-ahead log")
+_M_SNAPSHOTS = _monitor.counter(
+    "coord_snapshots_total",
+    "compacted coordination-state snapshots written (WAL truncated)")
+
+_M_RECONNECTS = {}
+
+
+def _m_reconnects(kind):
+    c = _M_RECONNECTS.get(kind)
+    if c is None:
+        c = _M_RECONNECTS[kind] = _monitor.counter(
+            "coord_client_reconnects_total",
+            help="client re-dials that succeeded, by kind (resume: same "
+                 "server epoch, a partition healed; restart: the epoch "
+                 "changed, the server was restarted/replaced)",
+            labels={"kind": kind})
+    return c
 
 
 def current_coord_addr():
@@ -125,21 +183,221 @@ class _Barrier:
 
 
 class CoordServer(_wire.FramedServer):
-    """Threaded in-memory control-plane server. All state lives under
-    one ``threading.Condition`` — every mutation notifies, every wait
-    is a bounded ``wait_for`` on it; with tens of clients and
+    """Threaded control-plane server. All state lives under one
+    ``threading.Condition`` — every mutation notifies, every wait is a
+    bounded ``wait_for`` on it; with tens of clients and
     control-plane-sized traffic the single lock is nowhere near
-    contention."""
+    contention.
+
+    With ``wal_dir`` set the server is CRASH-RECOVERABLE: mutations are
+    journaled (fsync'd) before they are acknowledged, snapshots compact
+    the log, and a restart with the same ``wal_dir`` resumes with the
+    full KV/counter/barrier/lease state at a bumped epoch. Without it
+    the server is the original ephemeral in-memory service (epoch
+    derived from the wall clock so restarts are still detectable).
+
+    ``clock``/``wall`` are injectable for tests: ``clock`` (monotonic
+    domain) drives every in-memory deadline and sweep, ``wall`` is used
+    ONLY to persist absolute lease deadlines across restarts — a wall
+    clock step therefore cannot expire a live lease."""
 
     MAGIC = _MAGIC
     TOKEN_ENV = ENV_TOKEN
 
-    def __init__(self, host="127.0.0.1", port=0, token=None):
+    def __init__(self, host="127.0.0.1", port=0, token=None,
+                 wal_dir=None, snapshot_every=None, clock=time.monotonic,
+                 wall=time.time):
         super().__init__(host=host, port=port, token=token, backlog=64)
+        self._clock = clock
+        self._wall = wall
         self._cv = threading.Condition()
         self._kv = {}             # key -> bytes
         self._barriers = {}       # name -> _Barrier
-        self._leases = {}         # client id -> absolute expiry deadline
+        self._leases = {}         # client id -> MONOTONIC expiry deadline
+        self._wal_dir = wal_dir
+        self._snapshot_every = int(
+            snapshot_every if snapshot_every is not None
+            else os.environ.get(ENV_SNAPSHOT_EVERY, 512) or 512)
+        self._wal_fsync = os.environ.get(ENV_WAL_FSYNC, "1") != "0"
+        self._wal_f = None
+        self._seq = 0             # last journaled/applied record number
+        self._since_snapshot = 0
+        if wal_dir:
+            os.makedirs(wal_dir, exist_ok=True)
+            self._epoch = self._recover() + 1
+            # make the new epoch durable (and compact the replayed WAL)
+            # BEFORE the first client can be answered
+            self._snapshot_locked()
+        else:
+            self._epoch = int(self._wall() * 1000.0) & 0xFFFFFFFFFFFF
+
+    @property
+    def epoch(self):
+        """Monotonically increasing server incarnation number,
+        advertised in the handshake hello."""
+        return self._epoch
+
+    def _hello_payload(self):
+        return struct.pack("<Q", self._epoch)
+
+    # -- durability ---------------------------------------------------------
+    def _wal_path(self):
+        return os.path.join(self._wal_dir, WAL_FILE)
+
+    def _snap_path(self):
+        return os.path.join(self._wal_dir, SNAPSHOT_FILE)
+
+    def _recover(self):
+        """Rebuild state from snapshot + WAL tail; returns the
+        recovered epoch (0 for a fresh dir). Replay skips records the
+        snapshot already covers (``seq`` guard — a crash between the
+        snapshot rename and the WAL truncate leaves such records) and
+        stops at the first torn line (a crash mid-append tears only
+        the unacknowledged tail)."""
+        epoch, snap_seq = 0, 0
+        try:
+            with open(self._snap_path(), "rb") as f:
+                snap = json.loads(f.read().decode())
+        except FileNotFoundError:
+            snap = None
+        except (ValueError, OSError, UnicodeDecodeError) as e:
+            # the snapshot is written atomically, so garbage here is
+            # operator error (wrong dir, torn copy) — refuse loudly
+            # rather than silently serving empty state
+            raise RuntimeError("corrupt coordination snapshot %s: %r"
+                               % (self._snap_path(), e))
+        if snap is not None:
+            epoch = int(snap.get("epoch", 0))
+            snap_seq = int(snap.get("seq", 0))
+            self._apply_snapshot(snap)
+        self._seq = snap_seq
+        try:
+            f = open(self._wal_path(), "rb")
+        except FileNotFoundError:
+            return epoch
+        with f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line.decode())
+                    seq = int(rec["s"])
+                except (ValueError, KeyError, UnicodeDecodeError):
+                    break         # torn tail: everything before it holds
+                if seq <= snap_seq:
+                    continue
+                self._apply(rec)
+                self._seq = seq
+        return epoch
+
+    def _apply_snapshot(self, snap):
+        self._kv = {k: base64.b64decode(v)
+                    for k, v in snap.get("kv", {}).items()}
+        self._barriers = {}
+        for name, b in snap.get("barriers", {}).items():
+            bar = _Barrier()
+            bar.generation = int(b["g"])
+            bar.arrived = set(b.get("a", []))
+            self._barriers[name] = bar
+        now_mono, now_wall = self._clock(), self._wall()
+        # wall deadline -> monotonic: the REMAINING ttl is what survives
+        self._leases = {cid: now_mono + (float(wd) - now_wall)
+                        for cid, wd in snap.get("leases", {}).items()}
+
+    def _apply(self, rec):
+        op = rec.get("o")
+        if op == "put":
+            self._kv[rec["k"]] = base64.b64decode(rec["v"])
+        elif op == "del":
+            self._kv.pop(rec["k"], None)
+        elif op == "bar":
+            bar = self._barriers.setdefault(rec["n"], _Barrier())
+            bar.generation = int(rec["g"])
+            bar.arrived = set(rec.get("a", []))
+            bar.arrive_ts = {}
+        elif op == "lease":
+            self._leases[rec["id"]] = \
+                self._clock() + (float(rec["wd"]) - self._wall())
+        elif op == "sweep":
+            for cid in rec.get("ids", []):
+                self._leases.pop(cid, None)
+                if rec.get("kv"):
+                    self._kv.pop(cid, None)
+        # unknown record types from a newer version are skipped: they
+        # describe state this version cannot hold anyway
+
+    def _journal(self, rec):
+        """Append one WAL record (caller holds ``self._cv``). The
+        handler acks only after this returns, so an acknowledged
+        mutation is on disk (fsync unless PADDLE_COORD_WAL_FSYNC=0).
+        No-op for ephemeral servers."""
+        if self._wal_f is None:
+            return
+        self._seq += 1
+        rec["s"] = self._seq
+        self._wal_f.write(
+            (json.dumps(rec, separators=(",", ":")) + "\n").encode())
+        self._wal_f.flush()
+        if self._wal_fsync:
+            os.fsync(self._wal_f.fileno())
+        _M_WAL_RECORDS.inc()
+        self._since_snapshot += 1
+        if self._since_snapshot >= self._snapshot_every:
+            self._snapshot_locked()
+
+    def _snapshot_locked(self):
+        """Compact the state into an atomic snapshot (the PR-4
+        tmp+fsync+rename helper) and truncate the WAL. Called under
+        ``self._cv`` once serving (construction runs single-threaded)."""
+        if not self._wal_dir:
+            return
+        from ..fluid.io import _atomic_write_bytes
+
+        now_mono, now_wall = self._clock(), self._wall()
+        snap = {
+            "epoch": self._epoch,
+            "seq": self._seq,
+            "kv": {k: base64.b64encode(v).decode("ascii")
+                   for k, v in self._kv.items()},
+            "barriers": {n: {"g": b.generation, "a": sorted(b.arrived)}
+                         for n, b in self._barriers.items()},
+            "leases": {cid: now_wall + (d - now_mono)
+                       for cid, d in self._leases.items()},
+        }
+        _atomic_write_bytes(
+            self._snap_path(),
+            json.dumps(snap, separators=(",", ":")).encode())
+        if self._wal_f is not None:
+            self._wal_f.close()
+        # every record <= seq now lives in the snapshot: restart the log
+        self._wal_f = open(self._wal_path(), "wb")
+        self._since_snapshot = 0
+        _M_SNAPSHOTS.inc()
+
+    def stop(self):
+        super().stop()
+        with self._cv:
+            if self._wal_f is not None:
+                # clean shutdown: compact so the next start replays
+                # nothing, then release the handle
+                self._snapshot_locked()
+                self._wal_f.close()
+                self._wal_f = None
+
+    def crash(self):
+        """Simulated kill -9 for chaos tests: sever every connection
+        and the listener WITHOUT the final snapshot/compaction a clean
+        ``stop()`` performs — recovery must come from the fsync'd WAL
+        alone, exactly as after a real SIGKILL."""
+        _wire.FramedServer.stop(self)
+        with self._cv:
+            f, self._wal_f = self._wal_f, None
+        if f is not None:
+            try:
+                f.close()     # per-record flush means nothing is lost here
+            except OSError:
+                pass
 
     # -- request handling ---------------------------------------------------
     def _serve_authenticated(self, conn):
@@ -147,6 +405,11 @@ class CoordServer(_wire.FramedServer):
             try:
                 req = _wire.read_frame(conn, _MAX_FRAME)
             except (ConnectionError, OSError):
+                return
+            if _faults.take("coord.crash"):
+                # chaos: die mid-request — the requester never gets an
+                # ack, every other client sees its connection sever
+                self.crash()
                 return
             resp = self._handle(req)
             try:
@@ -229,19 +492,22 @@ class CoordServer(_wire.FramedServer):
     def _do_put(self, key, value):
         with self._cv:
             self._kv[key] = bytes(value)
+            self._journal({"o": "put", "k": key,
+                           "v": base64.b64encode(
+                               self._kv[key]).decode("ascii")})
             self._cv.notify_all()
         _M_PUTS.inc()
         return b"\x00"
 
-    def _do_get(self, key, wait):
+    def _do_get(self, key, wait):  # wal: read-only (wait-and-watch GET)
         _M_GETS.inc()
-        deadline = time.monotonic() + min(max(wait, 0.0), _WAIT_SLICE)
+        deadline = self._clock() + min(max(wait, 0.0), _WAIT_SLICE)
         with self._cv:
             if key in self._kv:
                 return b"\x00\x01" + self._kv[key]  # ok, found + value
             with _M_WATCHERS.track():
                 while key not in self._kv:
-                    left = deadline - time.monotonic()
+                    left = deadline - self._clock()
                     if left <= 0 or self._stop.is_set():
                         return b"\x00\x00"          # ok, not found
                     self._cv.wait(timeout=min(left, 0.2))
@@ -250,6 +516,8 @@ class CoordServer(_wire.FramedServer):
     def _do_del(self, key):
         with self._cv:
             existed = self._kv.pop(key, None) is not None
+            if existed:
+                self._journal({"o": "del", "k": key})
             self._cv.notify_all()
         return b"\x00" + (b"\x01" if existed else b"\x00")
 
@@ -259,10 +527,15 @@ class CoordServer(_wire.FramedServer):
             cur = int(self._kv.get(key, b"0") or b"0")
             cur += int(delta)
             self._kv[key] = str(cur).encode()
+            # journaled as the RESULT, not the delta: replaying a
+            # record the snapshot already covers stays idempotent
+            self._journal({"o": "put", "k": key,
+                           "v": base64.b64encode(
+                               self._kv[key]).decode("ascii")})
             self._cv.notify_all()
         return b"\x00" + struct.pack("<q", cur)
 
-    def _do_list(self, prefix):
+    def _do_list(self, prefix):  # wal: read-only (key enumeration)
         with self._cv:
             keys = sorted(k for k in self._kv if k.startswith(prefix))
         return b"\x00" + json.dumps(keys).encode()
@@ -271,13 +544,15 @@ class CoordServer(_wire.FramedServer):
     def _do_barrier_arrive(self, name, cid, world):
         if world <= 0:
             raise _wire.DecodeError("barrier world must be positive")
-        now = time.monotonic()
+        now = self._clock()
         with self._cv:
             bar = self._barriers.setdefault(name, _Barrier())
             entry_gen = bar.generation
+            changed = False
             if cid not in bar.arrived:       # idempotent re-arrival
                 bar.arrived.add(cid)
                 bar.arrive_ts[cid] = now
+                changed = True
             if len(bar.arrived) >= world:
                 for t in bar.arrive_ts.values():
                     _M_BARRIER_WAIT.observe(now - t)
@@ -285,18 +560,26 @@ class CoordServer(_wire.FramedServer):
                 bar.arrived.clear()
                 bar.arrive_ts.clear()
                 _M_BARRIERS.inc()
+                changed = True
                 self._cv.notify_all()
+            if changed:
+                # the POST-arrival state (generation + arrived set), so
+                # replay is a state replace, not a re-count — a blocked
+                # gang survives a coordinator restart mid-barrier
+                self._journal({"o": "bar", "n": name,
+                               "g": bar.generation,
+                               "a": sorted(bar.arrived)})
             return b"\x00" + struct.pack("<q", entry_gen)
 
-    def _do_barrier_wait(self, name, gen, wait):
-        deadline = time.monotonic() + min(max(wait, 0.0), _WAIT_SLICE)
+    def _do_barrier_wait(self, name, gen, wait):  # wal: read-only (generation watch)
+        deadline = self._clock() + min(max(wait, 0.0), _WAIT_SLICE)
         with self._cv:
             bar = self._barriers.setdefault(name, _Barrier())
             if bar.generation > gen:
                 return b"\x00\x01" + struct.pack("<q", bar.generation)
             with _M_WATCHERS.track():
                 while bar.generation <= gen:
-                    left = deadline - time.monotonic()
+                    left = deadline - self._clock()
                     if left <= 0 or self._stop.is_set():
                         return (b"\x00\x00"
                                 + struct.pack("<q", bar.generation))
@@ -305,18 +588,26 @@ class CoordServer(_wire.FramedServer):
 
     # -- leases -------------------------------------------------------------
     def _do_lease(self, cid, ttl):
+        ttl = max(float(ttl), 0.0)
         with self._cv:
-            self._leases[cid] = time.monotonic() + max(float(ttl), 0.0)
+            # in-memory deadline on the MONOTONIC clock (immune to NTP
+            # steps); journaled with the absolute WALL deadline — the
+            # only clock that survives a restart
+            self._leases[cid] = self._clock() + ttl
+            self._journal({"o": "lease", "id": cid,
+                           "wd": self._wall() + ttl})
         return b"\x00"
 
     def _do_live(self):
-        now = time.monotonic()
+        now = self._clock()
         with self._cv:
             # expired leases are garbage, not history — drop them so the
             # map cannot grow with elastic client churn
             dead = [c for c, d in self._leases.items() if d <= now]
             for c in dead:
                 del self._leases[c]
+            if dead:
+                self._journal({"o": "sweep", "ids": dead})
             live = sorted(self._leases)
         return b"\x00" + json.dumps(live).encode()
 
@@ -326,7 +617,7 @@ class CoordServer(_wire.FramedServer):
         # the member's KV entry (its registration blob), so one atomic
         # server-side pass guarantees the returned keys all carry a live
         # lease — the caller can never observe a dead replica.
-        now = time.monotonic()
+        now = self._clock()
         with self._cv:
             dead = [c for c, d in self._leases.items()
                     if c.startswith(prefix) and d <= now]
@@ -334,6 +625,7 @@ class CoordServer(_wire.FramedServer):
                 del self._leases[c]
                 self._kv.pop(c, None)
             if dead:
+                self._journal({"o": "sweep", "ids": dead, "kv": True})
                 self._cv.notify_all()
             live = sorted(c for c in self._leases
                           if c.startswith(prefix) and c in self._kv)
@@ -343,24 +635,65 @@ class CoordServer(_wire.FramedServer):
 class CoordClient:
     """Client proxy over one ``wire.Conn``. Thread-safe (the Conn owns a
     request lock). Every wait is a client-side loop of short
-    server-side waits so socket timeouts never fire mid-wait."""
+    server-side waits so socket timeouts never fire mid-wait.
 
-    def __init__(self, endpoint, token=None):
-        self._conn = _CoordConn(endpoint, token=token)
+    ``grace`` is the re-dial budget (seconds) across a coordinator
+    outage — requests transparently retry/reconnect up to that long
+    before surfacing ConnectionError (default ``PADDLE_COORD_GRACE_S``
+    or 30 s; pass 0 for the legacy fail-fast policy, what the fleet
+    router uses so its refresh loop never blocks). After any reconnect
+    the client re-asserts every lease it holds, re-probes ``_TRACED``
+    support (a replaced server may speak it even if the old one did
+    not), and fires ``on_reconnect`` callbacks."""
+
+    def __init__(self, endpoint, token=None, grace=None, max_frame=None):
+        if grace is None:
+            grace = float(os.environ.get(ENV_GRACE, "") or _DEFAULT_GRACE)
+        self._grace = max(float(grace), 0.0)
+        self._conn = _CoordConn(endpoint, token=token,
+                                deadline=self._grace or None,
+                                max_frame=max_frame)
         self._lease_thread = None
         self._lease_stop = threading.Event()
         self._trace_ok = None     # False after an old server rejects _TRACED
+        self._leases_mu = threading.Lock()
+        self._leases_held = {}    # lease id -> ttl, replayed on reconnect
+        self._reconnect_cbs = []
 
     @property
     def endpoint(self):
         return self._conn.endpoint
+
+    @property
+    def server_epoch(self):
+        """The server incarnation from the last handshake, or None
+        against a server that predates the epoch hello."""
+        hello = self._conn.server_hello
+        if hello and len(hello) >= 8:
+            return struct.unpack_from("<Q", hello)[0]
+        return None
+
+    def on_reconnect(self, fn):
+        """Register ``fn()`` to run after this client re-dials the
+        server (restart or healed partition) — the hook fleet replicas
+        re-register through. Lease re-establishment is automatic and
+        happens before the callbacks fire."""
+        self._reconnect_cbs.append(fn)
+        return fn
 
     def _request(self, payload):
         """Every RPC routes here: with telemetry on and a sampled trace
         active, the request ships inside the ``_TRACED`` envelope so the
         server's span lands in the caller's trace. An old server that
         rejects the envelope ("unknown opcode" — the inner op was NOT
-        executed) downgrades this client to unwrapped requests."""
+        executed) downgrades this client to unwrapped requests (until
+        the next reconnect re-probes)."""
+        try:
+            return self._request_raw(payload)
+        finally:
+            self._after_rpc()
+
+    def _request_raw(self, payload):
         from .. import telemetry as _telemetry
 
         if self._trace_ok is not False and _telemetry.enabled():
@@ -377,6 +710,33 @@ class CoordClient:
                         raise
                     self._trace_ok = False
         return self._conn.request(payload)
+
+    def _after_rpc(self):
+        """Reconnect re-establishment, run AFTER the triggering request
+        completes (the Conn's request lock is released — hooks issue
+        RPCs of their own). The flag handoff clears first, so nested
+        ``_request`` calls from the hooks cannot recurse."""
+        reconnected, restarted = self._conn.consume_reconnect()
+        if not reconnected:
+            return
+        _m_reconnects("restart" if restarted else "resume").inc()
+        # the server may be a different build now: probe _TRACED again
+        # instead of inheriting a permanent downgrade
+        self._trace_ok = None
+        with self._leases_mu:
+            held = list(self._leases_held.items())
+        for cid, ttl in held:
+            try:
+                self._conn.request(
+                    struct.pack("<B", _LEASE) + _pack_str(cid)
+                    + struct.pack("<d", ttl))
+            except (ConnectionError, RuntimeError):
+                break   # still flapping: the keeper's next beat retries
+        for cb in list(self._reconnect_cbs):
+            try:
+                cb()
+            except Exception:  # a broken hook must not poison the RPC that tripped it
+                pass
 
     # -- KV -----------------------------------------------------------------
     def put(self, key, value):
@@ -458,8 +818,18 @@ class CoordClient:
 
     # -- liveness -----------------------------------------------------------
     def lease(self, client_id, ttl=10.0):
+        with self._leases_mu:
+            # remembered FIRST: even if this very request rides a
+            # reconnect, the replay set already includes it
+            self._leases_held[client_id] = float(ttl)
         self._request(struct.pack("<B", _LEASE) +
                            _pack_str(client_id) + struct.pack("<d", ttl))
+
+    def forget_lease(self, client_id):
+        """Stop replaying ``client_id`` after reconnects (deregistration
+        path); the server-side lease simply expires."""
+        with self._leases_mu:
+            self._leases_held.pop(client_id, None)
 
     def live(self):
         resp = self._request(struct.pack("<B", _LIVE) +
@@ -488,7 +858,10 @@ class CoordClient:
                 try:
                     self.lease(client_id, ttl=ttl)
                 except (ConnectionError, RuntimeError):
-                    return  # server gone; the lease will expire on its own
+                    # server down past the grace window: KEEP the
+                    # keeper alive — the first beat that lands after
+                    # the server returns re-establishes the lease
+                    continue
         self.lease(client_id, ttl=ttl)
         self._lease_thread = threading.Thread(target=_keep, daemon=True)
         self._lease_thread.start()
@@ -513,6 +886,53 @@ class _CoordConn(_wire.Conn):
     MAGIC = _MAGIC
     TOKEN_ENV = ENV_TOKEN
 
-    def __init__(self, endpoint, token=None):
+    def __init__(self, endpoint, token=None, deadline=None,
+                 max_frame=None):
         super().__init__(endpoint, token=token, retry_name="coord.rpc",
-                         max_frame=_MAX_FRAME)
+                         max_frame=max_frame or _MAX_FRAME,
+                         deadline=deadline)
+
+    def _round_trip(self, payload):
+        # coord.partition models a network partition: the attempt fails
+        # transiently (FaultInjected is retryable), so an armed streak
+        # of N looks like an N-attempt-long outage to this client only
+        _faults.check("coord.partition")
+        return super()._round_trip(payload)
+
+
+def main(argv=None):
+    """Standalone coordinator entry
+    (``python -m paddle_tpu.distributed.coordination``) — what the
+    chaos harness and multi-node deployments SIGKILL and restart
+    against the same ``--wal-dir``. Prints the bound endpoint and
+    epoch on stdout, then serves until STOP/SIGTERM."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.distributed.coordination",
+        description="standalone durable coordination service")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--wal-dir",
+                   default=os.environ.get(ENV_WAL_DIR) or None,
+                   help="WAL/snapshot dir (default $%s); omit for an "
+                        "ephemeral in-memory server" % ENV_WAL_DIR)
+    p.add_argument("--token", default=None,
+                   help="shared secret (default $%s)" % ENV_TOKEN)
+    args = p.parse_args(argv)
+    srv = CoordServer(host=args.host, port=args.port, token=args.token,
+                      wal_dir=args.wal_dir).start()
+    sys.stdout.write("coordination service at %s epoch=%d wal=%s\n"
+                     % (srv.endpoint, srv.epoch, args.wal_dir or "-"))
+    sys.stdout.flush()
+    try:
+        while not srv._stop.wait(0.5):
+            pass
+    except KeyboardInterrupt:
+        pass
+    srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

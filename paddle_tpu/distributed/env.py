@@ -15,6 +15,14 @@ Env contract (reference role_maker.py:327 + launch.py):
                            missing PADDLE_TRAINER_ID/TRAINERS_NUM are
                            assigned by the service (atomic rank
                            counter + published world size).
+  PADDLE_COORD_WAL_DIR     makes the launcher-owned coordinator durable
+                           (WAL + snapshots): a coordinator kill+restart
+                           mid-bootstrap or mid-run resumes the rank
+                           map, barrier generations, and leases instead
+                           of stranding the gang.
+  PADDLE_COORD_GRACE_S     how long each bootstrap/worker client re-dials
+                           through a coordinator outage before surfacing
+                           ConnectionError (default 30).
   PADDLE_DIST_BACKEND      optional: "cpu" forces the virtual-CPU backend
                            with gloo cross-process collectives (the test
                            fake-cluster mode, SURVEY §4); unset = chips.

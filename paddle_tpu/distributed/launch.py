@@ -76,7 +76,7 @@ def _reserve_port_range(nproc, tries=10, extra=0):
 
 
 def _start_coord_server(node_ip, nproc, started_port, port_retries,
-                        token=None):
+                        token=None, wal_dir=None):
     """Bind + start the gang's CoordServer on the port just past the
     worker range (base+nproc). A lost bind race (another process took
     the port between the probe and the bind — the same TOCTOU shape as
@@ -92,7 +92,7 @@ def _start_coord_server(node_ip, nproc, started_port, port_retries,
         try:
             srv = _coordination.CoordServer(host=node_ip,
                                             port=base + int(nproc),
-                                            token=token)
+                                            token=token, wal_dir=wal_dir)
         except OSError:
             if started_port is not None or retry >= port_retries:
                 raise
@@ -280,8 +280,12 @@ def launch(nproc, cmd, node_ip="127.0.0.1", started_port=None, env=None,
     coord_base = None
     rdzv_is_tmp = False
     if rdzv_backend == "tcp":
+        # $PADDLE_COORD_WAL_DIR makes the gang's coordinator durable: a
+        # launcher restart (or a chaos kill) resumes leases, barrier
+        # generations, and the rank map instead of re-bootstrapping
         coord_srv, coord_base = _start_coord_server(
-            node_ip, int(nproc), started_port, port_retries)
+            node_ip, int(nproc), started_port, port_retries,
+            wal_dir=base_env.get(_coordination.ENV_WAL_DIR) or None)
         base_env[_coordination.ENV_ADDR] = coord_srv.endpoint
         base_env[_coordination.ENV_BACKEND] = "tcp"
         # stale PADDLE_RENDEZVOUS_DIR from an outer launcher must not

@@ -248,7 +248,7 @@ class FramedServer:
                 if tok != self.token:
                     send_all(conn, frame(b"\x01bad token"))
                     return
-                send_all(conn, frame(b"\x00"))
+                send_all(conn, frame(b"\x00" + self._hello_payload()))
                 conn.settimeout(None)
             except (ConnectionError, OSError, struct.error):
                 return
@@ -260,6 +260,15 @@ class FramedServer:
                 conn.close()
             except OSError:
                 pass
+
+    def _hello_payload(self):
+        """Extra bytes appended to the handshake OK frame (after the
+        ``\\x00`` status byte). Subclasses advertise instance identity
+        here — the coordination service packs its server epoch so a
+        reconnecting client can tell a restarted server from a healed
+        partition. Clients that predate the field only check byte 0 and
+        ignore the surplus, so extending it is wire-compatible."""
+        return b""
 
     def _serve_authenticated(self, conn):
         raise NotImplementedError
@@ -275,9 +284,12 @@ class Conn:
 
     The retry policy is the shared ``fluid.resilience.Retry`` (5
     attempts, 0.2s base, doubled per attempt) under the caller's
-    ``retry_name`` monitor site; ``fault_site`` (default: retry_name)
-    is checked through ``fluid.faults`` before every attempt so tests
-    can inject transport failures."""
+    ``retry_name`` monitor site; ``deadline`` switches it to a
+    time-budgeted reconnect loop instead (short capped delays retried
+    until the budget runs out — the coordination client's grace
+    window). ``fault_site`` (default: retry_name) is checked through
+    ``fluid.faults`` before every attempt so tests can inject
+    transport failures."""
 
     MAGIC = _DEFAULT_MAGIC
     TOKEN_ENV = "PADDLE_WIRE_TOKEN"
@@ -285,7 +297,8 @@ class Conn:
     BACKOFF = 0.2  # seconds, doubled per attempt
 
     def __init__(self, endpoint, token=None, retry_name="wire.rpc",
-                 fault_site=None, max_frame=None, connect_timeout=30):
+                 fault_site=None, max_frame=None, connect_timeout=30,
+                 deadline=None):
         host, port = endpoint.rsplit(":", 1)
         self._addr = (host, int(port))
         self._token = os.environ.get(self.TOKEN_ENV, "") \
@@ -295,9 +308,26 @@ class Conn:
         self._fault_site = fault_site or retry_name
         self._mu = threading.Lock()
         self._sock = None
+        # handshake-hello / reconnect bookkeeping (all mutated while a
+        # connect is in flight, i.e. under the request lock)
+        self._server_hello = None
+        self._connected_once = False
+        self._pending_reconnect = False
+        self._pending_ident_change = False
+        if deadline is None:
+            attempts, max_delay = self.RETRIES + 1, 30.0
+        else:
+            # deadline-bounded: enough attempts that the time budget —
+            # not the attempt count — is what runs out, with delays
+            # capped low so the client re-dials promptly once the
+            # server is back
+            attempts = 1000
+            max_delay = min(2.0, max(float(deadline) / 8.0, 0.05))
+        self._attempts = attempts
         self._retry = _resilience.Retry(
-            max_attempts=self.RETRIES + 1, base_delay=self.BACKOFF,
-            factor=2.0, max_delay=30.0, jitter=0.0,
+            max_attempts=attempts, base_delay=self.BACKOFF,
+            factor=2.0, max_delay=max_delay, deadline=deadline,
+            jitter=0.0,
             retryable=(OSError, ConnectionError,
                        _resilience.TransientError),
             name=retry_name)
@@ -306,6 +336,25 @@ class Conn:
     @property
     def endpoint(self):
         return "%s:%d" % self._addr
+
+    @property
+    def server_hello(self):
+        """The server's identity payload from the last successful
+        handshake (b"" from servers that predate the field)."""
+        return self._server_hello
+
+    def consume_reconnect(self):
+        """``(reconnected, identity_changed)`` since the last call,
+        clearing both flags — the handoff point for re-establishment
+        hooks (lease replay, trace re-probe), which callers run AFTER
+        their request completes, outside the request lock.
+        ``identity_changed`` distinguishes a replaced/restarted server
+        (hello payload differs) from a healed partition."""
+        with self._mu:
+            r, c = self._pending_reconnect, self._pending_ident_change
+            self._pending_reconnect = False
+            self._pending_ident_change = False
+        return r, c
 
     def _connect(self):
         sock = socket.create_connection(self._addr,
@@ -321,6 +370,13 @@ class Conn:
         except Exception:
             sock.close()
             raise
+        hello = resp[1:]
+        if self._connected_once:
+            self._pending_reconnect = True
+            if hello != self._server_hello:
+                self._pending_ident_change = True
+        self._server_hello = hello
+        self._connected_once = True
         self._sock = sock
 
     def _round_trip(self, payload):
@@ -345,13 +401,21 @@ class Conn:
             raise
 
     def request(self, payload):
+        if self._max_frame is not None and len(payload) > self._max_frame:
+            # refuse BEFORE the socket sees a byte: the server would
+            # drop the connection (an oversized frame cannot be
+            # resynchronized) and the retry layer would burn its whole
+            # budget re-sending a frame that can never fit
+            raise FrameTooLarge(
+                "request of %d bytes exceeds the %d-byte frame cap"
+                % (len(payload), self._max_frame))
         with self._mu:
             try:
                 resp = self._retry.call(self._round_trip, payload)
             except (OSError, ConnectionError) as e:
                 raise ConnectionError(
                     "server %s:%d unreachable after %d attempts: %r"
-                    % (self._addr + (self.RETRIES + 1, e)))
+                    % (self._addr + (self._attempts, e)))
         if not resp or resp[0] != 0:
             raise RuntimeError("server error: %s"
                                % resp[1:].decode("utf-8", "replace"))

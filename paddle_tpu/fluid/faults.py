@@ -46,6 +46,12 @@ POINTS = (
                        #   request round-trip
     "coord.rpc",       # distributed/coordination.CoordClient: before
                        #   each coordination-service round-trip
+    "coord.crash",     # distributed/coordination.CoordServer: taken in
+                       #   the serve loop — the server dies mid-request
+                       #   (crash(): no final snapshot, WAL-only state)
+    "coord.partition", # distributed/coordination._CoordConn: each armed
+                       #   hit fails one client attempt transiently — a
+                       #   network partition of exactly N attempts
     "worker.exit",     # training scripts call check() once per step;
                        #   fires os._exit(EXIT_CODE) — a hard crash
     "step.nonfinite",  # executor anomaly check: the step's results are

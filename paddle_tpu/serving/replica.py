@@ -210,6 +210,11 @@ class Replica:
             key = _p.replica_key(self.prefix, self.replica_id)
             self._coord.put(key, json.dumps(self.describe()))
             self._coord.start_lease_keeper(key, ttl=self._lease_ttl)
+            # coordinator restart/partition heal: the client replays the
+            # lease itself; this hook re-publishes the registration blob
+            # (a coordinator recovered WITHOUT a WAL comes back empty —
+            # the fleet must relearn itself)
+            self._coord.on_reconnect(self._reregister)
             self._publish_stats()
             self._stats_thread = threading.Thread(
                 target=self._stats_loop, daemon=True,
@@ -267,6 +272,19 @@ class Replica:
             with self._idle:
                 self._inflight -= 1
                 self._idle.notify_all()
+
+    def _reregister(self):
+        """Reconnect hook: re-publish registration + stats blobs. Keeps
+        serving throughout — the wire endpoint never depended on the
+        coordinator being up."""
+        if self._draining:
+            return
+        try:
+            self._coord.put(_p.replica_key(self.prefix, self.replica_id),
+                            json.dumps(self.describe()))
+        except (ConnectionError, RuntimeError):
+            return  # still flapping: the next reconnect fires again
+        self._publish_stats()
 
     # -- load reporting ------------------------------------------------------
     def _stats(self):
@@ -333,6 +351,10 @@ class Replica:
             self._stats_thread.join(timeout=2)
         _telemetry.pusher.stop_pusher("replica:%s" % self.replica_id)
         if self._coord is not None:
+            # deliberate deregistration: stop replaying the lease on
+            # any later reconnect before deleting the records
+            self._coord.forget_lease(
+                _p.replica_key(self.prefix, self.replica_id))
             try:
                 self._coord.delete(
                     _p.replica_key(self.prefix, self.replica_id))

@@ -31,6 +31,7 @@ path.
 """
 
 import json
+import os
 import threading
 import time
 
@@ -65,6 +66,11 @@ _M_REQUEUED = _monitor.counter(
          "re-dispatched to another (the kill-one-replica no-loss path)")
 _M_REPLICAS = _monitor.gauge(
     "fleet_replicas", help="replicas currently in the routing table")
+_M_STALE_ROUTED = _monitor.counter(
+    "fleet_stale_routing_total",
+    help="requests routed over the last-known replica set while the "
+         "coordination service was unreachable (degraded mode, inside "
+         "the grace window)")
 
 
 def _m_e2e(model):
@@ -129,13 +135,22 @@ class Router(_wire.FramedServer):
     MAGIC = _p.MAGIC_ROUTER
     TOKEN_ENV = _p.ENV_TOKEN
 
+    ENV_GRACE = "PADDLE_FLEET_GRACE_S"
+
     def __init__(self, coord_addr=None, prefix=None, host="127.0.0.1",
-                 port=0, token=None, refresh_interval=0.2):
+                 port=0, token=None, refresh_interval=0.2, grace=None):
         super().__init__(host=host, port=port, token=token, backlog=128)
         self.prefix = prefix or "fleet/"
+        # fail-fast coordination client (small grace): the STALE TABLE
+        # is this router's outage resilience — a refresh that blocked
+        # for the full coordinator grace window would be pure latency
         self._coord = _coordination.CoordClient(
-            coord_addr or _coordination.current_coord_addr())
+            coord_addr or _coordination.current_coord_addr(), grace=1.0)
         self._refresh_interval = float(refresh_interval)
+        if grace is None:
+            grace = float(os.environ.get(self.ENV_GRACE, "") or 10.0)
+        self._grace = float(grace)
+        self._stale_since = None      # monotonic ts of first failed refresh
         self._table = {}              # rid -> _Member
         self._table_mu = threading.Lock()
         self._rr = 0                  # round-robin tie-break cursor
@@ -155,12 +170,38 @@ class Router(_wire.FramedServer):
     def refresh(self):
         """One membership pull: live_members is the authority (expired
         leases already swept server-side); stats blobs update the
-        balancing inputs and the per-replica gauges."""
-        rep_prefix = self.prefix + "replicas/"
+        balancing inputs and the per-replica gauges. A coordinator
+        outage anywhere in the pull flips the table to STALE instead of
+        raising — see ``_refresh_failed``."""
         try:
-            keys = self._coord.live_members(rep_prefix)
+            self._refresh_once()
         except (ConnectionError, RuntimeError):
-            return            # coord briefly unreachable: keep last view
+            self._refresh_failed()
+        else:
+            with self._table_mu:
+                self._stale_since = None
+
+    def _refresh_failed(self):
+        """Coordinator unreachable: keep routing over the last-known
+        replica set (marked stale — every request routed counts in
+        ``fleet_stale_routing_total``) until the outage outlives the
+        grace window; past it the view is too old to trust, so the
+        table drops and requests shed typed ``no_replica``."""
+        now = time.monotonic()
+        with self._table_mu:
+            if self._stale_since is None:
+                self._stale_since = now
+                return
+            if now - self._stale_since <= self._grace:
+                return
+            for mem in self._table.values():
+                mem.gauges["inflight"].set(0.0)
+            self._table.clear()
+            _M_REPLICAS.set(0.0)
+
+    def _refresh_once(self):
+        rep_prefix = self.prefix + "replicas/"
+        keys = self._coord.live_members(rep_prefix)
         live = {}
         for key in keys:
             rid = key[len(rep_prefix):]
@@ -225,6 +266,8 @@ class Router(_wire.FramedServer):
             self._rr += 1
             mem.inflight += 1
             mem.gauges["inflight"].set(float(mem.inflight))
+            if self._stale_since is not None:
+                _M_STALE_ROUTED.inc()   # degraded mode: last-known view
             return mem
 
     def _release(self, mem):

@@ -13,10 +13,12 @@ The read side (``collect_metrics`` / ``collect_spans``) is what
 consume: snapshots of every LIVE publisher, parsed, junk skipped.
 """
 
+import collections
 import json
 import os
 import threading
 
+from ..distributed import wire as _wire
 from ..fluid import monitor as _monitor
 
 __all__ = ["ENV_PUSH_MS", "start_pusher", "stop_pusher",
@@ -25,6 +27,7 @@ __all__ = ["ENV_PUSH_MS", "start_pusher", "stop_pusher",
 ENV_PUSH_MS = "PADDLE_TELEMETRY_PUSH_MS"
 
 _SPAN_PUSH_LIMIT = 4096   # newest spans shipped per push (KV blobs stay small)
+_BACKLOG_LIMIT = 8        # span snapshots buffered across a coord outage
 
 _LOCK = threading.Lock()
 _PUSHERS = {}             # proc name -> (stop_event, thread, client)
@@ -35,6 +38,16 @@ _M_PUSHES = _monitor.counter(
 _M_PUSH_ERRORS = _monitor.counter(
     "telemetry_push_errors_total",
     help="snapshot publications lost to coordination-server errors")
+_M_PUSH_BUFFERED = _monitor.counter(
+    "telemetry_push_buffered_total",
+    help="span snapshots buffered locally while the coordination "
+         "service was unreachable (bounded; flushed with the next "
+         "successful push)")
+_M_PUSH_OVERSIZE = _monitor.counter(
+    "telemetry_push_oversize_total",
+    help="snapshot publications dropped because the blob exceeded the "
+         "coordination frame cap (refused client-side, the connection "
+         "stays usable)")
 
 
 def _client(coord_addr, token=None):
@@ -46,18 +59,37 @@ def _client(coord_addr, token=None):
 
 
 def push_once(client, proc, prefix="telemetry/", ttl=10.0,
-              span_limit=_SPAN_PUSH_LIMIT):
+              span_limit=_SPAN_PUSH_LIMIT, backlog=None):
     """One publication: metrics snapshot + span-ring tail, both leased.
     Raises on transport errors (the loop counts and retries; one-shot
-    callers want to see the failure)."""
+    callers want to see the failure). ``backlog`` is the pusher loop's
+    bounded deque of span snapshots captured during a coordination
+    outage — they are prepended to this push and cleared on success, so
+    spans that rotated out of the ring while the coordinator was down
+    still reach the fleet view."""
     from . import spans as _spans
 
     mkey = prefix + "metrics/" + proc
     skey = prefix + "spans/" + proc
+    span_tail = _spans.snapshot(limit=span_limit)
+    if backlog:
+        merged, seen = [], set()
+        for batch in list(backlog) + [span_tail]:
+            for rec in batch:
+                sid = (rec.get("trace_id"), rec.get("span_id")) \
+                    if isinstance(rec, dict) else None
+                if sid is not None and sid in seen:
+                    continue      # buffered batches overlap the ring tail
+                if sid is not None:
+                    seen.add(sid)
+                merged.append(rec)
+        span_tail = merged[-span_limit:]
     client.put(mkey, json.dumps(_monitor.snapshot(proc=proc)))
-    client.put(skey, json.dumps(_spans.snapshot(limit=span_limit)))
+    client.put(skey, json.dumps(span_tail))
     client.lease(mkey, ttl=ttl)
     client.lease(skey, ttl=ttl)
+    if backlog:
+        backlog.clear()
     _M_PUSHES.inc()
 
 
@@ -75,17 +107,32 @@ def start_pusher(coord_addr, proc, interval=None, prefix="telemetry/",
             return proc
         client, owned = _client(coord_addr, token=token)
         stop_ev = threading.Event()
+        # outage buffer: bounded span snapshots (metrics are cumulative
+        # — the latest snapshot supersedes the missed ones for free)
+        backlog = collections.deque(maxlen=_BACKLOG_LIMIT)
+
+        def _push(track_backlog):
+            from . import spans as _spans
+
+            try:
+                push_once(client, proc, prefix=prefix, ttl=ttl,
+                          backlog=backlog)
+            except _wire.FrameTooLarge:
+                # the blob can never fit: refused client-side before a
+                # byte hit the socket, so the connection is NOT wedged —
+                # count, drop, keep pushing the next (smaller) snapshot
+                _M_PUSH_OVERSIZE.inc()
+                backlog.clear()
+            except (ConnectionError, RuntimeError, OSError):
+                _M_PUSH_ERRORS.inc()  # server down/restarting: retry
+                if track_backlog:
+                    backlog.append(_spans.snapshot(limit=_SPAN_PUSH_LIMIT))
+                    _M_PUSH_BUFFERED.inc()
 
         def _loop():
             while not stop_ev.wait(interval):
-                try:
-                    push_once(client, proc, prefix=prefix, ttl=ttl)
-                except (ConnectionError, RuntimeError, OSError):
-                    _M_PUSH_ERRORS.inc()  # server down/restarting: retry
-        try:
-            push_once(client, proc, prefix=prefix, ttl=ttl)
-        except (ConnectionError, RuntimeError, OSError):
-            _M_PUSH_ERRORS.inc()
+                _push(track_backlog=True)
+        _push(track_backlog=False)
         t = threading.Thread(target=_loop, daemon=True,
                              name="telemetry-push-%s" % proc)
         _PUSHERS[proc] = (stop_ev, t, client if owned else None)

@@ -72,6 +72,10 @@ def pytest_configure(config):
         "markers", "telemetry: exercises the fleet telemetry plane "
                    "(distributed tracing, cross-process metrics "
                    "aggregation, crash flight recorder)")
+    config.addinivalue_line(
+        "markers", "chaos: kills and restarts the coordination "
+                   "service mid-run (WAL recovery, reconnecting "
+                   "clients, degraded-mode fleet routing)")
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,89 @@
+"""Chaos gang runner: a 2-process data-parallel training gang whose
+per-step lockstep goes through the DURABLE coordination service — a
+generation-numbered barrier every step plus a held lease — while the
+parent test SIGKILLs the coordinator mid-run and restarts it on the
+same port against the same WAL dir. The gang must ride the outage
+(reconnecting clients, journaled barrier state) and finish with
+bit-identical weights on every rank.
+
+Prints one ``STEP i gen g`` line per step, then ``EPOCH n`` (the
+server incarnation the client ended on — proves the restart happened
+under this run) and ``WDIGEST <sha256>`` of the final weights.
+
+Run with PADDLE_COORD_ADDR pointing at a durable standalone
+coordinator and PADDLE_TRAINER_ID/PADDLE_TRAINERS_NUM set.
+"""
+
+import hashlib
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+assert os.environ.get("PADDLE_COORD_ADDR"), \
+    "runner requires a TCP coordination service (PADDLE_COORD_ADDR)"
+
+from paddle_tpu.distributed import env as dist_env  # noqa: E402
+
+rank, world = dist_env.init_parallel_env(ndev_per_proc=1)
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu.fluid as fluid  # noqa: E402
+from paddle_tpu.distributed import coordination  # noqa: E402
+from paddle_tpu.fluid import layers, optimizer  # noqa: E402
+
+STEPS = 8
+
+
+def build(seed=17):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[8], dtype="float32")
+        label = layers.data("label", shape=[1], dtype="int64")
+        h = layers.fc(x, size=16, act="relu",
+                      param_attr=fluid.ParamAttr(name="cg_w1"))
+        logits = layers.fc(h, size=4,
+                           param_attr=fluid.ParamAttr(name="cg_w2"))
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+        optimizer.SGD(0.1).minimize(loss)
+    return main, startup, loss
+
+
+def main():
+    cli = coordination.CoordClient(
+        coordination.current_coord_addr(), grace=240.0)
+    cid = "gang/r%d" % rank
+    cli.start_lease_keeper(cid, ttl=5.0, interval=0.5)
+    main_p, startup, loss = build()
+    compiled = fluid.CompiledProgram(main_p).with_data_parallel(
+        loss_name=loss.name)
+    exe = fluid.Executor()
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(16, 8).astype(np.float32),
+            "label": rng.randint(0, 4, (16, 1)).astype(np.int64)}
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for i in range(STEPS):
+            (lv,) = exe.run(compiled, feed=feed, fetch_list=[loss])
+            assert np.isfinite(np.asarray(lv)).all()
+            # paced so the parent's kill window reliably lands mid-run
+            time.sleep(0.4)
+            gen = cli.barrier("chaos/step%d" % i, world,
+                              "r%d" % rank, timeout=300.0)
+            print("STEP %d gen %d" % (i, gen), flush=True)
+        w = np.asarray(exe.run(compiled, feed=feed,
+                               fetch_list=["cg_w1"])[0])
+    # the keeper's lease survived the restart (replayed on reconnect)
+    assert cid in cli.live(), cli.live()
+    print("EPOCH %d" % cli.server_epoch, flush=True)
+    print("WDIGEST %s"
+          % hashlib.sha256(np.ascontiguousarray(w).tobytes()).hexdigest(),
+          flush=True)
+    cli.close()
+
+
+if __name__ == "__main__":
+    main()

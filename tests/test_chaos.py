@@ -1,0 +1,255 @@
+"""Chaos: the coordination service dies (kill -9, no drain, no final
+snapshot) and comes back on the same port + WAL dir while the systems
+built on top keep running — the serving fleet in-process, and (slow) a
+2-process training gang whose lockstep barriers ride the outage."""
+
+import os
+import re
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers, monitor
+from paddle_tpu.distributed.coordination import CoordClient, CoordServer
+from paddle_tpu.serving import FleetClient, Replica, Router
+
+pytestmark = pytest.mark.chaos
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RUNNER = os.path.join(REPO, "tests", "dist_runner_chaos.py")
+
+
+def _restart(port, wal_dir):
+    deadline = time.time() + 10
+    while True:
+        try:
+            return CoordServer(port=port, wal_dir=wal_dir).start()
+        except OSError:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.1)
+
+
+# -- in-process fleet -------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("chaos_model")
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 33
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[6], dtype="float32")
+        h = layers.fc(x, size=16, act="relu")
+        prob = layers.softmax(layers.fc(h, size=3))
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_inference_model(str(d), ["x"], [prob], exe,
+                                      main_program=main)
+    return str(d)
+
+
+def _spec(model_dir):
+    return {"prefix": "fleet/",
+            "models": [{"name": "fc", "model_dir": model_dir,
+                        "warmup": {"x": {"shape": [1, 6],
+                                         "dtype": "float32"}},
+                        "config": {"max_batch_size": 8,
+                                   "max_queue_delay_ms": 2.0}}]}
+
+
+def test_fleet_rides_out_coordinator_crash(model_dir, tmp_path):
+    """Acceptance: coordinator kill -9 + same-WAL restart under a
+    2-replica fleet. The data path never touches the coordinator, so
+    EVERY request is served (100% accounted, zero shed): healthy,
+    degraded (stale routing table, ``fleet_stale_routing_total``
+    grows), and recovered phases all included. The restarted server
+    replays replica leases from its WAL at a bumped epoch and the
+    router's refresh goes fresh again."""
+    wal = str(tmp_path / "wal")
+    srv = CoordServer(wal_dir=wal).start()
+    addr, port, epoch0 = srv.endpoint, srv.port, srv.epoch
+    dbg = CoordClient(addr, grace=10.0)
+    reps, router, cli = [], None, None
+    try:
+        reps = [Replica(_spec(model_dir), coord_addr=addr,
+                        replica_id="cx%d" % i, lease_ttl=5.0,
+                        stats_interval=0.05).start()
+                for i in range(2)]
+        deadline = time.time() + 120
+        while len(dbg.live_members("fleet/replicas/")) < 2:
+            assert time.time() < deadline, "replicas never registered"
+            time.sleep(0.05)
+        router = Router(coord_addr=addr, refresh_interval=0.05).start()
+        cli = FleetClient("%s:%d" % (router.host, router.port))
+        rng = np.random.RandomState(3)
+        shed0 = monitor.sum_labeled("fleet_shed_total")
+        stale0 = monitor.counter("fleet_stale_routing_total").value
+
+        def burst(n):
+            for _ in range(n):
+                x = rng.rand(rng.randint(1, 5), 6).astype(np.float32)
+                out = cli.submit("fc", {"x": x}, deadline_ms=10000)
+                assert out[0].shape == (x.shape[0], 3)
+
+        burst(6)                       # healthy
+        srv.crash()
+        deadline = time.time() + 30    # detection = router's fail-fast
+        while True:                    # coordination client (~1 s)
+            with router._table_mu:
+                stale = router._stale_since is not None
+            if stale:
+                break
+            assert time.time() < deadline, "router never marked stale"
+            time.sleep(0.05)
+        burst(6)                       # degraded: last-known table
+        assert monitor.counter(
+            "fleet_stale_routing_total").value > stale0
+        srv = _restart(port, wal)
+        assert srv.epoch == epoch0 + 1
+        deadline = time.time() + 60
+        while True:
+            with router._table_mu:
+                fresh = router._stale_since is None \
+                    and len(router._table) == 2
+            if fresh:
+                break
+            assert time.time() < deadline, "router never recovered"
+            time.sleep(0.05)
+        burst(6)                       # recovered
+        # 18/18 served above; shed-by-reason totals unchanged — the
+        # outage never cost a request, typed or otherwise
+        assert monitor.sum_labeled("fleet_shed_total") == shed0
+    finally:
+        if cli is not None:
+            cli.close()
+        if router is not None:
+            router.close()
+        for r in reps:
+            r.drain(timeout=10)
+        dbg.close()
+        srv.stop()
+
+
+def test_fleet_sheds_typed_after_grace_expires(model_dir, tmp_path):
+    """Past the degraded-mode grace window the stale view is too old to
+    trust: the table drops and requests shed typed ``no_replica`` —
+    never an untyped error, never a hang."""
+    from paddle_tpu import inference
+
+    wal = str(tmp_path / "wal")
+    srv = CoordServer(wal_dir=wal).start()
+    addr = srv.endpoint
+    dbg = CoordClient(addr, grace=10.0)
+    reps, router, cli = [], None, None
+    try:
+        reps = [Replica(_spec(model_dir), coord_addr=addr,
+                        replica_id="gx0", lease_ttl=5.0,
+                        stats_interval=0.05).start()]
+        deadline = time.time() + 120
+        while len(dbg.live_members("fleet/replicas/")) < 1:
+            assert time.time() < deadline, "replica never registered"
+            time.sleep(0.05)
+        # grace=0: the first failed refresh already exceeds the window
+        router = Router(coord_addr=addr, refresh_interval=0.05,
+                        grace=0.0).start()
+        cli = FleetClient("%s:%d" % (router.host, router.port))
+        x = np.ones((1, 6), np.float32)
+        assert cli.submit("fc", {"x": x}, deadline_ms=10000)[0].shape \
+            == (1, 3)
+        srv.crash()
+        deadline = time.time() + 30
+        while router.members():
+            assert time.time() < deadline, "stale table never dropped"
+            time.sleep(0.05)
+        with pytest.raises(inference.Overloaded):
+            cli.submit("fc", {"x": x}, deadline_ms=500)
+    finally:
+        if cli is not None:
+            cli.close()
+        if router is not None:
+            router.close()
+        # coordinator stays dead: deregistration RPCs can't land, so
+        # tear the replicas down hard instead of drain()
+        for r in reps:
+            r.stop()
+        dbg.close()
+        srv.stop()
+
+
+# -- 2-process training gang (slow) -----------------------------------------
+
+def _worker_env(rank, addr):
+    env = dict(os.environ)
+    for k in ("XLA_FLAGS", "JAX_PLATFORMS", "PADDLE_RENDEZVOUS_DIR"):
+        env.pop(k, None)
+    env.update({"PADDLE_COORD_ADDR": addr,
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_TRAINERS_NUM": "2",
+                "PADDLE_DIST_BACKEND": "cpu",
+                "PADDLE_COORD_GRACE_S": "240"})
+    return env
+
+
+def _read(paths):
+    out = ""
+    for i, p in enumerate(paths):
+        with open(p) as f:
+            out += "--- worker %d ---\n%s\n" % (i, f.read())
+    return out
+
+
+@pytest.mark.slow
+def test_gang_training_survives_coordinator_kill9(tmp_path):
+    """Acceptance: SIGKILL the standalone durable coordinator mid-run,
+    restart it on the same port + WAL dir — the 2-process gang's
+    barriers and leases resume (journaled arrivals, reconnecting
+    clients) and both ranks finish with BIT-IDENTICAL weights."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import chaos
+    finally:
+        sys.path.pop(0)
+
+    wal = str(tmp_path / "wal")
+    proc, addr, port, epoch0 = chaos._spawn(wal)
+    paths = [str(tmp_path / ("worker.%d.log" % r)) for r in range(2)]
+    workers = []
+    try:
+        for r in range(2):
+            f = open(paths[r], "w")
+            try:
+                workers.append(subprocess.Popen(
+                    [sys.executable, RUNNER], env=_worker_env(r, addr),
+                    cwd=REPO, stdout=f, stderr=subprocess.STDOUT))
+            finally:
+                f.close()
+        deadline = time.time() + 300
+        while not all("STEP 1 " in open(p).read() for p in paths):
+            assert all(w.poll() is None for w in workers), _read(paths)
+            assert time.time() < deadline, _read(paths)
+            time.sleep(0.2)
+        chaos._kill9(proc)
+        time.sleep(1.0)                # a real outage, not a blip
+        proc, _, _, epoch1 = chaos._spawn(wal, port=port)
+        assert epoch1 == epoch0 + 1
+        for w in workers:
+            assert w.wait(timeout=600) == 0, _read(paths)
+        text = _read(paths)
+        assert text.count("STEP 7 ") == 2, text       # every step ran
+        digests = re.findall(r"WDIGEST (\S+)", text)
+        assert len(digests) == 2, text
+        assert digests[0] == digests[1], text         # bit-identical
+        epochs = [int(e) for e in re.findall(r"EPOCH (\d+)", text)]
+        assert epochs == [epoch1, epoch1], text       # rode the restart
+    finally:
+        for w in workers:
+            if w.poll() is None:
+                w.kill()
+                w.wait()
+        chaos._kill9(proc)

@@ -286,6 +286,33 @@ def test_lint_catches_violations(tmp_path):
     assert len(check_resilience.check_file(str(sneaky))) == 1
 
 
+def test_lint_wal_discipline(tmp_path):
+    """Every mutating CoordServer._do_* handler must journal to the
+    WAL; read-only ones must say so on their def line."""
+    sys.path.insert(0, os.path.join(_repo_root(), "tools"))
+    try:
+        import check_resilience
+    finally:
+        sys.path.pop(0)
+    src = (
+        "class CoordServer:\n"
+        "    def _do_put(self, k, v):\n"
+        "        self._journal({'o': 'put'})\n"
+        "    def _do_list(self, p):  # wal: read-only (enumeration)\n"
+        "        return []\n"
+        "    def _do_sneaky(self, k):\n"
+        "        return 1\n"
+        "class Other:\n"
+        "    def _do_elsewhere(self):\n"
+        "        return 2\n")
+    viol = check_resilience._wal_violations(src)
+    assert len(viol) == 1 and "_do_sneaky" in viol[0][1], viol
+    # the rule only fires on the coordination module itself
+    other = tmp_path / "not_coordination.py"
+    other.write_text(src)
+    assert check_resilience.check_file(str(other)) == []
+
+
 # -- background-exception surfacing contracts -------------------------------
 # The runtime's four long-lived catch-all sites must deliver the
 # ORIGINAL exception to the consumer, not swallow it.

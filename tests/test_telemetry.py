@@ -410,6 +410,50 @@ def test_coord_client_downgrades_against_old_server():
         srv.stop()
 
 
+def test_coord_client_reprobes_trace_after_server_restart():
+    """The _TRACED downgrade must not outlive the server that caused
+    it: when the client reconnects (old server replaced by a modern
+    build on the same endpoint), it re-probes the envelope and traces
+    flow again."""
+    from paddle_tpu.distributed import coordination as dcoord
+
+    class _OldServer(CoordServer):
+        def _handle(self, req):
+            if req and req[0] == dcoord._TRACED:  # trace: simulating a peer too old to know the envelope
+                return b"\x01decode error: unknown opcode 13"
+            return CoordServer._handle(self, req)
+
+    telemetry.enable()
+    srv = _OldServer().start()
+    port = srv.port
+    cli = CoordClient("%s:%d" % (srv.host, srv.port), grace=30.0)
+    try:
+        with telemetry.span("op"):
+            cli.put("k", b"v")
+        assert cli._trace_ok is False      # downgraded, stays down...
+        srv.crash()
+        deadline = time.time() + 10
+        while True:                        # modern build, same endpoint
+            try:
+                srv = CoordServer(port=port).start()
+                break
+            except OSError:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.1)
+        with telemetry.span("op2") as sp:
+            cli.put("k", b"v2")            # rides the reconnect (sent
+            #                                unwrapped); probe re-arms
+            cli.put("k", b"v3")            # ...and this one re-probes
+            tid = sp.ctx.trace_id
+        assert cli._trace_ok is not False  # downgrade forgotten
+        assert [r for r in telemetry.trace_spans(tid)
+                if r["name"] == "coord.rpc"]
+    finally:
+        cli.close()
+        srv.stop()
+
+
 # -- metrics aggregation ----------------------------------------------------
 
 
@@ -496,6 +540,35 @@ def test_pusher_publishes_leased_snapshots_to_the_kv():
         snaps = pusher.collect_metrics(addr)
         assert {s["proc"] for s in snaps} == {"p1"}
     finally:
+        cli.close()
+        srv.stop()
+
+
+def test_pusher_oversized_snapshot_counted_and_dropped():
+    """A snapshot bigger than the frame cap is refused CLIENT-side
+    (FrameTooLarge before a byte hits the socket): the one-shot caller
+    sees the raise, the pusher loop counts+drops it without touching
+    the error counter, and the connection is NOT wedged — the same
+    client keeps serving normal-sized requests."""
+    srv = CoordServer().start()
+    addr = "%s:%d" % (srv.host, srv.port)
+    # tiny cap: the global monitor registry's JSON blob cannot fit
+    cli = CoordClient(addr, grace=5.0, max_frame=512)
+    over0 = monitor.counter("telemetry_push_oversize_total").value
+    errs0 = monitor.counter("telemetry_push_errors_total").value
+    try:
+        with pytest.raises(dwire.FrameTooLarge):
+            pusher.push_once(cli, "pbig", ttl=30.0)
+        # the loop path: counted as oversize, NOT as a transport error
+        pusher.start_pusher(cli, "pbig", interval=60.0)
+        assert monitor.counter(
+            "telemetry_push_oversize_total").value >= over0 + 1
+        assert monitor.counter(
+            "telemetry_push_errors_total").value == errs0
+        cli.put("k", b"small")          # connection still usable
+        assert cli.get("k") == b"small"
+    finally:
+        pusher.stop_pusher()
         cli.close()
         srv.stop()
 

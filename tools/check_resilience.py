@@ -84,6 +84,15 @@ _TRACE_FILES = ("paddle_tpu/serving/", "paddle_tpu/distributed/"
                 "coordination.py")
 _TRACE_OP_RE = re.compile(r"^OP_[A-Z_0-9]+$")
 
+# durable-coordination discipline: every coordination-service request
+# handler (``CoordServer._do_*``) either journals its effect to the
+# WAL (``self._journal(...)``) BEFORE the ack is sent — a crash
+# between ack and disk would otherwise silently rewind acknowledged
+# state on recovery — or declares itself read-only with a trailing
+# ``# wal: ...`` justification on its ``def`` line.
+_WAL_FILE = "paddle_tpu/distributed/coordination.py"
+_WAL_CLASS = "CoordServer"
+
 
 def _line_has_justification(line):
     """True when the except line carries a real trailing comment
@@ -225,6 +234,42 @@ def _trace_violations(source):
     return out
 
 
+def _wal_violations(source):
+    """(lineno, line) for ``CoordServer._do_*`` handlers that neither
+    call ``self._journal(...)`` anywhere in their body nor carry a
+    ``# wal:`` read-only justification on the ``def`` line. A new
+    mutating opcode is linted the moment its handler is written."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return []
+    lines = source.splitlines()
+    out = []
+    for cls in tree.body:
+        if not isinstance(cls, ast.ClassDef) or cls.name != _WAL_CLASS:
+            continue
+        for fn in cls.body:
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)) \
+                    or not fn.name.startswith("_do_"):
+                continue
+            journals = any(
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "_journal"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "self"
+                for node in ast.walk(fn))
+            if journals:
+                continue
+            line = lines[fn.lineno - 1] if fn.lineno <= len(lines) \
+                else ""
+            if "# wal:" in line and _line_has_justification(line):
+                continue
+            out.append((fn.lineno, line.strip()))
+    return out
+
+
 def check_file(path):
     """Violations in one file: list of (lineno, line)."""
     out = []
@@ -245,6 +290,8 @@ def check_file(path):
         out.extend(_call_violations(source, _SOCKET_CALLS))
     if any(pat in norm for pat in _TRACE_FILES):
         out.extend(_trace_violations(source))
+    if norm.endswith(_WAL_FILE):
+        out.extend(_wal_violations(source))
     return sorted(set(out))  # nested fns can report a site twice
 
 
@@ -276,7 +323,9 @@ def main(argv=None):
               ".xc cache entry opened outside fluid/compile_cache, "
               "a raw socket.socket/socket.create_connection outside "
               "distributed/wire, or an opcode handler in "
-              "serving/coordination that drops the trace header — "
+              "serving/coordination that drops the trace header, or a "
+              "mutating CoordServer._do_ handler that skips the WAL "
+              "journal — "
               "add a trailing comment explaining why the site is safe, "
               "narrow the exception, or route the access through the "
               "sanctioned module" % len(violations))
