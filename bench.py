@@ -382,6 +382,186 @@ def bench_longseq(batch_size=8, seq_len=2048, warmup=3, iters=10,
             prefix + "_seq_len": seq_len}
 
 
+def bench_longctx(shard_counts=(1, 2, 4, 8), budget_mb=64, warmup=2,
+                  iters=5):
+    """Sequence-parallel long-context tier (opt-in BENCH_LONGCTX=1):
+    ring/Ulysses attention over the 'sp' mesh axis
+    (kernels/attention.py sequence_parallel_attention).
+
+    Four measurements back the tier's claims:
+    1. max trainable S under a fixed per-device activation budget, per
+       shard count — per-device ring memory is O(S/n) (each device holds
+       its q chunk plus one rotating KV chunk), so max S must rise
+       STRICTLY with the shard count (asserted). Sized with the static
+       liveness estimator (utils/liveness.py) over the fwd+bwd jaxpr of
+       one device's chunk-vs-chunk attention step.
+    2. attention tokens/sec at fixed global S over 1->8 shards (actual
+       shard_map dispatch; on CPU forwarding the virtual devices share
+       cores, so the curve is layout overhead, not speedup — on a real
+       ICI ring it is the scaling curve).
+    3. recompute (RecomputeOptimizer over the transformer's per-block
+       checkpoint vars): peak live bytes with vs without at fixed S —
+       must drop — with the loss trajectory unchanged (asserted).
+    4. sequence-sharded decode: seq_shards=4 session vs unsharded —
+       token streams must be identical (asserted).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import dygraph, layers, optimizer
+    from paddle_tpu.kernels.attention import sequence_parallel_attention
+    from paddle_tpu.models import transformer
+    from paddle_tpu.utils import liveness
+
+    H, D, B = 4, 64, 1
+    budget = budget_mb * 2 ** 20
+    out = {"longctx_budget_mb": budget_mb}
+
+    # -- 1. max trainable S per shard count (liveness-sized) ------------
+    def chunk_peak_bytes(s_local):
+        """fwd+bwd peak of ONE device's per-hop chunk attention — the
+        memory that actually bounds S on a fixed-HBM device."""
+        q = jnp.zeros((B, s_local, H * D), jnp.float32)
+
+        def step(q, k, v):
+            o = sequence_parallel_attention(q, k, v, H, mesh=None,
+                                            causal=True)
+            return jnp.sum(o * o)
+
+        closed = jax.make_jaxpr(jax.grad(step, argnums=(0, 1, 2)))(q, q, q)
+        return liveness.peak_live_bytes(closed)
+
+    max_s = {}
+    for n in shard_counts:
+        s = 256
+        while chunk_peak_bytes(2 * s // n) <= budget and s < 2 ** 20:
+            s *= 2
+        max_s[n] = s
+        out["longctx_max_trainable_s_%dshard" % n] = s
+    ordered = [max_s[n] for n in sorted(shard_counts)]
+    assert all(a < b for a, b in zip(ordered, ordered[1:])), (
+        "max trainable S not strictly increasing with shard count: %r"
+        % max_s)
+
+    # -- 2. tokens/sec at fixed global S over the shard ladder ----------
+    S_fix = 2048
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, S_fix, H * D).astype(np.float32) * 0.5)
+    for n in shard_counts:
+        mesh = Mesh(np.array(jax.devices()[:n]).reshape(1, n),
+                    ("dp", "sp"))
+
+        def step(q, k, v, mesh=mesh, n=n):
+            o = sequence_parallel_attention(
+                q, k, v, H, mesh=mesh if n > 1 else None, causal=True,
+                strategy="ring" if n > 1 else "auto")
+            return jnp.sum(o * o)
+
+        g = jax.jit(jax.grad(step, argnums=(0, 1, 2)))
+        for _ in range(warmup):
+            jax.block_until_ready(g(q, q, q))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            jax.block_until_ready(g(q, q, q))
+        dt = (time.perf_counter() - t0) / iters
+        out["longctx_attn_tokens_per_sec_%dshard" % n] = \
+            round(B * S_fix / dt, 1)
+    out["longctx_attn_seq_len"] = S_fix
+
+    # -- 3. recompute: lower peak, unchanged losses ---------------------
+    V, Bm, Sm = 64, 4, 64
+
+    def trace_tiny():
+        with dygraph.guard():
+            model = transformer.Transformer(
+                V, V, d_model=32, n_heads=4, d_inner=64, n_layers=2,
+                max_len=Sm, dropout_rate=0.0, seq_parallel=True,
+                attn_strategy="ring")
+            prng = np.random.RandomState(7)
+            for _, p in model.named_parameters():
+                p.set_value(prng.uniform(-0.1, 0.1,
+                                         p.shape).astype(np.float32))
+            src, tgt, labels, pos = transformer.synthetic_batch(
+                V, V, Bm, Sm)
+            bias = transformer.make_causal_bias(Sm)
+            args = [dygraph.to_variable(x)
+                    for x in (src, tgt, pos, pos, bias)]
+            _, tl = dygraph.jit.trace(model, args)
+        return model, tl, (src, tgt, pos, bias, labels)
+
+    def train(model, tl, data, recompute):
+        src, tgt, pos, bias, labels = data
+        startup = fluid.Program()
+        with fluid.program_guard(tl.program, startup):
+            logits = tl.program.global_block().var(tl._fetch_names[0])
+            label = layers.data("lc_label", [Sm, 1], dtype="int64")
+            ce = layers.softmax_with_cross_entropy(
+                layers.reshape(logits, [-1, V]),
+                layers.reshape(label, [-1, 1]))
+            loss = layers.mean(ce)
+            opt = optimizer.SGD(learning_rate=0.1)
+            if recompute:
+                opt = optimizer.RecomputeOptimizer(opt)
+                opt._set_checkpoints(model.checkpoint_vars(tl.program))
+            opt.minimize(loss)
+        tl._materialize_scope()
+        exe = fluid.Executor()
+        feed = dict(zip(tl._feed_names, (src, tgt, pos, pos, bias)))
+        feed["lc_label"] = labels
+        losses = []
+        with fluid.scope_guard(tl._scope):
+            exe.run(startup)
+            for _ in range(3):
+                (lv,) = exe.run(tl.program, feed=feed, fetch_list=[loss])
+                losses.append(float(np.asarray(lv)))
+        return losses, tl, feed, loss.name
+
+    m0, tl0, data = trace_tiny()
+    base, tl0, feed0, l0 = train(m0, tl0, data, False)
+    m1, tl1, _ = trace_tiny()
+    rec, tl1, feed1, l1 = train(m1, tl1, data, True)
+    assert max(abs(a - b) for a, b in zip(base, rec)) < 1e-5, (
+        "recompute changed the loss trajectory: %r vs %r" % (base, rec))
+    p0 = liveness.program_peak_bytes(tl0.program, feed0, tl0._scope, [l0])
+    p1 = liveness.program_peak_bytes(tl1.program, feed1, tl1._scope, [l1])
+    assert p1 < p0, "recompute did not lower peak: %d >= %d" % (p1, p0)
+    out["longctx_peak_live_mb"] = round(p0 / 2 ** 20, 3)
+    out["longctx_peak_live_recompute_mb"] = round(p1 / 2 ** 20, 3)
+    out["longctx_recompute_saving_pct"] = round(100 * (1 - p1 / p0), 1)
+
+    # -- 4. sequence-sharded decode identity ----------------------------
+    SRC, PROMPT, CAP = 16, 8, 16
+    rng = np.random.RandomState(3)
+    src = rng.randint(2, V, (2, SRC)).astype(np.int64)
+    prompt = rng.randint(2, V, (2, PROMPT)).astype(np.int64)
+    plens = np.array([PROMPT, PROMPT - 2], np.int64)
+
+    def gen(seq_shards):
+        with dygraph.guard():
+            model = transformer.Transformer.tiny(V, V)
+            prng = np.random.RandomState(11)
+            for _, p in model.named_parameters():
+                p.set_value(prng.uniform(-0.3, 0.3,
+                                         p.shape).astype(np.float32))
+            sess = transformer.build_decode_session(
+                model, 2, SRC, PROMPT, CAP, end_id=1,
+                seq_shards=seq_shards)
+        t0 = time.perf_counter()
+        toks, _ = sess.generate(src, prompt, plens, 12)
+        return toks, time.perf_counter() - t0
+
+    toks1, t1 = gen(1)
+    toks4, t4 = gen(4)
+    assert np.array_equal(toks1, toks4), (
+        "sequence-sharded decode diverged from the unsharded session")
+    out["longctx_decode_identical"] = True
+    out["longctx_decode_unsharded_s"] = round(t1, 3)
+    out["longctx_decode_4shard_s"] = round(t4, 3)
+    return out
+
+
 def bench_multihost(warmup=3, iters=10, grad_mb=4):
     """Hierarchical-DP scaling curve (opt-in BENCH_MULTIHOST=1, the
     MULTICHIP_r06 shape): simulate H hosts x D devices over the local
@@ -716,9 +896,13 @@ def bench_transformer_decode(batch_sizes=(1, 64), src_len=128,
             t0 = time.perf_counter()
             sess.generate(src, prompt, plens, 1)  # prefill + argmax only
             t_prefill = time.perf_counter() - t0
+            dec_hist = monitor.get_metric("decode_step_seconds")
+            disp0 = dec_hist.sum if dec_hist is not None else 0.0
             t0 = time.perf_counter()
             toks, _ = sess.generate(src, prompt, plens, new_tokens)
             t_full = time.perf_counter() - t0
+            dec_hist = monitor.get_metric("decode_step_seconds")
+            disp1 = dec_hist.sum if dec_hist is not None else 0.0
             t0 = time.perf_counter()
             toks2, _ = sess.generate(src, prompt, plens, 2 * new_tokens)
             t_full2 = time.perf_counter() - t0
@@ -740,6 +924,17 @@ def bench_transformer_decode(batch_sizes=(1, 64), src_len=128,
         out["transformer_decode_step_ms" + tag] = \
             round(step_s * B * 1e3, 3)
         out["transformer_decode_compile_misses" + tag] = m1 - m0
+        # per-phase breakdown (PROFILE_r06 debt): where a full generation
+        # spends its wall clock. Decode dispatch is async, so the device
+        # sync cost pools at the host boundary — the final token
+        # materialization — not in the per-step dispatch times.
+        dispatch_s = max(0.0, disp1 - disp0)
+        out["transformer_decode_phases" + tag] = {
+            "prefill_ms": round(t_prefill * 1e3, 3),
+            "decode_dispatch_ms": round(dispatch_s * 1e3, 3),
+            "host_boundary_ms": round(
+                max(0.0, t_full - t_prefill - dispatch_s) * 1e3, 3),
+        }
     # headline: the throughput-oriented batch (the last one)
     out["transformer_decode_tokens_per_sec"] = \
         out["transformer_decode_tokens_per_sec_batch%d" % batch_sizes[-1]]
@@ -1440,6 +1635,12 @@ def monitor_summary():
         if dec_cache is not None else 0.0,
         "decode_step_seconds_sum": round(dec_hist.sum, 3)
         if dec_hist is not None else 0.0,
+        # long-context tier: ring hop count climbs once per traced ring
+        # pass (n_shards - 1 each); the gauge holds the last traced
+        # sequence-shard count
+        "attn_ring_hops_total":
+            monitor.counter("attn_ring_hops_total").value,
+        "attn_seq_shards": monitor.gauge("attn_seq_shards").value,
         # serving tier: coalescing + admission across ALL hosted models
         # (the per-model labeled series stay in dump_prometheus)
         "serving_requests_total": _sum_labeled("serving_requests_total"),
@@ -1753,6 +1954,8 @@ if __name__ == "__main__":
         out.update(bench_restart())
     if os.environ.get("BENCH_MULTIHOST") == "1":
         out.update(bench_multihost())
+    if os.environ.get("BENCH_LONGCTX") == "1":
+        out.update(bench_longctx())
     if os.environ.get("BENCH_LONGSEQ") == "1":
         out.update(bench_longseq())
         out.update(bench_longseq(batch_size=4, seq_len=4096,

@@ -91,15 +91,27 @@ class CompiledProgram:
         self._loss_name = None
         self._mesh = None
         self._sharded_feeds = None  # None => shard all feeds on dim 0
+        self._seq_feeds = None      # name -> dim sharded over "sp"
+        self._seq_fetches = None    # fetch name -> dim pinned to "sp"
 
     def with_data_parallel(self, loss_name=None, build_strategy=None,
                            exec_strategy=None, share_vars_from=None,
-                           places=None, mesh_axes=("dp",), mesh_shape=None):
+                           places=None, mesh_axes=("dp",), mesh_shape=None,
+                           seq_feeds=None, seq_fetches=None):
         """GSPMD execution. ``mesh_axes``/``mesh_shape`` open the hybrid
         surface: e.g. mesh_axes=("dp","tp"), mesh_shape={"dp":2,"tp":4}
         lays parameters carrying a ``ParamAttr(shard=...)`` spec over the
         'tp' axis (Megatron-style) while the batch shards over 'dp'; XLA
-        inserts the TP collectives over ICI."""
+        inserts the TP collectives over ICI.
+
+        ``seq_feeds``: {feed name: dim} — that dim of the feed shards
+        over the 'sp' (sequence) axis, composing with the dim-0 'dp'
+        batch sharding; long-context programs feed token/cache arrays
+        pre-split this way so no single device ever holds the full
+        sequence. ``seq_fetches``: {fetch name: dim} — pins those fetch
+        outputs to the same 'sp' layout instead of the replicated
+        default, so a decode loop can feed a fetched KV cache straight
+        back without an all-gather per token."""
         self._is_data_parallel = True
         self._mode = "gspmd"
         self._loss_name = loss_name
@@ -108,6 +120,8 @@ class CompiledProgram:
         self._places = places
         self._mesh_axes = tuple(mesh_axes)
         self._mesh_shape = dict(mesh_shape) if mesh_shape else None
+        self._seq_feeds = dict(seq_feeds) if seq_feeds else None
+        self._seq_fetches = dict(seq_fetches) if seq_fetches else None
         return self
 
     def with_pipeline(self, loss_name=None, places=None, num_microbatches=2,
@@ -476,7 +490,7 @@ class CompiledProgram:
 
         return fn
 
-    def feed_sharding(self, value, batch_dim=0):
+    def feed_sharding(self, value, batch_dim=0, name=None):
         """The ``NamedSharding`` this strategy lays a feed array out
         with — the single source of truth the step wrappers AND the
         ahead-of-time stagers (``fluid.reader.DeviceStager``,
@@ -490,7 +504,11 @@ class CompiledProgram:
         the strategy shards feeds ('dp' under GSPMD, the first mesh
         axis under shard_map) and the batch dim divides evenly,
         replicated otherwise; ``None`` when the strategy stages feeds
-        itself (pipeline mode) or no mesh is attached."""
+        itself (pipeline mode) or no mesh is attached.
+
+        ``name`` keys the GSPMD ``seq_feeds`` table: a registered feed
+        additionally shards that dim over 'sp' (composing with the
+        batch-over-'dp' split) when the dim divides the axis size."""
         if not self._is_data_parallel:
             return None
         mode = getattr(self, "_mode", "gspmd")
@@ -501,6 +519,18 @@ class CompiledProgram:
 
         mesh = self.mesh
         ndim = np.ndim(value)
+        seq_feeds = getattr(self, "_seq_feeds", None)
+        if (mode == "gspmd" and seq_feeds and name in seq_feeds
+                and "sp" in mesh.shape):
+            sdim = int(seq_feeds[name])
+            if sdim != batch_dim and ndim > sdim and \
+                    np.shape(value)[sdim] % mesh.shape["sp"] == 0:
+                spec = [None] * ndim
+                spec[sdim] = "sp"
+                if "dp" in mesh.shape and ndim > batch_dim and \
+                        np.shape(value)[batch_dim] % mesh.shape["dp"] == 0:
+                    spec[batch_dim] = "dp"
+                return NamedSharding(mesh, P(*spec))
         if mode == "shard_map" and len(mesh.axis_names) > 1:
             # hierarchical mesh: the batch shards over EVERY axis (each
             # of the H*D shards is one data-parallel rank); fall back to
@@ -594,6 +624,23 @@ class CompiledProgram:
             block, name, mesh, repl,
             shape=np.shape(value) if value is not None else None)
 
+    def _fetch_sharding(self, name, mesh, repl):
+        """Fetch layout: replicated unless registered in ``seq_fetches``
+        — those pin the given dim to 'sp' so a decode loop can feed the
+        fetched (still-sharded) KV cache straight back without the
+        per-token all-gather a replicated fetch would force."""
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        seq_fetches = getattr(self, "_seq_fetches", None)
+        if not seq_fetches or name not in seq_fetches or \
+                "sp" not in mesh.shape:
+            return repl
+        sdim = int(seq_fetches[name])
+        spec = [None] * (sdim + 1)
+        spec[sdim] = "sp"
+        return NamedSharding(mesh, P(*spec))
+
     def _wrap_step_gspmd(self, step, block, feed, fetch_names, state_names):
         """jit the lowered step under the mesh: batch over 'dp', params
         laid out by their ``shard_spec`` (TP), everything else replicated.
@@ -606,7 +653,8 @@ class CompiledProgram:
         mesh = self.mesh
         repl = NamedSharding(mesh, P())
 
-        feed_shardings = {n: self.feed_sharding(feed[n]) for n in feed}
+        feed_shardings = {n: self.feed_sharding(feed[n], name=n)
+                          for n in feed}
         state_shardings = {n: self._state_sharding(block, n, mesh, repl)
                            for n in state_names}
         in_shardings = (
@@ -618,7 +666,8 @@ class CompiledProgram:
         # buffer must alias an identically-sharded output, and leaving the
         # state output unconstrained lets XLA pick per-shard layouts that
         # break the aliasing on older jax builds.
-        out_shardings = ([repl for _ in fetch_names], state_shardings, repl)
+        out_shardings = ([self._fetch_sharding(n, mesh, repl)
+                          for n in fetch_names], state_shardings, repl)
         donate = (0,) if self._build_strategy.enable_inplace else ()
         jfn = self._cache_wrap(jax.jit(
             step,

@@ -712,6 +712,33 @@ def _fused_multihead_attention_packed(ctx, op):
         rng_key=key))
 
 
+@register("sequence_parallel_attention", has_state=True)
+def _sequence_parallel_attention(ctx, op):
+    """Long-context attention with the sequence dim sharded over the
+    strategy mesh's "sp" axis (kernels/attention.py: ring KV rotation or
+    Ulysses all-to-all, picked per the ``strategy`` attr / auto rule).
+    Packed [B, S, H*d] in and out; with no mesh (or no "sp" axis) the
+    same math runs single-shard, so programs are portable."""
+    from ...kernels.attention import sequence_parallel_attention
+
+    q = ctx.get_input(op, "Q")
+    k = ctx.get_input(op, "K")
+    v = ctx.get_input(op, "V")
+    bias = ctx.get_input(op, "Bias")
+    p = float(op.attr("dropout_prob", 0.0))
+    is_test = bool(op.attr("is_test", False))
+    drop = 0.0 if is_test else p
+    key = ctx.next_rng() if drop > 0.0 else None
+    ctx.set_output(op, "Out", sequence_parallel_attention(
+        q, k, v, int(op.attr("n_heads", 1)), bias=bias,
+        mesh=getattr(ctx, "mesh", None),    # eager ctx carries no mesh
+        seq_axis=str(op.attr("seq_axis", "sp")),
+        batch_axis=str(op.attr("batch_axis", "dp")),
+        causal=bool(op.attr("causal", False)),
+        scale=op.attr("scale", None), dropout_prob=drop, rng_key=key,
+        strategy=str(op.attr("strategy", "auto"))))
+
+
 @register("kv_cache_update")
 def _kv_cache_update(ctx, op):
     """Ring-buffer KV cache write (kernels/attention.py): New [B, H, T, d]

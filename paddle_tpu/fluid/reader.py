@@ -70,7 +70,7 @@ def _as_sharding_fn(sharding):
     if sharding is None:
         return None
     if hasattr(sharding, "feed_sharding"):  # CompiledProgram strategy
-        return lambda name, value: sharding.feed_sharding(value)
+        return lambda name, value: sharding.feed_sharding(value, name=name)
     if isinstance(sharding, dict):
         return lambda name, value: sharding.get(name)
     if callable(sharding):

@@ -13,30 +13,56 @@ Dropout inside the kernel draws from the TPU PRNG
 (``pltpu.prng_seed``/``prng_random_bits``) seeded per (batch, head); the
 backward reseeds identically, so the regenerated mask is bit-exact.
 
-Sequence-length dispatch (single chip):
+Tier dispatch. ``PADDLE_TPU_ATTN_FORCE`` (read ONLY through
+``_attn_force()``) is the single authority that overrides every gate
+below; any value outside ``_ATTN_FORCE_VALUES`` raises instead of
+silently routing to the default tier.
+
+Training attention, single chip (``fused_attention`` -> ``_fused``):
   S <= 1024  — batch-blocked kernel, full [S, S] score tile in VMEM.
-  1024 < S <= ~3k — Q-tiled long kernels (_fwd/_bwd_kernel_long): K/V for
-      one (batch, head) live in VMEM (S·d stays small when S² doesn't),
-      scores exist only as [Qb, S] tiles; dk/dv accumulate across the
-      q-tile grid dim. Measured v5e BERT-base s=2048: 3.1x over the
-      blockwise fallback (20k -> 63k tokens/sec), and +1.5% over the
-      flash tier (r5 interleaved pairs: 64.3k vs 63.3k, spread ±0.4% —
-      whole-K/V residency plus a single backward beats flash's
-      logsumexp I/O and split-backward re-reads at this scale), so the
-      tier stays. Force flash with PADDLE_TPU_ATTN_FORCE=flash.
-  ~3k < S — flash tier (_flash_*): BOTH q and k are tiled, so no VMEM
-      term scales with S². The forward runs online softmax over k-tiles
-      in VMEM scratch and saves per-row logsumexp; the backward is the
-      flash-attention-2 SPLIT pair — one kernel accumulates dq over
-      k-tiles, a second accumulates dk/dv over q-tiles — each
-      regenerating probabilities from the saved logsumexp, which is
-      exactly the split the fused long-kernel backward could not fit
-      (its K/V + dK/dV [S, d] blocks plus [Qb, S] tiles overflow scoped
-      VMEM at S=4096; see _long_qb). Row-broadcast bias only
-      (per-row bias falls through to blockwise).
-  fallback — blockwise online-softmax scan (no [S, S] anywhere); and
-      the ring/Ulysses layers in ``paddle_tpu.parallel`` shard S over
-      chips (SURVEY §5.7).
+  1024 < S <= 4096 — Q-tiled long kernels (_fwd/_bwd_kernel_long): K/V
+      for one (batch, head) live in VMEM (S·d stays small when S²
+      doesn't), scores exist only as [Qb, S] tiles; dk/dv accumulate
+      across the q-tile grid dim. Measured v5e BERT-base s=2048: 3.1x
+      over the blockwise fallback (20k -> 63k tokens/sec), and +1.5%
+      over the flash tier (r5 interleaved pairs: 64.3k vs 63.3k,
+      spread ±0.4%), so the tier stays. FORCE=flash bypasses it.
+  S > 4096 (or FORCE=flash) — flash tier (_flash_*): BOTH q and k are
+      tiled, so no VMEM term scales with S². The forward runs online
+      softmax over k-tiles in VMEM scratch and saves per-row logsumexp;
+      the backward is the flash-attention-2 SPLIT pair — one kernel
+      accumulates dq over k-tiles, a second accumulates dk/dv over
+      q-tiles — each regenerating probabilities from the saved
+      logsumexp (its K/V + dK/dV [S, d] blocks plus [Qb, S] tiles
+      overflow scoped VMEM at S=4096; see _long_qb). Row-broadcast
+      bias only (per-row bias falls through to blockwise).
+  fallback — blockwise online-softmax scan (no [S, S] anywhere).
+
+Packed layout (``fused_attention_packed``, FORCE=packed): q/k/v stay in
+the fc-native [B, S, H*d] layout with heads handled inside the kernel;
+dispatches resident head-pair tier, then chunked, then the fallback.
+
+Decode (``attention_with_cache``): q [B, H, 1, d] against a KV ring
+buffer [B, H, C, d].
+  C >= 1024 (or FORCE=decode) — Pallas decode tier
+      (_decode_fwd_kernel): online softmax over cache blocks with the
+      per-sequence valid length in SMEM. Inference-only, no backward.
+  fallback — masked-length one-pass reference (_ref_attention_cache).
+
+Sequence-parallel (``sequence_parallel_attention``): S sharded over a
+mesh axis, selected per call (strategy attr / auto) with FORCE=ring |
+ulysses as the escape hatch.
+  ring — KV chunks rotate around ICI neighbors via ``lax.ppermute``
+      inside ``shard_map``; each hop runs the flash forward
+      (``_pallas_attention_flash``, when the chunk tiles) as the inner
+      loop and merges per-hop (o, logsumexp) online; the custom-vjp
+      backward is a second ring reusing the flash-attention-2 split
+      kernels per hop. Causal hops with src > rank are skipped
+      (~halves average work).
+  ulysses — ``lax.all_to_all`` swaps heads<->sequence so each device
+      runs FULL-sequence attention over H/n heads through the
+      single-chip ``_fused`` dispatch above; auto-picked when the axis
+      size divides H (ring is the general fallback).
 
 There is also a PACKED entry (``fused_attention_packed``): q/k/v in the
 fc-native [B, S, H*d] layout with heads handled inside the kernel,
@@ -72,7 +98,7 @@ def _interpret():
     return os.environ.get("PADDLE_TPU_PALLAS_INTERPRET", "") == "1"
 
 
-_ATTN_FORCE_VALUES = ("flash", "packed", "decode")
+_ATTN_FORCE_VALUES = ("flash", "packed", "decode", "ring", "ulysses")
 
 
 def _attn_force():
@@ -1691,3 +1717,436 @@ def attention_with_cache(q, k_cache, v_cache, cache_len, scale=None):
         return _pallas_attention_decode(q, k_cache, v_cache, cache_len,
                                         scale)
     return _ref_attention_cache(q, k_cache, v_cache, cache_len, scale)
+
+
+# ---------------------------------------------------------------------------
+# Sequence parallelism: S sharded over a mesh axis.
+#
+# Two strategies behind one entry point (``sequence_parallel_attention``):
+#
+#   ring    — every device keeps its own Q chunk; K/V chunks (plus the
+#             row-broadcast bias column slice) rotate around the axis via
+#             ``lax.ppermute``, one hop per shard. Each hop is ordinary
+#             chunk-vs-chunk attention — the flash forward/backward kernels
+#             when the chunk tiles, the jnp form otherwise — and the per-hop
+#             (o, logsumexp) pairs merge online, so nothing [S, S]-shaped
+#             ever exists and per-device attention memory is O((S/n)²).
+#             Causal hops where the source chunk sits entirely in the
+#             future are skipped under ``lax.cond`` (~halves average work).
+#             The whole ring is one ``custom_vjp``: the backward is a
+#             second ring pass in the flash-attention-2 style — the saved
+#             GLOBAL logsumexp turns each hop's probabilities into global
+#             softmax rows, so per-hop gradients are independent and the
+#             dk/dv/dbias accumulators simply travel with their K/V chunk
+#             (n rotations lands them home).
+#
+#   ulysses — ``lax.all_to_all`` trades the head axis for the sequence
+#             axis ([B, H, S/n, d] -> [B, H/n, S, d]); each device then
+#             runs FULL-sequence attention over its head subset through
+#             the single-chip ``_fused`` dispatch, and the inverse
+#             all_to_all restores the layout. Needs n | H; communicates
+#             activations (2 all_to_alls) instead of K/V (n-1 hops).
+#
+# Dropout is shard-count-invariant: masks are generated per fixed
+# ``_SP_DROP_TILE`` tile from a counter-based key fold
+# (seed, global head, global q-tile, global k-tile), so the n-shard run
+# reproduces the 1-shard run of the same op exactly — which is what the
+# closeness tests assert. Denominator semantics match the rest of the
+# file: softmax normalizes with UNDROPPED weights, only the value
+# accumulation is masked.
+# ---------------------------------------------------------------------------
+
+_SP_DROP_TILE = 64
+
+
+def _sp_dropout_keep(seed, batch_ids, head_ids, q_tile0, k_tile0, sq, sk,
+                     p_drop):
+    """Tiled keep-mask [B, H, sq, sk] for the local (q-chunk, k-chunk)
+    pair. Each [T, T] tile draws from fold_in(seed, GLOBAL batch index,
+    GLOBAL head, GLOBAL q-tile, GLOBAL k-tile) — fully position-keyed,
+    so every shard of a run (over the sequence axis AND the batch axis)
+    regenerates exactly the tiles of the equivalent single-shard run.
+    All ids/offsets may be traced (they come from mesh ranks)."""
+    T = _SP_DROP_TILE
+    nqt, nkt = sq // T, sk // T
+    base = jax.random.fold_in(jax.random.PRNGKey(0), seed[0])
+
+    def tile(b, h, qt, kt):
+        key = jax.random.fold_in(jax.random.fold_in(
+            jax.random.fold_in(jax.random.fold_in(base, b), h), qt), kt)
+        return jax.random.uniform(key, (T, T)) >= p_drop
+
+    keep = jax.vmap(lambda b: jax.vmap(lambda h: jax.vmap(
+        lambda qt: jax.vmap(lambda kt: tile(b, h, qt, kt))(
+            k_tile0 + jnp.arange(nkt)))(
+                q_tile0 + jnp.arange(nqt)))(head_ids))(batch_ids)
+    # [B, H, nqt, nkt, T, T] -> [B, H, nqt*T, nkt*T]
+    return jnp.transpose(keep, (0, 1, 2, 4, 3, 5)).reshape(
+        batch_ids.shape[0], head_ids.shape[0], sq, sk)
+
+
+def _sp_flash_ok(sq, p_drop):
+    """A ring hop can run the Pallas flash pair when the chunk tiles
+    (q and k chunks are the same size under even sharding) and there is
+    no dropout — the flash kernels' in-kernel TPU PRNG cannot reproduce
+    the shard-invariant tiled masks, so the dropout path stays jnp."""
+    return (_supports_pallas() and p_drop == 0.0
+            and _flash_block(sq) is not None)
+
+
+def _diag_causal_mask(s):
+    """Intra-chunk causal mask for the ring's diagonal hop: q and k carry
+    the SAME global offset there, so the global triangle is the local
+    one. -1e30, not -inf (NaN discipline, cf. _ref_attention_cache)."""
+    rows = jax.lax.broadcasted_iota(jnp.int32, s.shape[-2:], 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, s.shape[-2:], 1)
+    return jnp.where((cols <= rows)[None, None], s, -1e30)
+
+
+def _sp_hop_fwd(q, kb, vb, bias_b, scale, p_drop, keep, diag_causal):
+    """One ring hop, jnp form: chunk-vs-chunk attention returning the
+    NORMALIZED partial output and the row logsumexp (both f32) — the
+    same (o, lse) contract as ``_pallas_attention_flash``, so the merge
+    in the hop loop cannot tell the paths apart."""
+    f32 = jnp.float32
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(f32),
+                   kb.astype(f32)) * scale + bias_b
+    if diag_causal:
+        s = _diag_causal_mask(s)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.exp(s - m)
+    l = jnp.sum(e, axis=-1, keepdims=True)
+    p = e / l
+    if p_drop > 0.0:
+        p = jnp.where(keep, p / (1.0 - p_drop), 0.0)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, vb.astype(f32))
+    return o, m + jnp.log(l)
+
+
+def _ring_fwd_pass(q, k, v, bias_k, seed, batch_ids, axis_name, n, causal,
+                   scale, p_drop):
+    """Forward ring: n hops, Python-unrolled (n is static), K/V/bias
+    rotating between hops (the rotation after the last hop is elided —
+    the inputs themselves are the residuals). Per-hop outputs merge via
+    logsumexp: the result is bit-for-bit global softmax with the
+    file-wide undropped-denominator dropout semantics."""
+    B, H, sq, dh = q.shape
+    r = jax.lax.axis_index(axis_name) if n > 1 else jnp.int32(0)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    T = _SP_DROP_TILE
+    o = jnp.zeros((B, H, sq, dh), jnp.float32)
+    lse = jnp.full((B, H, sq, 1), -1e30, jnp.float32)
+    kb, vb, bkb = k, v, bias_k
+    use_flash = _sp_flash_ok(sq, p_drop)
+    head_ids = jnp.arange(H)
+    for i in range(n):
+        src = jnp.mod(r - i, n)     # whose K/V chunk this hop holds
+
+        def hop(o_, lse_, kb=kb, vb=vb, bkb=bkb, src=src,
+                diag=(causal and i == 0)):
+            if use_flash and not diag:
+                ob, lseb = _pallas_attention_flash(q, kb, vb, bkb, scale,
+                                                   0.0, seed)
+                ob = ob.astype(jnp.float32)
+            else:
+                keep = None
+                if p_drop > 0.0:
+                    keep = _sp_dropout_keep(seed, batch_ids, head_ids,
+                                            r * (sq // T), src * (sq // T),
+                                            sq, sq, p_drop)
+                ob, lseb = _sp_hop_fwd(q, kb, vb, bkb, scale, p_drop,
+                                       keep, diag)
+            lse_new = jnp.logaddexp(lse_, lseb)
+            return (o_ * jnp.exp(lse_ - lse_new)
+                    + ob * jnp.exp(lseb - lse_new), lse_new)
+
+        if causal and i > 0:
+            # src is traced (depends on rank) -> runtime skip; only the
+            # i==0 diagonal hop is statically known
+            o, lse = jax.lax.cond(src > r, lambda o_, l_: (o_, l_), hop,
+                                  o, lse)
+        else:
+            o, lse = hop(o, lse)
+        if n > 1 and i < n - 1:
+            kb = jax.lax.ppermute(kb, axis_name, perm)
+            vb = jax.lax.ppermute(vb, axis_name, perm)
+            bkb = jax.lax.ppermute(bkb, axis_name, perm)
+    return o.astype(q.dtype), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10))
+def _ring(q, k, v, bias_k, seed, batch_ids, axis_name, n, causal, scale,
+          p_drop):
+    """Ring attention over ``axis_name`` (shard-local view): q/k/v
+    [B, H, S/n, d], bias_k [B, 1, 1, S/n] = this shard's bias columns,
+    batch_ids [B] int32 = GLOBAL batch indices (dropout mask keys)."""
+    return _ring_fwd_pass(q, k, v, bias_k, seed, batch_ids, axis_name, n,
+                          causal, scale, p_drop)[0]
+
+
+def _ring_fwd_rule(q, k, v, bias_k, seed, batch_ids, axis_name, n, causal,
+                   scale, p_drop):
+    o, lse = _ring_fwd_pass(q, k, v, bias_k, seed, batch_ids, axis_name,
+                            n, causal, scale, p_drop)
+    # flash-attention-2 residual set, ring edition: global o and global
+    # row logsumexp make every hop's backward independent
+    return o, (q, k, v, bias_k, seed, batch_ids, o, lse)
+
+
+def _ring_bwd_rule(axis_name, n, causal, scale, p_drop, res, do):
+    """Backward ring: a second pass over the same rotation schedule. The
+    global lse turns exp(s - lse) into global softmax rows per hop, so
+    ds = pd*dpd - p*rowsum(do*o) is exact per chunk (the flash split-
+    kernel identity); dq accumulates locally while dk/dv/dbias
+    accumulators travel WITH their K/V chunk — after n rotations each
+    chunk (and its gradient) is back on its home device."""
+    q, k, v, bias_k, seed, batch_ids, o, lse = res
+    B, H, sq, dh = q.shape
+    f32 = jnp.float32
+    r = jax.lax.axis_index(axis_name) if n > 1 else jnp.int32(0)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    T = _SP_DROP_TILE
+    do_f = do.astype(f32)
+    dd = jnp.sum(do_f * o.astype(f32), axis=-1, keepdims=True)
+    dq = jnp.zeros(q.shape, f32)
+    kb, vb, bkb = k, v, bias_k
+    dk_acc = jnp.zeros(k.shape, f32)
+    dv_acc = jnp.zeros(v.shape, f32)
+    db_acc = jnp.zeros(bias_k.shape, f32)
+    use_flash = _sp_flash_ok(sq, p_drop)
+    head_ids = jnp.arange(H)
+    for i in range(n):
+        src = jnp.mod(r - i, n)
+
+        def hop(dq_, dk_, dv_, db_, kb=kb, vb=vb, bkb=bkb, src=src,
+                diag=(causal and i == 0)):
+            if use_flash and not diag:
+                dqh, dkh, dvh, dbh = _pallas_attention_flash_bwd(
+                    q, kb, vb, bkb, seed, do, o, lse, scale, 0.0)
+            else:
+                s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(f32),
+                               kb.astype(f32)) * scale + bkb
+                if diag:
+                    s = _diag_causal_mask(s)
+                p = jnp.exp(s - lse)          # global softmax, undropped
+                pd = p
+                if p_drop > 0.0:
+                    keep = _sp_dropout_keep(seed, batch_ids, head_ids,
+                                            r * (sq // T), src * (sq // T),
+                                            sq, sq, p_drop)
+                    pd = jnp.where(keep, p / (1.0 - p_drop), 0.0)
+                dpd = jnp.einsum("bhqd,bhkd->bhqk", do_f, vb.astype(f32))
+                dvh = jnp.einsum("bhqk,bhqd->bhkd", pd, do_f)
+                ds = pd * dpd - p * dd
+                dqh = jnp.einsum("bhqk,bhkd->bhqd", ds,
+                                 kb.astype(f32)) * scale
+                dkh = jnp.einsum("bhqk,bhqd->bhkd", ds,
+                                 q.astype(f32)) * scale
+                dbh = jnp.sum(ds, axis=(1, 2), keepdims=True)
+            return (dq_ + dqh.astype(f32), dk_ + dkh.astype(f32),
+                    dv_ + dvh.astype(f32), db_ + dbh.astype(f32))
+
+        if causal and i > 0:
+            dq, dk_acc, dv_acc, db_acc = jax.lax.cond(
+                src > r, lambda a, b, c, d: (a, b, c, d), hop,
+                dq, dk_acc, dv_acc, db_acc)
+        else:
+            dq, dk_acc, dv_acc, db_acc = hop(dq, dk_acc, dv_acc, db_acc)
+        if n > 1:
+            # unlike the forward, rotate after EVERY hop: n rotations
+            # land each chunk's gradient accumulator back home
+            kb = jax.lax.ppermute(kb, axis_name, perm)
+            vb = jax.lax.ppermute(vb, axis_name, perm)
+            bkb = jax.lax.ppermute(bkb, axis_name, perm)
+            dk_acc = jax.lax.ppermute(dk_acc, axis_name, perm)
+            dv_acc = jax.lax.ppermute(dv_acc, axis_name, perm)
+            db_acc = jax.lax.ppermute(db_acc, axis_name, perm)
+    return (dq.astype(q.dtype), dk_acc.astype(k.dtype),
+            dv_acc.astype(v.dtype), db_acc.astype(bias_k.dtype),
+            _seed_ct(seed), _seed_ct(batch_ids))
+
+
+_ring.defvjp(_ring_fwd_rule, _ring_bwd_rule)
+
+
+def _sp_dropout_attention(q, k, v, bias, scale, p_drop, keep):
+    """Full-sequence attention with the shard-invariant tiled dropout
+    mask (the Ulysses dropout path; plain autodiff — no custom vjp)."""
+    f32 = jnp.float32
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(f32),
+                   k.astype(f32)) * scale + bias
+    m = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.exp(s - m)
+    p = e / jnp.sum(e, axis=-1, keepdims=True)
+    pd = jnp.where(keep, p / (1.0 - p_drop), 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", pd, v.astype(f32))
+
+
+def _ulysses_attention(q, k, v, bias_k, seed, batch_ids, axis_name, n,
+                       causal, scale, p_drop):
+    """Ulysses hop (shard-local view): all_to_all heads<->sequence, full-
+    sequence attention over H/n heads via the single-chip dispatch, then
+    the inverse all_to_all. Dropout masks key on GLOBAL head ids so the
+    sharded run reproduces the single-shard run."""
+    B, H, sl, dh = q.shape
+    if n > 1:
+        qg = jax.lax.all_to_all(q, axis_name, 1, 2, tiled=True)
+        kg = jax.lax.all_to_all(k, axis_name, 1, 2, tiled=True)
+        vg = jax.lax.all_to_all(v, axis_name, 1, 2, tiled=True)
+        bias_g = jax.lax.all_gather(bias_k, axis_name, axis=3, tiled=True)
+        r = jax.lax.axis_index(axis_name)
+    else:
+        qg, kg, vg, bias_g, r = q, k, v, bias_k, jnp.int32(0)
+    Hc, S = qg.shape[1], qg.shape[2]
+    bias_full = bias_g                               # [B, 1, 1, S]
+    if causal:
+        rows = jax.lax.broadcasted_iota(jnp.int32, (S, S), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (S, S), 1)
+        bias_full = bias_g + jnp.where(cols <= rows, 0.0,
+                                       -1e30)[None, None]
+    if p_drop > 0.0:
+        keep = _sp_dropout_keep(seed, batch_ids, r * Hc + jnp.arange(Hc),
+                                0, 0, S, S, p_drop)
+        og = _sp_dropout_attention(qg, kg, vg, bias_full, scale, p_drop,
+                                   keep).astype(q.dtype)
+    else:
+        og = _fused(qg, kg, vg,
+                    jnp.broadcast_to(bias_full,
+                                     (B, 1, bias_full.shape[2], S)),
+                    scale, 0.0, seed)
+    if n > 1:
+        og = jax.lax.all_to_all(og, axis_name, 2, 1, tiled=True)
+    return og
+
+
+def _sp_split_heads(x3, n_heads):
+    B, S, HD = x3.shape
+    return x3.reshape(B, S, n_heads, HD // n_heads).transpose(0, 2, 1, 3)
+
+
+def _sp_merge_heads(x4):
+    B, H, S, dh = x4.shape
+    return x4.transpose(0, 2, 1, 3).reshape(B, S, H * dh)
+
+
+def _sp_local(q3, k3, v3, bias_k, seed, *, strategy, axis_name, batch_axis,
+              n, n_heads, causal, scale, p_drop):
+    """Shard-local body (also the n=1 single-device path, which is the
+    shard-invariance oracle in tests): packed [B, S/n, H*d] in and out —
+    the head split/merge stays inside the shard, off the program graph.
+    batch_axis names the mesh axis the batch dim is sharded over (None
+    when unsharded) — dropout masks key on GLOBAL batch indices."""
+    q = _sp_split_heads(q3, n_heads)
+    k = _sp_split_heads(k3, n_heads)
+    v = _sp_split_heads(v3, n_heads)
+    b0 = jnp.int32(0)
+    if batch_axis is not None:
+        b0 = jax.lax.axis_index(batch_axis) * q.shape[0]
+    batch_ids = b0 + jnp.arange(q.shape[0])
+    if strategy == "ulysses":
+        o = _ulysses_attention(q, k, v, bias_k, seed, batch_ids,
+                               axis_name, n, causal, scale, p_drop)
+    else:
+        o = _ring(q, k, v, bias_k, seed, batch_ids, axis_name, n, causal,
+                  scale, p_drop)
+    return _sp_merge_heads(o.astype(q3.dtype))
+
+
+def sequence_parallel_attention(q, k, v, n_heads, bias=None, mesh=None,
+                                seq_axis="sp", batch_axis="dp",
+                                causal=False, scale=None, dropout_prob=0.0,
+                                rng_key=None, strategy="auto"):
+    """Multi-head attention with the sequence dim sharded over
+    ``mesh[seq_axis]``.
+
+    q/k/v: GLOBAL packed [B, S, H*d] (the fc-native layout — no head
+    transposes in the graph); bias: optional row-broadcast [B, 1, 1, S]
+    additive (the k-side padding mask; the causal triangle comes from
+    ``causal=True``, never from bias). Returns [B, S, H*d].
+
+    strategy: "auto" picks ulysses when the axis size divides H (lower
+    comm volume: 2 all_to_alls of activations vs n-1 K/V hops), ring
+    otherwise; PADDLE_TPU_ATTN_FORCE=ring|ulysses overrides everything.
+    With ``mesh=None`` (or no seq_axis in it) the same math runs
+    single-shard with no collectives.
+    """
+    B, S, HD = q.shape
+    H = int(n_heads)
+    if HD % H:
+        raise ValueError("model width %d not divisible by n_heads %d"
+                         % (HD, H))
+    if scale is None:
+        scale = 1.0 / math.sqrt(HD // H)
+    scale = float(scale)
+    p_drop = float(dropout_prob)
+    if p_drop > 0.0:
+        if rng_key is None:
+            raise ValueError("dropout_prob > 0 requires rng_key")
+        seed = jax.random.randint(rng_key, (1,), 0, 2 ** 31 - 1,
+                                  dtype=jnp.int32)
+    else:
+        seed = jnp.zeros((1,), jnp.int32)
+    if bias is None:
+        bias_k = jnp.zeros((B, 1, 1, S), jnp.float32)
+    else:
+        if bias.ndim != 4 or bias.shape[1] != 1 or bias.shape[2] != 1:
+            raise ValueError(
+                "sequence_parallel_attention bias must be row-broadcast "
+                "[B, 1, 1, S] (pass causal=True for the causal mask); "
+                "got %r" % (bias.shape,))
+        bias_k = jnp.broadcast_to(bias.astype(jnp.float32), (B, 1, 1, S))
+
+    n = 1
+    if mesh is not None and seq_axis in mesh.shape:
+        n = int(mesh.shape[seq_axis])
+    force = _attn_force()
+    if force in ("ring", "ulysses"):
+        strategy = force
+    if strategy == "auto":
+        strategy = "ulysses" if H % n == 0 else "ring"
+    if strategy not in ("ring", "ulysses"):
+        raise ValueError("strategy %r not understood (ring | ulysses | "
+                         "auto)" % (strategy,))
+    if strategy == "ulysses" and H % n:
+        raise ValueError("ulysses needs the %r axis size (%d) to divide "
+                         "n_heads (%d); use strategy='ring'"
+                         % (seq_axis, n, H))
+    if S % max(n, 1):
+        raise ValueError("sequence length %d not divisible by %r axis "
+                         "size %d" % (S, seq_axis, n))
+    if p_drop > 0.0 and (S // n) % _SP_DROP_TILE:
+        raise ValueError(
+            "sequence-parallel dropout needs the per-shard chunk "
+            "(S/n = %d) divisible by the %d-wide mask tile"
+            % (S // n, _SP_DROP_TILE))
+
+    from paddle_tpu.fluid import monitor
+    monitor.gauge("attn_seq_shards",
+                  "sequence shards in the last traced "
+                  "sequence-parallel attention").set(n)
+    if strategy == "ring" and n > 1:
+        monitor.counter("attn_ring_hops_total",
+                        "ring-attention KV rotation hops traced "
+                        "(n_shards - 1 per ring pass)").inc(n - 1)
+
+    if n == 1:
+        return _sp_local(q, k, v, bias_k, seed, strategy=strategy,
+                         axis_name=None, batch_axis=None, n=1, n_heads=H,
+                         causal=causal, scale=scale, p_drop=p_drop)
+    from paddle_tpu import jax_compat
+    P = jax.sharding.PartitionSpec
+    ba = None
+    if batch_axis and batch_axis in mesh.shape:
+        if int(mesh.shape[batch_axis]) > 1 and \
+                B % int(mesh.shape[batch_axis]) == 0:
+            ba = batch_axis
+    local = functools.partial(_sp_local, strategy=strategy,
+                              axis_name=seq_axis, batch_axis=ba, n=n,
+                              n_heads=H, causal=causal, scale=scale,
+                              p_drop=p_drop)
+    spec = P(ba, seq_axis, None)
+    bspec = P(ba, None, None, seq_axis)
+    sm = jax_compat.shard_map(
+        local, mesh, in_specs=(spec, spec, spec, bspec, P(None)),
+        out_specs=spec, check_vma=False)
+    return sm(q, k, v, bias_k, seed)

@@ -119,6 +119,27 @@ class MultiHeadAttention(Layer):
         kh, vh = self._kv_heads(kv)
         return self._attend(qh, kh, vh, bias)
 
+    def forward_seq(self, q, kv, bias, causal, strategy="auto"):
+        """Sequence-parallel self-attention: the fc outputs go into the
+        ``sequence_parallel_attention`` op STILL PACKED [B, S, H*d] (the
+        block-parallel layout — head split/merge happens inside the
+        shard, so the graph carries no [B, H, S, d] transposes and every
+        surrounding op keeps the clean [B, S, D] layout the 'sp' axis
+        shards). ``bias`` is the optional k-side padding mask
+        [B, 1, 1, S]; the causal triangle comes from ``causal``, not
+        from a materialized [S, S] bias feed."""
+        inputs = {"Q": [self.q_fc(q)], "K": [self.k_fc(kv)],
+                  "V": [self.v_fc(kv)]}
+        if bias is not None:
+            inputs["Bias"] = [bias]
+        (out,) = _op("sequence_parallel_attention", inputs, ["Out"],
+                     {"n_heads": self.n_heads, "causal": bool(causal),
+                      "dropout_prob": self.dropout_rate,
+                      "is_test": not self.training,
+                      "scale": 1.0 / math.sqrt(self.d_key),
+                      "strategy": strategy})
+        return self.out_fc(out)
+
     def forward_cached(self, x, k_cache, v_cache, cache_len):
         """ONE decode step of self-attention: project the incoming
         token(s), write K/V into the ring caches at slot cache_len % C,
@@ -160,9 +181,17 @@ class EncoderLayer(Layer):
         self.ln1 = nn.LayerNorm(normalized_shape=[d_model], begin_norm_axis=2)
         self.ln2 = nn.LayerNorm(normalized_shape=[d_model], begin_norm_axis=2)
         self.dropout_rate = dropout_rate
+        self.seq_parallel = False
+        self.attn_strategy = "auto"
 
     def forward(self, x, bias):
-        y = self.attn(x, x, bias)
+        if self.seq_parallel:
+            # src_bias is already the [B, 1, 1, S] k-side form the sp op
+            # takes; encoder self-attention is non-causal
+            y = self.attn.forward_seq(x, x, bias, causal=False,
+                                      strategy=self.attn_strategy)
+        else:
+            y = self.attn(x, x, bias)
         x = self.ln1(x + dropout(y, self.dropout_rate,
                                  is_test=not self.training))
         y = self.ffn(x)
@@ -180,9 +209,21 @@ class DecoderLayer(Layer):
         self.ln2 = nn.LayerNorm(normalized_shape=[d_model], begin_norm_axis=2)
         self.ln3 = nn.LayerNorm(normalized_shape=[d_model], begin_norm_axis=2)
         self.dropout_rate = dropout_rate
+        self.seq_parallel = False
+        self.attn_strategy = "auto"
 
     def forward(self, x, enc, self_bias, cross_bias):
-        y = self.self_attn(x, x, self_bias)
+        if self.seq_parallel:
+            # the causal triangle comes from the kernel's causal=True,
+            # not a materialized [S, S] bias feed — the dense triangle
+            # would have to be replicated to every shard, defeating the
+            # point of sequence sharding. Cross-attention stays on the
+            # regular path: its q-length != kv-length rectangle is
+            # GSPMD's job, not the equal-chunk ring's.
+            y = self.self_attn.forward_seq(x, x, None, causal=True,
+                                           strategy=self.attn_strategy)
+        else:
+            y = self.self_attn(x, x, self_bias)
         x = self.ln1(x + dropout(y, self.dropout_rate,
                                  is_test=not self.training))
         y = self.cross_attn(x, enc, cross_bias)
@@ -238,7 +279,8 @@ class Transformer(Layer):
     """Encoder-decoder transformer for teacher-forced NMT training."""
 
     def __init__(self, src_vocab, tgt_vocab, d_model=512, n_heads=8,
-                 d_inner=2048, n_layers=6, max_len=256, dropout_rate=0.1):
+                 d_inner=2048, n_layers=6, max_len=256, dropout_rate=0.1,
+                 seq_parallel=False, attn_strategy="auto"):
         super().__init__()
         self.d_model = d_model
         self.n_heads = n_heads
@@ -256,6 +298,29 @@ class Transformer(Layer):
             self.add_sublayer("dec_%d" % i, l)
         self.proj = nn.Linear(d_model, tgt_vocab)
         self.dropout_rate = dropout_rate
+        self.last_checkpoints = []
+        self.set_seq_parallel(seq_parallel, attn_strategy)
+
+    def set_seq_parallel(self, enabled, strategy="auto"):
+        """Route every encoder/decoder SELF-attention through the
+        sequence-parallel op (ring or Ulysses over the 'sp' mesh axis).
+        Toggleable post-construction so one model instance can trace
+        both the single-device oracle and the sharded program."""
+        self.seq_parallel = bool(enabled)
+        self.attn_strategy = strategy
+        for l in self.enc_layers + self.dec_layers:
+            l.seq_parallel = bool(enabled)
+            l.attn_strategy = strategy
+        return self
+
+    def checkpoint_vars(self, program):
+        """The per-block checkpoint Variables of the LAST traced forward,
+        resolved in ``program`` (the jit.trace output) — feed these to
+        ``RecomputeOptimizer._set_checkpoints`` so each attention+FFN
+        block's activations are rematerialized in the backward pass
+        instead of held live across it (the long-context memory knob)."""
+        blk = program.global_block()
+        return [blk.var(n) for n in self.last_checkpoints]
 
     @staticmethod
     def big(src_vocab=32000, tgt_vocab=32000):
@@ -279,14 +344,17 @@ class Transformer(Layer):
         """src_bias: optional [B, 1, 1, S_src] additive padding mask (0 keep,
         -1e4 pad) applied to encoder self-attention and decoder
         cross-attention; None = no source padding."""
+        self.last_checkpoints = []
         enc = dropout(self._embed(src_ids, self.src_emb, pos_src),
                       self.dropout_rate, is_test=not self.training)
         for l in self.enc_layers:
             enc = l(enc, src_bias)
+            self.last_checkpoints.append(enc.name)
         dec = dropout(self._embed(tgt_ids, self.tgt_emb, pos_tgt),
                       self.dropout_rate, is_test=not self.training)
         for l in self.dec_layers:
             dec = l(dec, enc, causal_bias, src_bias)
+            self.last_checkpoints.append(dec.name)
         return self.proj(dec)
 
     # -- incremental decode (prefill + per-token step) -----------------------
@@ -442,7 +510,7 @@ def run_cached_phases(exe, scope, phase1, feed1, fetch1, phase2, feed2,
 
 def build_decode_session(model, batch_size, src_len, prompt_len,
                          cache_capacity, end_id=1, use_compiled=True,
-                         slot_prefill=False):
+                         slot_prefill=False, seq_shards=1):
     """Trace ``model``'s (prefill, decode_step) pair at FIXED shapes and
     wrap them in a DecodeSession. Must run under fluid.dygraph.guard();
     puts the model in eval() mode (decode is inference-only — the
@@ -452,7 +520,15 @@ def build_decode_session(model, batch_size, src_len, prompt_len,
     the program ``session.open_stream()`` uses to prefill ONE request's
     prompt into a vacant slot of a live decode batch (continuous
     batching) without touching the other slots. Three compiles total
-    instead of two; the third is amortized over every mid-stream join."""
+    instead of two; the third is amortized over every mid-stream join.
+
+    ``seq_shards=n`` (requires ``use_compiled``) lays the session over
+    an n-device 'sp' mesh with the KV ring caches and precomputed cross
+    K/V sharded on their sequence dim (dim 2 of [B, H, C, d]) — no
+    device ever holds a full-capacity cache, so capacity scales with
+    the mesh. Cache fetches stay pinned to the 'sp' layout, so the
+    per-token feedback loop never all-gathers. ``cache_capacity`` and
+    ``src_len`` must divide n."""
     from paddle_tpu.fluid import dygraph
     from paddle_tpu.fluid.executor import Scope
 
@@ -460,6 +536,16 @@ def build_decode_session(model, batch_size, src_len, prompt_len,
         raise ValueError(
             "cache_capacity=%d < prompt_len=%d: the prefill write would "
             "cross the ring boundary" % (cache_capacity, prompt_len))
+    seq_shards = int(seq_shards)
+    if seq_shards > 1:
+        if not use_compiled:
+            raise ValueError("seq_shards > 1 needs use_compiled=True "
+                             "(the sharding lives on CompiledProgram)")
+        if cache_capacity % seq_shards or src_len % seq_shards:
+            raise ValueError(
+                "cache_capacity=%d and src_len=%d must both divide "
+                "seq_shards=%d for the sequence dim to shard evenly"
+                % (cache_capacity, src_len, seq_shards))
     model.eval()
     L = len(model.dec_layers)
     B, H = int(batch_size), model.n_heads
@@ -517,7 +603,8 @@ def build_decode_session(model, batch_size, src_len, prompt_len,
                          batch_size=B, src_len=src_len,
                          prompt_len=prompt_len, cache_capacity=C,
                          n_heads=H, d_key=d, end_id=end_id,
-                         use_compiled=use_compiled, prefill1_tl=prefill1_tl)
+                         use_compiled=use_compiled, prefill1_tl=prefill1_tl,
+                         seq_shards=seq_shards)
 
 
 class DecodeSession:
@@ -536,7 +623,7 @@ class DecodeSession:
 
     def __init__(self, prefill_tl, decode_tl, scope, n_layers, batch_size,
                  src_len, prompt_len, cache_capacity, n_heads, d_key,
-                 end_id, use_compiled=True, prefill1_tl=None):
+                 end_id, use_compiled=True, prefill1_tl=None, seq_shards=1):
         self._exe = fluid.Executor()
         self.scope = scope
         self._L = n_layers
@@ -547,6 +634,7 @@ class DecodeSession:
         self.end_id = int(end_id)
         self.n_heads = n_heads
         self.d_key = d_key
+        self.seq_shards = int(seq_shards)
         self._prefill_feeds = list(prefill_tl._feed_names)
         self._prefill_fetches = list(prefill_tl._fetch_names)
         self._decode_feeds = list(decode_tl._feed_names)
@@ -554,6 +642,25 @@ class DecodeSession:
         if use_compiled:
             self.prefill_program = fluid.CompiledProgram(prefill_tl.program)
             self.decode_program = fluid.CompiledProgram(decode_tl.program)
+            if self.seq_shards > 1:
+                L, n = n_layers, self.seq_shards
+                # seq-dim positions: prefill feeds 6.. are the 2L zero
+                # caches [B,H,C,d]; prefill fetches 1.. are 2L updated
+                # caches + 2L cross K/V; decode feeds 4.. are 2L cross +
+                # 2L caches; decode fetches 3.. are the 2L caches that
+                # feed straight back. All shard dim 2 over 'sp'.
+                self.prefill_program.with_data_parallel(
+                    mesh_axes=("sp",), mesh_shape={"sp": n}, places=n,
+                    seq_feeds={f: 2 for f in
+                               self._prefill_feeds[6:6 + 2 * L]},
+                    seq_fetches={f: 2 for f in
+                                 self._prefill_fetches[1:1 + 4 * L]})
+                self.decode_program.with_data_parallel(
+                    mesh_axes=("sp",), mesh_shape={"sp": n}, places=n,
+                    seq_feeds={f: 2 for f in
+                               self._decode_feeds[4:4 + 4 * L]},
+                    seq_fetches={f: 2 for f in
+                                 self._decode_fetches[3:3 + 2 * L]})
         else:
             self.prefill_program = prefill_tl.program
             self.decode_program = decode_tl.program

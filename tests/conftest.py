@@ -76,6 +76,11 @@ def pytest_configure(config):
         "markers", "chaos: kills and restarts the coordination "
                    "service mid-run (WAL recovery, reconnecting "
                    "clients, degraded-mode fleet routing)")
+    config.addinivalue_line(
+        "markers", "longctx: exercises the long-context tier (ring / "
+                   "Ulysses sequence-parallel attention over the 'sp' "
+                   "mesh axis, recompute, sequence-sharded decode); "
+                   "heavy S>=1024 cases additionally carry 'slow'")
 
 
 @pytest.fixture(autouse=True)
