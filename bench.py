@@ -857,6 +857,138 @@ def bench_transformer(batch_size=32, seq_len=64, warmup=3, iters=10):
             "transformer_big_seq_len": seq_len}
 
 
+def _build_tower_pipeline(n_layers, n_stages, trace_batch, seq_len, vocab,
+                          d_model=64, n_heads=4, d_inner=128, lr=0.1,
+                          num_microbatches=4, seed=7):
+    """Trace an EncoderTower LM at per-shard microbatch size, cut it into
+    ``n_stages`` uniform segments at encoder-layer boundaries, and wrap
+    it with ``with_pipeline``. Returns (traced, startup, loss, compiled,
+    feed_fn) where feed_fn(batch_rows, seed) builds a full-batch feed."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import dygraph, layers, optimizer
+    from paddle_tpu.models import transformer
+
+    import jax
+
+    with dygraph.guard():
+        model = transformer.EncoderTower(
+            vocab, d_model=d_model, n_heads=n_heads, d_inner=d_inner,
+            n_layers=n_layers, max_len=seq_len, dropout_rate=0.0)
+        rng = np.random.RandomState(seed)
+        ids = rng.randint(0, vocab, size=(trace_batch, seq_len),
+                          ).astype("int64")
+        pos = np.tile(np.arange(seq_len, dtype="int64"), (trace_batch, 1))
+        args = [dygraph.to_variable(v) for v in (ids, pos)]
+        _, traced = dygraph.jit.trace(model, args)
+
+    startup = fluid.Program()
+    with fluid.program_guard(traced.program, startup):
+        blk = traced.program.global_block()
+        logits = blk.var(traced._fetch_names[0])
+        label = layers.data("tower_lbl", [seq_len, 1], dtype="int64")
+        ce = layers.softmax_with_cross_entropy(
+            layers.reshape(logits, [-1, vocab]),
+            layers.reshape(label, [-1, 1]))
+        loss = layers.mean(ce)
+        opt = optimizer.SGD(learning_rate=lr)
+        if n_stages > 1:
+            per = n_layers // n_stages
+            cuts = [blk.var(model.last_checkpoints[per * (i + 1) - 1])
+                    for i in range(n_stages - 1)]
+            opt = optimizer.PipelineOptimizer(opt, cut_list=cuts)
+        opt.minimize(loss)
+    traced._materialize_scope()
+
+    compiled = fluid.CompiledProgram(traced.program).with_pipeline(
+        loss_name=loss.name, places=jax.devices()[:n_stages],
+        num_microbatches=num_microbatches)
+
+    def feed_fn(batch_rows, fseed=11):
+        frng = np.random.RandomState(fseed)
+        fids = frng.randint(0, vocab, size=(batch_rows, seq_len),
+                            ).astype("int64")
+        fpos = np.tile(np.arange(seq_len, dtype="int64"), (batch_rows, 1))
+        flbl = frng.randint(0, vocab, size=(batch_rows, seq_len, 1),
+                            ).astype("int64")
+        feed = dict(zip(traced._feed_names, (fids, fpos)))
+        feed["tower_lbl"] = flbl
+        return feed
+
+    return traced, startup, loss, compiled, feed_fn
+
+
+def bench_pipeline(seq_len=32, vocab=256, layers_per_stage=2, mb_rows=4,
+                   warmup=2, iters=8):
+    """3D-parallelism bench (opt-in BENCH_PIPELINE=1), CPU-mesh friendly.
+
+    Two measurements:
+      * bubble fraction — a fixed 2-stage pipeline timed at two
+        microbatch counts (M=4 and M=8). The per-tick time comes from
+        the slope (T(M2)-T(M1))/(M2-M1), which cancels the fixed
+        per-step overhead; the measured bubble (S-1)*tick/T(M) must
+        match the analytic (S-1)/(M+S-1) within 10 points, and the
+        ``pipeline_bubble_fraction`` gauge must equal the analytic
+        value exactly (it is set from the schedule shape at wrap).
+      * weak scaling — 1 -> 2 -> 4 stages with ``layers_per_stage``
+        encoder layers per stage (the model grows with the mesh), so
+        ideal scaling is flat tokens/sec; reported, not asserted.
+    """
+    from paddle_tpu.fluid import monitor
+
+    import paddle_tpu.fluid as fluid
+
+    def run_config(n_stages, M):
+        traced, startup, loss, compiled, feed_fn = _build_tower_pipeline(
+            n_layers=layers_per_stage * n_stages, n_stages=n_stages,
+            trace_batch=mb_rows, seq_len=seq_len, vocab=vocab,
+            num_microbatches=M)
+        B = M * mb_rows
+        feed = feed_fn(B)
+        exe = fluid.Executor()
+        with fluid.scope_guard(traced._scope):
+            exe.run(startup)
+            for _ in range(warmup):
+                (lv,) = exe.run(compiled, feed=feed, fetch_list=[loss])
+                assert np.isfinite(np.asarray(lv)).all()
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                exe.run(compiled, feed=feed, fetch_list=[loss])
+            dt = (time.perf_counter() - t0) / iters
+        gauge = monitor.gauge("pipeline_bubble_fraction").value
+        return dt, B * seq_len / dt, gauge
+
+    # -- bubble fraction: same 2-stage model, two microbatch counts ------
+    S, M1, M2 = 2, 4, 8
+    t1, _, g1 = run_config(S, M1)
+    t2, _, g2 = run_config(S, M2)
+    tick = (t2 - t1) / ((M2 + S - 1) - (M1 + S - 1))
+    analytic1 = (S - 1) / (M1 + S - 1)
+    analytic2 = (S - 1) / (M2 + S - 1)
+    measured = (S - 1) * tick / t1 if tick > 0 else 0.0
+    assert g1 == analytic1 and g2 == analytic2, (
+        "pipeline_bubble_fraction gauge %r/%r != analytic %r/%r"
+        % (g1, g2, analytic1, analytic2))
+    assert abs(measured - analytic1) <= 0.10, (
+        "measured bubble %.3f vs analytic %.3f: off by more than 10 "
+        "points" % (measured, analytic1))
+
+    # -- weak scaling: layers grow with the stage count ------------------
+    weak = {}
+    for n_stages in (1, 2, 4):
+        _, tps, _ = run_config(n_stages, M=8)
+        weak["pipeline_weak_tokens_per_sec_%dstage" % n_stages] = (
+            round(tps, 1))
+
+    out = {"pipeline_bubble_analytic": round(analytic1, 4),
+           "pipeline_bubble_measured": round(measured, 4),
+           "pipeline_bubble_gauge": g1,
+           "pipeline_tick_seconds": round(tick, 6),
+           "pipeline_microbatches_total":
+               monitor.counter("pipeline_microbatches_total").value}
+    out.update(weak)
+    return out
+
+
 def bench_transformer_decode(batch_sizes=(1, 64), src_len=128,
                              prompt_len=64, cache_capacity=1024,
                              new_tokens=64):
@@ -1694,6 +1826,13 @@ def bench_smoke():
     syncs before ``.numpy()``, finite decoupled losses) and prints the
     same one-line JSON shape as the real bench."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if ("jax" not in sys.modules
+            and "xla_force_host_platform_device_count" not in _flags):
+        # the pipeline smoke leg wants a 2-stage mesh; harmless for the
+        # rest (every other leg shards or replicates transparently)
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
     import paddle_tpu.fluid as fluid
     from paddle_tpu.fluid import layers, monitor
 
@@ -1891,6 +2030,29 @@ def bench_smoke():
             os.environ[compile_cache.ENV_DIR] = cache_env_prev
         shutil.rmtree(cache_tmp, ignore_errors=True)
 
+    # tiny 2-stage GPipe pipeline: one step through with_pipeline must
+    # populate the schedule-shape gauge and the microbatch counter (the
+    # 3D-parallelism observability contract — BENCH_PIPELINE=1 runs the
+    # full bubble/weak-scaling leg)
+    import jax as _jax
+
+    pipe_stages = 2 if len(_jax.devices()) >= 2 else 1
+    pipe_mb0 = monitor.counter("pipeline_microbatches_total").value
+    ptraced, pstartup, ploss, pcompiled, pfeed_fn = _build_tower_pipeline(
+        n_layers=2, n_stages=pipe_stages, trace_batch=2, seq_len=8,
+        vocab=64, d_model=32, n_heads=2, d_inner=64, num_microbatches=2)
+    pexe = fluid.Executor()
+    with fluid.scope_guard(ptraced._scope):
+        pexe.run(pstartup)
+        (plv,) = pexe.run(pcompiled, feed=pfeed_fn(4), fetch_list=[ploss])
+    assert np.isfinite(np.asarray(plv)).all()
+    pipe_bubble = monitor.gauge("pipeline_bubble_fraction").value
+    pipe_mb = monitor.counter("pipeline_microbatches_total").value - pipe_mb0
+    assert pipe_bubble == (pipe_stages - 1) / (2 + pipe_stages - 1), (
+        "pipeline smoke: bubble gauge %r != analytic" % pipe_bubble)
+    assert pipe_mb == 2, (
+        "pipeline smoke: microbatch counter moved %d, want 2" % pipe_mb)
+
     return {
         "serve_smoke_requests_per_sec": serve["serve_requests_per_sec"],
         "serve_smoke_mean_batch_occupancy":
@@ -1913,6 +2075,8 @@ def bench_smoke():
         "coord_smoke_requests_lost": coordrec["coord_requests_lost"],
         "coord_smoke_stale_routed": coordrec["coord_stale_routed"],
         "coord_smoke_recovery_s": coordrec["coord_recovery_s"],
+        "pipeline_smoke_bubble_fraction": pipe_bubble,
+        "pipeline_smoke_microbatches": pipe_mb,
         "monitor": monitor_summary(),
     }
 
@@ -1940,6 +2104,8 @@ if __name__ == "__main__":
         out.update(bench_deepfm())
     if os.environ.get("BENCH_TRANSFORMER") == "1":
         out.update(bench_transformer())
+    if os.environ.get("BENCH_PIPELINE") == "1":
+        out.update(bench_pipeline())
     if os.environ.get("BENCH_DECODE") == "1":
         out.update(bench_transformer_decode())
     if os.environ.get("BENCH_SERVE") == "1":

@@ -23,8 +23,13 @@ class _ProgramRecorder:
             return self._known[key]
         name = vb.name
         if as_param or vb.persistable:
-            self.block.create_parameter(shape=list(vb.shape), dtype=vb.dtype,
-                                        name=name)
+            param = self.block.create_parameter(
+                shape=list(vb.shape), dtype=vb.dtype, name=name)
+            # carry the eager param's tensor-parallel layout into the
+            # static Program so CompiledProgram sees it after the trace
+            spec = getattr(vb, "shard_spec", None)
+            if spec is not None and param is not None:
+                param.shard_spec = tuple(spec)
         else:
             self.block.create_var(name=name, shape=list(vb.shape),
                                   dtype=vb.dtype, is_data=True,

@@ -52,6 +52,12 @@ class Layer:
             "%s.%s" % (self._full_name, "b" if is_bias else "w"))
         p = VarBase(value, name=name, stop_gradient=not attr.trainable,
                     persistable=True)
+        if attr.shard is not None:
+            if len(attr.shard) != len(shape):
+                raise ValueError(
+                    "ParamAttr.shard %r must have one entry per param "
+                    "dim %r" % (attr.shard, tuple(shape)))
+            p.shard_spec = tuple(attr.shard)
         p.trainable = attr.trainable
         p.regularizer = attr.regularizer
         p.optimize_attr = {"learning_rate": attr.learning_rate}

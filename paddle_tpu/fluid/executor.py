@@ -227,7 +227,7 @@ def _feed_signature(feed, block):
     return tuple(sig)
 
 
-def _split_batched_feed(feed, block, iters):
+def _split_batched_feed(feed, block, iters, batch_factor=1):
     """Classify each ``iters=k`` feed as per-iteration STACKED
     (``[k, ...]``, sliced by the device-side loop) or loop-INVARIANT
     (the per-step shape, reused every iteration).
@@ -237,7 +237,13 @@ def _split_batched_feed(feed, block, iters):
     axis decides: ``shape[0] == k`` means one slice per iteration.
     Ambiguity (a per-step shape whose own leading dim equals k)
     resolves to the declared/per-step reading for static vars and the
-    stacked reading for dynamic ones — stack explicitly to be safe."""
+    stacked reading for dynamic ones — stack explicitly to be safe.
+
+    ``batch_factor > 1`` (manual pipeline mode): programs traced at the
+    per-shard microbatch size take per-step feeds at the FULL batch —
+    leading dim scaled by ``M * data * host`` — so that scaled shape is
+    accepted alongside the declared one (batch-invariant feeds like an
+    attention bias still arrive at their declared shape)."""
     stacked, invariant = {}, {}
     for name, arr in feed.items():
         shape = tuple(np.shape(arr))
@@ -246,9 +252,12 @@ def _split_batched_feed(feed, block, iters):
             if var is not None and var.shape is not None else None
         static = declared is not None and all(d >= 0 for d in declared)
         if static:
-            if shape == declared:
+            per_step = {declared}
+            if batch_factor > 1 and declared and declared[0] > 0:
+                per_step.add((declared[0] * batch_factor,) + declared[1:])
+            if shape in per_step:
                 invariant[name] = arr
-            elif shape == (iters,) + declared:
+            elif shape[:1] == (iters,) and shape[1:] in per_step:
                 stacked[name] = arr
             elif shape[:1] == (iters,):
                 raise ValueError(
@@ -1202,7 +1211,16 @@ class Executor:
                 arr = arr.astype(var.dtype)
             feed[name] = arr
 
-        stacked, invariant = _split_batched_feed(feed, block, iters)
+        batch_factor = 1
+        if strategy is not None and \
+                getattr(strategy, "_mode", "") == "pipeline":
+            batch_factor = int(strategy._num_microbatches)
+            mesh = strategy.mesh
+            for ax in ("host", "data"):
+                if mesh is not None and ax in mesh.shape:
+                    batch_factor *= int(mesh.shape[ax])
+        stacked, invariant = _split_batched_feed(feed, block, iters,
+                                                 batch_factor)
 
         state_names = sorted(
             v.name
@@ -1424,7 +1442,8 @@ class Executor:
                                            invariant, fetch_names,
                                            state_names,
                                            cache_key=cache_key,
-                                           cache_read_dirs=self._cache_read_dirs),
+                                           cache_read_dirs=self._cache_read_dirs,
+                                           program=program, iters=iters),
                 state_names,
                 fetch_names,
             )

@@ -76,16 +76,38 @@ def reduce_sum(x, dim=None, keep_dim=False):
     return out
 
 
+def _tp_attrs(model_axis, kind):
+    """(param_attr, bias_attr) for a Megatron-sharded Linear: 'col'
+    splits the OUTPUT features over the model axis (bias rides along),
+    'row' splits the INPUT features (bias stays replicated — it adds
+    AFTER the partial products are reduced). None model_axis = dense."""
+    if model_axis is None:
+        return None, None
+    from paddle_tpu.fluid.param_attr import ParamAttr
+
+    if kind == "col":
+        return (ParamAttr(shard=(None, model_axis)),
+                ParamAttr(shard=(model_axis,)))
+    return ParamAttr(shard=(model_axis, None)), None
+
+
 class MultiHeadAttention(Layer):
-    def __init__(self, d_model, n_heads, dropout_rate=0.1):
+    def __init__(self, d_model, n_heads, dropout_rate=0.1,
+                 model_axis=None):
         super().__init__()
         self.n_heads = n_heads
         self.d_key = d_model // n_heads
         self.dropout_rate = dropout_rate
-        self.q_fc = nn.Linear(d_model, d_model)
-        self.k_fc = nn.Linear(d_model, d_model)
-        self.v_fc = nn.Linear(d_model, d_model)
-        self.out_fc = nn.Linear(d_model, d_model)
+        # Megatron split: QKV column-parallel (each shard owns H/size
+        # whole heads), output row-parallel (one psum per attention
+        # block, inserted by the compiler from these shard specs)
+        cw, cb = _tp_attrs(model_axis, "col")
+        rw, rb = _tp_attrs(model_axis, "row")
+        self.q_fc = nn.Linear(d_model, d_model, param_attr=cw, bias_attr=cb)
+        self.k_fc = nn.Linear(d_model, d_model, param_attr=cw, bias_attr=cb)
+        self.v_fc = nn.Linear(d_model, d_model, param_attr=cw, bias_attr=cb)
+        self.out_fc = nn.Linear(d_model, d_model, param_attr=rw,
+                                bias_attr=rb)
 
     def _split(self, t):
         t = reshape(t, [t.shape[0], -1, self.n_heads, self.d_key])
@@ -162,10 +184,15 @@ class MultiHeadAttention(Layer):
 
 
 class FFN(Layer):
-    def __init__(self, d_model, d_inner, dropout_rate=0.1):
+    def __init__(self, d_model, d_inner, dropout_rate=0.1,
+                 model_axis=None):
         super().__init__()
-        self.fc1 = nn.Linear(d_model, d_inner, act="relu")
-        self.fc2 = nn.Linear(d_inner, d_model)
+        cw, cb = _tp_attrs(model_axis, "col")
+        rw, rb = _tp_attrs(model_axis, "row")
+        self.fc1 = nn.Linear(d_model, d_inner, act="relu",
+                             param_attr=cw, bias_attr=cb)
+        self.fc2 = nn.Linear(d_inner, d_model, param_attr=rw,
+                             bias_attr=rb)
         self.dropout_rate = dropout_rate
 
     def forward(self, x):
@@ -174,10 +201,13 @@ class FFN(Layer):
 
 
 class EncoderLayer(Layer):
-    def __init__(self, d_model, n_heads, d_inner, dropout_rate=0.1):
+    def __init__(self, d_model, n_heads, d_inner, dropout_rate=0.1,
+                 model_axis=None):
         super().__init__()
-        self.attn = MultiHeadAttention(d_model, n_heads, dropout_rate)
-        self.ffn = FFN(d_model, d_inner, dropout_rate)
+        self.attn = MultiHeadAttention(d_model, n_heads, dropout_rate,
+                                       model_axis=model_axis)
+        self.ffn = FFN(d_model, d_inner, dropout_rate,
+                       model_axis=model_axis)
         self.ln1 = nn.LayerNorm(normalized_shape=[d_model], begin_norm_axis=2)
         self.ln2 = nn.LayerNorm(normalized_shape=[d_model], begin_norm_axis=2)
         self.dropout_rate = dropout_rate
@@ -200,11 +230,15 @@ class EncoderLayer(Layer):
 
 
 class DecoderLayer(Layer):
-    def __init__(self, d_model, n_heads, d_inner, dropout_rate=0.1):
+    def __init__(self, d_model, n_heads, d_inner, dropout_rate=0.1,
+                 model_axis=None):
         super().__init__()
-        self.self_attn = MultiHeadAttention(d_model, n_heads, dropout_rate)
-        self.cross_attn = MultiHeadAttention(d_model, n_heads, dropout_rate)
-        self.ffn = FFN(d_model, d_inner, dropout_rate)
+        self.self_attn = MultiHeadAttention(d_model, n_heads, dropout_rate,
+                                            model_axis=model_axis)
+        self.cross_attn = MultiHeadAttention(d_model, n_heads, dropout_rate,
+                                             model_axis=model_axis)
+        self.ffn = FFN(d_model, d_inner, dropout_rate,
+                       model_axis=model_axis)
         self.ln1 = nn.LayerNorm(normalized_shape=[d_model], begin_norm_axis=2)
         self.ln2 = nn.LayerNorm(normalized_shape=[d_model], begin_norm_axis=2)
         self.ln3 = nn.LayerNorm(normalized_shape=[d_model], begin_norm_axis=2)
@@ -280,18 +314,27 @@ class Transformer(Layer):
 
     def __init__(self, src_vocab, tgt_vocab, d_model=512, n_heads=8,
                  d_inner=2048, n_layers=6, max_len=256, dropout_rate=0.1,
-                 seq_parallel=False, attn_strategy="auto"):
+                 seq_parallel=False, attn_strategy="auto",
+                 model_axis=None):
         super().__init__()
         self.d_model = d_model
         self.n_heads = n_heads
         self.max_len = max_len
+        self.model_axis = model_axis
+        # embeddings and the output projection stay replicated under TP:
+        # sharding them over 'model' would make the softmax+CE vocab-
+        # parallel, a different (all-gather-bearing) lowering
         self.src_emb = nn.Embedding(size=[src_vocab, d_model])
         self.tgt_emb = nn.Embedding(size=[tgt_vocab, d_model])
         self.pos_emb = nn.Embedding(size=[max_len, d_model])
         self.enc_layers = [EncoderLayer(d_model, n_heads, d_inner,
-                                        dropout_rate) for _ in range(n_layers)]
+                                        dropout_rate,
+                                        model_axis=model_axis)
+                           for _ in range(n_layers)]
         self.dec_layers = [DecoderLayer(d_model, n_heads, d_inner,
-                                        dropout_rate) for _ in range(n_layers)]
+                                        dropout_rate,
+                                        model_axis=model_axis)
+                           for _ in range(n_layers)]
         for i, l in enumerate(self.enc_layers):
             self.add_sublayer("enc_%d" % i, l)
         for i, l in enumerate(self.dec_layers):
@@ -328,9 +371,9 @@ class Transformer(Layer):
                            d_inner=4096, n_layers=6)
 
     @staticmethod
-    def tiny(src_vocab=512, tgt_vocab=512):
+    def tiny(src_vocab=512, tgt_vocab=512, **kw):
         return Transformer(src_vocab, tgt_vocab, d_model=32, n_heads=4,
-                           d_inner=64, n_layers=2, max_len=64)
+                           d_inner=64, n_layers=2, max_len=64, **kw)
 
     def _embed(self, ids, emb, pos_ids):
         x = emb(ids)
@@ -425,6 +468,47 @@ class Transformer(Layer):
         (fin,) = _op("logical_or", {"X": [finished], "Y": [is_end]},
                      ["Out"])
         return tuple([nxt, new_len, fin] + new_k + new_v)
+
+
+class EncoderTower(Layer):
+    """Encoder-only LM tower (embed -> N encoder layers -> vocab proj).
+
+    The pipeline-parallel workhorse: every encoder layer boundary
+    carries the SAME [B, S, D] activation, so the tower admits uniform
+    GPipe cuts at ANY stage count dividing the layer count — unlike the
+    encoder-decoder Transformer, whose decoder-side cuts would need the
+    encoder output bundled into every boundary. ``last_checkpoints``
+    (layer-output var names, recorded per trace) are the cut
+    candidates."""
+
+    def __init__(self, vocab, d_model=64, n_heads=4, d_inner=128,
+                 n_layers=4, max_len=64, dropout_rate=0.0,
+                 model_axis=None):
+        super().__init__()
+        self.d_model = d_model
+        self.emb = nn.Embedding(size=[vocab, d_model])
+        self.pos_emb = nn.Embedding(size=[max_len, d_model])
+        self.layers_ = [EncoderLayer(d_model, n_heads, d_inner,
+                                     dropout_rate, model_axis=model_axis)
+                        for _ in range(n_layers)]
+        for i, l in enumerate(self.layers_):
+            self.add_sublayer("tower_%d" % i, l)
+        self.proj = nn.Linear(d_model, vocab)
+        self.dropout_rate = dropout_rate
+        self.last_checkpoints = []
+
+    def forward(self, ids, pos):
+        self.last_checkpoints = []
+        x = self.emb(ids)
+        (x,) = _op("scale", {"X": [x]}, ["Out"],
+                   {"scale": math.sqrt(self.d_model), "bias": 0.0,
+                    "bias_after_scale": True})
+        x = dropout(x + self.pos_emb(pos), self.dropout_rate,
+                    is_test=not self.training)
+        for l in self.layers_:
+            x = l(x, None)
+            self.last_checkpoints.append(x.name)
+        return self.proj(x)
 
 
 def make_causal_bias(seq_len):

@@ -81,6 +81,14 @@ def pytest_configure(config):
                    "Ulysses sequence-parallel attention over the 'sp' "
                    "mesh axis, recompute, sequence-sharded decode); "
                    "heavy S>=1024 cases additionally carry 'slow'")
+    config.addinivalue_line(
+        "markers", "pipeline3d: exercises 3D parallelism (GPipe "
+                   "pipeline schedule over 'stage', Megatron tensor "
+                   "parallelism over 'model', hierarchical DP over "
+                   "'host'/'data' — loss-trajectory equivalence, "
+                   "iters=k windows, checkpoint resharding); the "
+                   "compile-heavy equivalence/report cases additionally "
+                   "carry 'slow' — run -m pipeline3d for full coverage")
 
 
 @pytest.fixture(autouse=True)

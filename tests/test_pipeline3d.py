@@ -1,0 +1,372 @@
+"""3D parallelism: GPipe pipeline x Megatron tensor parallel x
+hierarchical data parallel, composed on one mesh.
+
+The oracle is loss-trajectory equivalence: the SAME initial parameters
+stepped by plain SGD on one device must reproduce (CPU fp32,
+rtol <= 1e-6) under every composition — pipeline-only (2 stages),
+TP-only (GSPMD over a 'model' axis), and pipeline x TP x DP on the full
+8-device mesh. Microbatch loss averaging, the stage psum, the Megatron
+region collectives and the DP pmean must all telescope back to the
+single-device math or the trajectory drifts in step one.
+
+Also here: the ``iters=k`` window bit-identity contract for pipelined
+programs, the typed ``UnsupportedStrategyError`` refusal, reserved
+mesh-axis validation, checkpoint resharding across a mesh-shape change
+that adds 'stage', and the ``tools/stagebalance.py`` cut audit."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers, monitor, optimizer
+from paddle_tpu.fluid.compiler import (RESERVED_AXES,
+                                       UnsupportedStrategyError)
+from paddle_tpu.fluid import dygraph
+from paddle_tpu.fluid.executor import scope_guard
+from paddle_tpu.models import transformer
+
+V, SEQ = 64, 8
+M = 2                 # microbatches
+B_SHARD = 2           # per-shard microbatch rows (pipeline trace batch)
+B_FULL = M * B_SHARD  # global batch
+STEPS = 3
+
+
+def _build_tiny(trace_batch, pipeline, model_axis=None):
+    """Trace the tiny NMT transformer at ``trace_batch`` rows, append
+    CE loss + SGD (wrapped in PipelineOptimizer cutting at the final
+    encoder output when ``pipeline``), and materialize the eager params
+    into a scope."""
+    with dygraph.guard():
+        model = transformer.Transformer.tiny(V, V, dropout_rate=0.0,
+                                             model_axis=model_axis)
+        src, tgt, labels, pos = transformer.synthetic_batch(
+            V, V, trace_batch, SEQ)
+        bias = transformer.make_causal_bias(SEQ)
+        args = [dygraph.to_variable(v) for v in (src, tgt, pos, pos, bias)]
+        _, traced = dygraph.jit.trace(model, args)
+    startup = fluid.Program()
+    with fluid.program_guard(traced.program, startup):
+        blk = traced.program.global_block()
+        logits = blk.var(traced._fetch_names[0])
+        label = layers.data("lbl", [SEQ, 1], dtype="int64")
+        ce = layers.softmax_with_cross_entropy(
+            layers.reshape(logits, [-1, V]),
+            layers.reshape(label, [-1, 1]))
+        loss = layers.mean(ce)
+        opt = optimizer.SGD(learning_rate=0.1)
+        if pipeline:
+            cut = blk.var(model.last_checkpoints[1])  # final encoder out
+            opt = optimizer.PipelineOptimizer(opt, cut_list=[cut])
+        opt.minimize(loss)
+    traced._materialize_scope()
+    return model, traced, startup, loss
+
+
+def _copy_params(ref_values, model, traced):
+    """Same init across traces: eager params pair up by construction
+    order (dygraph names are globally counted, so name equality can't).
+    ``ref_values`` are numpy snapshots — the reference run donates its
+    scope buffers, so the eager arrays themselves don't survive it."""
+    ps = model.parameters()
+    assert len(ref_values) == len(ps)
+    for rv, pp in zip(ref_values, ps):
+        assert tuple(rv.shape) == tuple(pp.shape), (rv.shape, pp.name)
+        traced._scope.set_var(pp.name, rv)
+
+
+def _run_steps(exe, program, traced, loss, feed, n=STEPS):
+    with scope_guard(traced._scope):
+        return [float(np.asarray(exe.run(program, feed=feed,
+                                         fetch_list=[loss])[0]).ravel()[0])
+                for _ in range(n)]
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    """Single-device reference trajectory + the eager params and batch
+    every composition must reproduce."""
+    model, traced, startup, loss = _build_tiny(B_FULL, pipeline=False)
+    src, tgt, labels, pos = transformer.synthetic_batch(V, V, B_FULL, SEQ,
+                                                        seed=3)
+    bias = transformer.make_causal_bias(SEQ)
+    feed = dict(zip(traced._feed_names, (src, tgt, pos, pos, bias)))
+    feed["lbl"] = labels
+    exe = fluid.Executor()
+    with scope_guard(traced._scope):
+        exe.run(startup)
+    init = [np.asarray(p._ivar).copy() for p in model.parameters()]
+    losses = _run_steps(exe, traced.program, traced, loss, feed)
+    return {"params": init, "losses": losses,
+            "arrays": (src, tgt, pos, pos, bias), "labels": labels}
+
+
+def _feed_for(traced, arrays, labels):
+    feed = dict(zip(traced._feed_names, arrays))
+    feed["lbl"] = labels
+    return feed
+
+
+@pytest.mark.slow
+@pytest.mark.pipeline3d
+def test_pipeline_matches_single_device(oracle):
+    model, traced, startup, loss = _build_tiny(B_SHARD, pipeline=True)
+    _copy_params(oracle["params"], model, traced)
+    cp = fluid.CompiledProgram(traced.program).with_pipeline(
+        loss_name=loss.name, places=jax.devices()[:2], num_microbatches=M)
+    exe = fluid.Executor()
+    with scope_guard(traced._scope):
+        exe.run(startup)
+    losses = _run_steps(exe, cp, traced, loss,
+                        _feed_for(traced, oracle["arrays"],
+                                  oracle["labels"]))
+    np.testing.assert_allclose(oracle["losses"], losses, rtol=1e-6)
+
+
+@pytest.mark.slow
+@pytest.mark.pipeline3d
+def test_tensor_parallel_matches_single_device(oracle):
+    model, traced, startup, loss = _build_tiny(B_FULL, pipeline=False,
+                                               model_axis="model")
+    _copy_params(oracle["params"], model, traced)
+    cp = fluid.CompiledProgram(traced.program).with_data_parallel(
+        loss_name=loss.name, mesh_axes=("dp", "model"),
+        mesh_shape={"dp": 2, "model": 4})
+    exe = fluid.Executor()
+    with scope_guard(traced._scope):
+        exe.run(startup)
+    losses = _run_steps(exe, cp, traced, loss,
+                        _feed_for(traced, oracle["arrays"],
+                                  oracle["labels"]))
+    np.testing.assert_allclose(oracle["losses"], losses, rtol=1e-6)
+
+
+@pytest.mark.slow
+@pytest.mark.pipeline3d
+def test_pipeline_tp_dp_composed_matches_single_device(oracle):
+    """The full 3D mesh: stage=2 x model=2 x data=2 over all 8 CPU
+    devices, per-shard microbatch of ONE row."""
+    model, traced, startup, loss = _build_tiny(1, pipeline=True,
+                                               model_axis="model")
+    _copy_params(oracle["params"], model, traced)
+    cp = fluid.CompiledProgram(traced.program).with_pipeline(
+        loss_name=loss.name, num_microbatches=M,
+        mesh_axes=("stage", "model", "data"),
+        mesh_shape={"stage": 2, "model": 2, "data": 2})
+    exe = fluid.Executor()
+    with scope_guard(traced._scope):
+        exe.run(startup)
+    losses = _run_steps(exe, cp, traced, loss,
+                        _feed_for(traced, oracle["arrays"],
+                                  oracle["labels"]))
+    np.testing.assert_allclose(oracle["losses"], losses, rtol=1e-6)
+
+
+# -- iters=k window ----------------------------------------------------------
+
+def _build_mlp_pipeline(seed=13):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[32], dtype="float32")
+        label = layers.data("label", shape=[1], dtype="int64")
+        h1 = layers.fc(x, 32, act="tanh")
+        h2 = layers.fc(h1, 32, act="tanh")
+        logits = layers.fc(h2, 10)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+        opt = optimizer.PipelineOptimizer(optimizer.SGD(learning_rate=0.1),
+                                          cut_list=[h1])
+        opt.minimize(loss)
+    return main, startup, loss
+
+
+def _snapshot(scope):
+    return {n: np.asarray(scope.find_var(n)).copy()
+            for n in scope.var_names()}
+
+
+@pytest.mark.pipeline3d
+def test_pipeline_iters_window_bit_identical():
+    """A k-step device-side window through the pipelined program must be
+    BIT-identical to k single steps: the window scans the same GPipe
+    kernel, so not even float reassociation may differ."""
+    k = 3
+    rng = np.random.RandomState(7)
+    xs = rng.rand(k, 8, 32).astype(np.float32)
+    ys = rng.randint(0, 10, (k, 8, 1)).astype(np.int64)
+
+    main, startup, loss = _build_mlp_pipeline()
+    cp = fluid.CompiledProgram(main).with_pipeline(
+        loss_name=loss.name, places=jax.devices()[:2], num_microbatches=2)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        snap = _snapshot(scope)
+        single = [np.asarray(exe.run(cp, feed={"x": xs[i], "label": ys[i]},
+                                     fetch_list=[loss])[0])
+                  for i in range(k)]
+        end_single = _snapshot(scope)
+
+    # same Program, fresh strategy/scope, identical initial state
+    cp2 = fluid.CompiledProgram(main).with_pipeline(
+        loss_name=loss.name, places=jax.devices()[:2], num_microbatches=2)
+    exe2 = fluid.Executor()
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        exe2.run(startup)
+        for n, v in snap.items():
+            scope2.set_var(n, v)
+        (traj,) = exe2.run(cp2, feed={"x": xs, "label": ys},
+                           fetch_list=[loss], iters=k)
+        traj = np.asarray(traj)
+        end_window = _snapshot(scope2)
+
+    np.testing.assert_array_equal(
+        traj.ravel(), np.asarray(single).ravel())
+    for n in end_single:
+        if end_single[n].dtype == np.float32:
+            np.testing.assert_array_equal(end_single[n], end_window[n],
+                                          err_msg=n)
+
+
+@pytest.mark.pipeline3d
+def test_iters_refuses_shard_map_strategy_with_typed_error():
+    """shard_map mode schedules its own device loop; asking it to batch
+    steps must raise the TYPED error naming the strategy and the
+    supported set — not a silent fallback, not a bare RuntimeError."""
+    main, startup, loss = _build_mlp_pipeline()
+    cp = fluid.CompiledProgram(main).with_explicit_collectives(
+        loss_name=loss.name)
+    rng = np.random.RandomState(0)
+    xs = rng.rand(2, 8, 32).astype(np.float32)
+    ys = rng.randint(0, 10, (2, 8, 1)).astype(np.int64)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        with pytest.raises(UnsupportedStrategyError) as ei:
+            exe.run(cp, feed={"x": xs, "label": ys}, fetch_list=[loss],
+                    iters=2)
+    msg = str(ei.value)
+    assert "shard_map" in msg
+    assert "with_data_parallel" in msg and "with_pipeline" in msg
+    assert isinstance(ei.value, RuntimeError)  # back-compat contract
+
+
+# -- mesh-axis validation ----------------------------------------------------
+
+@pytest.mark.pipeline3d
+def test_reserved_axes_rejected_outside_owning_strategy():
+    main, _, loss = _build_mlp_pipeline()
+
+    def fresh():
+        return fluid.CompiledProgram(main)
+
+    # 'stage' belongs to the pipeline schedule, not GSPMD
+    with pytest.raises(ValueError, match="reserved"):
+        fresh().with_data_parallel(loss_name=loss.name,
+                                   mesh_axes=("stage", "dp"))
+    # 'model'/'sp' have no meaning under explicit collectives
+    with pytest.raises(ValueError, match="reserved"):
+        fresh().with_explicit_collectives(loss_name=loss.name,
+                                          mesh_axes=("model",))
+    # the pipeline cannot run without its own axis
+    with pytest.raises(ValueError, match="requires mesh axes"):
+        fresh().with_pipeline(loss_name=loss.name, mesh_axes=("data",))
+    # and accepts only axes with a role in the schedule
+    with pytest.raises(ValueError, match="no role"):
+        fresh().with_pipeline(loss_name=loss.name,
+                              mesh_axes=("stage", "foo"))
+    with pytest.raises(ValueError, match="duplicates"):
+        fresh().with_data_parallel(loss_name=loss.name,
+                                   mesh_axes=("dp", "dp"))
+    # free (non-reserved) names stay legal where they always were
+    fresh().with_data_parallel(loss_name=loss.name, mesh_axes=("dp", "tp"))
+    assert RESERVED_AXES == {"host", "stage", "model", "data", "sp"}
+
+
+# -- checkpoint resharding across a mesh-shape change ------------------------
+
+def _sharded_fc_program(seed=11):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[8], dtype="float32")
+        h = layers.fc(x, size=8, act="relu",
+                      param_attr=fluid.ParamAttr(shard=("model", None)))
+        loss = layers.reduce_mean(h)
+        optimizer.SGD(0.05).minimize(loss)
+    return main, startup, loss
+
+
+@pytest.mark.pipeline3d
+def test_checkpoint_reshards_onto_pipeline_mesh(tmp_path):
+    """A 'model'-sharded checkpoint saved under a 1x4 GSPMD mesh restores
+    onto a 2x2 stage-x-model pipeline mesh (the spec's axis survived, so
+    it reshards) and onto a stage-only mesh (axis gone: the degradation
+    path replicates and counts it) — mesh-shape changes across the
+    pipeline axes go through the same single source of truth."""
+    from jax.sharding import PartitionSpec as P
+
+    main, startup, loss = _sharded_fc_program()
+    name = [v.name for v in main.list_vars()
+            if getattr(v, "shard_spec", None)][0]
+    exe = fluid.Executor()
+    save_cp = fluid.CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name, mesh_axes=("dp", "model"),
+        mesh_shape={"dp": 1, "model": 4}, places=jax.devices()[:4])
+    exe.run(startup)
+    mgr = fluid.io.CheckpointManager(str(tmp_path))
+    mgr.save(main, step=1)
+
+    restore_cp = fluid.CompiledProgram(main).with_pipeline(
+        loss_name=loss.name, mesh_axes=("stage", "model"),
+        mesh_shape={"stage": 2, "model": 2}, places=jax.devices()[:4])
+    assert mgr.restore(exe, restore_cp) == 1
+    w = fluid.global_scope().find_var(name)
+    assert w.sharding.spec == P("model", None)
+    assert w.sharding.mesh.shape["model"] == 2  # re-laid-out, not 4
+
+    before = monitor.counter("state_reshard_replicated_total").value
+    stage_only = fluid.CompiledProgram(main).with_pipeline(
+        loss_name=loss.name, mesh_axes=("stage",),
+        mesh_shape={"stage": 4}, places=jax.devices()[:4])
+    assert mgr.restore(exe, stage_only) == 1
+    w2 = fluid.global_scope().find_var(name)
+    assert w2.sharding.spec == P()
+    assert monitor.counter(
+        "state_reshard_replicated_total").value > before
+    del save_cp
+
+
+# -- stagebalance cut audit --------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.pipeline3d
+def test_stagebalance_reports_per_stage_bytes():
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools"))
+    import stagebalance
+
+    program, feed = stagebalance._build_demo(
+        n_layers=2, n_stages=2, mb_rows=2, seq_len=SEQ, vocab=V)
+    rows = stagebalance.stage_report(program, feed)
+    assert [r["stage"] for r in rows] == [0, 1]
+    assert all(r["param_bytes"] > 0 for r in rows)
+    assert all(r["peak_act_bytes"] > 0 for r in rows)
+    # exactly one boundary, carried by stage 0, per-microbatch sized
+    assert rows[0]["boundary_bytes"] > 0
+    assert rows[1]["boundary_bytes"] == 0
+    # the audited segmentation covers every forward op exactly once
+    from paddle_tpu.fluid.compiler import pipeline_segments
+
+    segs, cuts, ad_idx = pipeline_segments(program,
+                                           program.global_block())
+    assert len(segs) == 2 and len(cuts) == 1
+    assert sum(r["ops"] for r in rows) == sum(len(s) for s in segs)
