@@ -1073,7 +1073,286 @@ def bench_transformer_decode(batch_sizes=(1, 64), src_len=128,
     out["transformer_decode_new_tokens"] = new_tokens
     out["transformer_decode_prompt_len"] = prompt_len
     out["transformer_decode_cache_capacity"] = cache_capacity
+    # the paged/prefix/speculative engine legs (ROADMAP decode metrics)
+    from paddle_tpu.models import transformer as _tf
+    out.update(bench_decode_engine(
+        _tf.Transformer.big, 32000, width=8, src_len=src_len,
+        prompt_len=prompt_len, cache_capacity=cache_capacity,
+        page_tokens=cache_capacity // 8))
     return out
+
+
+def bench_decode_engine(model_fn, vocab, width=8, src_len=128,
+                        prompt_len=64, cache_capacity=1024,
+                        page_tokens=128, pool_frac=0.375, spec_k=4,
+                        spec_new_tokens=12, prefix_joins=6,
+                        hbm_budget_gb=32.0):
+    """The decode ENGINE legs of BENCH_DECODE — the ROADMAP's missing
+    serving metrics:
+
+    * concurrent-streams-per-HBM-budget, paged vs dense, from the
+      utils/liveness.py peak-bytes estimator over one decode dispatch
+      (feeds + state). The dense stream pays width x capacity ring
+      caches whether slots are live or not; the paged pool is sized to
+      ``pool_frac`` of that (the continuous-batching regime: admitted
+      prompts plus growth headroom), so the same budget seats strictly
+      more streams — asserted.
+    * prefix-hit prefill tokens/sec on a shared-prefix workload: every
+      request carries the same (src, prompt), so after the first join
+      the prefill dispatch is skipped and the pages are aliased
+      copy-on-write — the hit must beat the miss, asserted, and the
+      hits' tokens must match the miss's, asserted.
+    * accepted-tokens-per-step for greedy speculative decoding with a
+      full-depth self-draft (the acceptance ceiling: proposals always
+      match), token-identical to the dense baseline and exactly two
+      extra compiles — all asserted."""
+    from paddle_tpu.fluid import dygraph, monitor
+    from paddle_tpu.models import transformer
+    from paddle_tpu.utils.liveness import program_peak_bytes
+
+    out = {}
+    rng = np.random.RandomState(7)
+    B = width
+    with dygraph.guard():
+        model = model_fn()
+        dense = transformer.build_decode_session(
+            model, B, src_len, prompt_len, cache_capacity, end_id=1)
+        n_pages = cache_capacity // page_tokens
+        pool_pages = max(n_pages + 1, int(B * n_pages * pool_frac) + 1)
+        paged = transformer.build_paged_decode_session(
+            model, B, src_len, prompt_len, cache_capacity, end_id=1,
+            page_tokens=page_tokens, pool_pages=pool_pages,
+            prefix_cache_size=8)
+        H = model.n_heads
+        d = model.d_model // H
+        L = dense._L
+
+        # ---- streams per HBM budget (liveness estimator) --------------
+        dense_prog = getattr(dense.decode_program, "_program",
+                             dense.decode_program)
+        dense_feed = dict(zip(dense._decode_feeds, [
+            np.zeros((B, 1), np.int32), np.zeros((B, 1), bool),
+            np.array([1], np.int32),
+            np.full((B,), prompt_len, np.int32),
+        ] + [np.zeros((B, H, src_len, d), np.float32)
+             for _ in range(2 * L)]
+          + [np.zeros((B, H, cache_capacity, d), np.float32)
+             for _ in range(2 * L)]))
+        dense_peak = program_peak_bytes(dense_prog, dense_feed,
+                                       dense.scope,
+                                       dense._decode_fetches)
+        paged_feed = dict(zip(paged._decode_feeds, [
+            np.zeros((B, 1), np.int32), np.zeros((B, 1), bool),
+            np.array([1], np.int32), np.ones((B,), np.int32),
+            np.zeros((B, n_pages), np.int32),
+        ] + [np.zeros((B, H, src_len, d), np.float32)
+             for _ in range(2 * L)]
+          + [np.zeros((pool_pages, H, page_tokens, d), np.float32)
+             for _ in range(2 * L)]))
+        paged_peak = program_peak_bytes(paged._decode_traced, paged_feed,
+                                        paged.scope,
+                                        paged._decode_fetches)
+        budget = hbm_budget_gb * float(1 << 30)
+        streams_dense = B * budget / max(dense_peak, 1)
+        streams_paged = B * budget / max(paged_peak, 1)
+        assert streams_paged > streams_dense, (
+            "paged decode must seat MORE streams per HBM byte: paged "
+            "%.1f vs dense %.1f" % (streams_paged, streams_dense))
+        out["decode_hbm_budget_gb"] = hbm_budget_gb
+        out["decode_peak_bytes_dense"] = int(dense_peak)
+        out["decode_peak_bytes_paged"] = int(paged_peak)
+        out["decode_streams_per_hbm_budget_dense"] = round(streams_dense,
+                                                           1)
+        out["decode_streams_per_hbm_budget_paged"] = round(streams_paged,
+                                                           1)
+        out["decode_paged_pool_pages"] = pool_pages
+        out["decode_page_tokens"] = page_tokens
+
+        # ---- shared-prefix workload ----------------------------------
+        src1 = rng.randint(2, vocab, (src_len,)).astype(np.int64)
+        pr1 = rng.randint(2, vocab, (prompt_len,)).astype(np.int64)
+
+        def run_one(budget_toks=4):
+            t0 = time.perf_counter()
+            slot, done = paged.join(src1, pr1,
+                                    max_new_tokens=budget_toks)
+            t_join = time.perf_counter() - t0
+            if done is not None:          # finished at the prefill
+                return t_join, np.asarray(done[0])
+            toks = None
+            while toks is None:
+                for s_, toks_, _fin in paged.step():
+                    if s_ == slot:
+                        toks = toks_
+            return t_join, np.asarray(toks)
+
+        t_miss, toks_miss = run_one()
+        hit_times, hit_ok = [], True
+        for _ in range(prefix_joins - 1):
+            t_hit, toks_hit = run_one()
+            hit_times.append(t_hit)
+            hit_ok = hit_ok and np.array_equal(toks_hit, toks_miss)
+        t_hit_mean = sum(hit_times) / len(hit_times)
+        assert hit_ok, "prefix-hit tokens diverged from the miss join"
+        assert t_hit_mean < t_miss, (
+            "prefix hit (%.1f ms) did not amortize the prefill "
+            "(%.1f ms)" % (t_hit_mean * 1e3, t_miss * 1e3))
+        out["decode_prefix_miss_join_ms"] = round(t_miss * 1e3, 3)
+        out["decode_prefix_hit_join_ms"] = round(t_hit_mean * 1e3, 3)
+        out["decode_prefix_miss_prefill_tokens_per_sec"] = round(
+            prompt_len / t_miss, 1)
+        out["decode_prefix_hit_prefill_tokens_per_sec"] = round(
+            prompt_len / t_hit_mean, 1)
+        out["decode_prefix_hit_speedup"] = round(t_miss / t_hit_mean, 2)
+
+        # ---- speculative: full-depth draft = acceptance ceiling ------
+        srcB = rng.randint(2, vocab, (B, src_len)).astype(np.int64)
+        prB = rng.randint(2, vocab, (B, prompt_len)).astype(np.int64)
+        plensB = np.full((B,), prompt_len, np.int64)
+        base_toks, _ = dense.generate(srcB, prB, plensB, spec_new_tokens)
+        t0 = time.perf_counter()        # time the WARM baseline pass
+        base_toks2, _ = dense.generate(srcB, prB, plensB,
+                                       spec_new_tokens)
+        t_base = time.perf_counter() - t0
+        assert (base_toks2 == base_toks).all()
+        hist = monitor.get_metric("decode_spec_accepted_tokens")
+        c0, s0 = hist.count, hist.sum
+        m0 = monitor.counter("executor_compile_cache_miss_total").value
+        spec = transformer.build_speculative_session(
+            model, dense, k=spec_k, draft_layers=L)
+        spec_toks, _ = spec.generate(srcB, prB, plensB, spec_new_tokens)
+        m1 = monitor.counter("executor_compile_cache_miss_total").value
+        t0 = time.perf_counter()
+        spec_toks2, _ = spec.generate(srcB, prB, plensB, spec_new_tokens)
+        t_spec = time.perf_counter() - t0
+        m2 = monitor.counter("executor_compile_cache_miss_total").value
+        assert m1 - m0 == 2, (
+            "speculative session cost %d compiles, want 2 (draft + "
+            "verify)" % (m1 - m0))
+        assert m2 == m1, "speculative decode retraced on reuse"
+        assert (spec_toks == base_toks).all() and \
+            (spec_toks2 == base_toks).all(), (
+            "speculative decode diverged from the dense baseline")
+        accepted = (hist.sum - s0) / max(1, hist.count - c0)
+        assert accepted >= 1.5, (
+            "greedy speculative accepted %.2f tokens/step, want >= 1.5"
+            % accepted)
+        out["decode_spec_accepted_tokens_per_step"] = round(accepted, 2)
+        out["decode_spec_k"] = spec_k
+        out["decode_spec_extra_compiles"] = int(m1 - m0)
+        out["decode_spec_tokens_per_sec"] = round(
+            B * spec_new_tokens / max(t_spec, 1e-12), 1)
+        out["decode_spec_baseline_tokens_per_sec"] = round(
+            B * spec_new_tokens / max(t_base, 1e-12), 1)
+    return out
+
+
+def bench_decode_profile(B=4, H=16, d=64, page_tokens=128, n_pages=16,
+                         pool_pages=None, iters=20):
+    """PROFILE_r06 leg (opt-in BENCH_DECODE_PROFILE=1): per-phase
+    timings of the paged decode attention at Pallas-regime geometry
+    (capacity = n_pages * page_tokens >= the fused-kernel threshold).
+
+    Phases, timed separately over jitted closures:
+    * ``index``: pure page-table indexing — jnp.take of the pool rows
+    * ``gather``: index + reshape/transpose to the dense [B, H, C, d]
+      layout (everything the fallback path adds before attention)
+    * ``softmax_v``: masked online attention over the PRE-gathered
+      dense cache (the compute floor)
+    * ``paged_kernel``: the fused Pallas paged kernel — table indexing
+      via scalar prefetch + gather + softmax*V in one pass (interpret
+      mode on CPU; the real kernel on TPU)
+
+    Asserts the profiled path dispatched the Pallas paged kernel
+    (attn_paged_kernel_dispatch_total moved) — the profile must never
+    silently measure the fallback — and that kernel output matches the
+    gather+reference oracle."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.fluid import monitor
+    from paddle_tpu.kernels import attention as A
+
+    C = n_pages * page_tokens
+    P = int(pool_pages) if pool_pages else B * n_pages + 1
+    scale = 1.0 / float(np.sqrt(d))
+    rng = np.random.RandomState(3)
+    k_pool = jnp.asarray(
+        rng.randn(P, H, page_tokens, d).astype(np.float32))
+    v_pool = jnp.asarray(
+        rng.randn(P, H, page_tokens, d).astype(np.float32))
+    q = jnp.asarray(rng.randn(B, H, 1, d).astype(np.float32))
+    perm = rng.permutation(np.arange(1, P))[:B * n_pages]
+    table = jnp.asarray(perm.reshape(B, n_pages).astype(np.int32))
+    lens = jnp.asarray(np.full((B,), C - 7, np.int32))
+
+    def timeit(fn, *args):
+        r = fn(*args)
+        jax.block_until_ready(r)        # compile outside the window
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            r = fn(*args)
+        jax.block_until_ready(r)
+        return (time.perf_counter() - t0) / iters
+
+    index = jax.jit(lambda p, t: jnp.take(p, t.reshape(-1), axis=0))
+    t_index = timeit(index, k_pool, table)
+    gather = jax.jit(A.gather_paged_cache)
+    t_gather = timeit(gather, k_pool, table)
+    kd = gather(k_pool, table)
+    vd = gather(v_pool, table)
+    ref = jax.jit(lambda q_, k_, v_, l_: A._ref_attention_cache(
+        q_, k_, v_, l_, scale))
+    t_attn = timeit(ref, q, kd, vd, lens)
+
+    c0 = monitor.counter("attn_paged_kernel_dispatch_total").value
+    old_force = os.environ.get("PADDLE_TPU_ATTN_FORCE")
+    old_interp = os.environ.get("PADDLE_TPU_PALLAS_INTERPRET")
+    os.environ["PADDLE_TPU_ATTN_FORCE"] = "paged"
+    if jax.devices()[0].platform == "cpu":
+        os.environ["PADDLE_TPU_PALLAS_INTERPRET"] = "1"
+    try:
+        paged = jax.jit(
+            lambda q_, kp, vp, t_, l_: A.paged_attention_cache(
+                q_, kp, vp, t_, l_, scale=scale))
+        # interpret mode emulates the kernel per-grid-cell in python —
+        # seconds per call at real geometry; 2 iters bound the leg's
+        # wall-clock without losing the (already unindicative) number
+        if jax.devices()[0].platform == "cpu":
+            iters, save_iters = min(iters, 2), iters
+        t_paged = timeit(paged, q, k_pool, v_pool, table, lens)
+        if jax.devices()[0].platform == "cpu":
+            iters = save_iters
+        err = float(jnp.max(jnp.abs(
+            paged(q, k_pool, v_pool, table, lens) - ref(q, kd, vd,
+                                                        lens))))
+    finally:
+        for k, v in (("PADDLE_TPU_ATTN_FORCE", old_force),
+                     ("PADDLE_TPU_PALLAS_INTERPRET", old_interp)):
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    c1 = monitor.counter("attn_paged_kernel_dispatch_total").value
+    assert c1 > c0, (
+        "profiled path took the gather-dense fallback, not the Pallas "
+        "paged kernel — check PADDLE_TPU_ATTN_FORCE/capacity")
+    assert err < 1e-4, "paged kernel diverged from oracle by %g" % err
+    interpret = jax.devices()[0].platform == "cpu"
+    return {
+        "decode_profile_geometry": {
+            "batch": B, "heads": H, "d_key": d,
+            "page_tokens": page_tokens, "n_pages": n_pages,
+            "pool_pages": P, "capacity": C,
+        },
+        "decode_profile_interpret_mode": interpret,
+        "decode_profile_index_us": round(t_index * 1e6, 1),
+        "decode_profile_gather_us": round(t_gather * 1e6, 1),
+        "decode_profile_softmax_v_us": round(t_attn * 1e6, 1),
+        "decode_profile_paged_kernel_us": round(t_paged * 1e6, 1),
+        "decode_profile_kernel_max_err": err,
+        "decode_profile_kernel_dispatches": int(c1 - c0),
+    }
 
 
 def bench_serve(n_clients=64, per_client=8, max_batch_size=16,
@@ -1782,6 +2061,31 @@ def monitor_summary():
             monitor.counter("decode_slot_join_total").value,
         "decode_slot_retires_total":
             monitor.counter("decode_slot_retire_total").value,
+        "decode_slot_scatter_dispatches_total":
+            monitor.counter("decode_slot_scatter_dispatch_total").value,
+        # paged decode engine: page pool churn, prefix-cache behavior,
+        # and the Pallas paged-kernel dispatch count (0 on the gather-
+        # dense fallback path)
+        "decode_pages_allocated_total":
+            monitor.counter("decode_pages_allocated_total").value,
+        "decode_pages_freed_total":
+            monitor.counter("decode_pages_freed_total").value,
+        "decode_pages_shared_total":
+            monitor.counter("decode_pages_shared_total").value,
+        "decode_prefix_hits_total":
+            monitor.counter("decode_prefix_hit_total").value,
+        "decode_prefix_misses_total":
+            monitor.counter("decode_prefix_miss_total").value,
+        "attn_paged_kernel_dispatches_total":
+            monitor.counter("attn_paged_kernel_dispatch_total").value,
+        # speculative decoding: mean tokens emitted per target verify
+        # dispatch (1.0 = speculation never helps; k = always accepts)
+        "decode_spec_verify_steps":
+            _hist_count("decode_spec_accepted_tokens"),
+        "decode_spec_accepted_tokens_total":
+            _hist_sum("decode_spec_accepted_tokens"),
+        "decode_spec_accepted_per_step": _hist_mean(
+            "decode_spec_accepted_tokens"),
         # sparse embedding engine: residency/prefetch behavior summed
         # across ALL tables (per-table labeled series stay in
         # dump_prometheus)
@@ -1816,6 +2120,29 @@ def _sum_labeled(name):
     from paddle_tpu.fluid import monitor
 
     return monitor.sum_labeled(name)
+
+
+def _hist_count(name):
+    from paddle_tpu.fluid import monitor
+
+    h = monitor.get_metric(name)
+    return h.count if h is not None else 0
+
+
+def _hist_sum(name):
+    from paddle_tpu.fluid import monitor
+
+    h = monitor.get_metric(name)
+    return round(h.sum, 3) if h is not None else 0.0
+
+
+def _hist_mean(name):
+    from paddle_tpu.fluid import monitor
+
+    h = monitor.get_metric(name)
+    if h is None or not h.count:
+        return 0.0
+    return round(h.sum / h.count, 3)
 
 
 def bench_smoke():
@@ -1890,9 +2217,49 @@ def bench_smoke():
         m1 = monitor.counter("executor_compile_cache_miss_total").value
         toks2, _ = sess.generate(src, prompt, plens, 6)
         m2 = monitor.counter("executor_compile_cache_miss_total").value
+
+        # speculative smoke: a full-depth self-draft over the same
+        # session must cost exactly two extra compiles (draft + verify)
+        # and reproduce the baseline tokens bit-for-bit
+        spec_hist = monitor.get_metric("decode_spec_accepted_tokens")
+        sc0, ss0 = spec_hist.count, spec_hist.sum
+        spec = transformer.build_speculative_session(
+            model, sess, k=3, draft_layers=len(model.dec_layers))
+        spec_toks, _ = spec.generate(src, prompt, plens, 6)
+        m3 = monitor.counter("executor_compile_cache_miss_total").value
+        spec_acc = (spec_hist.sum - ss0) / max(1, spec_hist.count - sc0)
+
+        # paged smoke: the block-pool engine through join/step must
+        # cost exactly two compiles (batch-1 prefill + paged decode)
+        # and emit the dense baseline's tokens per slot
+        paged = transformer.build_paged_decode_session(
+            model, batch_size=2, src_len=6, prompt_len=4,
+            cache_capacity=16, end_id=1, page_tokens=4)
+        paged_done = {}
+        for b in range(2):
+            pslot, pdone = paged.join(src[b], prompt[b],
+                                      prompt_len=int(plens[b]),
+                                      max_new_tokens=6)
+            if pdone is not None:
+                paged_done[pslot] = pdone[0]
+        while paged.active_count:
+            for pslot, ptoks, _pfin in paged.step():
+                paged_done[pslot] = ptoks
+        m4 = monitor.counter("executor_compile_cache_miss_total").value
     assert m1 - m0 == 2, "decode smoke: %d compiles, want 2" % (m1 - m0)
     assert m2 == m1, "decode smoke: repeat generation retraced"
     assert (toks == toks2).all(), "decode smoke: non-deterministic"
+    assert m3 - m2 == 2, (
+        "spec smoke: %d compiles, want 2 (draft + verify)" % (m3 - m2))
+    assert (spec_toks == toks).all(), (
+        "spec smoke: speculative tokens diverged from dense baseline")
+    assert m4 - m3 == 2, (
+        "paged smoke: %d compiles, want 2 (prefill1 + paged decode)"
+        % (m4 - m3))
+    for b in range(2):
+        _pt = np.asarray(paged_done[b])
+        assert np.array_equal(_pt, toks[b][:_pt.size]), (
+            "paged smoke: slot %d tokens diverged from dense" % b)
 
     # tiny embedding loop: DeepFM with its big table host-offloaded at a
     # budget far under the vocabulary — admissions, evictions, and the
@@ -2066,6 +2433,9 @@ def bench_smoke():
         "window_losses": losses,
         "decode_smoke_tokens": int(toks.size),
         "decode_smoke_compile_misses": int(m1 - m0),
+        "decode_spec_smoke_compile_misses": int(m3 - m2),
+        "decode_spec_smoke_accepted_per_step": round(spec_acc, 2),
+        "decode_paged_smoke_compile_misses": int(m4 - m3),
         "embed_smoke_steps": len(embed_losses),
         "embed_smoke_prefetch_hits": embed_hits,
         "embed_smoke_evictions": embed_evictions,
@@ -2108,6 +2478,8 @@ if __name__ == "__main__":
         out.update(bench_pipeline())
     if os.environ.get("BENCH_DECODE") == "1":
         out.update(bench_transformer_decode())
+    if os.environ.get("BENCH_DECODE_PROFILE") == "1":
+        out.update(bench_decode_profile())
     if os.environ.get("BENCH_SERVE") == "1":
         out.update(bench_serve())
     if os.environ.get("BENCH_FLEET") == "1":

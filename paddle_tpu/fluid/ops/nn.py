@@ -759,7 +759,10 @@ def _kv_cache_update(ctx, op):
 def _fused_multihead_attention_cache(ctx, op):
     """Decode-step attention against a KV ring buffer
     (kernels/attention.py attention_with_cache): masked-length fallback
-    or the Pallas decode tier at large capacities. Inference-only."""
+    or the Pallas decode tier at large capacities. Inference-only.
+    ``causal_window`` (default off — old programs deserialize unchanged)
+    is the speculative-verify form: Q rows are the last Q tokens
+    written, each masking the columns written after it."""
     from ...kernels.attention import attention_with_cache
 
     q = ctx.get_input(op, "Q")
@@ -768,4 +771,41 @@ def _fused_multihead_attention_cache(ctx, op):
     cache_len = ctx.get_input(op, "CacheLen")
     scale = op.attr("scale", None)
     ctx.set_output(op, "Out", attention_with_cache(
-        q, k_cache, v_cache, cache_len, scale=scale))
+        q, k_cache, v_cache, cache_len, scale=scale,
+        causal_window=bool(op.attr("causal_window", False))))
+
+
+@register("paged_kv_cache_update")
+def _paged_kv_cache_update(ctx, op):
+    """Block-granular KV cache write (kernels/attention.py): New
+    [B, H, T, d] scatters through PageTable [B, npages] into the shared
+    Pool [P, H, ptok, d] at the slot's logical ring positions; OutLen =
+    CacheLen + T. The paged generalization of ``kv_cache_update`` —
+    writes may cross page and ring boundaries."""
+    from ...kernels.attention import paged_kv_cache_update
+
+    pool = ctx.get_input(op, "Pool")
+    new = ctx.get_input(op, "New")
+    table = ctx.get_input(op, "PageTable")
+    cache_len = ctx.get_input(op, "CacheLen")
+    out, out_len = paged_kv_cache_update(pool, new, table, cache_len)
+    ctx.set_output(op, "Out", out)
+    ctx.set_output(op, "OutLen", out_len)
+
+
+@register("paged_multihead_attention_cache")
+def _paged_multihead_attention_cache(ctx, op):
+    """Decode-step attention against a PAGED KV cache
+    (kernels/attention.py paged_attention_cache): gather-dense fallback
+    or the Pallas paged tier (SMEM page table via scalar prefetch) at
+    large capacities. Inference-only."""
+    from ...kernels.attention import paged_attention_cache
+
+    q = ctx.get_input(op, "Q")
+    k_pool = ctx.get_input(op, "KPool")
+    v_pool = ctx.get_input(op, "VPool")
+    table = ctx.get_input(op, "PageTable")
+    cache_len = ctx.get_input(op, "CacheLen")
+    ctx.set_output(op, "Out", paged_attention_cache(
+        q, k_pool, v_pool, table, cache_len,
+        scale=op.attr("scale", None)))

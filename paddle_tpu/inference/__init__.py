@@ -239,25 +239,43 @@ class GenerativePredictor:
     exactly one prefill compile plus one decode compile, ever."""
 
     def __init__(self, model, batch_size, src_len, prompt_len,
-                 cache_capacity, end_id=1, slot_prefill=False):
+                 cache_capacity, end_id=1, slot_prefill=False,
+                 paged=False, page_tokens=None, pool_pages=None,
+                 prefix_cache_size=0):
         from ..fluid import framework
-        from ..models.transformer import build_decode_session
+        from ..models.transformer import (build_decode_session,
+                                          build_paged_decode_session)
 
+        self._paged = bool(paged)
+        if self._paged:
+            def build():
+                return build_paged_decode_session(
+                    model, batch_size, src_len, prompt_len,
+                    cache_capacity, end_id=end_id,
+                    page_tokens=page_tokens, pool_pages=pool_pages,
+                    prefix_cache_size=prefix_cache_size)
+        else:
+            def build():
+                return build_decode_session(
+                    model, batch_size, src_len, prompt_len,
+                    cache_capacity, end_id=end_id,
+                    slot_prefill=slot_prefill)
         if framework._dygraph_tracer() is not None:
-            self._session = build_decode_session(
-                model, batch_size, src_len, prompt_len, cache_capacity,
-                end_id=end_id, slot_prefill=slot_prefill)
+            self._session = build()
         else:
             with fluid.dygraph.guard():
-                self._session = build_decode_session(
-                    model, batch_size, src_len, prompt_len, cache_capacity,
-                    end_id=end_id, slot_prefill=slot_prefill)
+                self._session = build()
         self._seen_sigs = set()
 
     def open_stream(self):
-        """Continuous-batching stream over this predictor's session
-        (requires ``slot_prefill=True`` at construction) — see
-        ``models.transformer.ContinuousDecodeSession``."""
+        """Continuous-batching stream over this predictor's session —
+        a ``PagedDecodeSession`` when built with ``paged=True`` (shared
+        KV page pool, prefix caching, typed Overloaded admission), else
+        the dense ``ContinuousDecodeSession`` (requires
+        ``slot_prefill=True`` at construction). Both serve the same
+        join/step contract, so ``GenerativeServer`` drives either."""
+        if self._paged:
+            return self._session
         return self._session.open_stream()
 
     def get_input_names(self):
@@ -270,6 +288,11 @@ class GenerativePredictor:
         """feed: {"src": [B, S] int64, "prompt": [B, P] int64,
         "prompt_lens": [B] (optional; defaults to full P)}. Returns
         (tokens [B, max_new_tokens] int64, finished [B] bool)."""
+        if self._paged:
+            raise ValueError(
+                "paged GenerativePredictor serves through open_stream() "
+                "(continuous batching) — batch generate() is the dense "
+                "session's path")
         feed = dict(feed)
         missing = [n for n in ("src", "prompt") if n not in feed]
         if missing:

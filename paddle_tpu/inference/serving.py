@@ -674,8 +674,17 @@ class GenerativeServer:
                 req = waiting.pop(0)
                 src, prompt, plen, budget = req.extra
                 m["wait"].observe(time.perf_counter() - req.t_submit)
-                slot, done = stream.join(src, prompt, prompt_len=plen,
-                                         max_new_tokens=budget)
+                try:
+                    slot, done = stream.join(src, prompt, prompt_len=plen,
+                                             max_new_tokens=budget)
+                except Overloaded as e:
+                    # paged stream: the KV page pool cannot seat this
+                    # prompt — shed THIS request (typed, like the
+                    # breaker/depth sheds at submit) and keep the batch
+                    # alive for everyone already decoding
+                    m["shed"].inc()
+                    req.future._reject(e)
+                    continue
                 if done is not None:    # finished at prefill
                     req.future._resolve(done)
                     m["e2e"].observe(time.perf_counter() - req.t_submit)

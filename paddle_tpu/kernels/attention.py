@@ -49,6 +49,18 @@ buffer [B, H, C, d].
       per-sequence valid length in SMEM. Inference-only, no backward.
   fallback — masked-length one-pass reference (_ref_attention_cache).
 
+Paged decode (``paged_attention_cache``): the KV cache is a SHARED pool
+[P, H, ptok, d] indexed by per-slot page tables [B, npages]
+(PagedAttention layout; ``paged_kv_cache_update`` is the block-granular
+scatter).
+  capacity >= 1024 (or FORCE=paged) — Pallas paged tier
+      (_paged_decode_fwd_kernel): the decode kernel's online softmax
+      with the page table + lengths as SMEM scalar-prefetch operands —
+      each K/V block DMAs straight from the pool row the table names,
+      so no dense [B, H, C, d] gather ever materializes.
+  fallback — gather pages dense (``gather_paged_cache``), then the same
+      masked-length reference: bit-identical to the dense ring path.
+
 Sequence-parallel (``sequence_parallel_attention``): S sharded over a
 mesh axis, selected per call (strategy attr / auto) with FORCE=ring |
 ulysses as the escape hatch.
@@ -98,7 +110,8 @@ def _interpret():
     return os.environ.get("PADDLE_TPU_PALLAS_INTERPRET", "") == "1"
 
 
-_ATTN_FORCE_VALUES = ("flash", "packed", "decode", "ring", "ulysses")
+_ATTN_FORCE_VALUES = ("flash", "packed", "decode", "paged", "ring",
+                      "ulysses")
 
 
 def _attn_force():
@@ -1585,11 +1598,19 @@ def kv_cache_update(cache, new, cache_len):
     return out, lens + jnp.int32(T)
 
 
-def _ref_attention_cache(q, k_cache, v_cache, cache_len, scale):
+def _ref_attention_cache(q, k_cache, v_cache, cache_len, scale,
+                         causal_window=False):
     """Masked-length fallback (and the numerics oracle in tests): fp32
     scores over the FULL capacity, slots at column >= min(cache_len, C)
     masked to -1e30 (not -inf: an exp(-inf - -inf) NaN would poison
-    rows), softmax, PV."""
+    rows), softmax, PV.
+
+    ``causal_window=True`` is the speculative-verify form: the Q rows
+    are ``cache_len`` - Q .. ``cache_len`` - 1 in sequence order (the
+    last Q tokens just written), so row r additionally masks the
+    columns written AFTER it — col < valid - (Q-1-r). Slot index ==
+    sequence position is assumed (no ring wraparound), which the
+    speculative session asserts at build time."""
     B, H, Q, d = q.shape
     C = k_cache.shape[2]
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
@@ -1597,7 +1618,11 @@ def _ref_attention_cache(q, k_cache, v_cache, cache_len, scale):
     valid = jnp.minimum(jnp.reshape(cache_len, (B,)).astype(jnp.int32),
                         jnp.int32(C))
     col = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, C), 3)
-    s = jnp.where(col < valid.reshape(B, 1, 1, 1), s, -1e30)
+    limit = valid.reshape(B, 1, 1, 1)
+    if causal_window:
+        row = jax.lax.broadcasted_iota(jnp.int32, (1, 1, Q, 1), 2)
+        limit = limit - jnp.int32(Q - 1) + row
+    s = jnp.where(col < limit, s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p,
                       v_cache.astype(jnp.float32)).astype(q.dtype)
@@ -1626,11 +1651,14 @@ def _use_decode_kernel(k_cache):
 
 
 def _decode_fwd_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
-                       acc_scr, m_scr, l_scr, *, scale, kb, nk):
+                       acc_scr, m_scr, l_scr, *, scale, kb, nk,
+                       causal_window=False):
     """Grid (B, H, nk), k-block fastest: online softmax over cache
     blocks, same (m, l, acc) VMEM-scratch carry as the flash forward.
     The per-sequence valid length rides whole-array in SMEM; columns at
-    or past it (including ring capacity padding) mask to -1e30."""
+    or past it (including ring capacity padding) mask to -1e30.
+    ``causal_window`` shifts the per-row limit for the speculative
+    verify step (row r of Q sees col < len - (Q-1-r))."""
     from jax.experimental import pallas as pl
 
     b, j = pl.program_id(0), pl.program_id(2)
@@ -1640,7 +1668,11 @@ def _decode_fwd_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
     col = j * kb + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    s = jnp.where(col < len_ref[b], s, -1e30)
+    limit = len_ref[b]
+    if causal_window:
+        row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        limit = limit - (s.shape[0] - 1) + row
+    s = jnp.where(col < limit, s, -1e30)
 
     @pl.when(j == 0)
     def _init():
@@ -1663,7 +1695,8 @@ def _decode_fwd_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0, 0] = (acc_scr[...] / l_scr[...]).astype(o_ref.dtype)
 
 
-def _pallas_attention_decode(q, k_cache, v_cache, cache_len, scale):
+def _pallas_attention_decode(q, k_cache, v_cache, cache_len, scale,
+                             causal_window=False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -1686,7 +1719,8 @@ def _pallas_attention_decode(q, k_cache, v_cache, cache_len, scale):
     kspec = pl.BlockSpec((1, 1, KB, d), lambda b, h, j: (b, h, j, 0))
     f32 = jnp.float32
     return pl.pallas_call(
-        functools.partial(_decode_fwd_kernel, scale=scale, kb=KB, nk=nk),
+        functools.partial(_decode_fwd_kernel, scale=scale, kb=KB, nk=nk,
+                          causal_window=causal_window),
         grid=(B, H, nk),
         in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
                   qspec, kspec, kspec],
@@ -1699,7 +1733,8 @@ def _pallas_attention_decode(q, k_cache, v_cache, cache_len, scale):
     )(lens, q, k_cache, v_cache)
 
 
-def attention_with_cache(q, k_cache, v_cache, cache_len, scale=None):
+def attention_with_cache(q, k_cache, v_cache, cache_len, scale=None,
+                         causal_window=False):
     """Decode-step attention against a KV ring buffer.
 
     q [B, H, Q, d] (Q=1 for incremental decode), k_cache/v_cache
@@ -1708,15 +1743,194 @@ def attention_with_cache(q, k_cache, v_cache, cache_len, scale=None):
     must be >= 1). Only the first min(cache_len, C) slots participate;
     slot order does not matter (softmax is permutation-invariant), so
     ring wraparound needs no unscrambling. Returns [B, H, Q, d] in q's
-    dtype. Inference-only: no backward."""
+    dtype. Inference-only: no backward.
+
+    ``causal_window=True`` (speculative verify, Q > 1): row r of Q is
+    the token at sequence position cache_len - Q + r, so it masks the
+    columns written after it (col < cache_len - (Q-1-r)). Requires
+    slot index == position, i.e. cache_len <= C (no wraparound)."""
     B, H, Q, d = q.shape
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     scale = float(scale)
     if _use_decode_kernel(k_cache):
         return _pallas_attention_decode(q, k_cache, v_cache, cache_len,
-                                        scale)
-    return _ref_attention_cache(q, k_cache, v_cache, cache_len, scale)
+                                        scale,
+                                        causal_window=causal_window)
+    return _ref_attention_cache(q, k_cache, v_cache, cache_len, scale,
+                                causal_window=causal_window)
+
+
+# ---------------------------------------------------------------------------
+# Paged KV: a SHARED block pool [P, H, ptok, d] indexed by per-slot page
+# tables [B, npages] (vLLM's PagedAttention layout). A slot's logical ring
+# position p (= cache_len % capacity, capacity = npages*ptok) lives at
+# pool row table[b, p // ptok], offset p % ptok — so the paged cache is
+# BIT-identical to the dense ring of the same capacity, including
+# wraparound, and HBM is bounded by live tokens (allocated pages), not
+# B x capacity. Page 0 is the never-allocated SCRATCH page: table entries
+# of idle slots and not-yet-allocated regions point at it, so the
+# shape-closed decode program can write every step unconditionally —
+# scratch absorbs the garbage, allocation/COW stay host-side table edits.
+# ---------------------------------------------------------------------------
+
+def paged_kv_cache_update(pool, new, page_table, cache_len):
+    """Write ``new`` [B, H, T, d] through the page table into the shared
+    pool [P, H, ptok, d] and return ``(updated_pool, cache_len + T)``.
+
+    ``page_table`` [B, npages] int32 maps each slot's logical page j to
+    a pool row; token t of ``new`` lands at logical ring position
+    (cache_len + t) % (npages * ptok). Unlike the dense ring's
+    ``kv_cache_update`` a write MAY cross page (and ring) boundaries —
+    each token scatters independently. Rows of different slots must map
+    to disjoint writable pages (the session's free list guarantees it);
+    duplicate scratch-page writes are harmless garbage."""
+    P, H, ptok, d = pool.shape
+    B, _, T, _ = new.shape
+    npages = page_table.shape[1]
+    cap = npages * ptok
+    lens = jnp.reshape(cache_len, (B,)).astype(jnp.int32)
+    pos = jnp.mod(lens[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :],
+                  jnp.int32(cap))                          # [B, T]
+    page = jnp.take_along_axis(page_table.astype(jnp.int32),
+                               pos // jnp.int32(ptok), axis=1)  # [B, T]
+    off = jnp.mod(pos, jnp.int32(ptok))
+    vals = jnp.transpose(new.astype(pool.dtype),
+                         (0, 2, 1, 3)).reshape(B * T, H, d)
+    out = pool.at[page.reshape(-1), :, off.reshape(-1), :].set(vals)
+    return out, lens + jnp.int32(T)
+
+
+def gather_paged_cache(pool, page_table):
+    """Materialize the dense [B, H, capacity, d] view of a paged cache —
+    the fallback attention path and the paged<->dense equivalence oracle
+    in tests. Pure gather: pool rows in table order, pages concatenated
+    along the slot axis."""
+    B = page_table.shape[0]
+    # [B, npages, H, ptok, d] -> [B, H, npages*ptok, d]
+    g = jnp.take(pool, page_table.astype(jnp.int32).reshape(-1), axis=0)
+    g = g.reshape(B, page_table.shape[1], *pool.shape[1:])
+    g = jnp.transpose(g, (0, 2, 1, 3, 4))
+    return g.reshape(B, pool.shape[1], -1, pool.shape[3])
+
+
+def _use_paged_kernel(page_table, ptok):
+    """Same dispatch shape as the dense decode tier: the big-capacity
+    regime (or FORCE=paged), gated on Pallas availability. The kernel
+    additionally needs the page size to tile the lane/sublane rules
+    (interpret mode is exempt, like every other tier)."""
+    if not _supports_pallas():
+        return False
+    if _attn_force() == "paged":
+        return True
+    return page_table.shape[1] * ptok >= _MAX_FUSED_SEQ
+
+
+def _paged_decode_fwd_kernel(tab_ref, len_ref, q_ref, k_ref, v_ref,
+                             o_ref, acc_scr, m_scr, l_scr, *, scale,
+                             ptok, npages):
+    """Grid (B, H, npages), page fastest: the dense decode kernel's
+    online softmax, except each k/v block is DMA'd from whatever pool
+    row the SMEM page table names — the gather never materializes a
+    dense [B, H, C, d] cache. tab_ref/len_ref are the scalar-prefetch
+    operands (PrefetchScalarGridSpec passes them to the kernel AND to
+    every BlockSpec index map)."""
+    from jax.experimental import pallas as pl
+
+    b, j = pl.program_id(0), pl.program_id(2)
+    q = q_ref[0, 0]                               # [Q, d]
+    k = k_ref[0, 0]                               # [ptok, d]
+    v = v_ref[0, 0]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    col = j * ptok + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(col < len_ref[b], s, -1e30)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full(m_scr.shape, -1e30, jnp.float32)
+        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    m_prev = m_scr[...]                           # [Q, 1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)                        # [Q, ptok]
+    corr = jnp.exp(m_prev - m_new)
+    l_scr[...] = l_scr[...] * corr + jnp.sum(p, axis=-1, keepdims=True)
+    acc_scr[...] = acc_scr[...] * corr + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_scr[...] = m_new
+
+    @pl.when(j == npages - 1)
+    def _emit():
+        o_ref[0, 0] = (acc_scr[...] / l_scr[...]).astype(o_ref.dtype)
+
+
+def _pallas_attention_paged(q, k_pool, v_pool, page_table, cache_len,
+                            scale):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, Q, d = q.shape
+    P, _, ptok, _ = k_pool.shape
+    npages = page_table.shape[1]
+    cap = npages * ptok
+    table = page_table.astype(jnp.int32)
+    lens = jnp.minimum(jnp.reshape(cache_len, (B,)).astype(jnp.int32),
+                       jnp.int32(cap))
+    # index maps receive the scalar-prefetch refs as trailing args: the
+    # k/v block for grid cell (b, h, j) is pool row table[b, j] — the
+    # page-table indirection happens in the DMA schedule, not the graph
+    qspec = pl.BlockSpec((1, 1, Q, d), lambda b, h, j, tab, ln:
+                         (b, h, 0, 0))
+    kspec = pl.BlockSpec((1, 1, ptok, d), lambda b, h, j, tab, ln:
+                         (tab[b, j], h, 0, 0))
+    f32 = jnp.float32
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, H, npages),
+        in_specs=[qspec, kspec, kspec],
+        out_specs=qspec,
+        scratch_shapes=[pltpu.VMEM((Q, d), f32),
+                        pltpu.VMEM((Q, 1), f32),
+                        pltpu.VMEM((Q, 1), f32)])
+    return pl.pallas_call(
+        functools.partial(_paged_decode_fwd_kernel, scale=scale,
+                          ptok=ptok, npages=npages),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=_interpret(),
+    )(table, lens, q, k_pool, v_pool)
+
+
+def paged_attention_cache(q, k_pool, v_pool, page_table, cache_len,
+                          scale=None):
+    """Decode-step attention against a PAGED KV cache.
+
+    q [B, H, Q, d] (Q=1), pools [P, H, ptok, d], page_table [B, npages]
+    int32, cache_len [B] int32 (post-update). Valid slots are the first
+    min(cache_len, npages*ptok) logical positions in page-table order;
+    masking and numerics match the dense ``attention_with_cache`` of
+    the gathered cache bit-for-bit (the token-identity contract the
+    paged sessions rely on). Inference-only: no backward."""
+    B, H, Q, d = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    scale = float(scale)
+    ptok = k_pool.shape[2]
+    if _use_paged_kernel(page_table, ptok):
+        from ..fluid import monitor as _monitor
+
+        _monitor.counter(
+            "attn_paged_kernel_dispatch_total",
+            "paged-attention Pallas kernel dispatches (trace-time: one "
+            "per traced decode program, not per step)").inc()
+        return _pallas_attention_paged(q, k_pool, v_pool, page_table,
+                                       cache_len, scale)
+    dense_k = gather_paged_cache(k_pool, page_table)
+    dense_v = gather_paged_cache(v_pool, page_table)
+    return _ref_attention_cache(q, dense_k, dense_v, cache_len, scale)
 
 
 # ---------------------------------------------------------------------------
